@@ -1,0 +1,67 @@
+"""Headline benchmark: ResNet-18 CIFAR-10 train-step throughput on TPU.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Baseline: the reference (`sheaconlon/serverless_learn`) publishes no numbers
+(README is one line; BASELINE.md). Its workers are CPU processes whose
+training is *simulated* (`src/worker.cc:221-231`), so the honest denominator
+for BASELINE.json's ">=10x the repo's CPU-worker samples/sec" target is a real
+CPU worker running the same ResNet-18 train step. Measured in this container
+(JAX CPU backend, batch 128, single device, steady state): 12.09 samples/sec.
+"""
+
+import json
+import sys
+import time
+
+CPU_WORKER_BASELINE_SPS = 12.09  # ResNet-18 CIFAR b128, JAX CPU, this image
+
+BATCH = 256
+WARMUP = 3
+STEPS = 20
+
+
+def main():
+    import jax
+
+    from serverless_learn_tpu.config import (
+        DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig, TrainConfig)
+    from serverless_learn_tpu.data.datasets import SyntheticSource
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    n_dev = len(jax.devices())
+    cfg = ExperimentConfig(
+        model="resnet18_cifar",
+        mesh=MeshConfig(dp=n_dev),
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.1, momentum=0.9),
+        train=TrainConfig(batch_size=BATCH * n_dev),
+        data=DataConfig(),
+    )
+    trainer = build_trainer(cfg)
+    state = trainer.init()
+    src = iter(SyntheticSource(trainer.bundle.make_batch, cfg.data,
+                               cfg.train.batch_size, seed=0))
+    batch = trainer.shard_batch(next(src))
+    for _ in range(WARMUP):
+        state, metrics = trainer.step(state, batch)
+    # device_get (not block_until_ready): the axon remote platform has been
+    # observed to return from block_until_ready before execution finishes;
+    # fetching the scalar is a reliable sync point.
+    float(jax.device_get(metrics["loss"]))
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, metrics = trainer.step(state, batch)
+    float(jax.device_get(metrics["loss"]))
+    dt = time.perf_counter() - t0
+    sps = cfg.train.batch_size * STEPS / dt
+    sps_chip = sps / n_dev
+    print(json.dumps({
+        "metric": "resnet18_cifar_train_samples_per_sec_per_chip",
+        "value": round(sps_chip, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps_chip / CPU_WORKER_BASELINE_SPS, 2),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,26 @@
+"""serverless_learn_tpu — a TPU-native framework with the capabilities of
+``sheaconlon/serverless_learn``.
+
+The reference (see ``SURVEY.md``) is a C++ gRPC prototype of decentralized
+"serverless" learning: elastic worker membership (reference
+``src/master.cc:79-91``), heartbeat failure detection (``src/master.cc:240-266``),
+peer-list dissemination (``src/master.cc:183-188``), push-based data
+distribution (``src/file_server.cc:60-87``) and gossip model synchronization
+(``src/worker.cc:194-219``).
+
+This framework keeps that capability contract but is designed TPU-first:
+
+* compute is real JAX/XLA (replacing the reference's simulated trainer at
+  ``src/worker.cc:221-231``),
+* model synchronization is XLA collectives over ICI emitted by ``jit`` /
+  ``shard_map`` over a ``jax.sharding.Mesh`` (replacing gossip-over-gRPC —
+  zero gRPC bytes on the gradient path),
+* the control plane (membership / heartbeats / epochs) and the data plane
+  (shard + checkpoint streaming) are native C++ daemons under ``native/``,
+  the idiomatic successors of the reference's ``master.cc`` and
+  ``file_server.cc``.
+"""
+
+from serverless_learn_tpu.version import __version__
+
+__all__ = ["__version__"]

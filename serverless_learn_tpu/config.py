@@ -1,0 +1,148 @@
+"""Typed configuration layer.
+
+Successor of the reference's config "system" — four ``#define``s in
+``src/serverless_learn.h:5-12`` plus scattered per-binary constants
+(``src/master.cc:43,46,60``, ``src/file_server.cc:40,46``). Changing any
+interval there required recompiling; here everything is a dataclass that can
+be constructed programmatically, loaded from JSON, or overridden from CLI
+flags.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical device-mesh shape.
+
+    Axes follow the canonical TPU-parallelism decomposition:
+
+    * ``dp``  — data parallelism (gradient ``psum`` over ICI; the TPU-native
+      successor of the reference's gossip exchange, ``src/worker.cc:194-219``).
+    * ``fsdp`` — data parallelism with parameter/optimizer sharding (ZeRO-3
+      style; params are all-gathered per layer, grads reduce-scattered).
+    * ``tp``  — tensor (model) parallelism over attention heads / MLP hidden.
+    * ``sp``  — sequence/context parallelism (ring attention over an ICI ring).
+    * ``pp``  — pipeline parallelism (stage-sharded, microbatched).
+
+    Any axis of size 1 is inert; total size must equal the device count used.
+    """
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+
+    AXIS_NAMES = ("dp", "fsdp", "tp", "sp", "pp")
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.dp, self.fsdp, self.tp, self.sp, self.pp)
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def validate(self, n_devices: int) -> None:
+        if self.size != n_devices:
+            raise ValueError(
+                f"Mesh shape {dict(zip(self.AXIS_NAMES, self.shape))} has size "
+                f"{self.size} but {n_devices} devices are available."
+            )
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    name: str = "adamw"  # adamw | sgd | adafactor
+    learning_rate: float = 1e-3
+    weight_decay: float = 0.0
+    b1: float = 0.9
+    b2: float = 0.999
+    momentum: float = 0.9  # sgd only
+    warmup_steps: int = 0
+    decay_steps: int = 0  # 0 => constant after warmup
+    grad_clip_norm: float = 0.0  # 0 => no clipping
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    batch_size: int = 128  # global batch size
+    num_steps: int = 100
+    seed: int = 0
+    dtype: str = "bfloat16"  # compute dtype
+    param_dtype: str = "float32"
+    log_every: int = 10
+    checkpoint_every: int = 0  # 0 => disabled
+    remat: bool = False  # jax.checkpoint the model apply
+    donate_state: bool = True
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    dataset: str = "synthetic_mnist"
+    shard_server_addr: Optional[str] = None  # None => generate locally
+    prefetch: int = 2
+    seq_len: int = 128  # LM/MLM datasets
+
+
+@dataclass(frozen=True)
+class ControlConfig:
+    """Control-plane endpoints & intervals.
+
+    Successor of ``src/serverless_learn.h:4-12`` (MASTER_ADDR,
+    FILE_SERVER_ADDR, GOSSIP_INTERVAL, SIMULATED_TRAIN_INTERVAL) and
+    ``src/master.cc:43,46`` (push/checkup intervals).
+    """
+
+    coordinator_addr: str = "localhost:50052"
+    shard_server_addr: str = "localhost:50053"
+    heartbeat_interval_ms: int = 1000
+    lease_ttl_ms: int = 5000
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    model: str = "mlp_mnist"
+    model_overrides: dict = field(default_factory=dict)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    control: ControlConfig = field(default_factory=ControlConfig)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ExperimentConfig":
+        raw = json.loads(text)
+        return cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "ExperimentConfig":
+        def build(tp, val):
+            if val is None:
+                return tp()
+            return tp(**val)
+
+        return cls(
+            model=raw.get("model", "mlp_mnist"),
+            model_overrides=raw.get("model_overrides", {}) or {},
+            mesh=build(MeshConfig, raw.get("mesh")),
+            optimizer=build(OptimizerConfig, raw.get("optimizer")),
+            train=build(TrainConfig, raw.get("train")),
+            data=build(DataConfig, raw.get("data")),
+            control=build(ControlConfig, raw.get("control")),
+        )
+
+    def override(self, **kwargs: Any) -> "ExperimentConfig":
+        return dataclasses.replace(self, **kwargs)

@@ -1,0 +1,76 @@
+"""Host-side batch sources.
+
+Two sources, one interface (an iterator of host batches):
+
+* ``SyntheticSource`` — deterministic RNG batches from the model bundle's
+  ``make_batch``; stands in for MNIST/CIFAR/ImageNet/corpus data the same way
+  the reference's file server synthesizes a random 100 MB "dataset"
+  (``src/file_server.cc:150-156``) — but typed and shaped, not raw bytes.
+* ``ShardStreamSource`` (``data/shard_client.py``) — pulls shard bytes from
+  the native shard server (successor of ``src/file_server.cc``) and decodes
+  them into batches.
+
+``Prefetcher`` overlaps host batch production and device transfer with the
+device step — the double-buffering the reference lacks (its push loop is
+fully synchronous, ``src/master.cc:231-234``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+
+class SyntheticSource:
+    def __init__(self, make_batch: Callable, data_config, batch_size: int,
+                 seed: int = 0):
+        self.make_batch = make_batch
+        self.data_config = data_config
+        self.batch_size = batch_size
+        self.rng = np.random.default_rng(seed)
+
+    def __iter__(self) -> Iterator:
+        while True:
+            yield self.make_batch(self.rng, self.data_config, self.batch_size)
+
+
+class Prefetcher:
+    """Background thread that maps ``place_fn`` (host→device put) over an
+    iterator and keeps ``depth`` batches in flight."""
+
+    def __init__(self, source, place_fn: Callable, depth: int = 2):
+        self.q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
+        self._stop = threading.Event()
+
+        def run():
+            for batch in source:
+                if self._stop.is_set():
+                    return
+                placed = place_fn(batch)
+                while not self._stop.is_set():
+                    try:
+                        self.q.put(placed, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            try:
+                return self.q.get(timeout=1.0)
+            except queue.Empty:
+                if not self.thread.is_alive():
+                    raise StopIteration
+                continue
+
+    def close(self):
+        self._stop.set()

@@ -1,0 +1,3 @@
+from serverless_learn_tpu.models.registry import get_model, register_model, list_models
+
+__all__ = ["get_model", "register_model", "list_models"]

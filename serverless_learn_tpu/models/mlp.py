@@ -1,0 +1,64 @@
+"""MNIST MLP — first rung of the config ladder (BASELINE.md).
+
+Replaces the reference's simulated trainer (``src/worker.cc:221-231``:
+``model_state[i] += 1`` every 2 s) with a real forward/backward network.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from serverless_learn_tpu.models.registry import ModelBundle, register_model
+from serverless_learn_tpu.ops.losses import softmax_cross_entropy
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (512, 512)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, feat in enumerate(self.features):
+            x = nn.Dense(feat, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name=f"dense_{i}")(x)
+            x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=self.param_dtype, name="head")(x)
+
+
+@register_model("mlp_mnist")
+def make_mlp_mnist(features=(512, 512), num_classes=10,
+                   dtype=jnp.bfloat16, param_dtype=jnp.float32,
+                   image_shape=(28, 28, 1)):
+    module = MLP(features=tuple(features), num_classes=num_classes,
+                 dtype=dtype, param_dtype=param_dtype)
+
+    def loss_fn(params, batch, rngs=None, model_state=None):
+        logits = module.apply({"params": params}, batch["image"])
+        loss, metrics = softmax_cross_entropy(logits, batch["label"])
+        return loss, {"metrics": metrics, "model_state": {}}
+
+    def input_spec(data_config, batch_size):
+        return {
+            "image": jax.ShapeDtypeStruct((batch_size, *image_shape), jnp.float32),
+            "label": jax.ShapeDtypeStruct((batch_size,), jnp.int32),
+        }
+
+    def make_batch(rng: np.random.Generator, data_config, batch_size):
+        return {
+            "image": rng.standard_normal(
+                (batch_size, *image_shape), dtype=np.float32),
+            "label": rng.integers(
+                0, num_classes, (batch_size,)).astype(np.int32),
+        }
+
+    return ModelBundle(module=module, loss_fn=loss_fn, input_spec=input_spec,
+                       make_batch=make_batch, task="classification")

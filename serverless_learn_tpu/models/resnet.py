@@ -1,0 +1,150 @@
+"""ResNet family (CIFAR-10 ResNet-18, ImageNet ResNet-50) — rungs 2-3 of the
+config ladder (BASELINE.md: "CIFAR-10 ResNet-18, 4 workers data-parallel",
+"ImageNet ResNet-50, v4-32 data-parallel").
+
+TPU notes: NHWC layout (XLA's native conv layout on TPU), bf16 activations,
+fp32 BatchNorm statistics. Under ``jit`` over a sharded batch the BN
+reductions are *global-batch* reductions — XLA inserts the cross-replica
+psum on ICI automatically, i.e. synchronized BatchNorm falls out for free
+(the reference has no equivalent; its "sync" is gossip on a flat vector,
+``src/worker.cc:194-219``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from serverless_learn_tpu.models.registry import ModelBundle, register_model
+from serverless_learn_tpu.ops.losses import softmax_cross_entropy
+
+ModuleDef = Any
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 10
+    num_filters: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+    small_images: bool = True  # CIFAR stem (3x3/1) vs ImageNet stem (7x7/2+pool)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=self.param_dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=self.param_dtype)
+        x = x.astype(self.dtype)
+        if self.small_images:
+            x = conv(self.num_filters, (3, 3), name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = norm(name="norm_init")(x)
+        x = nn.relu(x)
+        if not self.small_images:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(self.num_filters * 2 ** i, conv=conv,
+                                   norm=norm, strides=strides)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="head")(x)
+        return x
+
+
+def _bundle(module, num_classes, image_shape):
+    def loss_fn(params, batch, rngs=None, model_state=None):
+        variables = {"params": params, **(model_state or {})}
+        logits, updates = module.apply(
+            variables, batch["image"], train=True, mutable=["batch_stats"])
+        loss, metrics = softmax_cross_entropy(logits, batch["label"])
+        return loss, {"metrics": metrics, "model_state": dict(updates)}
+
+    def input_spec(data_config, batch_size):
+        return {
+            "image": jax.ShapeDtypeStruct((batch_size, *image_shape), jnp.float32),
+            "label": jax.ShapeDtypeStruct((batch_size,), jnp.int32),
+        }
+
+    def make_batch(rng: np.random.Generator, data_config, batch_size):
+        return {
+            "image": rng.standard_normal(
+                (batch_size, *image_shape), dtype=np.float32),
+            "label": rng.integers(0, num_classes, (batch_size,)).astype(np.int32),
+        }
+
+    return ModelBundle(module=module, loss_fn=loss_fn, input_spec=input_spec,
+                       make_batch=make_batch, task="classification")
+
+
+@register_model("resnet18_cifar")
+def make_resnet18_cifar(num_classes=10, dtype=jnp.bfloat16,
+                        param_dtype=jnp.float32, image_shape=(32, 32, 3)):
+    module = ResNet(stage_sizes=(2, 2, 2, 2), block_cls=ResNetBlock,
+                    num_classes=num_classes, dtype=dtype,
+                    param_dtype=param_dtype, small_images=True)
+    return _bundle(module, num_classes, image_shape)
+
+
+@register_model("resnet50_imagenet")
+def make_resnet50_imagenet(num_classes=1000, dtype=jnp.bfloat16,
+                           param_dtype=jnp.float32, image_shape=(224, 224, 3)):
+    module = ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock,
+                    num_classes=num_classes, dtype=dtype,
+                    param_dtype=param_dtype, small_images=False)
+    return _bundle(module, num_classes, image_shape)

@@ -1,0 +1,187 @@
+"""Shared transformer backbone for the BERT and Llama model families.
+
+One configurable module covers both ends of BASELINE.md's ladder:
+
+* BERT-base MLM — bidirectional, learned positions, LayerNorm, GeLU MLP.
+* Llama-style LM — causal, RoPE, RMSNorm, SwiGLU, grouped-query attention,
+  optional LoRA adapters on the projections (the "Llama-3-8B LoRA" stretch).
+
+Parameter names (``q_proj``, ``wi``, ``embedder`` …) are load-bearing: the
+sharding rule table in ``parallel/sharding.py`` keys on them, so the same
+module runs DP / FSDP / TP / SP purely by mesh shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from serverless_learn_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None  # None => MHA
+    d_ff: int = 2048
+    max_seq_len: int = 512
+    causal: bool = True
+    use_rope: bool = True
+    rope_theta: float = 10000.0
+    norm: str = "rms"  # "rms" | "layer"
+    activation: str = "swiglu"  # "swiglu" | "gelu"
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    tie_embeddings: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "xla"  # "xla" | "flash" | "ring"
+    sp_axis: Optional[str] = None  # mesh axis for ring attention
+    remat: bool = False
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def rope_angles(positions: jax.Array, head_dim: int, theta: float):
+    """positions [B, T] -> (sin, cos) each [B, T, head_dim/2]."""
+    freqs = 1.0 / theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    ang = positions[..., None].astype(jnp.float32) * freqs
+    return jnp.sin(ang), jnp.cos(ang)
+
+
+def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
+    """x [B, T, H, D]; rotate pairs (x[2i], x[2i+1])."""
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    s, c = sin[:, :, None, :], cos[:, :, None, :]
+    r1 = x1 * c - x2 * s
+    r2 = x2 * c + x1 * s
+    return jnp.stack([r1, r2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+class LoRAAdapter(nn.Module):
+    """Low-rank delta added to a frozen projection's output: x @ A @ B * s."""
+
+    rank: int
+    alpha: float
+    out_features: tuple
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        a = nn.DenseGeneral(self.rank, use_bias=False, name="lora_a",
+                            dtype=self.dtype, param_dtype=self.param_dtype,
+                            kernel_init=nn.initializers.normal(0.02))(x)
+        b = nn.DenseGeneral(self.out_features, use_bias=False, name="lora_b",
+                            dtype=self.dtype, param_dtype=self.param_dtype,
+                            kernel_init=nn.initializers.zeros)(a)
+        return b * (self.alpha / self.rank)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, *, mask=None, positions=None):
+        cfg = self.cfg
+        H, K, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, use_bias=False, name=name, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype)
+        q = dense((H, D), "q_proj")(x)
+        k = dense((K, D), "k_proj")(x)
+        v = dense((K, D), "v_proj")(x)
+        if cfg.lora_rank > 0:
+            q = q + LoRAAdapter(cfg.lora_rank, cfg.lora_alpha, (H, D),
+                                cfg.dtype, cfg.param_dtype, name="q_lora")(x)
+            v = v + LoRAAdapter(cfg.lora_rank, cfg.lora_alpha, (K, D),
+                                cfg.dtype, cfg.param_dtype, name="v_lora")(x)
+        if cfg.use_rope:
+            if positions is None:
+                positions = jnp.arange(x.shape[1])[None, :]
+            sin, cos = rope_angles(positions, D, cfg.rope_theta)
+            q = apply_rope(q, sin, cos)
+            k = apply_rope(k, sin, cos)
+        out = dot_product_attention(
+            q, k, v, causal=cfg.causal, mask=mask,
+            impl=cfg.attention_impl, axis_name=cfg.sp_axis)
+        return nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=False,
+                               name="o_proj", dtype=cfg.dtype,
+                               param_dtype=cfg.param_dtype)(out)
+
+
+class MlpBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, use_bias=False, name=name, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype)
+        if cfg.activation == "swiglu":
+            gate = nn.silu(dense(cfg.d_ff, "gate_proj")(x))
+            up = dense(cfg.d_ff, "up_proj")(x)
+            return dense(cfg.d_model, "down_proj")(gate * up)
+        h = nn.gelu(dense(cfg.d_ff, "wi")(x))
+        return dense(cfg.d_model, "wo")(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, *, mask=None, positions=None):
+        cfg = self.cfg
+        norm = (nn.RMSNorm if cfg.norm == "rms" else nn.LayerNorm)
+        mk_norm = lambda name: norm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                    name=name)
+        x = x + Attention(cfg, name="attn")(
+            mk_norm("norm_attn")(x), mask=mask, positions=positions)
+        x = x + MlpBlock(cfg, name="mlp")(mk_norm("norm_mlp")(x))
+        return x
+
+
+class Transformer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, mask=None, positions=None):
+        """tokens [B, T] int32 -> logits [B, T, vocab]."""
+        cfg = self.cfg
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model, name="embedder",
+                         dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        x = embed(tokens)
+        if not cfg.use_rope:
+            pos = positions if positions is not None else (
+                jnp.arange(tokens.shape[1])[None, :])
+            pos_emb = nn.Embed(cfg.max_seq_len, cfg.d_model, name="pos_embedder",
+                               dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+            x = x + pos_emb(pos)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=())
+        for i in range(cfg.n_layers):
+            x = block(cfg, name=f"layer_{i}")(x, mask=mask, positions=positions)
+        norm = (nn.RMSNorm if cfg.norm == "rms" else nn.LayerNorm)
+        x = norm(dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="norm_f")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x.astype(cfg.param_dtype))
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
+                              dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
+        return logits

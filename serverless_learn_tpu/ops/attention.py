@@ -1,0 +1,90 @@
+"""Attention ops.
+
+Single entry point ``dot_product_attention`` that dispatches between:
+
+* ``xla``  — plain einsum attention; XLA fuses softmax into the matmuls well
+  on TPU for moderate sequence lengths.
+* ``flash`` — Pallas blocked flash-attention kernel (``ops/pallas``), for long
+  sequences where the [T, T] score matrix would blow HBM bandwidth.
+* ``ring`` — sequence-parallel ring attention over the mesh's ``sp`` axis
+  (``parallel/ring_attention.py``): K/V blocks rotate around an ICI ring via
+  ``ppermute`` while each shard keeps running softmax statistics.
+
+The reference has no attention at all (its model is a flat double vector,
+``src/protos/serverless_learn.proto:81-83``); this module exists for the
+BERT/Llama rungs of BASELINE.md's config ladder.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _causal_mask(q_len: int, kv_len: int, dtype) -> jax.Array:
+    # q positions are the last q_len of kv_len (supports decode later).
+    q_pos = jnp.arange(q_len)[:, None] + (kv_len - q_len)
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return (kv_pos <= q_pos).astype(dtype)
+
+
+def xla_attention(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,  # [B, S, K, D]  (K heads; K == H or H % K == 0 for GQA)
+    v: jax.Array,  # [B, S, K, D]
+    *,
+    causal: bool = False,
+    mask: Optional[jax.Array] = None,  # [B, 1, T, S] or broadcastable, 1=keep
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    B, T, H, D = q.shape
+    S, K = k.shape[1], k.shape[2]
+    scale = softmax_scale if softmax_scale is not None else D ** -0.5
+    if K != H:
+        group = H // K
+        q = q.reshape(B, T, K, group, D)
+        scores = jnp.einsum("btkgd,bskd->bkgts", q, k) * scale
+        scores = scores.reshape(B, K * group, T, S)
+    else:
+        scores = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+    scores = scores.astype(jnp.float32)
+    neg = jnp.finfo(jnp.float32).min
+    if causal:
+        cm = _causal_mask(T, S, jnp.bool_)
+        scores = jnp.where(cm[None, None], scores, neg)
+    if mask is not None:
+        scores = jnp.where(mask.astype(jnp.bool_), scores, neg)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if K != H:
+        group = H // K
+        probs4 = probs.reshape(B, K, group, T, S)
+        out = jnp.einsum("bkgts,bskd->btkgd", probs4, v)
+        return out.reshape(B, T, H, D)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: Optional[jax.Array] = None,
+    impl: str = "xla",
+    axis_name: Optional[str] = None,  # sp axis for ring attention
+) -> jax.Array:
+    if impl == "xla":
+        return xla_attention(q, k, v, causal=causal, mask=mask)
+    if impl == "flash":
+        from serverless_learn_tpu.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, mask=mask)
+    if impl == "ring":
+        from serverless_learn_tpu.parallel.ring_attention import ring_attention
+
+        if axis_name is None:
+            raise ValueError("ring attention needs axis_name (the sp mesh axis)")
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+    raise ValueError(f"unknown attention impl {impl!r}")

@@ -1,0 +1,40 @@
+"""Loss functions shared across model families.
+
+All losses compute in float32 regardless of activation dtype (bf16 logits are
+upcast) — the standard TPU mixed-precision recipe: bf16 on the MXU, fp32 for
+softmax/reductions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array):
+    """Classification loss. logits [B, C] (any float dtype), labels [B] int."""
+    logits = logits.astype(jnp.float32)
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    acc = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+    return loss.mean(), {"accuracy": acc.mean()}
+
+
+def masked_lm_loss(logits: jax.Array, labels: jax.Array, mask: jax.Array):
+    """MLM loss. logits [B, T, V], labels [B, T], mask [B, T] (1 where masked)."""
+    logits = logits.astype(jnp.float32)
+    raw = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    mask = mask.astype(jnp.float32)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (raw * mask).sum() / denom
+    acc = (((jnp.argmax(logits, -1) == labels) * mask).sum()) / denom
+    return loss, {"accuracy": acc}
+
+
+def causal_lm_loss(logits: jax.Array, tokens: jax.Array):
+    """Next-token loss. logits [B, T, V], tokens [B, T]; predicts tokens[:, 1:]."""
+    logits = logits[:, :-1].astype(jnp.float32)
+    targets = tokens[:, 1:]
+    raw = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    loss = raw.mean()
+    return loss, {"perplexity": jnp.exp(loss)}

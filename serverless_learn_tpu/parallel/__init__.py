@@ -1,0 +1,19 @@
+from serverless_learn_tpu.parallel.mesh import (
+    make_mesh,
+    batch_sharding,
+    replicated,
+)
+from serverless_learn_tpu.parallel.sharding import (
+    ShardingRules,
+    shardings_for_tree,
+    DEFAULT_RULES,
+)
+
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "replicated",
+    "ShardingRules",
+    "shardings_for_tree",
+    "DEFAULT_RULES",
+]

@@ -1,0 +1,65 @@
+"""Device-mesh construction.
+
+The reference's "cluster" is a flat list of gRPC addresses held by the master
+(``src/master.cc:63-66``) with random pairwise gossip as the only topology.
+On TPU the cluster *is* the mesh: a ``jax.sharding.Mesh`` over the slice's
+devices, with named axes that parallelism strategies bind to. XLA lowers the
+collectives onto ICI links; no framework networking code exists on the hot
+path (the successor of SURVEY.md §2.9's "communication backend" row).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from serverless_learn_tpu.config import MeshConfig
+
+
+def make_mesh(
+    config: MeshConfig,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh with axes (dp, fsdp, tp, sp, pp) of the configured sizes.
+
+    Axis order puts ``dp`` outermost and ``pp`` innermost; on real hardware
+    `jax.devices()` order follows the physical torus so that the innermost
+    axes (tp/sp) land on nearest-neighbor ICI links, which is what ring
+    attention and tensor-parallel all-reduces want.
+    """
+    if devices is None:
+        devices = jax.devices()
+    config.validate(len(devices))
+    dev_array = np.asarray(devices).reshape(config.shape)
+    return Mesh(dev_array, MeshConfig.AXIS_NAMES)
+
+
+def data_axes(mesh: Mesh) -> tuple:
+    """Mesh axes the global batch is sharded over (dp and fsdp both consume
+    batch; sp additionally shards the sequence dimension, handled by callers)."""
+    return ("dp", "fsdp")
+
+
+def batch_sharding(mesh: Mesh, *, sp_seq: bool = False) -> NamedSharding:
+    """Sharding for a [batch, ...] array: batch split over dp+fsdp.
+
+    With ``sp_seq=True`` the second dimension (sequence) is additionally split
+    over the sp axis — used by sequence-parallel transformer inputs.
+    """
+    if sp_seq:
+        return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+    return NamedSharding(mesh, P(("dp", "fsdp")))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+    n = mesh.shape["dp"] * mesh.shape["fsdp"]
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by dp*fsdp={n}")
+    return global_batch // n

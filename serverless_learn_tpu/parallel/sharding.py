@@ -1,0 +1,126 @@
+"""Parameter-sharding rules.
+
+The reference's model is a single anonymous ``repeated double`` on the wire
+(``src/protos/serverless_learn.proto:81-83``) — no shapes, no names, fully
+replicated on every node. Here parameters are pytrees with named paths, and a
+small rule table maps path patterns to ``PartitionSpec``s so the same model
+code runs pure-DP (everything replicated), FSDP (params sharded over fsdp),
+or TP (heads/hidden sharded over tp) just by changing the mesh shape.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+@dataclass
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) rules; first match wins.
+
+    Specs may name axes that don't exist or have size 1 in the current mesh —
+    those entries are dropped at resolution time, so one rule table serves
+    every mesh shape.
+    """
+
+    rules: Sequence[Tuple[str, P]] = field(default_factory=list)
+    default: P = P()
+
+    def spec_for(self, path: str, ndim: int, mesh: Mesh) -> P:
+        for pat, spec in self.rules:
+            if re.search(pat, path):
+                return _prune_spec(spec, ndim, mesh)
+        return _prune_spec(self.default, ndim, mesh)
+
+
+def _prune_spec(spec: P, ndim: int, mesh: Mesh) -> P:
+    """Drop axes absent from the mesh or of size 1; trim/pad to ndim."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        kept = tuple(n for n in names if mesh.shape.get(n, 1) > 1)
+        if not kept:
+            out.append(None)
+        elif len(kept) == 1:
+            out.append(kept[0])
+        else:
+            out.append(kept)
+    out = out[:ndim]
+    while len(out) < ndim:
+        out.append(None)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+# Rules shared by the built-in model families. Conventions:
+#  - transformer attention projections:  .../{q,k,v}_proj/kernel  [d_model, heads, head_dim]
+#    or 2-D [d_model, d_inner]; heads shard over tp.
+#  - MLP: wi/kernel [d_model, d_ff] shards d_ff over tp; wo/kernel [d_ff, d_model]
+#    shards d_ff over tp.
+#  - embeddings shard vocab over tp.
+#  - everything additionally shards dim 0 over fsdp (ZeRO-3) when fsdp > 1.
+DEFAULT_RULES = ShardingRules(
+    rules=[
+        (r"(q_proj|k_proj|v_proj)/kernel$", P("fsdp", "tp")),
+        (r"o_proj/kernel$", P("tp", None, "fsdp")),
+        (r"(wi|wi_0|wi_1|up_proj|gate_proj)/kernel$", P("fsdp", "tp")),
+        (r"(wo|down_proj)/kernel$", P("tp", "fsdp")),
+        (r"embed(der|ding)?/embedding$", P("tp", "fsdp")),
+        (r"lm_head/kernel$", P("fsdp", "tp")),
+        (r"lora_a/kernel$", P("fsdp", None)),
+        (r"lora_b/kernel$", P(None, "tp")),
+        # conv kernels [h, w, cin, cout]: shard cout over tp, cin over fsdp
+        (r"conv[^/]*/kernel$", P(None, None, "fsdp", "tp")),
+        (r"kernel$", P("fsdp", "tp")),
+        (r"(bias|scale)$", P()),
+    ],
+    default=P(),
+)
+
+
+def shardings_for_tree(
+    tree: Any,
+    mesh: Mesh,
+    rules: Optional[ShardingRules] = None,
+) -> Any:
+    """Map a pytree of arrays (or ShapeDtypeStructs) to NamedShardings."""
+    rules = rules or DEFAULT_RULES
+
+    def one(path, leaf):
+        ndim = getattr(leaf, "ndim", len(getattr(leaf, "shape", ())))
+        spec = rules.spec_for(_path_str(path), ndim, mesh)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def specs_for_tree(tree: Any, mesh: Mesh, rules: Optional[ShardingRules] = None) -> Any:
+    rules = rules or DEFAULT_RULES
+
+    def one(path, leaf):
+        ndim = getattr(leaf, "ndim", len(getattr(leaf, "shape", ())))
+        return rules.spec_for(_path_str(path), ndim, mesh)
+
+    return jax.tree_util.tree_map_with_path(one, tree)

@@ -1,0 +1,4 @@
+from serverless_learn_tpu.training.train_state import TrainState
+from serverless_learn_tpu.training.train_step import build_trainer, Trainer
+
+__all__ = ["TrainState", "build_trainer", "Trainer"]

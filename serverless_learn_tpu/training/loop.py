@@ -1,0 +1,62 @@
+"""Training loop: wire a Trainer, a data source and metrics together.
+
+Functional successor of the reference worker's thread soup (service thread +
+gossip thread + simulated-training thread, ``src/worker.cc:233-258``): one
+loop, with data prefetch on a background thread and all synchronization
+inside the jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from serverless_learn_tpu.config import ExperimentConfig
+from serverless_learn_tpu.data.datasets import Prefetcher, SyntheticSource
+from serverless_learn_tpu.training.train_step import Trainer, build_trainer
+from serverless_learn_tpu.utils.metrics import ThroughputMeter, log_json
+
+
+def run_training(
+    config: ExperimentConfig,
+    trainer: Optional[Trainer] = None,
+    state=None,
+    source=None,
+    step_callback: Optional[Callable] = None,
+    verbose: bool = False,
+):
+    """Run ``config.train.num_steps`` steps; returns (state, meter).
+
+    ``step_callback(step, state, stats)`` runs after each step — the hook used
+    by checkpointing and the elastic controller.
+    """
+    trainer = trainer or build_trainer(config)
+    if state is None:
+        state = trainer.init()
+    if source is None:
+        source = SyntheticSource(trainer.bundle.make_batch, config.data,
+                                 config.train.batch_size,
+                                 seed=config.train.seed)
+    prefetch = Prefetcher(iter(source), trainer.shard_batch,
+                          depth=config.data.prefetch)
+    meter = ThroughputMeter(batch_size=config.train.batch_size,
+                            n_chips=trainer.mesh.size)
+    meter.start()
+    start_step = int(jax.device_get(state.step))
+    try:
+        for i, batch in zip(range(start_step, config.train.num_steps), prefetch):
+            state, metrics = trainer.step(state, batch)
+            # Block on the metrics (small) so step timing is honest; params
+            # stay on device.
+            metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+            stats = meter.record(i + 1, metrics)
+            if verbose and (i + 1) % config.train.log_every == 0:
+                log_json({"step": stats.step, "step_time_s": round(stats.step_time_s, 5),
+                          "samples_per_sec": round(stats.samples_per_sec, 1),
+                          **{k: round(v, 5) for k, v in metrics.items()}})
+            if step_callback is not None:
+                step_callback(i + 1, state, stats)
+    finally:
+        prefetch.close()
+    return state, meter

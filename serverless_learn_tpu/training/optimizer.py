@@ -1,0 +1,47 @@
+"""Optimizer construction from ``OptimizerConfig`` via optax."""
+
+from __future__ import annotations
+
+import jax
+import optax
+
+from serverless_learn_tpu.config import OptimizerConfig
+
+
+def make_schedule(cfg: OptimizerConfig):
+    if cfg.warmup_steps <= 0 and cfg.decay_steps <= 0:
+        return cfg.learning_rate
+    if cfg.decay_steps > 0:
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=cfg.learning_rate,
+            warmup_steps=max(cfg.warmup_steps, 1),
+            decay_steps=max(cfg.decay_steps, cfg.warmup_steps + 1),
+        )
+    return optax.linear_schedule(0.0, cfg.learning_rate, cfg.warmup_steps)
+
+
+def make_optimizer(cfg: OptimizerConfig, trainable_mask=None) -> optax.GradientTransformation:
+    schedule = make_schedule(cfg)
+    if cfg.name == "adamw":
+        core = optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2,
+                           weight_decay=cfg.weight_decay)
+    elif cfg.name == "sgd":
+        core = optax.sgd(schedule, momentum=cfg.momentum)
+    elif cfg.name == "adafactor":
+        core = optax.adafactor(schedule)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.name!r}")
+    parts = []
+    if cfg.grad_clip_norm > 0:
+        parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    parts.append(core)
+    tx = optax.chain(*parts)
+    if trainable_mask is not None:
+        # Freeze non-trainable params (LoRA): zero their updates entirely.
+        tx = optax.multi_transform(
+            {"train": tx, "freeze": optax.set_to_zero()},
+            lambda params: jax.tree_util.tree_map(
+                lambda m: "train" if m else "freeze", trainable_mask(params)),
+        )
+    return tx

@@ -1,0 +1,22 @@
+"""Training state pytree.
+
+The reference's entire training state is two global vectors + a float
+(``src/master.cc:58-60``), shared *by data race* between three threads
+(SURVEY.md §2.8). Here state is an immutable pytree threaded functionally
+through a jitted step — race-free by construction — and sharded across the
+mesh per ``parallel/sharding.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: Any  # scalar int32 array
+    params: Any  # trainable parameter pytree
+    opt_state: Any  # optax state
+    model_state: Any  # non-trainable collections (e.g. batch_stats), {} if none

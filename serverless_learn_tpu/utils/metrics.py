@@ -1,0 +1,61 @@
+"""Structured metrics & throughput accounting.
+
+Successor of the reference's observability story — unconditional ``std::cout``
+narration on every RPC (SURVEY.md §5 "Metrics") — as step-timed counters with
+JSON-line output. samples/sec/chip is BASELINE.json's primary metric.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class StepStats:
+    step: int
+    step_time_s: float
+    samples_per_sec: float
+    metrics: Dict[str, float]
+
+
+@dataclass
+class ThroughputMeter:
+    batch_size: int
+    n_chips: int = 1
+    history: List[StepStats] = field(default_factory=list)
+    _t_last: Optional[float] = None
+
+    def start(self):
+        self._t_last = time.perf_counter()
+
+    def record(self, step: int, metrics: Dict[str, float]) -> StepStats:
+        now = time.perf_counter()
+        dt = now - (self._t_last if self._t_last is not None else now)
+        self._t_last = now
+        sps = self.batch_size / dt if dt > 0 else float("inf")
+        stats = StepStats(step=step, step_time_s=dt, samples_per_sec=sps,
+                          metrics=metrics)
+        self.history.append(stats)
+        return stats
+
+    def steady_state(self, skip: int = 2) -> Dict[str, float]:
+        """Aggregate over history, skipping warmup/compile steps."""
+        usable = self.history[skip:] if len(self.history) > skip else self.history
+        if not usable:
+            return {"samples_per_sec": 0.0, "step_time_s": 0.0}
+        times = [s.step_time_s for s in usable]
+        sps = self.batch_size * len(usable) / sum(times)
+        return {
+            "samples_per_sec": sps,
+            "samples_per_sec_per_chip": sps / max(self.n_chips, 1),
+            "step_time_s": sum(times) / len(times),
+        }
+
+
+def log_json(record: dict, stream=None):
+    (stream or sys.stderr).write(json.dumps(record) + "\n")
+    (stream or sys.stderr).flush()

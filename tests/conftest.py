@@ -1,0 +1,35 @@
+"""Test harness: 8 virtual CPU devices stand in for a TPU slice.
+
+The reference's only "test rig" was three localhost processes simulating a
+cluster (SURVEY.md §4). The JAX-idiomatic equivalent is
+``--xla_force_host_platform_device_count``: one process, eight devices, real
+Mesh/collective semantics. Must run before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The image's sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS=axon (the real-TPU tunnel), so the env var above can be too
+# late; backends are lazy, so overriding the config before first device use
+# still wins.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,76 @@
+"""Mesh construction and sharding-rule resolution."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from serverless_learn_tpu.config import MeshConfig
+from serverless_learn_tpu.parallel.mesh import batch_sharding, local_batch_size, make_mesh
+from serverless_learn_tpu.parallel.sharding import (
+    DEFAULT_RULES, ShardingRules, shardings_for_tree, specs_for_tree)
+
+
+def test_mesh_shapes(devices):
+    mesh = make_mesh(MeshConfig(dp=8))
+    assert mesh.shape["dp"] == 8 and mesh.shape["tp"] == 1
+    mesh = make_mesh(MeshConfig(dp=2, tp=2, sp=2))
+    assert mesh.shape == {"dp": 2, "fsdp": 1, "tp": 2, "sp": 2, "pp": 1}
+
+
+def test_mesh_size_mismatch(devices):
+    with pytest.raises(ValueError):
+        make_mesh(MeshConfig(dp=3))
+
+
+def test_batch_sharding_splits_batch(devices):
+    mesh = make_mesh(MeshConfig(dp=4, tp=2))
+    x = np.zeros((16, 8), np.float32)
+    arr = jax.device_put(x, batch_sharding(mesh))
+    # each addressable shard holds 16/4 = 4 rows
+    shard_shapes = {s.data.shape for s in arr.addressable_shards}
+    assert shard_shapes == {(4, 8)}
+    assert local_batch_size(16, mesh) == 4
+
+
+def test_rule_pruning_drops_absent_axes(devices):
+    mesh = make_mesh(MeshConfig(dp=8))  # tp axis size 1
+    tree = {"attn": {"q_proj": {"kernel": jnp.zeros((16, 4, 8))}}}
+    specs = specs_for_tree(tree, mesh)
+    # fsdp and tp are both size-1 => everything replicated
+    assert specs["attn"]["q_proj"]["kernel"] == P()
+
+
+def test_tp_rules_shard_heads(devices):
+    mesh = make_mesh(MeshConfig(dp=2, tp=4))
+    tree = {
+        "q_proj": {"kernel": jnp.zeros((16, 8, 4))},
+        "o_proj": {"kernel": jnp.zeros((8, 4, 16))},
+        "gate_proj": {"kernel": jnp.zeros((16, 64))},
+        "down_proj": {"kernel": jnp.zeros((64, 16))},
+        "norm": {"scale": jnp.zeros((16,))},
+    }
+    specs = specs_for_tree(tree, mesh)
+    assert specs["q_proj"]["kernel"] == P(None, "tp")
+    assert specs["o_proj"]["kernel"] == P("tp")
+    assert specs["gate_proj"]["kernel"] == P(None, "tp")
+    assert specs["down_proj"]["kernel"] == P("tp")
+    assert specs["norm"]["scale"] == P()
+
+
+def test_fsdp_rules_shard_dim0(devices):
+    mesh = make_mesh(MeshConfig(fsdp=8))
+    tree = {"mlp": {"wi": {"kernel": jnp.zeros((32, 64))}}}
+    shardings = shardings_for_tree(tree, mesh)
+    s = shardings["mlp"]["wi"]["kernel"]
+    assert isinstance(s, NamedSharding) and s.spec == P("fsdp")
+
+
+def test_sharded_placement_distributes_bytes(devices):
+    mesh = make_mesh(MeshConfig(fsdp=8))
+    w = np.ones((64, 16), np.float32)
+    tree = {"wi": {"kernel": w}}
+    shardings = shardings_for_tree(tree, mesh)
+    arr = jax.device_put(w, shardings["wi"]["kernel"])
+    assert {s.data.shape for s in arr.addressable_shards} == {(8, 16)}
