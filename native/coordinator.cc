@@ -1,0 +1,241 @@
+// Coordinator daemon — elastic-membership control plane.
+//
+// Native C++ successor of the reference master (`src/master.cc`), keeping its
+// capability contract (SURVEY.md §0 items 1-3) and fixing its defects:
+//  * elastic join: RegisterBirth-equivalent (reference src/master.cc:79-91)
+//    hands out worker ids + the current membership epoch.
+//  * failure detection: lease-based — workers heartbeat us and are EVICTED
+//    when the lease lapses; the reference only logged failures and kept
+//    pushing to dead workers forever (src/master.cc:191-195).
+//  * peer-list dissemination piggybacks on heartbeat replies, as the
+//    reference piggybacked PeerList on CheckUp (src/master.cc:183-188).
+//  * membership epoch: monotonically bumps on every join/leave; workers use
+//    an epoch change as the signal to checkpoint + re-form the TPU mesh
+//    (the TPU realization of gossip's elasticity).
+//  * NO model math here: the reference master also gossiped model deltas
+//    (src/master.cc:95-114); that entire plane moved to XLA collectives.
+//
+// Usage: coordinator [--port 50052] [--lease_ttl_ms 5000] [--sweep_ms 500]
+
+#include <atomic>
+#include <cstdarg>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "framing.h"
+#include "log.h"
+#include "slt.pb.h"
+
+namespace {
+
+struct WorkerRec {
+  uint64_t id;
+  std::string addr;
+  std::string name;
+  uint32_t n_chips;
+  uint64_t last_seen_ms;
+  uint64_t step = 0;
+  double metric = 0.0;
+};
+
+uint64_t now_ms() {
+  using namespace std::chrono;
+  return duration_cast<milliseconds>(
+             steady_clock::now().time_since_epoch())
+      .count();
+}
+
+class Coordinator {
+ public:
+  Coordinator(uint32_t lease_ttl_ms) : lease_ttl_ms_(lease_ttl_ms) {}
+
+  slt::RegisterReply Register(const slt::RegisterRequest& req) {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint64_t id = next_id_++;
+    WorkerRec rec{id, req.addr(), req.name(), req.n_chips(), now_ms()};
+    workers_[id] = rec;
+    epoch_++;
+    slt::log_info("coord", "register worker=%llu addr=%s name=%s epoch=%llu",
+                  (unsigned long long)id, req.addr().c_str(),
+                  req.name().c_str(), (unsigned long long)epoch_);
+    slt::RegisterReply rep;
+    rep.set_ok(true);
+    rep.set_worker_id(id);
+    rep.set_epoch(epoch_);
+    rep.set_lease_ttl_ms(lease_ttl_ms_);
+    return rep;
+  }
+
+  slt::HeartbeatReply Heartbeat(const slt::HeartbeatRequest& req) {
+    std::lock_guard<std::mutex> lk(mu_);
+    slt::HeartbeatReply rep;
+    auto it = workers_.find(req.worker_id());
+    if (it == workers_.end()) {
+      // Lease already expired (or never registered): tell the worker to
+      // re-register — the re-join path of elastic membership.
+      rep.set_ok(false);
+      rep.set_epoch(epoch_);
+      return rep;
+    }
+    it->second.last_seen_ms = now_ms();
+    it->second.step = req.step();
+    it->second.metric = req.metric();
+    rep.set_ok(true);
+    rep.set_epoch(epoch_);
+    FillPeersLocked(rep.mutable_peers());
+    return rep;
+  }
+
+  slt::Ack Deregister(const slt::DeregisterRequest& req) {
+    std::lock_guard<std::mutex> lk(mu_);
+    slt::Ack ack;
+    auto it = workers_.find(req.worker_id());
+    if (it != workers_.end()) {
+      slt::log_info("coord", "deregister worker=%llu epoch=%llu",
+                    (unsigned long long)req.worker_id(),
+                    (unsigned long long)(epoch_ + 1));
+      workers_.erase(it);
+      epoch_++;
+      ack.set_ok(true);
+    } else {
+      ack.set_ok(false);
+      ack.set_error("unknown worker");
+    }
+    return ack;
+  }
+
+  slt::MembershipReply Membership() {
+    std::lock_guard<std::mutex> lk(mu_);
+    slt::MembershipReply rep;
+    rep.set_epoch(epoch_);
+    FillPeersLocked(rep.mutable_peers());
+    return rep;
+  }
+
+  // Lease sweep: evict workers whose lease lapsed. The failure-detection
+  // *and handling* the reference lacked (it detected via CheckUp timeouts
+  // but never removed anyone, src/master.cc:240-266).
+  void Sweep() {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint64_t cutoff = now_ms() - lease_ttl_ms_;
+    bool changed = false;
+    for (auto it = workers_.begin(); it != workers_.end();) {
+      if (it->second.last_seen_ms < cutoff) {
+        slt::log_warn("coord", "lease expired worker=%llu addr=%s",
+                      (unsigned long long)it->first,
+                      it->second.addr.c_str());
+        it = workers_.erase(it);
+        changed = true;
+      } else {
+        ++it;
+      }
+    }
+    if (changed) {
+      epoch_++;
+      slt::log_info("coord", "membership epoch -> %llu (%zu workers)",
+                    (unsigned long long)epoch_, workers_.size());
+    }
+  }
+
+ private:
+  void FillPeersLocked(
+      google::protobuf::RepeatedPtrField<slt::PeerInfo>* peers) {
+    for (const auto& [id, rec] : workers_) {
+      auto* p = peers->Add();
+      p->set_worker_id(id);
+      p->set_addr(rec.addr);
+      p->set_name(rec.name);
+      p->set_n_chips(rec.n_chips);
+    }
+  }
+
+  std::mutex mu_;
+  std::map<uint64_t, WorkerRec> workers_;
+  uint64_t next_id_ = 1;
+  uint64_t epoch_ = 0;
+  const uint32_t lease_ttl_ms_;
+};
+
+void serve_conn(Coordinator* coord, int fd) {
+  uint8_t type;
+  std::string payload;
+  while (slt::read_frame(fd, &type, &payload)) {
+    std::string out;
+    uint8_t out_type;
+    switch (type) {
+      case slt::MSG_REGISTER_REQ: {
+        slt::RegisterRequest req;
+        req.ParseFromString(payload);
+        coord->Register(req).SerializeToString(&out);
+        out_type = slt::MSG_REGISTER_REP;
+        break;
+      }
+      case slt::MSG_HEARTBEAT_REQ: {
+        slt::HeartbeatRequest req;
+        req.ParseFromString(payload);
+        coord->Heartbeat(req).SerializeToString(&out);
+        out_type = slt::MSG_HEARTBEAT_REP;
+        break;
+      }
+      case slt::MSG_DEREGISTER_REQ: {
+        slt::DeregisterRequest req;
+        req.ParseFromString(payload);
+        coord->Deregister(req).SerializeToString(&out);
+        out_type = slt::MSG_ACK;
+        break;
+      }
+      case slt::MSG_MEMBERSHIP_REQ: {
+        coord->Membership().SerializeToString(&out);
+        out_type = slt::MSG_MEMBERSHIP_REP;
+        break;
+      }
+      default: {
+        slt::Ack ack;
+        ack.set_ok(false);
+        ack.set_error("unknown message type");
+        ack.SerializeToString(&out);
+        out_type = slt::MSG_ACK;
+        break;
+      }
+    }
+    if (!slt::write_frame(fd, out_type, out)) break;
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 50052;
+  uint32_t lease_ttl_ms = 5000;
+  uint32_t sweep_ms = 500;
+  for (int i = 1; i < argc - 1; i++) {
+    if (!strcmp(argv[i], "--port")) port = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--lease_ttl_ms")) lease_ttl_ms = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--sweep_ms")) sweep_ms = atoi(argv[++i]);
+  }
+  Coordinator coord(lease_ttl_ms);
+  int lfd = slt::listen_on(port);
+  if (lfd < 0) {
+    slt::log_error("coord", "cannot listen on port %d", port);
+    return 1;
+  }
+  slt::log_info("coord", "listening on :%d lease_ttl=%ums", port, lease_ttl_ms);
+  std::thread sweeper([&coord, sweep_ms] {
+    while (true) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(sweep_ms));
+      coord.Sweep();
+    }
+  });
+  sweeper.detach();
+  while (true) {
+    int fd = ::accept(lfd, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(serve_conn, &coord, fd).detach();
+  }
+}

@@ -1,0 +1,145 @@
+// Length-prefixed protobuf framing over TCP.
+//
+// Wire format per frame: [u32 big-endian payload_len][u8 msg_type][payload].
+// This plus slt.proto is the whole transport — the successor of the
+// reference's gRPC layer (its entire cross-process API was 3 gRPC services,
+// src/protos/serverless_learn.proto:8-56). One shared implementation instead
+// of the reference's per-binary hand-rolled stubs (SURVEY.md §2.5), with
+// persistent connections (the reference rebuilt a channel per call,
+// src/master.cc:257 "TODO (PERF)").
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace slt {
+
+// Message type tags (one per slt.proto message that crosses the wire).
+enum MsgType : uint8_t {
+  MSG_REGISTER_REQ = 1,
+  MSG_REGISTER_REP = 2,
+  MSG_HEARTBEAT_REQ = 3,
+  MSG_HEARTBEAT_REP = 4,
+  MSG_DEREGISTER_REQ = 5,
+  MSG_MEMBERSHIP_REQ = 6,
+  MSG_MEMBERSHIP_REP = 7,
+  MSG_ACK = 8,
+  MSG_MANIFEST_REQ = 20,
+  MSG_MANIFEST_REP = 21,
+  MSG_FETCH_REQ = 22,
+  MSG_CHUNK = 23,
+  MSG_PUT_REQ = 24,
+  MSG_STATS_REQ = 25,
+  MSG_STATS_REP = 26,
+};
+
+constexpr uint32_t kMaxFrame = 64u * 1024 * 1024;  // 64 MB safety cap
+constexpr size_t kChunkSize = 1u * 1024 * 1024;    // data-plane chunk (1 MiB)
+
+inline bool read_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r == 0) return false;  // peer closed
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+inline bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+inline bool write_frame(int fd, uint8_t type, const std::string& payload) {
+  if (payload.size() > kMaxFrame) return false;
+  uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+  char hdr[5];
+  std::memcpy(hdr, &len, 4);
+  hdr[4] = static_cast<char>(type);
+  if (!write_all(fd, hdr, 5)) return false;
+  return payload.empty() || write_all(fd, payload.data(), payload.size());
+}
+
+inline bool read_frame(int fd, uint8_t* type, std::string* payload) {
+  char hdr[5];
+  if (!read_all(fd, hdr, 5)) return false;
+  uint32_t len;
+  std::memcpy(&len, hdr, 4);
+  len = ntohl(len);
+  if (len > kMaxFrame) return false;
+  *type = static_cast<uint8_t>(hdr[4]);
+  payload->resize(len);
+  return len == 0 || read_all(fd, &(*payload)[0], len);
+}
+
+// host:port dial with TCP_NODELAY; returns fd or -1.
+inline int dial(const std::string& host_port) {
+  auto colon = host_port.rfind(':');
+  if (colon == std::string::npos) return -1;
+  std::string host = host_port.substr(0, colon);
+  std::string port = host_port.substr(colon + 1);
+  struct addrinfo hints, *res = nullptr;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  if (::getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (auto* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  if (fd >= 0) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+  return fd;
+}
+
+// Bind+listen on port (all interfaces); returns fd or -1.
+inline int listen_on(int port, int backlog = 128) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, backlog) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+}  // namespace slt

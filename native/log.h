@@ -1,0 +1,50 @@
+// Structured leveled logging for the native daemons — successor of the
+// reference's bare std::cout narration (SURVEY.md §5 "Metrics/logging").
+
+#pragma once
+
+#include <chrono>
+#include <cstdio>
+#include <mutex>
+#include <string>
+
+namespace slt {
+
+inline std::mutex& log_mutex() {
+  static std::mutex m;
+  return m;
+}
+
+inline void vlog(const char* level, const char* component, const char* fmt,
+                 va_list ap) {
+  using namespace std::chrono;
+  auto now = system_clock::now();
+  auto t = system_clock::to_time_t(now);
+  auto ms = duration_cast<milliseconds>(now.time_since_epoch()).count() % 1000;
+  char ts[32];
+  struct tm tmv;
+  localtime_r(&t, &tmv);
+  strftime(ts, sizeof(ts), "%H:%M:%S", &tmv);
+  std::lock_guard<std::mutex> lk(log_mutex());
+  std::fprintf(stderr, "%s.%03lld %s [%s] ", ts, static_cast<long long>(ms),
+               level, component);
+  std::vfprintf(stderr, fmt, ap);
+  std::fprintf(stderr, "\n");
+  std::fflush(stderr);
+}
+
+#define SLT_LOG_FN(NAME, LEVEL)                                       \
+  inline void NAME(const char* component, const char* fmt, ...) {     \
+    va_list ap;                                                       \
+    va_start(ap, fmt);                                                \
+    ::slt::vlog(LEVEL, component, fmt, ap);                           \
+    va_end(ap);                                                       \
+  }
+
+SLT_LOG_FN(log_info, "INFO")
+SLT_LOG_FN(log_warn, "WARN")
+SLT_LOG_FN(log_error, "ERROR")
+
+#undef SLT_LOG_FN
+
+}  // namespace slt

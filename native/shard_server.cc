@@ -1,0 +1,366 @@
+// Shard/checkpoint server — the data plane.
+//
+// Native C++ successor of the reference file server (`src/file_server.cc`),
+// redesigned pull-based:
+//  * the reference blind-pushes a 100 MB dummy file to every worker every 5 s
+//    on the master's orders (src/master.cc:220-237, src/file_server.cc:60-87);
+//    here workers request a manifest and fetch exactly the byte ranges they
+//    own, resumable via per-chunk offsets.
+//  * chunked streaming retained (reference `stream Chunk`, proto :49,59-61;
+//    CHUNK_SIZE 1 MB, src/file_server.cc:46) as ChunkMsg frames.
+//  * checkpoints are first-class: PUT writes land atomically (tmp + rename)
+//    under the same keyspace, giving the framework the checkpoint/restore
+//    capability the reference lacked entirely (SURVEY.md §5).
+//  * a synthetic dataset mode ("synthetic:<bytes>") succeeds the reference's
+//    startup-synthesized random file (src/file_server.cc:150-156), generated
+//    deterministically on demand instead of held 100 MB-resident.
+//  * unknown keys return an error chunk — the reference called exit(1) on an
+//    unexpected file number (src/file_server.cc:107-110).
+//
+// Usage: shard_server [--port 50053] [--root DIR]
+
+#include <atomic>
+#include <cstdarg>
+#include <cstdint>
+#include <cstring>
+#include <dirent.h>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <thread>
+#include <vector>
+#include <zlib.h>
+
+#include "framing.h"
+#include "log.h"
+#include "slt.pb.h"
+
+namespace {
+
+struct Stats {
+  std::atomic<uint64_t> bytes_served{0};
+  std::atomic<uint64_t> bytes_stored{0};
+  std::atomic<uint32_t> active_streams{0};
+};
+
+Stats g_stats;
+std::string g_root = "/tmp/slt_shards";
+
+bool key_ok(const std::string& key) {
+  // Keys are relative paths; forbid traversal and absolute paths.
+  if (key.empty() || key[0] == '/') return false;
+  if (key.find("..") != std::string::npos) return false;
+  return true;
+}
+
+std::string key_path(const std::string& key) { return g_root + "/" + key; }
+
+void mkdirs_for(const std::string& path) {
+  for (size_t i = 1; i < path.size(); i++) {
+    if (path[i] == '/') {
+      ::mkdir(path.substr(0, i).c_str(), 0755);
+    }
+  }
+}
+
+// Deterministic synthetic bytes: key "synthetic:<size>" (xorshift stream
+// keyed by position so arbitrary offsets are servable without materializing).
+bool parse_synthetic(const std::string& key, uint64_t* size) {
+  const std::string prefix = "synthetic:";
+  if (key.rfind(prefix, 0) != 0) return false;
+  *size = strtoull(key.c_str() + prefix.size(), nullptr, 10);
+  return *size > 0;
+}
+
+void fill_synthetic(uint64_t offset, char* dst, size_t n) {
+  for (size_t i = 0; i < n; i += 8) {
+    uint64_t x = (offset + i) ^ 0x9e3779b97f4a7c15ull;
+    x ^= x >> 30;
+    x *= 0xbf58476d1ce4e5b9ull;
+    x ^= x >> 27;
+    x *= 0x94d049bb133111ebull;
+    x ^= x >> 31;
+    size_t take = std::min<size_t>(8, n - i);
+    std::memcpy(dst + i, &x, take);
+  }
+}
+
+bool send_error_chunk(int fd, const std::string& err) {
+  slt::ChunkMsg c;
+  c.set_last(true);
+  c.set_error(err);
+  std::string out;
+  c.SerializeToString(&out);
+  return slt::write_frame(fd, slt::MSG_CHUNK, out);
+}
+
+void handle_fetch(int fd, const slt::FetchRequest& req) {
+  g_stats.active_streams++;
+  struct Scope {
+    ~Scope() { g_stats.active_streams--; }
+  } scope;
+
+  uint64_t syn_size = 0;
+  bool synthetic = parse_synthetic(req.key(), &syn_size);
+  int file_fd = -1;
+  uint64_t total = 0;
+  if (synthetic) {
+    total = syn_size;
+  } else {
+    if (!key_ok(req.key())) {
+      send_error_chunk(fd, "bad key");
+      return;
+    }
+    file_fd = ::open(key_path(req.key()).c_str(), O_RDONLY);
+    if (file_fd < 0) {
+      send_error_chunk(fd, "no such key: " + req.key());
+      return;
+    }
+    struct stat st;
+    ::fstat(file_fd, &st);
+    total = static_cast<uint64_t>(st.st_size);
+  }
+  uint64_t offset = std::min(req.offset(), total);
+  uint64_t end = req.length() ? std::min(offset + req.length(), total) : total;
+  // Every fetch MUST end with a last=true (or error) chunk — a stream with
+  // no terminator leaves the client blocked in read_frame forever.
+  bool terminated = false;
+  std::string buf;
+  while (offset < end) {
+    size_t n = static_cast<size_t>(
+        std::min<uint64_t>(slt::kChunkSize, end - offset));
+    buf.resize(n);
+    if (synthetic) {
+      fill_synthetic(offset, &buf[0], n);
+    } else {
+      ssize_t r = ::pread(file_fd, &buf[0], n, static_cast<off_t>(offset));
+      if (r <= 0) {
+        send_error_chunk(fd, "read failed mid-stream");
+        terminated = true;
+        break;
+      }
+      buf.resize(static_cast<size_t>(r));
+      n = static_cast<size_t>(r);
+    }
+    slt::ChunkMsg c;
+    c.set_offset(offset);
+    offset += n;
+    c.set_last(offset >= end);
+    terminated = c.last();
+    c.set_data(std::move(buf));
+    std::string out;
+    c.SerializeToString(&out);
+    if (!slt::write_frame(fd, slt::MSG_CHUNK, out)) {
+      terminated = true;  // transport dead; nothing more to send
+      break;
+    }
+    g_stats.bytes_served += n;
+    buf.clear();
+  }
+  if (!terminated) {
+    // Empty range (offset >= end, zero-size file, offset past EOF): send a
+    // bare terminator chunk so the client returns 0 bytes instead of hanging.
+    slt::ChunkMsg c;
+    c.set_offset(offset);
+    c.set_last(true);
+    std::string out;
+    c.SerializeToString(&out);
+    slt::write_frame(fd, slt::MSG_CHUNK, out);
+  }
+  if (file_fd >= 0) ::close(file_fd);
+}
+
+// PUT: client sends PutRequest, then ChunkMsg frames until last=true; we
+// reply one Ack. Writes are atomic (tmp file + rename) so a checkpoint is
+// never observed half-written.
+void handle_put(int fd, const slt::PutRequest& req) {
+  // The client streams PutRequest + ChunkMsg frames back-to-back, so the
+  // chunk stream MUST be drained even on a rejected key — replying early
+  // would leave the leftover chunks to be misread as new requests and
+  // desync every later call on this connection.
+  slt::Ack ack;
+  std::string final_path, tmp_path;
+  int out_fd = -1;
+  if (!key_ok(req.key())) {
+    ack.set_ok(false);
+    ack.set_error("bad key");
+  } else {
+    static std::atomic<uint64_t> put_seq{0};
+    final_path = key_path(req.key());
+    // Per-put unique tmp path: all handler threads share one pid, so a
+    // pid-only suffix would interleave concurrent puts to the same key.
+    tmp_path = final_path + ".tmp." + std::to_string(::getpid()) + "." +
+               std::to_string(put_seq.fetch_add(1));
+    mkdirs_for(final_path);
+    out_fd = ::open(tmp_path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (out_fd < 0) {
+      ack.set_ok(false);
+      ack.set_error("cannot open " + tmp_path);
+    }
+  }
+  uint64_t written = 0;
+  bool done = false, failed = false;
+  uint8_t type;
+  std::string payload;
+  while (!done && slt::read_frame(fd, &type, &payload)) {
+    if (type != slt::MSG_CHUNK) {
+      failed = true;
+      break;
+    }
+    slt::ChunkMsg c;
+    if (!c.ParseFromString(payload)) {
+      failed = true;
+      break;
+    }
+    if (out_fd >= 0 && !c.data().empty()) {
+      if (::pwrite(out_fd, c.data().data(), c.data().size(),
+                   static_cast<off_t>(c.offset())) < 0) {
+        ack.set_ok(false);
+        ack.set_error("write failed");
+        ::close(out_fd);
+        ::unlink(tmp_path.c_str());
+        out_fd = -1;
+      } else {
+        written += c.data().size();
+      }
+    }
+    done = c.last();
+  }
+  if (out_fd >= 0) {
+    ::close(out_fd);
+    if (done && !failed) {
+      ::rename(tmp_path.c_str(), final_path.c_str());
+      g_stats.bytes_stored += written;
+      ack.set_ok(true);
+      slt::log_info("shard", "put key=%s bytes=%llu", req.key().c_str(),
+                    (unsigned long long)written);
+    } else {
+      ::unlink(tmp_path.c_str());
+      ack.set_ok(false);
+      ack.set_error("incomplete put");
+    }
+  }
+  std::string out;
+  ack.SerializeToString(&out);
+  slt::write_frame(fd, slt::MSG_ACK, out);
+}
+
+void list_dir(const std::string& dir, const std::string& rel,
+              slt::ManifestReply* rep) {
+  DIR* d = ::opendir(dir.c_str());
+  if (!d) return;
+  struct dirent* e;
+  while ((e = ::readdir(d))) {
+    std::string name = e->d_name;
+    if (name == "." || name == "..") continue;
+    if (name.size() > 4 && name.find(".tmp.") != std::string::npos) continue;
+    std::string full = dir + "/" + name;
+    std::string r = rel.empty() ? name : rel + "/" + name;
+    struct stat st;
+    if (::stat(full.c_str(), &st) != 0) continue;
+    if (S_ISDIR(st.st_mode)) {
+      list_dir(full, r, rep);
+    } else {
+      auto* b = rep->add_blobs();
+      b->set_key(r);
+      b->set_size(static_cast<uint64_t>(st.st_size));
+    }
+  }
+  ::closedir(d);
+}
+
+void handle_manifest(int fd, const slt::ManifestRequest& req) {
+  slt::ManifestReply rep;
+  uint64_t syn_size = 0;
+  if (parse_synthetic(req.dataset(), &syn_size)) {
+    auto* b = rep.add_blobs();
+    b->set_key(req.dataset());
+    b->set_size(syn_size);
+    rep.set_ok(true);
+  } else {
+    std::string dir = req.dataset().empty()
+                          ? g_root
+                          : (key_ok(req.dataset()) ? key_path(req.dataset())
+                                                   : std::string());
+    if (dir.empty()) {
+      rep.set_ok(false);
+      rep.set_error("bad dataset");
+    } else {
+      list_dir(dir, req.dataset(), &rep);
+      rep.set_ok(true);
+    }
+  }
+  std::string out;
+  rep.SerializeToString(&out);
+  slt::write_frame(fd, slt::MSG_MANIFEST_REP, out);
+}
+
+void serve_conn(int fd) {
+  uint8_t type;
+  std::string payload;
+  while (slt::read_frame(fd, &type, &payload)) {
+    switch (type) {
+      case slt::MSG_FETCH_REQ: {
+        slt::FetchRequest req;
+        req.ParseFromString(payload);
+        handle_fetch(fd, req);
+        break;
+      }
+      case slt::MSG_PUT_REQ: {
+        slt::PutRequest req;
+        req.ParseFromString(payload);
+        handle_put(fd, req);
+        break;
+      }
+      case slt::MSG_MANIFEST_REQ: {
+        slt::ManifestRequest req;
+        req.ParseFromString(payload);
+        handle_manifest(fd, req);
+        break;
+      }
+      case slt::MSG_STATS_REQ: {
+        slt::StatsReply rep;
+        rep.set_bytes_served(g_stats.bytes_served.load());
+        rep.set_bytes_stored(g_stats.bytes_stored.load());
+        rep.set_active_streams(g_stats.active_streams.load());
+        std::string out;
+        rep.SerializeToString(&out);
+        slt::write_frame(fd, slt::MSG_STATS_REP, out);
+        break;
+      }
+      default: {
+        slt::Ack ack;
+        ack.set_ok(false);
+        ack.set_error("unknown message type");
+        std::string out;
+        ack.SerializeToString(&out);
+        slt::write_frame(fd, slt::MSG_ACK, out);
+        break;
+      }
+    }
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 50053;
+  for (int i = 1; i < argc - 1; i++) {
+    if (!strcmp(argv[i], "--port")) port = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--root")) g_root = argv[++i];
+  }
+  mkdirs_for(g_root + "/x");
+  int lfd = slt::listen_on(port);
+  if (lfd < 0) {
+    slt::log_error("shard", "cannot listen on port %d", port);
+    return 1;
+  }
+  slt::log_info("shard", "listening on :%d root=%s", port, g_root.c_str());
+  while (true) {
+    int fd = ::accept(lfd, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(serve_conn, fd).detach();
+  }
+}

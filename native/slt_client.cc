@@ -1,0 +1,180 @@
+// C client library (libslt.so) — shared by all callers, exposed to Python
+// via ctypes.
+//
+// The one shared client the reference never had: it hand-rolled a separate
+// stub class per (caller, callee) pair and rebuilt channels per call
+// (SURVEY.md §2.5, src/master.cc:257 "TODO (PERF): don't reconstruct stubs
+// every time!"). Here a connection handle is persistent, thread-safe, and
+// generic over message types; the data-plane fast paths (`slt_fetch_into`,
+// `slt_put`) run the chunk loop in native code and memcpy straight into a
+// caller-owned buffer (e.g. numpy memory pinned for TPU transfer), keeping
+// Python off the per-chunk path.
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+#include "framing.h"
+#include "slt.pb.h"
+
+namespace {
+
+struct Conn {
+  int fd = -1;
+  std::string addr;
+  std::mutex mu;
+
+  bool ensure() {
+    if (fd >= 0) return true;
+    fd = slt::dial(addr);
+    return fd >= 0;
+  }
+
+  void drop() {
+    if (fd >= 0) ::close(fd);
+    fd = -1;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* slt_connect(const char* host_port) {
+  auto* c = new Conn();
+  c->addr = host_port;
+  if (!c->ensure()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void slt_disconnect(void* h) {
+  if (!h) return;
+  auto* c = static_cast<Conn*>(h);
+  c->drop();
+  delete c;
+}
+
+// Generic unary call: write one frame, read one frame. Returns the response
+// payload length (copied into resp_buf, truncated at cap) or -1 on transport
+// failure. One transparent reconnect+retry on a broken connection.
+long long slt_call(void* h, unsigned char req_type, const void* req,
+                   size_t req_len, void* resp_buf, size_t cap,
+                   unsigned char* resp_type) {
+  auto* c = static_cast<Conn*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  std::string payload(static_cast<const char*>(req), req_len);
+  for (int attempt = 0; attempt < 2; attempt++) {
+    if (!c->ensure()) return -1;
+    if (!slt::write_frame(c->fd, req_type, payload)) {
+      c->drop();
+      continue;
+    }
+    uint8_t type;
+    std::string out;
+    if (!slt::read_frame(c->fd, &type, &out)) {
+      c->drop();
+      continue;
+    }
+    if (resp_type) *resp_type = type;
+    size_t n = std::min(out.size(), cap);
+    if (n) std::memcpy(resp_buf, out.data(), n);
+    return static_cast<long long>(out.size());
+  }
+  return -1;
+}
+
+// Fetch [offset, offset+length) of `key` into dst (cap bytes). length==0
+// means to EOF. Returns bytes written or -1. Error chunks return -1.
+long long slt_fetch_into(void* h, const char* key, unsigned long long offset,
+                         unsigned long long length, void* dst, size_t cap) {
+  auto* c = static_cast<Conn*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (!c->ensure()) return -1;
+  slt::FetchRequest req;
+  req.set_key(key);
+  req.set_offset(offset);
+  req.set_length(length);
+  std::string payload;
+  req.SerializeToString(&payload);
+  if (!slt::write_frame(c->fd, slt::MSG_FETCH_REQ, payload)) {
+    c->drop();
+    return -1;
+  }
+  uint64_t written = 0;
+  while (true) {
+    uint8_t type;
+    std::string out;
+    if (!slt::read_frame(c->fd, &type, &out)) {
+      c->drop();
+      return -1;
+    }
+    if (type != slt::MSG_CHUNK) {
+      c->drop();
+      return -1;
+    }
+    slt::ChunkMsg chunk;
+    if (!chunk.ParseFromString(out)) {
+      c->drop();
+      return -1;
+    }
+    if (!chunk.error().empty()) return -1;
+    if (!chunk.data().empty()) {
+      uint64_t rel = chunk.offset() - offset;
+      size_t n = chunk.data().size();
+      if (rel + n > cap) n = rel < cap ? static_cast<size_t>(cap - rel) : 0;
+      if (n) {
+        std::memcpy(static_cast<char*>(dst) + rel, chunk.data().data(), n);
+        written = std::max<uint64_t>(written, rel + n);
+      }
+    }
+    if (chunk.last()) break;
+  }
+  return static_cast<long long>(written);
+}
+
+// Store `len` bytes under `key` (atomic on the server). Returns 0 or -1.
+int slt_put(void* h, const char* key, const void* src, size_t len) {
+  auto* c = static_cast<Conn*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (!c->ensure()) return -1;
+  slt::PutRequest req;
+  req.set_key(key);
+  req.set_total_size(len);
+  std::string payload;
+  req.SerializeToString(&payload);
+  if (!slt::write_frame(c->fd, slt::MSG_PUT_REQ, payload)) {
+    c->drop();
+    return -1;
+  }
+  const char* p = static_cast<const char*>(src);
+  size_t off = 0;
+  do {
+    size_t n = std::min(slt::kChunkSize, len - off);
+    slt::ChunkMsg chunk;
+    chunk.set_offset(off);
+    chunk.set_data(p + off, n);
+    off += n;
+    chunk.set_last(off >= len);
+    std::string out;
+    chunk.SerializeToString(&out);
+    if (!slt::write_frame(c->fd, slt::MSG_CHUNK, out)) {
+      c->drop();
+      return -1;
+    }
+  } while (off < len);
+  uint8_t type;
+  std::string out;
+  if (!slt::read_frame(c->fd, &type, &out) || type != slt::MSG_ACK) {
+    c->drop();
+    return -1;
+  }
+  slt::Ack ack;
+  ack.ParseFromString(out);
+  return ack.ok() ? 0 : -1;
+}
+
+}  // extern "C"

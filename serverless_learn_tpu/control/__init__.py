@@ -1,0 +1,19 @@
+from serverless_learn_tpu.control.client import (
+    CoordinatorClient,
+    ShardClient,
+    WorkerAgent,
+    ensure_native_built,
+)
+from serverless_learn_tpu.control.daemons import (
+    start_coordinator,
+    start_shard_server,
+)
+
+__all__ = [
+    "CoordinatorClient",
+    "ShardClient",
+    "WorkerAgent",
+    "ensure_native_built",
+    "start_coordinator",
+    "start_shard_server",
+]

@@ -1,0 +1,50 @@
+"""Launch/manage the native daemons from Python (tests, demos, CLI).
+
+The reference was operated by hand: run ``./file_server``, ``./master``, then
+``./worker ADDR`` per node (SURVEY.md §4). These helpers spawn the C++
+successors as subprocesses and wait for their ports to accept connections.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import time
+from typing import Optional
+
+from serverless_learn_tpu.control.client import ensure_native_built, _BIN
+
+
+def _wait_port(port: int, host: str = "127.0.0.1", timeout: float = 10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with socket.create_connection((host, port), timeout=0.5):
+                return True
+        except OSError:
+            time.sleep(0.05)
+    raise TimeoutError(f"port {port} not ready after {timeout}s")
+
+
+def start_coordinator(port: int = 50052, lease_ttl_ms: int = 5000,
+                      sweep_ms: int = 200) -> subprocess.Popen:
+    assert ensure_native_built(), "native build failed"
+    proc = subprocess.Popen(
+        [os.path.join(_BIN, "coordinator"), "--port", str(port),
+         "--lease_ttl_ms", str(lease_ttl_ms), "--sweep_ms", str(sweep_ms)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    _wait_port(port)
+    return proc
+
+
+def start_shard_server(port: int = 50053, root: Optional[str] = None
+                       ) -> subprocess.Popen:
+    assert ensure_native_built(), "native build failed"
+    cmd = [os.path.join(_BIN, "shard_server"), "--port", str(port)]
+    if root:
+        cmd += ["--root", root]
+    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    _wait_port(port)
+    return proc

@@ -1,0 +1,147 @@
+"""Process-level integration tests for the native coordinator — elastic
+membership, heartbeat leases, peer-list dissemination, epoch bumps, and
+fault injection (kill a worker, assert eviction), mirroring how the
+reference was exercised manually (SURVEY.md §4) but automated."""
+
+import socket
+import time
+
+import pytest
+
+from serverless_learn_tpu.control.client import (
+    CoordinatorClient, WorkerAgent, ensure_native_built)
+from serverless_learn_tpu.control.daemons import start_coordinator
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture()
+def coordinator():
+    port = _free_port()
+    proc = start_coordinator(port=port, lease_ttl_ms=1200, sweep_ms=100)
+    yield f"127.0.0.1:{port}"
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def test_native_build():
+    assert ensure_native_built()
+
+
+def test_register_and_membership(coordinator):
+    c = CoordinatorClient(coordinator)
+    r1 = c.register("w1:9000", name="w1", n_chips=4)
+    r2 = c.register("w2:9000", name="w2", n_chips=4)
+    assert r1.ok and r2.ok
+    assert r2.epoch > r1.epoch, "every join bumps the membership epoch"
+    m = c.membership()
+    assert {p.addr for p in m.peers} == {"w1:9000", "w2:9000"}
+    assert m.epoch == r2.epoch
+    c.close()
+
+
+def test_heartbeat_carries_peer_list(coordinator):
+    c = CoordinatorClient(coordinator)
+    r1 = c.register("w1:9000")
+    c.register("w2:9000")
+    hb = c.heartbeat(r1.worker_id, step=7, metric=1.5)
+    assert hb.ok
+    assert {p.addr for p in hb.peers} == {"w1:9000", "w2:9000"}
+    c.close()
+
+
+def test_lease_expiry_evicts_dead_worker(coordinator):
+    """Failure detection with actual handling — the reference only logged
+    dead workers and kept them in the list forever (src/master.cc:191-195)."""
+    c = CoordinatorClient(coordinator)
+    r_dead = c.register("dead:9000")
+    r_live = c.register("live:9000")
+    epoch0 = r_live.epoch
+    # keep the live worker's lease fresh; never heartbeat the dead one
+    for _ in range(20):
+        c.heartbeat(r_live.worker_id)
+        time.sleep(0.1)
+    m = c.membership()
+    assert {p.addr for p in m.peers} == {"live:9000"}
+    assert m.epoch > epoch0, "eviction must bump the epoch"
+    # dead worker's next heartbeat is told to re-register
+    hb = c.heartbeat(r_dead.worker_id)
+    assert not hb.ok
+    c.close()
+
+
+def test_deregister(coordinator):
+    c = CoordinatorClient(coordinator)
+    r = c.register("w:9000")
+    ack = c.deregister(r.worker_id)
+    assert ack.ok
+    assert len(c.membership().peers) == 0
+    c.close()
+
+
+def test_agent_callback_carries_peers_at_registration(coordinator):
+    """Regression: the first epoch-change callback (at registration) must
+    carry the actual membership, not an empty list."""
+    seen = []
+    a1 = WorkerAgent(coordinator, "w1:9001", heartbeat_interval_ms=100).start()
+    a2 = WorkerAgent(coordinator, "w2:9002", heartbeat_interval_ms=100,
+                     on_epoch_change=lambda e, p: seen.append((e, len(p)))
+                     ).start()
+    assert seen, "callback must fire at registration"
+    assert seen[0][1] == 2, f"registration callback saw {seen[0][1]} peers"
+    a1.stop()
+    a2.stop()
+
+
+def test_worker_agent_lifecycle_and_epoch_callback(coordinator):
+    events = []
+    agents = [
+        WorkerAgent(coordinator, f"w{i}:900{i}", name=f"w{i}",
+                    heartbeat_interval_ms=100,
+                    on_epoch_change=lambda e, p, i=i: events.append((i, e)))
+        .start()
+        for i in range(3)
+    ]
+    time.sleep(0.5)
+    # all agents converge on the same epoch and see all 3 peers
+    epochs = {a.epoch for a in agents}
+    assert len(epochs) == 1
+    assert all(len(a.peers) == 3 for a in agents)
+    # stop one -> deregister -> remaining agents observe an epoch bump
+    e_before = agents[0].epoch
+    agents[2].stop()
+    time.sleep(0.5)
+    assert agents[0].epoch > e_before
+    assert len(agents[0].peers) == 2
+    assert any(i == 0 for i, _ in events)
+    for a in agents[:2]:
+        a.stop()
+
+
+def test_agent_rejoins_after_lease_loss(coordinator):
+    """Elastic re-join: an agent whose lease lapsed (e.g. long GC pause /
+    network partition) transparently re-registers with a fresh id."""
+    agent = WorkerAgent(coordinator, "w:9000", heartbeat_interval_ms=100).start()
+    time.sleep(0.3)
+    first_id = agent.worker_id
+    # simulate a partition: pause heartbeats past the 1.2 s lease
+    agent._stop.set()
+    agent._thread.join()
+    time.sleep(1.5)
+    c = CoordinatorClient(coordinator)
+    assert len(c.membership().peers) == 0, "lease must have expired"
+    # resume heartbeating
+    agent._stop.clear()
+    import threading
+
+    agent._thread = threading.Thread(target=agent._run, daemon=True)
+    agent._thread.start()
+    time.sleep(0.5)
+    assert agent.worker_id != first_id, "must have re-registered"
+    assert len(c.membership().peers) == 1
+    agent.stop()
+    c.close()
