@@ -40,6 +40,7 @@ enum MsgType : uint8_t {
   MSG_PUT_REQ = 24,
   MSG_STATS_REQ = 25,
   MSG_STATS_REP = 26,
+  MSG_DELETE_REQ = 27,
 };
 
 constexpr uint32_t kMaxFrame = 64u * 1024 * 1024;  // 64 MB safety cap

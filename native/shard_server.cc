@@ -64,8 +64,9 @@ void mkdirs_for(const std::string& path) {
   }
 }
 
-// Deterministic synthetic bytes: key "synthetic:<size>" (xorshift stream
-// keyed by position so arbitrary offsets are servable without materializing).
+// Deterministic synthetic bytes: key "synthetic:<size>" (hash stream keyed
+// by ABSOLUTE 8-byte-aligned position so any byte range is servable without
+// materializing and ranged reads agree with full reads at every offset).
 bool parse_synthetic(const std::string& key, uint64_t* size) {
   const std::string prefix = "synthetic:";
   if (key.rfind(prefix, 0) != 0) return false;
@@ -73,16 +74,26 @@ bool parse_synthetic(const std::string& key, uint64_t* size) {
   return *size > 0;
 }
 
+uint64_t synthetic_word(uint64_t word_idx) {
+  uint64_t x = (word_idx * 8) ^ 0x9e3779b97f4a7c15ull;
+  x ^= x >> 30;
+  x *= 0xbf58476d1ce4e5b9ull;
+  x ^= x >> 27;
+  x *= 0x94d049bb133111ebull;
+  x ^= x >> 31;
+  return x;
+}
+
 void fill_synthetic(uint64_t offset, char* dst, size_t n) {
-  for (size_t i = 0; i < n; i += 8) {
-    uint64_t x = (offset + i) ^ 0x9e3779b97f4a7c15ull;
-    x ^= x >> 30;
-    x *= 0xbf58476d1ce4e5b9ull;
-    x ^= x >> 27;
-    x *= 0x94d049bb133111ebull;
-    x ^= x >> 31;
-    size_t take = std::min<size_t>(8, n - i);
-    std::memcpy(dst + i, &x, take);
+  uint64_t pos = offset;
+  size_t i = 0;
+  while (i < n) {
+    uint64_t word = synthetic_word(pos / 8);
+    size_t in_word = static_cast<size_t>(pos % 8);
+    size_t take = std::min<size_t>(8 - in_word, n - i);
+    std::memcpy(dst + i, reinterpret_cast<char*>(&word) + in_word, take);
+    i += take;
+    pos += take;
   }
 }
 
@@ -317,6 +328,24 @@ void serve_conn(int fd) {
         slt::ManifestRequest req;
         req.ParseFromString(payload);
         handle_manifest(fd, req);
+        break;
+      }
+      case slt::MSG_DELETE_REQ: {
+        slt::DeleteRequest req;
+        req.ParseFromString(payload);
+        slt::Ack ack;
+        if (!key_ok(req.key())) {
+          ack.set_ok(false);
+          ack.set_error("bad key");
+        } else if (::unlink(key_path(req.key()).c_str()) == 0) {
+          ack.set_ok(true);
+        } else {
+          ack.set_ok(false);
+          ack.set_error("no such key: " + req.key());
+        }
+        std::string out;
+        ack.SerializeToString(&out);
+        slt::write_frame(fd, slt::MSG_ACK, out);
         break;
       }
       case slt::MSG_STATS_REQ: {

@@ -60,14 +60,18 @@ void slt_disconnect(void* h) {
 
 // Generic unary call: write one frame, read one frame. Returns the response
 // payload length (copied into resp_buf, truncated at cap) or -1 on transport
-// failure. One transparent reconnect+retry on a broken connection.
+// failure. `allow_retry` enables ONE transparent reconnect+resend — callers
+// must set it only for idempotent requests: a resend after a post-delivery
+// connection drop would re-apply a non-idempotent op (e.g. a duplicate
+// Register creating a ghost worker that later causes a spurious eviction).
 long long slt_call(void* h, unsigned char req_type, const void* req,
                    size_t req_len, void* resp_buf, size_t cap,
-                   unsigned char* resp_type) {
+                   unsigned char* resp_type, int allow_retry) {
   auto* c = static_cast<Conn*>(h);
   std::lock_guard<std::mutex> lk(c->mu);
   std::string payload(static_cast<const char*>(req), req_len);
-  for (int attempt = 0; attempt < 2; attempt++) {
+  int attempts = allow_retry ? 2 : 1;
+  for (int attempt = 0; attempt < attempts; attempt++) {
     if (!c->ensure()) return -1;
     if (!slt::write_frame(c->fd, req_type, payload)) {
       c->drop();

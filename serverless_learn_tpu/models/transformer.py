@@ -118,7 +118,8 @@ class Attention(nn.Module):
             k = apply_rope(k, sin, cos)
         out = dot_product_attention(
             q, k, v, causal=cfg.causal, mask=mask,
-            impl=cfg.attention_impl, axis_name=cfg.sp_axis)
+            impl=cfg.attention_impl,
+            axis_name=cfg.sp_axis or "sp")
         return nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=False,
                                name="o_proj", dtype=cfg.dtype,
                                param_dtype=cfg.param_dtype)(out)

@@ -1,0 +1,215 @@
+"""Blocked (flash) attention as a Pallas TPU kernel.
+
+The hot op of the transformer families (the reference has no compute kernels
+at all — its hot loop is a 1 MB-chunk socket write, ``src/file_server.cc:68-77``).
+Forward is a Pallas kernel: Q is blocked over the grid, K/V stream through
+VMEM in ``block_k`` tiles with online-softmax accumulation in fp32, so the
+[T, S] score matrix never hits HBM — the HBM-bandwidth win flash attention
+exists for. Scores/accumulation run on the MXU via ``dot_general`` with
+``preferred_element_type=float32``.
+
+Backward uses the saved logsumexp and a ``lax.scan`` over K/V blocks (pure
+XLA, O(T·block) memory) — the standard recompute strategy, chosen over a
+hand-written backward kernel for robustness; XLA fuses it well.
+
+Falls back to dense attention for shapes the kernel doesn't tile (seq not a
+multiple of the block size, attention bias masks).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale: float, causal: bool):
+    # Grid (B, H, n_q, n_k) with K/V STREAMED: per grid step only one
+    # [block_k, D] tile of K and V is resident in VMEM (the whole point of
+    # flash attention — full-S K/V would blow the ~16 MB VMEM at long
+    # sequences). Online-softmax state lives in VMEM scratch, which persists
+    # across the innermost (j) grid iterations.
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    n_k = pl.num_programs(3)
+    block_q, d = q_ref.shape[2], q_ref.shape[3]
+    block_k = k_ref.shape[2]
+    # Last K/V block this Q block attends to (blocks fully above the causal
+    # diagonal are skipped — compute and final write both key off last_j).
+    if causal:
+        last_j = jnp.minimum(n_k - 1, ((i + 1) * block_q - 1) // block_k)
+    else:
+        last_j = n_k - 1
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j <= last_j)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG)
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        v = v_ref[0, 0].astype(jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(
+            (l_prev * alpha + p.sum(axis=-1))[:, None], l_ref.shape)
+
+    @pl.when(j == last_j)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:, 0] + jnp.log(l)
+
+
+def _flash_fwd_bhsd(q, k, v, *, causal: bool, block_q: int, block_k: int,
+                    interpret: bool):
+    """q,k,v in [B,H,T,D] layout. Returns (out [B,H,T,D], lse [B,H,T])."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    scale = D ** -0.5
+    grid = (B, H, T // block_q, S // block_k)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, T), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),    # acc
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max (lanes bcast)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running sum
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _bwd_bhsd(q, k, v, out, lse, g, *, causal: bool, block_k: int):
+    """Flash backward: scan over K/V blocks using saved lse. All [B,H,T,D]."""
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    scale = D ** -0.5
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    gf, of = g.astype(jnp.float32), out.astype(jnp.float32)
+    delta = (gf * of).sum(axis=-1)  # [B,H,T]
+    q_pos = jnp.arange(T)
+    n_blocks = S // block_k
+
+    def body(dq, j):
+        ks = jax.lax.dynamic_slice_in_dim(kf, j * block_k, block_k, axis=2)
+        vs = jax.lax.dynamic_slice_in_dim(vf, j * block_k, block_k, axis=2)
+        s = jnp.einsum("bhtd,bhsd->bhts", qf, ks) * scale
+        if causal:
+            k_pos = j * block_k + jnp.arange(block_k)
+            s = jnp.where((k_pos[None, :] <= q_pos[:, None])[None, None],
+                          s, _NEG)
+        p = jnp.exp(s - lse[..., None])  # [B,H,T,BK]
+        dp = jnp.einsum("bhtd,bhsd->bhts", gf, vs)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhts,bhsd->bhtd", ds, ks)
+        dk_j = jnp.einsum("bhts,bhtd->bhsd", ds, qf)
+        dv_j = jnp.einsum("bhts,bhtd->bhsd", p, gf)
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(body, dq0, jnp.arange(n_blocks))
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(B, H, S, D)
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(B, H, S, D)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd_bhsd(q, k, v, causal=causal, block_q=block_q,
+                             block_k=block_k, interpret=interpret)
+    return out
+
+
+def _flash_core_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_bhsd(q, k, v, causal=causal, block_q=block_q,
+                               block_k=block_k, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    return _bwd_bhsd(q, k, v, out, lse, g, causal=causal, block_k=block_k)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,  # [B, S, K, D]
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: Optional[jax.Array] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention in the framework's [B, T, H, D] convention; GQA via
+    KV-head expansion. Shapes the kernel can't tile (or additive masks) fall
+    back to dense XLA attention."""
+    from serverless_learn_tpu.ops.attention import xla_attention
+
+    B, T, H, D = q.shape
+    S, K = k.shape[1], k.shape[2]
+    if mask is not None or T % block_q or S % block_k or T < block_q:
+        return xla_attention(q, k, v, causal=causal, mask=mask)
+    backend = jax.default_backend()
+    if backend not in ("cpu", "tpu") and not os.environ.get("SLT_FORCE_PALLAS"):
+        # Tunneled/experimental platforms (e.g. "axon") have been observed to
+        # hang compiling Pallas kernels; dense attention is always correct.
+        return xla_attention(q, k, v, causal=causal, mask=mask)
+    if K != H:
+        k = jnp.repeat(k, H // K, axis=2)
+        v = jnp.repeat(v, H // K, axis=2)
+    if interpret is None:
+        interpret = backend == "cpu"
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out = _flash_core(qt, kt, vt, causal, block_q, block_k, interpret)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,106 @@
+"""Ring attention — sequence/context parallelism over the ``sp`` mesh axis.
+
+Long-context support the reference never had (its "model" is a flat double
+vector, ``src/protos/serverless_learn.proto:81-83``; SURVEY.md §5 records
+long-context as absent). Design: the sequence dimension is sharded over the
+``sp`` axis; each device holds a [B, T/n, H, D] shard of Q and streams K/V
+shards around an ICI ring with ``lax.ppermute`` while maintaining online
+(flash-style) softmax statistics, so the full [T, T] score matrix never
+materializes and each hop is nearest-neighbor.
+
+Works inside ``jit``: the public entry wraps the per-shard kernel in
+``shard_map`` over the active mesh (registered by ``build_trainer``), so the
+same model code runs sp=1 (no-op) or sp=N by changing the mesh shape.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # JAX >= 0.6 promotes shard_map out of experimental
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_NEG = -1e30  # finite "minus infinity": avoids NaN from (-inf) - (-inf)
+
+_ACTIVE_MESH: Optional[Mesh] = None
+
+
+def set_active_mesh(mesh: Optional[Mesh]) -> None:
+    """Register the mesh ring attention should shard_map over. Called by
+    ``build_trainer``; one active mesh per process (the elastic controller
+    re-registers on re-mesh)."""
+    global _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          softmax_scale: float):
+    """Per-device kernel. q,k,v: local shards [B, T_loc, H, D] (kv heads
+    already expanded to H). Sequence blocks are contiguous in axis order."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    qf = q.astype(jnp.float32)
+    q_pos = idx * T + jnp.arange(T)
+
+    def step(carry, s):
+        o, m, l, k_cur, v_cur = carry
+        block_idx = (idx - s) % n
+        scores = jnp.einsum("bthd,bshd->bhts", qf,
+                            k_cur.astype(jnp.float32)) * softmax_scale
+        if causal:
+            kv_pos = block_idx * T + jnp.arange(T)
+            keep = kv_pos[None, :] <= q_pos[:, None]
+            scores = jnp.where(keep[None, None], scores, _NEG)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        p = jnp.exp(scores - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhts,bshd->bhtd", p, v_cur.astype(jnp.float32))
+        # Rotate K/V one hop around the ring (nearest-neighbor on ICI).
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros((B, H, T, D), jnp.float32)
+    m0 = jnp.full((B, H, T), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(n))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
+                   mesh: Optional[Mesh] = None):
+    """Sequence-parallel attention. q [B,T,H,D], k/v [B,T,K,D] (global
+    logical shapes; T sharded over ``axis_name``)."""
+    mesh = mesh or _ACTIVE_MESH
+    if mesh is None:
+        raise RuntimeError(
+            "ring_attention needs an active mesh; call set_active_mesh() "
+            "(build_trainer does this automatically)")
+    H, K = q.shape[2], k.shape[2]
+    if K != H:  # GQA: expand KV heads so the ring carries uniform shards
+        k = jnp.repeat(k, H // K, axis=2)
+        v = jnp.repeat(v, H // K, axis=2)
+    softmax_scale = q.shape[-1] ** -0.5
+    spec = P(("dp", "fsdp"), axis_name, "tp", None)
+    fn = _shard_map(
+        partial(_ring_attention_local, axis_name=axis_name, causal=causal,
+                softmax_scale=softmax_scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,167 @@
+"""Elastic training: membership epoch changes drive mesh re-formation.
+
+This is the TPU-native realization of the reference's one genuinely novel
+capability — "any worker can join anytime" (``src/master.cc:79-91``) — made
+compatible with synchronous SPMD (SURVEY.md §7 "hard parts" (a), (d)):
+
+    steady state: jitted step over a fixed Mesh, gradients psum'd on ICI
+    epoch change (join/leave/eviction, from the native coordinator):
+        drain  -> finish the in-flight step
+        save   -> checkpoint to the shard server / local store
+        remesh -> rebuild the Mesh & retrace the step for the new world size
+        resume -> restore the checkpoint into the NEW shardings, continue
+
+Gossip tolerated membership churn because every exchange was pairwise and
+asynchronous; SPMD instead gets elasticity at checkpoint granularity — the
+price of replacing O(N)-round gossip convergence with single-collective
+exact synchronization.
+
+Single-process realization: the world is a subset of local devices sized by
+``device_policy(peers)`` (default: one device per chip registered by live
+peers, capped at the local device count). On a real multi-host pod the same
+epoch signal instead triggers a coordinated `jax.distributed` restart —
+worker processes re-initialize with the new world size and restore from the
+same checkpoint; the control-plane signals, drain/save/restore sequence, and
+sharding-aware restore below are exactly what that path reuses.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import jax
+
+from serverless_learn_tpu.config import ExperimentConfig, MeshConfig
+from serverless_learn_tpu.control.client import WorkerAgent
+from serverless_learn_tpu.data.datasets import SyntheticSource
+from serverless_learn_tpu.parallel.mesh import make_mesh
+from serverless_learn_tpu.training.checkpoint import Checkpointer
+from serverless_learn_tpu.training.train_step import build_trainer
+from serverless_learn_tpu.utils.metrics import log_json
+
+
+def default_device_policy(peers, local_devices) -> List:
+    """One device per registered chip across live peers, capped locally.
+    With no peer info yet, use all local devices."""
+    total = sum(p.n_chips for p in peers) if peers else len(local_devices)
+    n = max(1, min(total, len(local_devices)))
+    return list(local_devices)[:n]
+
+
+def default_mesh_policy(n_devices: int) -> MeshConfig:
+    return MeshConfig(dp=n_devices)
+
+
+@dataclass
+class EpochTransition:
+    epoch: int
+    step: int
+    n_devices: int
+
+
+class ElasticTrainer:
+    """Owns the worker agent, the checkpointer and the (re)built trainer."""
+
+    def __init__(
+        self,
+        config: ExperimentConfig,
+        store,
+        coordinator_addr: Optional[str] = None,
+        advertise_addr: str = "local:0",
+        name: str = "elastic",
+        n_chips: Optional[int] = None,
+        device_policy: Callable = default_device_policy,
+        mesh_policy: Callable = default_mesh_policy,
+        verbose: bool = False,
+    ):
+        self.config = config
+        self.ckpt = Checkpointer(store, name=name, async_save=False)
+        self.device_policy = device_policy
+        self.mesh_policy = mesh_policy
+        self.verbose = verbose
+        self.transitions: List[EpochTransition] = []
+        self._remesh = threading.Event()
+        self._stop = threading.Event()
+        self._agent: Optional[WorkerAgent] = None
+        if coordinator_addr is not None:
+            self._agent = WorkerAgent(
+                coordinator_addr, advertise_addr, name=name,
+                n_chips=n_chips if n_chips is not None else len(jax.devices()),
+                heartbeat_interval_ms=config.control.heartbeat_interval_ms,
+                on_epoch_change=self._on_epoch_change)
+
+    # -- membership hook ---------------------------------------------------
+
+    def _on_epoch_change(self, epoch: int, peers):
+        self._remesh.set()
+
+    def request_stop(self):
+        """Graceful shutdown: finish the in-flight step, checkpoint, return."""
+        self._stop.set()
+
+    def _current_world(self):
+        if self._agent is None:
+            return 0, self.device_policy([], jax.devices())
+        epoch, peers = self._agent.snapshot()
+        return epoch, self.device_policy(peers, jax.devices())
+
+    # -- main loop ---------------------------------------------------------
+
+    def run(self, num_steps: Optional[int] = None):
+        """Train to ``num_steps`` (default from config), re-meshing on every
+        membership epoch change. Returns (final_state, losses)."""
+        num_steps = num_steps or self.config.train.num_steps
+        if self._agent is not None:
+            self._agent.start()
+        losses: List[float] = []
+        state = None
+        source_iter = None
+        try:
+            while True:
+                self._remesh.clear()
+                epoch, devices = self._current_world()
+                mesh_cfg = self.mesh_policy(len(devices))
+                cfg = self.config.override(mesh=mesh_cfg)
+                mesh = make_mesh(mesh_cfg, devices=devices)
+                trainer = build_trainer(cfg, mesh=mesh)
+                if source_iter is None:
+                    source_iter = iter(SyntheticSource(
+                        trainer.bundle.make_batch, cfg.data,
+                        cfg.train.batch_size, seed=cfg.train.seed))
+                # restore (or cold-start) into the new world's shardings
+                template = trainer.init()
+                if self.ckpt.latest_step() is not None:
+                    state = self.ckpt.restore(
+                        template, shardings=trainer.state_shardings)
+                elif state is None:
+                    state = template
+                step = int(jax.device_get(state.step))
+                self.transitions.append(
+                    EpochTransition(epoch=epoch, step=step,
+                                    n_devices=len(devices)))
+                if self.verbose:
+                    log_json({"event": "mesh_formed", "epoch": epoch,
+                              "n_devices": len(devices), "step": step})
+
+                while (step < num_steps and not self._remesh.is_set()
+                       and not self._stop.is_set()):
+                    batch = next(source_iter)
+                    state, metrics = trainer.step(
+                        state, trainer.shard_batch(batch))
+                    loss = float(jax.device_get(metrics["loss"]))
+                    losses.append(loss)
+                    step += 1
+                    if self._agent is not None:
+                        self._agent.report(step, loss)
+
+                # drain is implicit (the step above completed); save before
+                # tearing the mesh down
+                self.ckpt.save(state)
+                self.ckpt.wait()
+                if step >= num_steps or self._stop.is_set():
+                    return state, losses
+        finally:
+            if self._agent is not None:
+                self._agent.stop()

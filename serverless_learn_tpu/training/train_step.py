@@ -43,10 +43,20 @@ class Trainer:
     batch_shardings: Any
 
     def init(self, seed: Optional[int] = None) -> TrainState:
+        self._bind_mesh()
         return self.init_fn(seed if seed is not None else self.config.train.seed)
 
     def step(self, state: TrainState, batch) -> tuple:
+        # (Re)tracing can happen at any step call; bind this trainer's mesh
+        # so mesh-dependent ops (ring attention's shard_map) trace against it
+        # even if another trainer was built since.
+        self._bind_mesh()
         return self.step_fn(state, batch)
+
+    def _bind_mesh(self):
+        from serverless_learn_tpu.parallel.ring_attention import set_active_mesh
+
+        set_active_mesh(self.mesh)
 
     def shard_batch(self, host_batch) -> Any:
         """Place a host batch onto the mesh with the input shardings."""
@@ -64,10 +74,19 @@ def build_trainer(
         mesh = make_mesh(config.mesh)
     tx = make_optimizer(config.optimizer, bundle.trainable_mask)
 
+    # Ring attention (sequence parallelism) shard_maps over this mesh.
+    from serverless_learn_tpu.parallel.ring_attention import set_active_mesh
+
+    set_active_mesh(mesh)
+
     batch_size = config.train.batch_size
     spec = bundle.input_spec(config.data, batch_size)
+    # Sequence-model inputs [B, T] additionally shard T over sp (inert when
+    # sp == 1); image batches stay batch-sharded only.
+    sp_seq = bundle.task in ("lm", "mlm") and mesh.shape["sp"] > 1
     b_shardings = jax.tree_util.tree_map(
-        lambda _: batch_sharding(mesh), spec)
+        lambda s: batch_sharding(mesh, sp_seq=sp_seq and len(s.shape) >= 2),
+        spec)
 
     def init_raw(seed):
         rng = jax.random.PRNGKey(seed)

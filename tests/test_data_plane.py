@@ -78,6 +78,37 @@ def test_synthetic_dataset_deterministic(shard_server):
     c.close()
 
 
+def test_synthetic_unaligned_offsets_consistent(shard_server):
+    """Regression: ranged reads at non-8-aligned offsets must agree with a
+    full read (the stream is keyed by absolute position, not request offset)."""
+    addr, _ = shard_server
+    c = ShardClient(addr)
+    full = c.fetch("synthetic:4096", offset=0, length=4096)
+    for off, ln in [(3, 8), (1, 4095), (7, 9), (13, 100)]:
+        part = c.fetch("synthetic:4096", offset=off, length=ln)
+        assert part == full[off:off + ln], f"offset={off} len={ln}"
+    c.close()
+
+
+def test_fetch_default_length_past_eof_returns_empty(shard_server):
+    addr, _ = shard_server
+    c = ShardClient(addr)
+    c.put("tiny", b"x" * 10)
+    assert c.fetch("tiny", offset=50) == b""
+    c.close()
+
+
+def test_delete_rpc(shard_server):
+    addr, _ = shard_server
+    c = ShardClient(addr)
+    c.put("doomed", b"bye")
+    c.delete("doomed")
+    assert "doomed" not in {b.key for b in c.manifest("")}
+    with pytest.raises(IOError):
+        c.delete("doomed")  # already gone
+    c.close()
+
+
 def test_fetch_into_numpy_buffer(shard_server):
     addr, _ = shard_server
     c = ShardClient(addr)

@@ -1,0 +1,102 @@
+"""Ring attention (sequence parallelism) correctness vs the dense reference
+implementation, and end-to-end training with an sp axis."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.config import (
+    DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig, TrainConfig)
+from serverless_learn_tpu.ops.attention import xla_attention
+from serverless_learn_tpu.parallel.mesh import make_mesh
+from serverless_learn_tpu.parallel.ring_attention import (
+    ring_attention, set_active_mesh)
+
+
+@pytest.fixture()
+def sp_mesh(devices):
+    mesh = make_mesh(MeshConfig(sp=8))
+    set_active_mesh(mesh)
+    yield mesh
+    set_active_mesh(None)
+
+
+def _qkv(rng, B, T, H, D, K=None):
+    K = K or H
+    q = jax.random.normal(rng, (B, T, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (B, T, K, D), jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (B, T, K, D), jnp.float32)
+    return q, k, v
+
+
+def test_ring_matches_dense_full(sp_mesh):
+    q, k, v = _qkv(jax.random.PRNGKey(0), 2, 64, 4, 16)
+    ref = xla_attention(q, k, v, causal=False)
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, causal=False, mesh=sp_mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_matches_dense_causal(sp_mesh):
+    q, k, v = _qkv(jax.random.PRNGKey(1), 2, 64, 4, 16)
+    ref = xla_attention(q, k, v, causal=True)
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, causal=True, mesh=sp_mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_matches_dense_gqa(sp_mesh):
+    q, k, v = _qkv(jax.random.PRNGKey(2), 2, 64, 8, 16, K=2)
+    ref = xla_attention(q, k, v, causal=True)
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, causal=True, mesh=sp_mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_grad_matches_dense(sp_mesh):
+    q, k, v = _qkv(jax.random.PRNGKey(3), 1, 32, 2, 8)
+
+    def loss_ring(q, k, v):
+        return (ring_attention(q, k, v, causal=True, mesh=sp_mesh) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (xla_attention(q, k, v, causal=True) ** 2).sum()
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_llama_trains_with_sp_axis(devices):
+    """End-to-end: llama_tiny with dp=2, sp=4 and ring attention produces the
+    same losses as pure-DP dense attention (fp32)."""
+    from serverless_learn_tpu.data.datasets import SyntheticSource
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    def run(mesh_cfg, overrides):
+        cfg = ExperimentConfig(
+            model="llama_tiny", mesh=mesh_cfg,
+            optimizer=OptimizerConfig(name="sgd", learning_rate=0.05),
+            train=TrainConfig(batch_size=8),
+            data=DataConfig(seq_len=32),
+            model_overrides=overrides)
+        trainer = build_trainer(cfg)
+        state = trainer.init()
+        src = SyntheticSource(trainer.bundle.make_batch, cfg.data, 8, seed=7)
+        losses = []
+        for batch, _ in zip(iter(src), range(3)):
+            state, m = trainer.step(state, trainer.shard_batch(batch))
+            losses.append(float(m["loss"]))
+        return losses
+
+    base = {"dtype": jnp.float32}
+    l_dense = run(MeshConfig(dp=8), dict(base))
+    l_ring = run(MeshConfig(dp=2, sp=4),
+                 dict(base, attention_impl="ring"))
+    np.testing.assert_allclose(l_dense, l_ring, rtol=2e-4)
