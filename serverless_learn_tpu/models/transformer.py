@@ -45,6 +45,8 @@ class TransformerConfig:
     attention_impl: str = "xla"  # "xla" | "flash" | "ring"
     sp_axis: Optional[str] = None  # mesh axis for ring attention
     remat: bool = False
+    pipeline: bool = False  # stack blocks [L,...] and GPipe over the pp axis
+    pipeline_microbatches: int = 4
 
     @property
     def kv_heads(self) -> int:
@@ -157,6 +159,57 @@ class Block(nn.Module):
         return x
 
 
+class PipelinedBlocks(nn.Module):
+    """Block stack with layer-stacked params, executed as a GPipe pipeline.
+
+    Params live under one ``pipe_blocks`` collection whose leaves carry a
+    leading ``n_layers`` dim; the sharding rule table maps that dim to the
+    ``pp`` mesh axis so each pipeline stage holds a contiguous layer slice
+    (``parallel/sharding.py``). Execution delegates to
+    ``parallel.pipeline.gpipe_apply`` (``pp > 1``) or its sequential golden
+    model (``pp == 1``) against the process's active mesh.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, *, mask=None, positions=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(x.shape[1], dtype=jnp.int32)[None, :],
+                (x.shape[0], x.shape[1]))
+
+        def init_stack(rng):
+            dummy = jnp.zeros((1, 4, cfg.d_model), cfg.dtype)
+            dpos = jnp.zeros((1, 4), jnp.int32)
+
+            def one(r):
+                return Block(cfg).init(r, dummy, mask=None,
+                                       positions=dpos)["params"]
+
+            return jax.vmap(one)(jax.random.split(rng, cfg.n_layers))
+
+        stacked = self.param("pipe_blocks", init_stack)
+
+        def block_apply(p, h, pos, m):
+            fn = lambda pp_, h_, pos_, m_: Block(cfg).apply(
+                {"params": pp_}, h_, mask=m_, positions=pos_)
+            if cfg.remat:
+                fn = jax.checkpoint(fn)
+            return fn(p, h, pos, m)
+
+        from serverless_learn_tpu.parallel.pipeline import (
+            gpipe_apply, sequential_apply)
+        from serverless_learn_tpu.parallel.ring_attention import get_active_mesh
+
+        mesh = get_active_mesh()
+        if mesh is None or mesh.shape.get("pp", 1) == 1:
+            return sequential_apply(block_apply, stacked, x, positions, mask)
+        return gpipe_apply(block_apply, stacked, x, positions, mask, mesh=mesh,
+                           n_microbatches=cfg.pipeline_microbatches)
+
+
 class Transformer(nn.Module):
     cfg: TransformerConfig
 
@@ -173,11 +226,16 @@ class Transformer(nn.Module):
             pos_emb = nn.Embed(cfg.max_seq_len, cfg.d_model, name="pos_embedder",
                                dtype=cfg.dtype, param_dtype=cfg.param_dtype)
             x = x + pos_emb(pos)
-        block = Block
-        if cfg.remat:
-            block = nn.remat(Block, static_argnums=())
-        for i in range(cfg.n_layers):
-            x = block(cfg, name=f"layer_{i}")(x, mask=mask, positions=positions)
+        if cfg.pipeline:
+            x = PipelinedBlocks(cfg, name="pipeline")(x, mask=mask,
+                                                      positions=positions)
+        else:
+            block = Block
+            if cfg.remat:
+                block = nn.remat(Block, static_argnums=())
+            for i in range(cfg.n_layers):
+                x = block(cfg, name=f"layer_{i}")(x, mask=mask,
+                                                  positions=positions)
         norm = (nn.RMSNorm if cfg.norm == "rms" else nn.LayerNorm)
         x = norm(dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="norm_f")(x)
         if cfg.tie_embeddings:

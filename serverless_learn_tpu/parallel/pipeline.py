@@ -1,0 +1,158 @@
+"""Pipeline parallelism — GPipe-style microbatched stages over the ``pp`` axis.
+
+A capability the reference never had (its model state is one flat vector on a
+single process, ``src/master.cc:58``; SURVEY.md §2.9 lists PP as absent).
+TPU-native design: transformer blocks are stacked along a leading layer axis
+and sharded over the ``pp`` mesh axis, so each pipeline stage owns a
+contiguous slice of layers in its own HBM. Execution runs under ``shard_map``:
+every tick each stage applies its layer slice to one microbatch and hands the
+activation to the next stage with a nearest-neighbor ``lax.ppermute`` over
+ICI. The schedule is plain GPipe (fill, steady state, drain — bubble fraction
+(S-1)/(M+S-1)); the backward pipeline falls out of JAX autodiff through the
+``lax.scan`` of ticks, so one forward definition yields both directions.
+
+No framework networking is involved: stage hand-off is an XLA collective on
+ICI, keeping BASELINE.md's "zero gRPC bytes on the gradient/activation path"
+invariant.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # JAX >= 0.6 promotes shard_map out of experimental
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def sequential_apply(block_apply: Callable, stacked_params, x, positions,
+                     mask=None):
+    """Reference semantics: apply the stacked layers one after another.
+
+    Used when ``pp == 1`` (single stage) and by tests as the golden model for
+    the pipelined schedule. ``stacked_params`` leaves have a leading layer
+    dim; ``block_apply(params_one_layer, x, positions, mask) -> x``.
+    """
+
+    def layer(h, p):
+        return block_apply(p, h, positions, mask), None
+
+    out, _ = lax.scan(layer, x, stacked_params)
+    return out
+
+
+def gpipe_apply(
+    block_apply: Callable,
+    stacked_params,
+    x,
+    positions,
+    mask=None,
+    *,
+    mesh: Mesh,
+    n_microbatches: int,
+    axis_name: str = "pp",
+    batch_axes=("dp", "fsdp"),
+):
+    """Run the stacked layers as a GPipe pipeline over ``mesh.shape[pp]`` stages.
+
+    Args:
+      block_apply: ``(params_one_layer, h, positions, mask) -> h`` per block.
+      stacked_params: pytree with leading dim ``n_layers`` on every leaf,
+        sharded ``P('pp')`` so each stage holds ``n_layers / S`` layers.
+      x: activations ``[B_global, T, D]``, batch-sharded over ``batch_axes``.
+      positions: ``[B_global, T]`` int32 token positions (RoPE), same batch
+        sharding as ``x``.
+      mask: optional attention mask with leading batch dim (e.g.
+        ``[B, 1, 1, T]``), same batch sharding; microbatched alongside ``x``.
+      n_microbatches: M; the per-device batch must divide by M.
+
+    Returns activations ``[B_global, T, D]``, batch-sharded, replicated over
+    ``pp`` (every stage ends with the final output — the unsharded logits
+    head that follows runs redundantly per stage, the standard trade).
+    """
+    S = mesh.shape[axis_name]
+    if S == 1:
+        return sequential_apply(block_apply, stacked_params, x, positions,
+                                mask)
+    for ax in ("tp", "sp"):
+        if mesh.shape.get(ax, 1) > 1:
+            raise NotImplementedError(
+                f"pipeline parallelism composes with dp/fsdp; mesh axis "
+                f"'{ax}' must be 1 (got {mesh.shape[ax]})")
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_layers % S:
+        raise ValueError(
+            f"n_layers={n_layers} not divisible by pp={S} pipeline stages")
+
+    M = int(n_microbatches)
+    bspec = P(batch_axes)
+    have_mask = mask is not None
+    operands = (stacked_params, x, positions) + ((mask,) if have_mask else ())
+    in_specs = (P(axis_name), bspec, bspec) + ((bspec,) if have_mask else ())
+
+    @partial(
+        _shard_map,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=bspec,
+        check_vma=False,
+    )
+    def run(params_local, x_local, pos_local, *rest):
+        mask_local = rest[0] if rest else None
+        B = x_local.shape[0]
+        if B % M:
+            raise ValueError(
+                f"per-device batch {B} not divisible by {M} microbatches")
+        mb = lambda a: a.reshape(M, B // M, *a.shape[1:])
+        mb_x = mb(x_local)
+        mb_pos = mb(pos_local)
+        mb_mask = mb(mask_local) if mask_local is not None else None
+        stage = lax.axis_index(axis_name)
+
+        def stage_fn(h, pos, m):
+            def layer(carry, p):
+                return block_apply(p, carry, pos, m), None
+
+            out, _ = lax.scan(layer, h, params_local)
+            return out
+
+        # Non-cyclic ring: stage i feeds i+1; the last stage's send is dropped.
+        perm = [(i, i + 1) for i in range(S - 1)]
+        T_ticks = M + S - 1
+
+        def tick(carry, t):
+            recv, out_buf = carry
+            read = jnp.clip(t - stage, 0, M - 1)
+            take = lambda a: lax.dynamic_index_in_dim(a, read, 0,
+                                                      keepdims=False)
+            my_pos = take(mb_pos)
+            my_mask = take(mb_mask) if mb_mask is not None else None
+            my_in = jnp.where(stage == 0, take(mb_x), recv)
+            out = stage_fn(my_in, my_pos, my_mask)
+            # Last stage banks microbatch t-(S-1) once the pipeline is full.
+            w = jnp.clip(t - (S - 1), 0, M - 1)
+            prev = lax.dynamic_index_in_dim(out_buf, w, 0, keepdims=False)
+            write = jnp.logical_and(stage == S - 1, t >= S - 1)
+            out_buf = lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(write, out, prev), w, 0)
+            nxt = lax.ppermute(out, axis_name, perm)
+            return (nxt, out_buf), None
+
+        out_buf0 = jnp.zeros_like(mb_x)
+        (_, out_buf), _ = lax.scan(
+            tick, (jnp.zeros_like(mb_x[0]), out_buf0), jnp.arange(T_ticks))
+        # Only the last stage holds real outputs; psum broadcasts them so the
+        # result is truly replicated over pp (out_specs says so).
+        out_buf = lax.psum(
+            jnp.where(stage == S - 1, out_buf, jnp.zeros_like(out_buf)),
+            axis_name)
+        return out_buf.reshape(B, *x_local.shape[1:])
+
+    return run(*operands)

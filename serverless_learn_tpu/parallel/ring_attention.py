@@ -40,6 +40,12 @@ def set_active_mesh(mesh: Optional[Mesh]) -> None:
     _ACTIVE_MESH = mesh
 
 
+def get_active_mesh() -> Optional[Mesh]:
+    """The mesh registered by ``set_active_mesh`` (shared by the shard_map
+    users inside model code: ring attention and the GPipe block stack)."""
+    return _ACTIVE_MESH
+
+
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
                           softmax_scale: float):
     """Per-device kernel. q,k,v: local shards [B, T_loc, H, D] (kv heads

@@ -83,6 +83,10 @@ def _prune_spec(spec: P, ndim: int, mesh: Mesh) -> P:
 #  - everything additionally shards dim 0 over fsdp (ZeRO-3) when fsdp > 1.
 DEFAULT_RULES = ShardingRules(
     rules=[
+        # GPipe block stacks: leading layer dim shards over pp; inner dims
+        # stay unsharded (stage math runs whole-layer inside shard_map, so
+        # fsdp/tp sharding inside the stack is deliberately not composed).
+        (r"pipe_blocks/", P("pp")),
         (r"(q_proj|k_proj|v_proj)/kernel$", P("fsdp", "tp")),
         (r"o_proj/kernel$", P("tp", None, "fsdp")),
         (r"(wi|wi_0|wi_1|up_proj|gate_proj)/kernel$", P("fsdp", "tp")),

@@ -1,0 +1,131 @@
+"""Pipeline parallelism (GPipe over the ``pp`` mesh axis).
+
+The reference has no pipeline concept (single-process model vector,
+``src/master.cc:58``; SURVEY.md §2.9 PP row: absent). These tests hold the
+pipelined schedule to the sequential golden model, on the 8-virtual-device
+CPU mesh from conftest.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from serverless_learn_tpu.config import (
+    DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig, TrainConfig)
+from serverless_learn_tpu.data.datasets import SyntheticSource
+from serverless_learn_tpu.parallel.mesh import make_mesh
+from serverless_learn_tpu.parallel.pipeline import gpipe_apply, sequential_apply
+from serverless_learn_tpu.training.train_step import build_trainer
+
+
+def _toy_block(p, h, pos, mask=None):
+    out = jnp.tanh(h @ p) + h
+    if mask is not None:
+        out = out * mask[..., None]
+    return out
+
+
+@pytest.fixture(scope="module")
+def pp_mesh(devices):
+    return make_mesh(MeshConfig(dp=2, pp=4))
+
+
+def _toy_inputs(pp_mesh, L=8, D=16, B=8, T=4):
+    W = jax.random.normal(jax.random.PRNGKey(0), (L, D, D)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, T, D))
+    pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T)).astype(jnp.int32)
+    W_s = jax.device_put(W, NamedSharding(pp_mesh, P("pp")))
+    x_s = jax.device_put(x, NamedSharding(pp_mesh, P(("dp", "fsdp"))))
+    pos_s = jax.device_put(pos, NamedSharding(pp_mesh, P(("dp", "fsdp"))))
+    return W, x, pos, W_s, x_s, pos_s
+
+
+def test_gpipe_matches_sequential_forward(pp_mesh):
+    W, x, pos, W_s, x_s, pos_s = _toy_inputs(pp_mesh)
+    ref = jax.jit(lambda w, h, p: sequential_apply(_toy_block, w, h, p))(
+        W, x, pos)
+    out = jax.jit(lambda w, h, p: gpipe_apply(
+        _toy_block, w, h, p, mesh=pp_mesh, n_microbatches=4))(W_s, x_s, pos_s)
+    assert jnp.allclose(ref, jax.device_get(out), atol=1e-5)
+
+
+def test_gpipe_matches_sequential_grads(pp_mesh):
+    W, x, pos, W_s, x_s, pos_s = _toy_inputs(pp_mesh)
+    gref = jax.grad(
+        lambda w: sequential_apply(_toy_block, w, x, pos).sum())(W)
+    gout = jax.jit(jax.grad(lambda w: gpipe_apply(
+        _toy_block, w, x_s, pos_s, mesh=pp_mesh,
+        n_microbatches=4).sum()))(W_s)
+    assert jnp.allclose(gref, jax.device_get(gout), atol=1e-4)
+
+
+def test_gpipe_microbatch_count_independence(pp_mesh):
+    W, x, pos, W_s, x_s, pos_s = _toy_inputs(pp_mesh)
+    outs = [
+        jax.device_get(jax.jit(lambda w, h, p, m=m: gpipe_apply(
+            _toy_block, w, h, p, mesh=pp_mesh, n_microbatches=m))(
+                W_s, x_s, pos_s))
+        for m in (1, 2, 4)
+    ]
+    assert jnp.allclose(outs[0], outs[1], atol=1e-5)
+    assert jnp.allclose(outs[1], outs[2], atol=1e-5)
+
+
+def _train_cfg(mesh_cfg):
+    return ExperimentConfig(
+        model="llama_tiny",
+        model_overrides=dict(pipeline=True, pipeline_microbatches=4,
+                             n_layers=4),
+        mesh=mesh_cfg,
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.1),
+        train=TrainConfig(batch_size=16),
+        data=DataConfig(seq_len=32),
+    )
+
+
+def test_pipelined_train_step_matches_dp(devices):
+    """Same seed, same batches: a dp=2,pp=4 pipelined run must track dp=8."""
+    losses = {}
+    for name, mesh_cfg in (("dp", MeshConfig(dp=8)),
+                           ("pp", MeshConfig(dp=2, pp=4))):
+        cfg = _train_cfg(mesh_cfg)
+        trainer = build_trainer(cfg)
+        state = trainer.init()
+        src = iter(SyntheticSource(trainer.bundle.make_batch, cfg.data,
+                                   cfg.train.batch_size, seed=0))
+        batch = trainer.shard_batch(next(src))
+        for _ in range(3):
+            state, metrics = trainer.step(state, batch)
+        losses[name] = float(jax.device_get(metrics["loss"]))
+    assert abs(losses["dp"] - losses["pp"]) < 5e-3, losses
+
+
+def test_gpipe_threads_mask(pp_mesh):
+    """An attention-style mask rides the microbatch schedule with x."""
+    W, x, pos, W_s, x_s, pos_s = _toy_inputs(pp_mesh)
+    mask = (jax.random.uniform(jax.random.PRNGKey(2), x.shape[:2]) > 0.3
+            ).astype(x.dtype)
+    mask_s = jax.device_put(
+        mask, NamedSharding(pp_mesh, P(("dp", "fsdp"))))
+    ref = jax.jit(lambda w, h, p, m: sequential_apply(
+        _toy_block, w, h, p, m))(W, x, pos, mask)
+    out = jax.jit(lambda w, h, p, m: gpipe_apply(
+        _toy_block, w, h, p, m, mesh=pp_mesh, n_microbatches=4))(
+            W_s, x_s, pos_s, mask_s)
+    assert jnp.allclose(ref, jax.device_get(out), atol=1e-5)
+
+
+def test_gpipe_rejects_indivisible_layers(pp_mesh):
+    W = jax.random.normal(jax.random.PRNGKey(0), (6, 16, 16))  # 6 % 4 != 0
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 4, 16))
+    pos = jnp.zeros((8, 4), jnp.int32)
+    with pytest.raises(ValueError, match="n_layers"):
+        gpipe_apply(_toy_block, W, x, pos, mesh=pp_mesh, n_microbatches=4)
+
+
+def test_pipeline_rejects_tp(devices):
+    mesh = make_mesh(MeshConfig(dp=1, tp=2, pp=4))
+    W, x, pos, *_ = _toy_inputs(make_mesh(MeshConfig(dp=2, pp=4)))
+    with pytest.raises(NotImplementedError):
+        gpipe_apply(_toy_block, W, x, pos, mesh=mesh, n_microbatches=4)
