@@ -27,6 +27,8 @@ class MeshConfig:
     * ``fsdp`` — data parallelism with parameter/optimizer sharding (ZeRO-3
       style; params are all-gathered per layer, grads reduce-scattered).
     * ``tp``  — tensor (model) parallelism over attention heads / MLP hidden.
+    * ``ep``  — expert parallelism (MoE experts sharded over devices; token
+      dispatch/combine become all-to-alls on ICI).
     * ``sp``  — sequence/context parallelism (ring attention over an ICI ring).
     * ``pp``  — pipeline parallelism (stage-sharded, microbatched).
 
@@ -35,15 +37,16 @@ class MeshConfig:
 
     dp: int = 1
     fsdp: int = 1
+    ep: int = 1
     tp: int = 1
     sp: int = 1
     pp: int = 1
 
-    AXIS_NAMES = ("dp", "fsdp", "tp", "sp", "pp")
+    AXIS_NAMES = ("dp", "fsdp", "ep", "tp", "sp", "pp")
 
     @property
     def shape(self) -> Tuple[int, ...]:
-        return (self.dp, self.fsdp, self.tp, self.sp, self.pp)
+        return (self.dp, self.fsdp, self.ep, self.tp, self.sp, self.pp)
 
     @property
     def size(self) -> int:

@@ -11,6 +11,7 @@ import numpy as np
 from serverless_learn_tpu.models.registry import ModelBundle, register_model
 from serverless_learn_tpu.models.transformer import Transformer, TransformerConfig
 from serverless_learn_tpu.ops.losses import masked_lm_loss
+from serverless_learn_tpu.ops.moe import apply_with_losses
 
 MASK_TOKEN = 1  # synthetic vocab: 0=pad, 1=[MASK]
 
@@ -33,10 +34,14 @@ def _bundle(cfg: TransformerConfig, mask_rate: float = 0.15):
     module = Transformer(cfg)
 
     def loss_fn(params, batch, rngs=None, model_state=None):
-        logits = module.apply({"params": params}, batch["tokens"],
-                              mask=batch["attn_mask"][:, None, None, :])
+        # apply_with_losses so n_experts model_overrides keep their aux loss
+        logits, aux = apply_with_losses(
+            module, params, batch["tokens"],
+            mask=batch["attn_mask"][:, None, None, :])
         loss, metrics = masked_lm_loss(logits, batch["labels"], batch["mlm_mask"])
-        return loss, {"metrics": metrics, "model_state": {}}
+        if cfg.n_experts > 0:
+            metrics = dict(metrics, moe_aux_loss=aux)
+        return loss + aux, {"metrics": metrics, "model_state": {}}
 
     def input_spec(data_config, batch_size):
         T = data_config.seq_len

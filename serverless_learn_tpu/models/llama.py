@@ -16,6 +16,7 @@ import numpy as np
 from serverless_learn_tpu.models.registry import ModelBundle, register_model
 from serverless_learn_tpu.models.transformer import Transformer, TransformerConfig
 from serverless_learn_tpu.ops.losses import causal_lm_loss
+from serverless_learn_tpu.ops.moe import apply_with_losses
 
 
 def _llama_cfg(size: str, **overrides) -> TransformerConfig:
@@ -38,9 +39,12 @@ def _bundle(cfg: TransformerConfig):
     module = Transformer(cfg)
 
     def loss_fn(params, batch, rngs=None, model_state=None):
-        logits = module.apply({"params": params}, batch["tokens"])
+        # apply_with_losses so n_experts model_overrides keep their aux loss
+        logits, aux = apply_with_losses(module, params, batch["tokens"])
         loss, metrics = causal_lm_loss(logits, batch["tokens"])
-        return loss, {"metrics": metrics, "model_state": {}}
+        if cfg.n_experts > 0:
+            metrics = dict(metrics, moe_aux_loss=aux)
+        return loss + aux, {"metrics": metrics, "model_state": {}}
 
     def input_spec(data_config, batch_size):
         return {"tokens": jax.ShapeDtypeStruct(

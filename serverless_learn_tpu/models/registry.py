@@ -37,7 +37,8 @@ def register_model(name: str):
 
 def get_model(name: str, **overrides) -> ModelBundle:
     # Import model modules lazily so the registry populates on first use.
-    from serverless_learn_tpu.models import mlp, resnet, bert, llama  # noqa: F401
+    from serverless_learn_tpu.models import (  # noqa: F401
+        mlp, resnet, bert, llama, moe)
 
     if name not in _REGISTRY:
         raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
@@ -45,6 +46,7 @@ def get_model(name: str, **overrides) -> ModelBundle:
 
 
 def list_models():
-    from serverless_learn_tpu.models import mlp, resnet, bert, llama  # noqa: F401
+    from serverless_learn_tpu.models import (  # noqa: F401
+        mlp, resnet, bert, llama, moe)
 
     return sorted(_REGISTRY)

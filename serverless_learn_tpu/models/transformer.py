@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from serverless_learn_tpu.ops.attention import dot_product_attention
+from serverless_learn_tpu.ops.moe import MoELayer
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,6 +40,11 @@ class TransformerConfig:
     activation: str = "swiglu"  # "swiglu" | "gelu"
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    n_experts: int = 0  # 0 => dense MLP; >0 => MoE (ops/moe.py), ep-shardable
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    moe_group_size: int = 1024  # routing-subgroup token count (0 => full row)
     tie_embeddings: bool = False
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
@@ -155,7 +161,10 @@ class Block(nn.Module):
                                     name=name)
         x = x + Attention(cfg, name="attn")(
             mk_norm("norm_attn")(x), mask=mask, positions=positions)
-        x = x + MlpBlock(cfg, name="mlp")(mk_norm("norm_mlp")(x))
+        if cfg.n_experts > 0:
+            x = x + MoELayer(cfg, name="moe")(mk_norm("norm_mlp")(x))
+        else:
+            x = x + MlpBlock(cfg, name="mlp")(mk_norm("norm_mlp")(x))
         return x
 
 
@@ -217,6 +226,13 @@ class Transformer(nn.Module):
     def __call__(self, tokens, *, mask=None, positions=None):
         """tokens [B, T] int32 -> logits [B, T, vocab]."""
         cfg = self.cfg
+        if cfg.pipeline and cfg.n_experts > 0:
+            # GPipe stages re-apply Block under a nested module.apply that
+            # does not thread the "losses" sow collection, which would
+            # silently drop the MoE load-balance loss — reject instead.
+            raise NotImplementedError(
+                "pipeline=True with n_experts>0 is not supported: the MoE "
+                "router aux loss cannot propagate out of pipeline stages")
         embed = nn.Embed(cfg.vocab_size, cfg.d_model, name="embedder",
                          dtype=cfg.dtype, param_dtype=cfg.param_dtype)
         x = embed(tokens)

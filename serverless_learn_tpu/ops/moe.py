@@ -1,0 +1,140 @@
+"""Mixture-of-experts layer with expert parallelism over the ``ep`` mesh axis.
+
+The reference has no concept of conditional computation (its model is a flat
+``repeated double``, ``src/protos/serverless_learn.proto:81-83``); this module
+exists so the framework covers expert parallelism alongside dp/fsdp/tp/sp/pp
+(SURVEY.md §2.9's strategy checklist).
+
+TPU-first design — the GShard/Switch "dense dispatch" formulation rather than
+gather/scatter: routing produces *static-shape* dispatch/combine tensors and
+the token→expert shuffle is two einsums, which XLA partitions into all-to-alls
+over the ``ep`` axis when the expert dimension is sharded. No dynamic shapes,
+no sorts on the hot path; expert FFNs are batched 3-D matmuls that tile onto
+the MXU. Over-capacity tokens are dropped by construction (their slot one-hot
+is all-zero) and pass through on the residual branch.
+
+Tokens are routed in *groups* (the GShard recipe): each batch row is split
+into subgroups of at most ``moe_group_size`` tokens, and slot competition,
+capacity, and the dispatch tensors are all per-group. Memory for the one-hot
+dispatch intermediates is therefore ``O(tokens × group_size)`` — independent
+of sequence length and of the global token count — and the routing cumsum
+never crosses the dp-sharded batch axis (each dp shard routes its own rows:
+no cross-replica slot competition, no all-reduce on dispatch), so per-device
+expert compute scales down with dp.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def top_k_routing(router_logits: jax.Array, n_experts: int, top_k: int,
+                  capacity: int):
+    """Static-shape grouped top-k routing with per-group expert capacity.
+
+    Args:
+      router_logits: [G, S, E] float32 — G independent routing groups of S
+        tokens each (callers use one group per batch row).
+      capacity: slots per expert per group (C).
+
+    Returns:
+      dispatch: [G, S, E, C] {0,1} — token (g, s) occupies slot c of expert e.
+      combine:  [G, S, E, C] float32 — dispatch weighted by the (renormalized)
+        gate probability.
+      aux: scalar load-balance loss (Switch-style: E * Σ_e frac_e · prob_e,
+        computed over all groups).
+    """
+    G, S, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), -1)  # [G, S, E]
+    gate_w, gate_idx = jax.lax.top_k(probs, top_k)  # [G, S, K]
+    gate_w = gate_w / jnp.maximum(gate_w.sum(-1, keepdims=True), 1e-9)
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)  # [G, S, K, E]
+
+    # Slot assignment within each group: all 1st choices take priority over
+    # 2nd choices, and within a choice rank tokens queue in order — arrange
+    # [G, K*S, E] with k major, exclusive-cumsum over positions, undo.
+    flat = jnp.swapaxes(onehot, 1, 2).reshape(G, top_k * S, E)
+    pos = jnp.cumsum(flat, axis=1) - flat
+    pos = jnp.swapaxes(pos.reshape(G, top_k, S, E), 1, 2)  # [G, S, K, E]
+
+    # one_hot maps out-of-range positions (>= capacity) to all-zero rows, so
+    # capacity overflow drops tokens without any branching.
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                          dtype=jnp.float32)  # [G, S, K, E, C]
+    disp_k = onehot[..., None] * slot  # [G, S, K, E, C]
+    combine = jnp.einsum("gsk,gskec->gsec", gate_w, disp_k)
+    dispatch = (disp_k.sum(axis=2) > 0).astype(jnp.float32)
+
+    # Load-balance: fraction of tokens whose FIRST choice is e, times mean
+    # router prob for e; minimized (== 1) when routing is uniform.
+    frac = onehot[:, :, 0, :].mean(axis=(0, 1))  # [E]
+    mean_prob = probs.mean(axis=(0, 1))  # [E]
+    aux = n_experts * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def apply_with_losses(module, params, *args, **kwargs):
+    """``module.apply`` that consumes the ``"losses"`` collection.
+
+    Returns ``(out, aux)`` where ``aux`` is the sum of every sown loss (0.0
+    when the model sows none). Model bundles must route ``apply`` through
+    this helper so that enabling MoE via ``model_overrides`` (``n_experts``)
+    can never silently drop the router load-balance loss.
+    """
+    out, mutables = module.apply({"params": params}, *args,
+                                 mutable=["losses"], **kwargs)
+    leaves = jax.tree_util.tree_leaves(mutables.get("losses", {}))
+    aux = sum(jnp.sum(leaf) for leaf in leaves) if leaves else jnp.float32(0.0)
+    return out, aux
+
+
+class MoELayer(nn.Module):
+    """Drop-in MLP replacement: top-k routed SwiGLU experts.
+
+    Expert weights are stacked on a leading ``[n_experts, ...]`` dim that the
+    sharding rule table maps to ``ep`` (``parallel/sharding.py``); the two
+    dispatch/combine einsums then induce ICI all-to-alls under GSPMD. The aux
+    load-balance loss is sown into the ``"losses"`` collection — model
+    bundles apply through ``apply_with_losses`` to add it to the task loss.
+    """
+
+    cfg: "TransformerConfig"  # noqa: F821 — transformer.py's config
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:  # [B, T, D] -> [B, T, D]
+        cfg = self.cfg
+        E, K, F = cfg.n_experts, cfg.moe_top_k, cfg.d_ff
+        B, T, D = x.shape
+        # Split each row into routing subgroups of <= moe_group_size tokens
+        # (largest divisor of T that fits) so the one-hot dispatch
+        # intermediates stay bounded at long sequence length.
+        limit = min(cfg.moe_group_size or T, T)
+        gs = max(d for d in range(1, limit + 1) if T % d == 0)
+        x = x.reshape(B * (T // gs), gs, D)  # [G, S, D]
+        capacity = max(1, int(cfg.moe_capacity_factor * K * gs / E))
+
+        router = self.param(
+            "router", nn.initializers.normal(0.02), (D, E), cfg.param_dtype)
+        logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32),
+                            router.astype(jnp.float32))
+        dispatch, combine, aux = top_k_routing(logits, E, K, capacity)
+        self.sow("losses", "moe_aux", cfg.moe_aux_weight * aux)
+
+        init = nn.initializers.lecun_normal(in_axis=1, out_axis=2)
+        w_gate = self.param("expert_gate", init, (E, D, F), cfg.param_dtype)
+        w_up = self.param("expert_up", init, (E, D, F), cfg.param_dtype)
+        w_down = self.param("expert_down", init, (E, F, D), cfg.param_dtype)
+
+        # Dispatch tokens to expert slots; with batch over dp and experts
+        # over ep, GSPMD lowers the e-contraction to an ICI all-to-all.
+        xe = jnp.einsum("btec,btd->becd", dispatch.astype(cfg.dtype),
+                        x.astype(cfg.dtype))  # [B, E, C, D]
+        h = nn.silu(jnp.einsum("becd,edf->becf", xe, w_gate.astype(cfg.dtype)))
+        h = h * jnp.einsum("becd,edf->becf", xe, w_up.astype(cfg.dtype))
+        ye = jnp.einsum("becf,efd->becd", h, w_down.astype(cfg.dtype))
+        # Combine back to token order, gate-weighted (second all-to-all).
+        y = jnp.einsum("btec,becd->btd", combine.astype(jnp.float32),
+                       ye.astype(jnp.float32))
+        return y.reshape(B, T, D).astype(cfg.dtype)

@@ -23,12 +23,15 @@ def make_mesh(
     config: MeshConfig,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a Mesh with axes (dp, fsdp, tp, sp, pp) of the configured sizes.
+    """Build a Mesh with axes (dp, fsdp, ep, tp, sp, pp) of the configured
+    sizes.
 
     Axis order puts ``dp`` outermost and ``pp`` innermost; on real hardware
     `jax.devices()` order follows the physical torus so that the innermost
     axes (tp/sp) land on nearest-neighbor ICI links, which is what ring
-    attention and tensor-parallel all-reduces want.
+    attention and tensor-parallel all-reduces want; ``ep`` sits between
+    ``fsdp`` and ``tp`` so MoE dispatch all-to-alls stay on short paths
+    without displacing the tp all-reduces from the innermost links.
     """
     if devices is None:
         devices = jax.devices()

@@ -87,6 +87,11 @@ DEFAULT_RULES = ShardingRules(
         # stay unsharded (stage math runs whole-layer inside shard_map, so
         # fsdp/tp sharding inside the stack is deliberately not composed).
         (r"pipe_blocks/", P("pp")),
+        # MoE (ops/moe.py): experts stacked on dim 0 shard over ep; inner
+        # dims follow the dense-MLP tp/fsdp convention. Router replicated.
+        (r"moe/expert_(gate|up)$", P("ep", "fsdp", "tp")),
+        (r"moe/expert_down$", P("ep", "tp", "fsdp")),
+        (r"moe/router$", P()),
         (r"(q_proj|k_proj|v_proj)/kernel$", P("fsdp", "tp")),
         (r"o_proj/kernel$", P("tp", None, "fsdp")),
         (r"(wi|wi_0|wi_1|up_proj|gate_proj)/kernel$", P("fsdp", "tp")),

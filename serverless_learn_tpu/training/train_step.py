@@ -95,7 +95,10 @@ def build_trainer(
         first = next(iter(dummy.values())) if isinstance(dummy, dict) else dummy
         variables = bundle.module.init(rng, first)
         params = variables["params"]
-        model_state = {k: v for k, v in variables.items() if k != "params"}
+        # "losses" is an ephemeral sow target (MoE aux), not model state —
+        # keeping it would freeze init-time scalars into checkpoints.
+        model_state = {k: v for k, v in variables.items()
+                       if k not in ("params", "losses")}
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,

@@ -16,7 +16,8 @@ def test_mesh_shapes(devices):
     mesh = make_mesh(MeshConfig(dp=8))
     assert mesh.shape["dp"] == 8 and mesh.shape["tp"] == 1
     mesh = make_mesh(MeshConfig(dp=2, tp=2, sp=2))
-    assert mesh.shape == {"dp": 2, "fsdp": 1, "tp": 2, "sp": 2, "pp": 1}
+    assert mesh.shape == {"dp": 2, "fsdp": 1, "ep": 1, "tp": 2, "sp": 2,
+                          "pp": 1}
 
 
 def test_mesh_size_mismatch(devices):

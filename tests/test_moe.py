@@ -1,0 +1,193 @@
+"""Mixture-of-experts: routing correctness, dense equivalence, and
+expert-parallel (ep axis) training equivalence vs pure DP."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.config import (
+    DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig, TrainConfig)
+from serverless_learn_tpu.models.transformer import TransformerConfig
+from serverless_learn_tpu.ops.moe import MoELayer, top_k_routing
+from serverless_learn_tpu.parallel.mesh import make_mesh
+from serverless_learn_tpu.parallel.sharding import specs_for_tree
+from jax.sharding import PartitionSpec as P
+
+
+def test_top_k_routing_shapes_and_mass():
+    rng = jax.random.PRNGKey(0)
+    logits = jax.random.normal(rng, (2, 16, 4))  # 2 groups of 16 tokens
+    dispatch, combine, aux = top_k_routing(logits, n_experts=4, top_k=2,
+                                           capacity=16)
+    assert dispatch.shape == (2, 16, 4, 16) and combine.shape == (2, 16, 4, 16)
+    # ample capacity => every token lands exactly top_k slots
+    np.testing.assert_allclose(np.asarray(dispatch.sum((2, 3))), 2.0)
+    # combine weights renormalized over the chosen experts => sum to 1
+    np.testing.assert_allclose(np.asarray(combine.sum((2, 3))), 1.0,
+                               rtol=1e-5)
+    assert float(aux) >= 1.0 - 1e-5  # uniform routing minimizes at 1
+
+
+def test_capacity_drops_overflow_tokens():
+    # All tokens prefer expert 0; capacity 2 keeps only the first 2 PER GROUP.
+    logits = jnp.tile(jnp.array([[[10.0, 0.0, 0.0, 0.0]]]), (2, 8, 1))
+    dispatch, _, _ = top_k_routing(logits, n_experts=4, top_k=1, capacity=2)
+    per_expert = np.asarray(dispatch.sum((0, 1, 3)))
+    assert per_expert[0] == 4.0  # 2 groups x capacity 2; rest dropped
+
+
+def test_routing_is_group_local():
+    """A hot group cannot steal capacity from another group's experts."""
+    g0 = jnp.tile(jnp.array([[10.0, 0.0]]), (6, 1))  # all want expert 0
+    g1 = jnp.stack([jnp.array([10.0, 0.0]),
+                    *([jnp.array([0.0, 10.0])] * 5)])  # one wants expert 0
+    logits = jnp.stack([g0, g1])  # [2, 6, 2]
+    dispatch, _, _ = top_k_routing(logits, n_experts=2, top_k=1, capacity=3)
+    kept_e0 = np.asarray(dispatch.sum((1, 3)))[:, 0]
+    assert kept_e0[0] == 3.0  # group 0 saturates its own capacity
+    assert kept_e0[1] == 1.0  # group 1's lone expert-0 token unaffected
+
+
+def test_moe_layer_matches_manual_dense_top1():
+    """top-1 routing with ample capacity == applying each token's argmax
+    expert FFN directly."""
+    cfg = TransformerConfig(d_model=16, d_ff=32, n_experts=4, moe_top_k=1,
+                            moe_capacity_factor=8.0, dtype=jnp.float32,
+                            param_dtype=jnp.float32)
+    layer = MoELayer(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+    params = layer.init(jax.random.PRNGKey(2), x)["params"]
+    y, _ = layer.apply({"params": params}, x, mutable=["losses"])
+
+    xf = np.asarray(x).reshape(-1, 16)
+    router = np.asarray(params["router"])
+    choice = (xf @ router).argmax(-1)
+    wg, wu, wd = (np.asarray(params["expert_gate"]),
+                  np.asarray(params["expert_up"]),
+                  np.asarray(params["expert_down"]))
+    silu = lambda a: a / (1.0 + np.exp(-a))
+    expect = np.stack([
+        (silu(t @ wg[e]) * (t @ wu[e])) @ wd[e]
+        for t, e in zip(xf, choice)])
+    np.testing.assert_allclose(np.asarray(y).reshape(-1, 16), expect,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_expert_sharding_rules(devices):
+    mesh = make_mesh(MeshConfig(dp=2, ep=2, tp=2))
+    tree = {"layer_0": {"moe": {
+        "expert_gate": jnp.zeros((4, 16, 32)),
+        "expert_down": jnp.zeros((4, 32, 16)),
+        "router": jnp.zeros((16, 4)),
+    }}}
+    specs = specs_for_tree(tree, mesh)["layer_0"]["moe"]
+    assert specs["expert_gate"] == P("ep", None, "tp")
+    assert specs["expert_down"] == P("ep", "tp")
+    assert specs["router"] == P()
+
+
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(dp=2, ep=4),
+    MeshConfig(dp=2, ep=2, tp=2),
+])
+def test_moe_trains_ep_matches_dp(devices, mesh_cfg):
+    """Expert-parallel training produces the same losses as pure DP — the
+    sharding changes the collectives, not the math."""
+    from serverless_learn_tpu.data.datasets import SyntheticSource
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    def run(mcfg):
+        cfg = ExperimentConfig(
+            model="moe_tiny",
+            model_overrides=dict(dtype=jnp.float32),
+            mesh=mcfg,
+            optimizer=OptimizerConfig(name="sgd", learning_rate=0.05),
+            train=TrainConfig(batch_size=8),
+            data=DataConfig(seq_len=32))
+        trainer = build_trainer(cfg)
+        state = trainer.init()
+        src = SyntheticSource(trainer.bundle.make_batch, cfg.data, 8, seed=11)
+        losses = []
+        for batch, _ in zip(iter(src), range(3)):
+            state, m = trainer.step(state, trainer.shard_batch(batch))
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        return losses
+
+    np.testing.assert_allclose(run(MeshConfig(dp=8)), run(mesh_cfg),
+                               rtol=2e-4)
+
+
+def test_pipeline_plus_moe_rejected(devices):
+    """pipeline stages can't thread the sown aux loss — must raise, not
+    silently train without load-balance pressure."""
+    from serverless_learn_tpu.models.registry import get_model
+
+    bundle = get_model("moe_tiny", pipeline=True)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    with pytest.raises(NotImplementedError, match="pipeline"):
+        bundle.module.init(jax.random.PRNGKey(0), tokens)
+
+
+def test_moe_group_size_bounds_capacity_without_changing_math():
+    """With ample capacity, subgroup routing (moe_group_size < T) gives the
+    same output as whole-row routing — groups only bound slot competition."""
+    mk = lambda gs: TransformerConfig(
+        d_model=16, d_ff=32, n_experts=4, moe_top_k=2,
+        moe_capacity_factor=8.0, moe_group_size=gs,
+        dtype=jnp.float32, param_dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 16, 16))
+    params = MoELayer(mk(0)).init(jax.random.PRNGKey(4), x)["params"]
+    y_row, _ = MoELayer(mk(0)).apply({"params": params}, x,
+                                     mutable=["losses"])
+    y_grp, _ = MoELayer(mk(4)).apply({"params": params}, x,
+                                     mutable=["losses"])
+    np.testing.assert_allclose(np.asarray(y_row), np.asarray(y_grp),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_n_experts_override_keeps_aux_loss(devices):
+    """Enabling MoE on a dense family via model_overrides must not silently
+    drop the router load-balance loss (all bundles use apply_with_losses)."""
+    from serverless_learn_tpu.models.registry import get_model
+
+    bundle = get_model("llama_tiny", n_experts=4, dtype=jnp.float32)
+    batch = bundle.make_batch(np.random.default_rng(0),
+                              DataConfig(seq_len=16), 4)
+    params = bundle.module.init(jax.random.PRNGKey(0), batch["tokens"])["params"]
+    loss, _ = bundle.loss_fn(params, batch)
+    from serverless_learn_tpu.ops.losses import causal_lm_loss
+    from serverless_learn_tpu.ops.moe import apply_with_losses
+
+    logits, aux = apply_with_losses(bundle.module, params, batch["tokens"])
+    lm_only, _ = causal_lm_loss(logits, batch["tokens"])
+    assert float(aux) > 0.0
+    np.testing.assert_allclose(float(loss), float(lm_only) + float(aux),
+                               rtol=1e-6)
+
+
+def test_moe_init_state_has_no_losses_collection(devices):
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    cfg = ExperimentConfig(
+        model="moe_tiny", mesh=MeshConfig(dp=8),
+        train=TrainConfig(batch_size=8), data=DataConfig(seq_len=16))
+    trainer = build_trainer(cfg)
+    state = trainer.init()
+    assert "losses" not in state.model_state
+
+
+def test_moe_aux_loss_reported(devices):
+    from serverless_learn_tpu.data.datasets import SyntheticSource
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    cfg = ExperimentConfig(
+        model="moe_tiny", mesh=MeshConfig(dp=8),
+        train=TrainConfig(batch_size=8), data=DataConfig(seq_len=16))
+    trainer = build_trainer(cfg)
+    state = trainer.init()
+    src = SyntheticSource(trainer.bundle.make_batch, cfg.data, 8, seed=0)
+    _, m = trainer.step(state, trainer.shard_batch(next(iter(src))))
+    aux = float(jax.device_get(m["moe_aux_loss"]))
+    assert np.isfinite(aux) and aux > 0.0
