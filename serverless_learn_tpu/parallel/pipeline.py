@@ -81,7 +81,7 @@ def gpipe_apply(
     if S == 1:
         return sequential_apply(block_apply, stacked_params, x, positions,
                                 mask)
-    for ax in ("tp", "sp"):
+    for ax in ("ep", "tp", "sp"):
         if mesh.shape.get(ax, 1) > 1:
             raise NotImplementedError(
                 f"pipeline parallelism composes with dp/fsdp; mesh axis "

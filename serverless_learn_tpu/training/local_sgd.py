@@ -1,0 +1,300 @@
+"""Local SGD with gossip or DiLoCo-style outer synchronization.
+
+The reference's headline model-sync mechanism is asynchronous *gossip*: each
+node trains locally and, on a timer, exchanges model deltas with ONE random
+peer, applying the remote delta at ``LEARN_RATE = 0.5``
+(``src/worker.cc:194-219``, ``src/master.cc:58-60,95-114``). The framework's
+default trainer replaces that with exact per-step all-reduce (zero gossip
+rounds); this module is the *faithful* TPU-native descendant for workloads
+that want gossip's communication pattern — infrequent, pairwise, inexact
+model mixing — but on ICI instead of gRPC:
+
+* Each ``dp``-axis replica trains **independently** for ``inner_steps``
+  batches: parameters carry a leading replica dimension sharded over ``dp``,
+  and the vmapped inner step compiles to purely replica-local compute — no
+  collectives at all between syncs (the analogue of the reference's nodes
+  training between gossip timers).
+* Every ``inner_steps``, one **outer sync** runs:
+  - ``outer="gossip"`` — one hypercube round: replica ``i`` mixes with
+    partner ``i XOR 2^(round mod log2 R)`` via ``lax.ppermute``, applying
+    ``p += mix_rate * (partner - p)`` — the reference's delta-apply rule
+    (rate 0.5 default), but deterministic, deadlock-free, and in one ICI hop
+    instead of a gRPC round-trip. With ``mix_rate=0.5``, ``log2 R``
+    consecutive rounds reproduce the exact global average.
+  - ``outer="average"`` — DiLoCo-style: the replica-mean delta from the last
+    anchor is fed to an outer SGD-with-Nesterov-momentum step on the anchor
+    parameters, and all replicas restart from the new anchor.
+
+Elasticity note: because replicas only meet at outer syncs, membership
+changes (the elastic controller re-meshing, ``training/elastic.py``) only
+need to land on outer-sync boundaries — the same property the reference's
+gossip bought with its tolerance of stale peers.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from serverless_learn_tpu.config import ExperimentConfig
+from serverless_learn_tpu.models.registry import get_model
+from serverless_learn_tpu.parallel.mesh import make_mesh
+from serverless_learn_tpu.training.optimizer import make_optimizer
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import flax.struct
+
+
+@flax.struct.dataclass
+class LocalSGDState:
+    step: Any  # scalar int32 — global inner-step counter
+    params: Any  # leaves [R, ...] — per-replica parameters
+    opt_state: Any  # leaves [R, ...] — per-replica inner optimizer state
+    anchor: Any  # leaves [...] — outer anchor params ("average" mode)
+    outer_opt_state: Any  # outer optimizer state ("average" mode)
+
+
+def replica_divergence(params) -> jax.Array:
+    """Max over leaves of max |p_r - mean_r p| — 0 iff replicas agree."""
+    leaves = jax.tree_util.tree_leaves(params)
+    divs = [jnp.max(jnp.abs(l - l.mean(0, keepdims=True))) for l in leaves]
+    return jnp.max(jnp.stack([jnp.asarray(d, jnp.float32) for d in divs]))
+
+
+class LocalSGDTrainer:
+    """Gossip / DiLoCo trainer over the mesh's ``dp`` axis.
+
+    v1 constraint: the replica axis is ``dp`` and all other mesh axes must be
+    1 (each replica is a single chip); composing per-replica fsdp/tp is
+    future work.
+    """
+
+    def __init__(
+        self,
+        config: ExperimentConfig,
+        mesh: Optional[Mesh] = None,
+        inner_steps: int = 8,
+        outer: str = "gossip",  # "gossip" | "average"
+        mix_rate: float = 0.5,  # reference LEARN_RATE (src/master.cc:60)
+        outer_lr: float = 0.7,
+        outer_momentum: float = 0.9,
+    ):
+        if mesh is None:
+            mesh = make_mesh(config.mesh)
+        for ax in ("fsdp", "ep", "tp", "sp", "pp"):
+            if mesh.shape[ax] != 1:
+                raise ValueError(f"local SGD uses only the dp axis; {ax}="
+                                 f"{mesh.shape[ax]}")
+        if outer not in ("gossip", "average"):
+            raise ValueError(f"outer must be 'gossip' or 'average', "
+                             f"got {outer!r}")
+        self.R = mesh.shape["dp"]
+        if outer == "gossip" and (self.R & (self.R - 1)):
+            raise ValueError(f"gossip needs a power-of-two replica count, "
+                             f"got {self.R}")
+        if config.train.batch_size % self.R:
+            raise ValueError(f"batch {config.train.batch_size} not divisible "
+                             f"by {self.R} replicas")
+        self.config = config
+        self.mesh = mesh
+        self.inner_steps = inner_steps
+        self.outer = outer
+        self.mix_rate = mix_rate
+        self.bundle = get_model(config.model, **config.model_overrides)
+        self.tx = make_optimizer(config.optimizer, self.bundle.trainable_mask)
+        self.outer_tx = optax.sgd(outer_lr, momentum=outer_momentum,
+                                  nesterov=True)
+        self._round = 0  # host-side outer-round counter (gossip schedule)
+        self._gossip_jits: Dict[int, Callable] = {}
+        self._build()
+
+    # -- construction ------------------------------------------------------
+
+    def _build(self):
+        cfg, mesh, R = self.config, self.mesh, self.R
+        bundle, tx = self.bundle, self.tx
+        per_replica = cfg.train.batch_size // R
+        spec = bundle.input_spec(cfg.data, per_replica)
+
+        # v1 supports stateless models only (no batch_stats etc.): the inner
+        # step would otherwise need per-replica model_state threading.
+        dummy = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), spec)
+        first = next(iter(dummy.values())) if isinstance(dummy, dict) else dummy
+        collections = jax.eval_shape(
+            lambda: bundle.module.init(jax.random.PRNGKey(0), first))
+        extra = [k for k in collections if k not in ("params", "losses")]
+        if extra:
+            raise ValueError(f"local SGD supports stateless models; "
+                             f"{cfg.model} has collections {extra}")
+
+        self.batch_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, P("dp")), spec)
+
+        average_mode = self.outer == "average"
+
+        def init_raw(seed):
+            rng = jax.random.PRNGKey(seed)
+            params = bundle.module.init(rng, first)["params"]
+            tile = lambda p: jnp.broadcast_to(p[None], (R,) + p.shape)
+            params_r = jax.tree_util.tree_map(tile, params)
+            opt_r = jax.vmap(tx.init)(params_r)
+            return LocalSGDState(
+                step=jnp.zeros((), jnp.int32),
+                params=params_r,
+                opt_state=opt_r,
+                # anchor + outer momentum exist only in DiLoCo mode — in
+                # gossip mode they'd be a dead 2x-params HBM cost.
+                anchor=params if average_mode else {},
+                outer_opt_state=(self.outer_tx.init(params)
+                                 if average_mode else {}),
+            )
+
+        abstract = jax.eval_shape(init_raw, 0)
+        shard_r = lambda tree: jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P("dp")), tree)
+        repl = lambda tree: jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P()), tree)
+        self.state_shardings = LocalSGDState(
+            step=NamedSharding(mesh, P()),
+            params=shard_r(abstract.params),
+            opt_state=shard_r(abstract.opt_state),
+            anchor=repl(abstract.anchor),
+            outer_opt_state=repl(abstract.outer_opt_state),
+        )
+        self.init_fn = jax.jit(init_raw, static_argnums=(0,),
+                               out_shardings=self.state_shardings)
+
+        def one_replica(params, opt_state, batch, rng):
+            def loss_fn(p):
+                loss, aux = bundle.loss_fn(p, batch, rngs=rng)
+                return loss, aux
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = jax.tree_util.tree_map(
+                lambda p, u: p + u.astype(p.dtype), params, updates)
+            return new_params, new_opt, loss
+
+        st_sh = self.state_shardings
+
+        @partial(jax.jit, donate_argnums=(0,),
+                 in_shardings=(st_sh, self.batch_shardings),
+                 out_shardings=(st_sh, NamedSharding(mesh, P("dp"))))
+        def inner_step(state: LocalSGDState, batch):
+            rngs = jax.vmap(
+                lambda i: jax.random.fold_in(
+                    jax.random.fold_in(jax.random.PRNGKey(cfg.train.seed), i),
+                    state.step))(jnp.arange(R))
+            new_params, new_opt, losses = jax.vmap(one_replica)(
+                state.params, state.opt_state, batch, rngs)
+            return state.replace(step=state.step + 1, params=new_params,
+                                 opt_state=new_opt), losses
+
+        self.inner_step = inner_step
+
+        if not average_mode:
+            self.average_sync = None
+            return
+
+        @partial(jax.jit, donate_argnums=(0,),
+                 in_shardings=(st_sh,), out_shardings=st_sh)
+        def average_sync(state: LocalSGDState):
+            # DiLoCo outer step: outer grad = anchor - mean(replicas).
+            mean_params = jax.tree_util.tree_map(
+                lambda p: p.mean(0).astype(p.dtype), state.params)
+            outer_grad = jax.tree_util.tree_map(
+                lambda a, m: (a - m).astype(jnp.float32),
+                state.anchor, mean_params)
+            updates, new_outer = self.outer_tx.update(
+                outer_grad, state.outer_opt_state, state.anchor)
+            new_anchor = jax.tree_util.tree_map(
+                lambda a, u: a + u.astype(a.dtype), state.anchor, updates)
+            tile = lambda p: jnp.broadcast_to(
+                p[None], (R,) + p.shape).astype(p.dtype)
+            return state.replace(
+                params=jax.tree_util.tree_map(tile, new_anchor),
+                anchor=new_anchor,
+                outer_opt_state=new_outer)
+
+        self.average_sync = average_sync
+
+    def _gossip_sync_for_bit(self, bit: int) -> Callable:
+        """Jitted one-hypercube-round gossip mix (partner = i XOR 2^bit)."""
+        if bit in self._gossip_jits:
+            return self._gossip_jits[bit]
+        mesh, R, rate = self.mesh, self.R, self.mix_rate
+        perm = [(j, j ^ (1 << bit)) for j in range(R)]
+
+        def mix_leaf(p):  # inside shard_map: leading dim 1 (this replica)
+            partner = jax.lax.ppermute(p, "dp", perm)
+            # The reference's delta-apply (src/worker.cc:91-94): mix toward
+            # the partner's model at the gossip learn rate.
+            return p + rate * (partner - p).astype(p.dtype)
+
+        @partial(jax.jit, donate_argnums=(0,),
+                 in_shardings=(self.state_shardings,),
+                 out_shardings=self.state_shardings)
+        def gossip_sync(state: LocalSGDState):
+            mixed = _shard_map(
+                lambda params: jax.tree_util.tree_map(mix_leaf, params),
+                mesh=mesh,
+                in_specs=(P("dp"),), out_specs=P("dp"),
+            )(state.params)
+            return state.replace(params=mixed)
+
+        self._gossip_jits[bit] = gossip_sync
+        return gossip_sync
+
+    # -- public API --------------------------------------------------------
+
+    def init(self, seed: Optional[int] = None) -> LocalSGDState:
+        return self.init_fn(seed if seed is not None
+                            else self.config.train.seed)
+
+    def shard_batch(self, host_batch):
+        """host batch [global_B, ...] -> [R, B/R, ...] placed on the mesh."""
+        R = self.R
+
+        def place(x, s):
+            x = np.asarray(x).reshape((R, x.shape[0] // R) + x.shape[1:])
+            return jax.device_put(x, s)
+
+        return jax.tree_util.tree_map(place, host_batch,
+                                      self.batch_shardings)
+
+    def outer_sync(self, state: LocalSGDState) -> LocalSGDState:
+        if self.outer == "average":
+            state = self.average_sync(state)
+        elif self.R > 1:  # gossip with one replica has no partner: no-op
+            bit = self._round % int(math.log2(self.R))
+            state = self._gossip_sync_for_bit(bit)(state)
+        self._round += 1
+        return state
+
+    def run(self, source_iter, num_steps: Optional[int] = None
+            ) -> Tuple[LocalSGDState, list]:
+        """Train ``num_steps`` inner steps, syncing every ``inner_steps``.
+        Returns (state, per-step mean losses)."""
+        num_steps = num_steps or self.config.train.num_steps
+        state = self.init()
+        losses = []
+        for t in range(num_steps):
+            state, step_losses = self.inner_step(
+                state, self.shard_batch(next(source_iter)))
+            losses.append(float(jax.device_get(step_losses.mean())))
+            if (t + 1) % self.inner_steps == 0:
+                state = self.outer_sync(state)
+        return state, losses

@@ -1,0 +1,137 @@
+"""Local SGD: gossip mixing (the reference's model-sync semantics on ICI)
+and DiLoCo-style outer averaging."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.config import (
+    DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig, TrainConfig)
+from serverless_learn_tpu.data.datasets import SyntheticSource
+from serverless_learn_tpu.training.local_sgd import (
+    LocalSGDTrainer, replica_divergence)
+
+
+def _trainer(outer="gossip", inner_steps=2, batch=16, **kw):
+    cfg = ExperimentConfig(
+        model="mlp_mnist",
+        model_overrides=dict(features=(32,), dtype=jnp.float32),
+        mesh=MeshConfig(dp=8),
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.05,
+                                  momentum=0.0),
+        train=TrainConfig(batch_size=batch, num_steps=8),
+        data=DataConfig())
+    return LocalSGDTrainer(cfg, inner_steps=inner_steps, outer=outer, **kw)
+
+
+def test_replicas_diverge_then_gossip_reconverges(devices):
+    """Inner steps on different shards diverge replicas; log2(R) hypercube
+    gossip rounds at rate 0.5 restore exact agreement (the global mean)."""
+    tr = _trainer(outer="gossip", mix_rate=0.5)
+    state = tr.init()
+    assert float(replica_divergence(state.params)) < 1e-6
+
+    src = iter(SyntheticSource(tr.bundle.make_batch, tr.config.data, 16,
+                               seed=3))
+    state, _ = tr.inner_step(state, tr.shard_batch(next(src)))
+    div_after_inner = float(replica_divergence(state.params))
+    assert div_after_inner > 1e-4  # different data => different replicas
+
+    mean_before = jax.tree_util.tree_map(
+        lambda p: np.asarray(p).mean(0), state.params)
+    for _ in range(3):  # log2(8) rounds
+        state = tr.outer_sync(state)
+    assert float(replica_divergence(state.params)) < 1e-6
+    # hypercube gossip at 0.5 computes exactly the pre-mix global mean
+    for a, b in zip(jax.tree_util.tree_leaves(mean_before),
+                    jax.tree_util.tree_leaves(state.params)):
+        np.testing.assert_allclose(a, np.asarray(b)[0], rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_gossip_single_round_is_pairwise_mix(devices):
+    """One round mixes each replica halfway toward exactly one partner —
+    the reference's delta-apply rule p += 0.5*(peer - p)."""
+    tr = _trainer(outer="gossip", mix_rate=0.5)
+    state = tr.init()
+    src = iter(SyntheticSource(tr.bundle.make_batch, tr.config.data, 16,
+                               seed=5))
+    state, _ = tr.inner_step(state, tr.shard_batch(next(src)))
+    before = np.asarray(
+        jax.device_get(state.params["dense_0"]["kernel"]))  # [8, 784, 32]
+    state = tr.outer_sync(state)  # round 0: partner = i XOR 1
+    after = np.asarray(jax.device_get(state.params["dense_0"]["kernel"]))
+    for i in range(8):
+        np.testing.assert_allclose(
+            after[i], 0.5 * (before[i] + before[i ^ 1]), rtol=1e-5,
+            atol=1e-6)
+
+
+def test_local_sgd_gossip_trains(devices):
+    import itertools
+
+    tr = _trainer(outer="gossip")
+    batch = tr.bundle.make_batch(np.random.default_rng(0), tr.config.data, 16)
+    state, losses = tr.run(itertools.repeat(batch), num_steps=8)
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]  # fixed batch is memorizable
+
+
+def test_diloco_average_resyncs_replicas(devices):
+    tr = _trainer(outer="average", inner_steps=3)
+    src = iter(SyntheticSource(tr.bundle.make_batch, tr.config.data, 16,
+                               seed=1))
+    state = tr.init()
+    for _ in range(3):
+        state, _ = tr.inner_step(state, tr.shard_batch(next(src)))
+    assert float(replica_divergence(state.params)) > 0.0
+    state = tr.outer_sync(state)
+    assert float(replica_divergence(state.params)) < 1e-6
+    # anchor moved from init toward the replica mean (outer step applied)
+    assert float(jax.device_get(state.step)) == 3
+
+
+def test_inner_step_has_no_collectives(devices):
+    """The compiled inner step must be purely replica-local — zero ICI
+    traffic between syncs (the analogue of the reference's nodes training
+    independently between gossip timers)."""
+    tr = _trainer(outer="gossip")
+    state = tr.init()
+    src = iter(SyntheticSource(tr.bundle.make_batch, tr.config.data, 16,
+                               seed=9))
+    batch = tr.shard_batch(next(src))
+    hlo = tr.inner_step.lower(state, batch).compile().as_text()
+    for op in ("all-reduce", "all-gather", "collective-permute",
+               "all-to-all", "reduce-scatter"):
+        assert op not in hlo, f"inner step contains {op}"
+
+
+def test_gossip_requires_power_of_two(devices):
+    from serverless_learn_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=6), devices=jax.devices()[:6])
+    cfg = ExperimentConfig(
+        model="mlp_mnist", mesh=MeshConfig(dp=6),
+        train=TrainConfig(batch_size=12))
+    with pytest.raises(ValueError, match="power-of-two"):
+        LocalSGDTrainer(cfg, mesh=mesh, outer="gossip")
+    # DiLoCo averaging has no such constraint
+    tr = LocalSGDTrainer(cfg, mesh=mesh, outer="average")
+    assert tr.R == 6
+
+
+def test_unknown_outer_mode_rejected(devices):
+    cfg = ExperimentConfig(
+        model="mlp_mnist", mesh=MeshConfig(dp=8),
+        train=TrainConfig(batch_size=16))
+    with pytest.raises(ValueError, match="outer"):
+        LocalSGDTrainer(cfg, outer="avg")
+
+
+def test_stateful_model_rejected(devices):
+    cfg = ExperimentConfig(
+        model="resnet18_cifar", mesh=MeshConfig(dp=8),
+        train=TrainConfig(batch_size=16))
+    with pytest.raises(ValueError, match="stateless"):
+        LocalSGDTrainer(cfg)
