@@ -44,18 +44,22 @@ class Prefetcher:
     def __init__(self, source, place_fn: Callable, depth: int = 2):
         self.q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
         self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
 
         def run():
-            for batch in source:
-                if self._stop.is_set():
-                    return
-                placed = place_fn(batch)
-                while not self._stop.is_set():
-                    try:
-                        self.q.put(placed, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+            try:
+                for batch in source:
+                    if self._stop.is_set():
+                        return
+                    placed = place_fn(batch)
+                    while not self._stop.is_set():
+                        try:
+                            self.q.put(placed, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+            except BaseException as e:  # surface to the consumer, not silence
+                self._error = e
 
         self.thread = threading.Thread(target=run, daemon=True)
         self.thread.start()
@@ -69,6 +73,8 @@ class Prefetcher:
                 return self.q.get(timeout=1.0)
             except queue.Empty:
                 if not self.thread.is_alive():
+                    if self._error is not None:
+                        raise self._error
                     raise StopIteration
                 continue
 

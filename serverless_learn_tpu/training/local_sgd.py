@@ -130,11 +130,10 @@ class LocalSGDTrainer:
 
         # v1 supports stateless models only (no batch_stats etc.): the inner
         # step would otherwise need per-replica model_state threading.
-        dummy = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), spec)
-        first = next(iter(dummy.values())) if isinstance(dummy, dict) else dummy
+        first_spec = (next(iter(spec.values()))
+                      if isinstance(spec, dict) else spec)
         collections = jax.eval_shape(
-            lambda: bundle.module.init(jax.random.PRNGKey(0), first))
+            lambda x: bundle.module.init(jax.random.PRNGKey(0), x), first_spec)
         extra = [k for k in collections if k not in ("params", "losses")]
         if extra:
             raise ValueError(f"local SGD supports stateless models; "
@@ -147,6 +146,7 @@ class LocalSGDTrainer:
 
         def init_raw(seed):
             rng = jax.random.PRNGKey(seed)
+            first = jnp.zeros(first_spec.shape, first_spec.dtype)
             params = bundle.module.init(rng, first)["params"]
             tile = lambda p: jnp.broadcast_to(p[None], (R,) + p.shape)
             params_r = jax.tree_util.tree_map(tile, params)

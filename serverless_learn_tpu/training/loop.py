@@ -18,6 +18,45 @@ from serverless_learn_tpu.training.train_step import Trainer, build_trainer
 from serverless_learn_tpu.utils.metrics import ThroughputMeter, log_json
 
 
+def make_source(config: ExperimentConfig, trainer: Trainer):
+    """Pick the host batch source for a config.
+
+    ``data.shard_server_addr`` set => stream the named dataset from the
+    native shard server (pull-based data plane); otherwise synthesize
+    batches locally from the model bundle.
+    """
+    if config.data.shard_server_addr:
+        from serverless_learn_tpu.data.shard_client import ShardStreamSource
+
+        # Each process pulls only its 1/process_count slice of the global
+        # batch from its own stripe of shards; Trainer.shard_batch assembles
+        # the global array from the process-local data.
+        n_proc = jax.process_count()
+        if config.train.batch_size % n_proc:
+            raise ValueError(
+                f"batch_size {config.train.batch_size} not divisible by "
+                f"process count {n_proc}")
+        return ShardStreamSource(
+            config.data.shard_server_addr,
+            config.data.dataset,
+            config.train.batch_size // n_proc,
+            seed=config.train.seed,
+            dp_rank=jax.process_index(),
+            dp_size=n_proc,
+        )
+    # Synthetic: same per-process contract — each host generates its own
+    # 1/process_count slice (distinct per-rank seed so hosts don't all
+    # produce identical data).
+    n_proc = jax.process_count()
+    if config.train.batch_size % n_proc:
+        raise ValueError(
+            f"batch_size {config.train.batch_size} not divisible by "
+            f"process count {n_proc}")
+    return SyntheticSource(trainer.bundle.make_batch, config.data,
+                           config.train.batch_size // n_proc,
+                           seed=config.train.seed + jax.process_index())
+
+
 def run_training(
     config: ExperimentConfig,
     trainer: Optional[Trainer] = None,
@@ -34,10 +73,9 @@ def run_training(
     trainer = trainer or build_trainer(config)
     if state is None:
         state = trainer.init()
+    created_source = source is None
     if source is None:
-        source = SyntheticSource(trainer.bundle.make_batch, config.data,
-                                 config.train.batch_size,
-                                 seed=config.train.seed)
+        source = make_source(config, trainer)
     prefetch = Prefetcher(iter(source), trainer.shard_batch,
                           depth=config.data.prefetch)
     meter = ThroughputMeter(batch_size=config.train.batch_size,
@@ -59,4 +97,6 @@ def run_training(
                 step_callback(i + 1, state, stats)
     finally:
         prefetch.close()
+        if created_source and hasattr(source, "close"):
+            source.close()
     return state, meter

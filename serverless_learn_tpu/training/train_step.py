@@ -59,9 +59,21 @@ class Trainer:
         set_active_mesh(self.mesh)
 
     def shard_batch(self, host_batch) -> Any:
-        """Place a host batch onto the mesh with the input shardings."""
+        """Place a host batch onto the mesh with the input shardings.
+
+        Single-process: ``host_batch`` is the global batch and ``device_put``
+        scatters it. Multi-host: ``host_batch`` is this process's slice of
+        the global batch (``make_source`` yields per-process batches) and the
+        global array is assembled from the process-local shards without any
+        cross-host copy.
+        """
+        if jax.process_count() == 1:
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s),
+                host_batch, self.batch_shardings)
         return jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(x, s), host_batch, self.batch_shardings)
+            lambda x, s: jax.make_array_from_process_local_data(s, x),
+            host_batch, self.batch_shardings)
 
 
 def build_trainer(
