@@ -29,9 +29,12 @@
 
 #include "framing.h"
 #include "log.h"
+#include "rpc_stats.h"
 #include "slt.pb.h"
 
 namespace {
+
+slt::RpcStats g_rpc_stats;
 
 struct WorkerRec {
   uint64_t id;
@@ -167,6 +170,7 @@ void serve_conn(Coordinator* coord, int fd) {
   while (slt::read_frame(fd, &type, &payload)) {
     std::string out;
     uint8_t out_type;
+    slt::ScopedRpcTimer timer(&g_rpc_stats, type);
     switch (type) {
       case slt::MSG_REGISTER_REQ: {
         slt::RegisterRequest req;
@@ -192,6 +196,13 @@ void serve_conn(Coordinator* coord, int fd) {
       case slt::MSG_MEMBERSHIP_REQ: {
         coord->Membership().SerializeToString(&out);
         out_type = slt::MSG_MEMBERSHIP_REP;
+        break;
+      }
+      case slt::MSG_STATS_REQ: {
+        slt::StatsReply rep;
+        g_rpc_stats.Fill(&rep);
+        rep.SerializeToString(&out);
+        out_type = slt::MSG_STATS_REP;
         break;
       }
       default: {

@@ -1,0 +1,76 @@
+// Per-RPC latency accounting for the native daemons.
+//
+// The reference's only observability was unconditional std::cout narration on
+// every RPC and a single in-source perf TODO ("don't reconstruct stubs every
+// time!", reference src/master.cc:257) — it had no way to *measure* that
+// problem. Here every served frame is timed and aggregated per message type;
+// the totals ride the StatsReply so clients (and the Python tracing layer)
+// can scrape them without touching logs.
+
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+
+#include "slt.pb.h"
+
+namespace slt {
+
+constexpr int kMaxMsgType = 32;
+
+struct RpcCounters {
+  std::atomic<uint64_t> count{0};
+  std::atomic<uint64_t> total_us{0};
+  std::atomic<uint64_t> max_us{0};
+};
+
+class RpcStats {
+ public:
+  void Record(uint8_t msg_type, uint64_t us) {
+    if (msg_type >= kMaxMsgType) return;
+    auto& c = counters_[msg_type];
+    c.count.fetch_add(1, std::memory_order_relaxed);
+    c.total_us.fetch_add(us, std::memory_order_relaxed);
+    uint64_t prev = c.max_us.load(std::memory_order_relaxed);
+    while (us > prev &&
+           !c.max_us.compare_exchange_weak(prev, us,
+                                           std::memory_order_relaxed)) {
+    }
+  }
+
+  void Fill(slt::StatsReply* rep) const {
+    for (int t = 0; t < kMaxMsgType; t++) {
+      uint64_t n = counters_[t].count.load(std::memory_order_relaxed);
+      if (n == 0) continue;
+      auto* s = rep->add_rpc();
+      s->set_msg_type(static_cast<uint32_t>(t));
+      s->set_count(n);
+      s->set_total_us(counters_[t].total_us.load(std::memory_order_relaxed));
+      s->set_max_us(counters_[t].max_us.load(std::memory_order_relaxed));
+    }
+  }
+
+ private:
+  RpcCounters counters_[kMaxMsgType];
+};
+
+class ScopedRpcTimer {
+ public:
+  ScopedRpcTimer(RpcStats* stats, uint8_t msg_type)
+      : stats_(stats), msg_type_(msg_type),
+        t0_(std::chrono::steady_clock::now()) {}
+  ~ScopedRpcTimer() {
+    auto us = std::chrono::duration_cast<std::chrono::microseconds>(
+                  std::chrono::steady_clock::now() - t0_)
+                  .count();
+    stats_->Record(msg_type_, static_cast<uint64_t>(us));
+  }
+
+ private:
+  RpcStats* stats_;
+  uint8_t msg_type_;
+  std::chrono::steady_clock::time_point t0_;
+};
+
+}  // namespace slt

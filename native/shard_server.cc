@@ -34,9 +34,12 @@
 
 #include "framing.h"
 #include "log.h"
+#include "rpc_stats.h"
 #include "slt.pb.h"
 
 namespace {
+
+slt::RpcStats g_rpc_stats;
 
 struct Stats {
   std::atomic<uint64_t> bytes_served{0};
@@ -311,6 +314,7 @@ void serve_conn(int fd) {
   uint8_t type;
   std::string payload;
   while (slt::read_frame(fd, &type, &payload)) {
+    slt::ScopedRpcTimer timer(&g_rpc_stats, type);
     switch (type) {
       case slt::MSG_FETCH_REQ: {
         slt::FetchRequest req;
@@ -353,6 +357,7 @@ void serve_conn(int fd) {
         rep.set_bytes_served(g_stats.bytes_served.load());
         rep.set_bytes_stored(g_stats.bytes_stored.load());
         rep.set_active_streams(g_stats.active_streams.load());
+        g_rpc_stats.Fill(&rep);
         std::string out;
         rep.SerializeToString(&out);
         slt::write_frame(fd, slt::MSG_STATS_REP, out);

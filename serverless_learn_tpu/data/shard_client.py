@@ -220,8 +220,11 @@ class ShardStreamSource:
         return list(rng.permutation(self._my_shards))
 
     def _fetch_loop(self):
-        client = ShardClient(self.addr)
+        client = None
         try:
+            # Inside the try: a connect failure must reach the consumer as an
+            # error, not read as clean end-of-data.
+            client = ShardClient(self.addr)
             epoch = 0
             while not self._stop.is_set():
                 for idx in self._epoch_order(epoch):
@@ -243,7 +246,8 @@ class ShardStreamSource:
         except Exception as e:  # surface fetch errors to the consumer
             self._put(e)
         finally:
-            client.close()
+            if client is not None:
+                client.close()
 
     def _put(self, item):
         while not self._stop.is_set():

@@ -16,6 +16,7 @@ from serverless_learn_tpu.config import ExperimentConfig
 from serverless_learn_tpu.data.datasets import Prefetcher, SyntheticSource
 from serverless_learn_tpu.training.train_step import Trainer, build_trainer
 from serverless_learn_tpu.utils.metrics import ThroughputMeter, log_json
+from serverless_learn_tpu.utils.tracing import get_tracer, step_annotation
 
 
 def make_source(config: ExperimentConfig, trainer: Trainer):
@@ -25,17 +26,18 @@ def make_source(config: ExperimentConfig, trainer: Trainer):
     native shard server (pull-based data plane); otherwise synthesize
     batches locally from the model bundle.
     """
+    # Each process handles only its 1/process_count slice of the global
+    # batch; Trainer.shard_batch assembles the global array from the
+    # process-local data.
+    n_proc = jax.process_count()
+    if config.train.batch_size % n_proc:
+        raise ValueError(
+            f"batch_size {config.train.batch_size} not divisible by "
+            f"process count {n_proc}")
     if config.data.shard_server_addr:
         from serverless_learn_tpu.data.shard_client import ShardStreamSource
 
-        # Each process pulls only its 1/process_count slice of the global
-        # batch from its own stripe of shards; Trainer.shard_batch assembles
-        # the global array from the process-local data.
-        n_proc = jax.process_count()
-        if config.train.batch_size % n_proc:
-            raise ValueError(
-                f"batch_size {config.train.batch_size} not divisible by "
-                f"process count {n_proc}")
+        # Stream the named dataset from the worker's own stripe of shards.
         return ShardStreamSource(
             config.data.shard_server_addr,
             config.data.dataset,
@@ -44,14 +46,8 @@ def make_source(config: ExperimentConfig, trainer: Trainer):
             dp_rank=jax.process_index(),
             dp_size=n_proc,
         )
-    # Synthetic: same per-process contract — each host generates its own
-    # 1/process_count slice (distinct per-rank seed so hosts don't all
-    # produce identical data).
-    n_proc = jax.process_count()
-    if config.train.batch_size % n_proc:
-        raise ValueError(
-            f"batch_size {config.train.batch_size} not divisible by "
-            f"process count {n_proc}")
+    # Synthetic: each host generates its own slice (distinct per-rank seed
+    # so hosts don't all produce identical data).
     return SyntheticSource(trainer.bundle.make_batch, config.data,
                            config.train.batch_size // n_proc,
                            seed=config.train.seed + jax.process_index())
@@ -82,12 +78,16 @@ def run_training(
                             n_chips=trainer.mesh.size)
     meter.start()
     start_step = int(jax.device_get(state.step))
+    tracer = get_tracer()
     try:
         for i, batch in zip(range(start_step, config.train.num_steps), prefetch):
-            state, metrics = trainer.step(state, batch)
-            # Block on the metrics (small) so step timing is honest; params
-            # stay on device.
-            metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+            with step_annotation(i + 1), tracer.span("train/step",
+                                                     annotate_device=False):
+                state, metrics = trainer.step(state, batch)
+                # Block on the metrics (small) so step timing is honest;
+                # params stay on device.
+                metrics = {k: float(v)
+                           for k, v in jax.device_get(metrics).items()}
             stats = meter.record(i + 1, metrics)
             if verbose and (i + 1) % config.train.log_every == 0:
                 log_json({"step": stats.step, "step_time_s": round(stats.step_time_s, 5),

@@ -1,0 +1,147 @@
+"""Tracing & profiling.
+
+The reference had no tracing at all — its observability was unconditional
+``std::cout`` narration on every RPC and one in-source perf TODO
+(reference ``src/master.cc:257``; SURVEY.md §5 "Tracing / profiling").
+This module is the rebuild's tracing story, in three parts:
+
+* **Host spans** — ``Tracer.span(name)`` times named host-side sections
+  (data fetch, shard decode, step dispatch) into per-name aggregates that
+  mirror the native daemons' ``RpcStat`` (count/total/max).
+* **Device traces** — ``capture(logdir)`` wraps ``jax.profiler.trace`` so a
+  training window can be captured for TensorBoard/Perfetto;
+  ``annotate(name)`` / ``step_annotation(step)`` wrap
+  ``jax.profiler.TraceAnnotation`` / ``StepTraceAnnotation`` so host spans
+  show up aligned with device ops inside the captured trace. All wrappers
+  degrade to no-ops when the profiler is unavailable.
+* **Daemon scrape** — ``rpc_stats(client)`` turns a Coordinator/Shard
+  ``StatsReply`` into the same dict shape as ``Tracer.summary()``, so one
+  report covers Python hosts and C++ daemons.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+# framing.h MsgType tag -> human name, for daemon-scraped reports.
+MSG_TYPE_NAMES = {
+    1: "register", 3: "heartbeat", 5: "deregister", 6: "membership",
+    20: "manifest", 22: "fetch", 24: "put", 25: "stats", 27: "delete",
+}
+
+
+@dataclass
+class SpanStat:
+    count: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+
+    def add(self, dt: float):
+        self.count += 1
+        self.total_s += dt
+        if dt > self.max_s:
+            self.max_s = dt
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+@dataclass
+class Tracer:
+    """Accumulates named host-side span timings; thread-safe."""
+
+    stats: Dict[str, SpanStat] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    @contextlib.contextmanager
+    def span(self, name: str, annotate_device: bool = True):
+        """Time a section; optionally mirror it into the device trace."""
+        ctx = annotate(name) if annotate_device else contextlib.nullcontext()
+        t0 = time.perf_counter()
+        with ctx:
+            yield
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.stats.setdefault(name, SpanStat()).add(dt)
+
+    def record(self, name: str, dt: float):
+        with self._lock:
+            self.stats.setdefault(name, SpanStat()).add(dt)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                name: {"count": s.count, "total_s": s.total_s,
+                       "mean_s": s.mean_s, "max_s": s.max_s}
+                for name, s in sorted(self.stats.items())
+            }
+
+    def reset(self):
+        with self._lock:
+            self.stats.clear()
+
+
+_global_tracer: Optional[Tracer] = None
+
+
+def get_tracer() -> Tracer:
+    global _global_tracer
+    if _global_tracer is None:
+        _global_tracer = Tracer()
+    return _global_tracer
+
+
+def annotate(name: str):
+    """Named device-trace annotation; no-op if the profiler is unavailable."""
+    try:
+        import jax.profiler
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+def step_annotation(step: int):
+    """Step marker for TensorBoard's step-time view."""
+    try:
+        import jax.profiler
+
+        return jax.profiler.StepTraceAnnotation("train", step_num=step)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def capture(logdir: str):
+    """Capture a jax.profiler trace (TensorBoard/Perfetto) over the block."""
+    import jax.profiler
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def rpc_stats(client) -> Dict[str, Dict[str, float]]:
+    """Scrape a daemon's per-RPC latency table into summary() shape.
+
+    ``client`` is a CoordinatorClient or ShardClient (both expose
+    ``stats()`` returning a StatsReply with repeated RpcStat).
+    """
+    rep = client.stats()
+    out: Dict[str, Dict[str, float]] = {}
+    for s in rep.rpc:
+        name = MSG_TYPE_NAMES.get(s.msg_type, f"msg_{s.msg_type}")
+        out[f"rpc/{name}"] = {
+            "count": s.count,
+            "total_s": s.total_us / 1e6,
+            "mean_s": (s.total_us / s.count / 1e6) if s.count else 0.0,
+            "max_s": s.max_us / 1e6,
+        }
+    return out

@@ -1,0 +1,142 @@
+"""Tracing/profiling subsystem (SURVEY.md §5 — absent in the reference,
+whose only observability was std::cout narration on every RPC).
+
+Covers: host-span aggregation, jax.profiler trace capture, and the native
+daemons' per-RPC latency accounting scraped over the stats RPC.
+"""
+
+import glob
+import os
+import socket
+import time
+
+import pytest
+
+from serverless_learn_tpu.utils.tracing import (
+    MSG_TYPE_NAMES, Tracer, capture, get_tracer, rpc_stats, step_annotation)
+
+
+def test_tracer_span_aggregation():
+    tr = Tracer()
+    for _ in range(3):
+        with tr.span("unit/sleep", annotate_device=False):
+            time.sleep(0.01)
+    s = tr.summary()["unit/sleep"]
+    assert s["count"] == 3
+    assert s["total_s"] >= 0.03
+    assert s["max_s"] >= s["mean_s"] > 0
+
+
+def test_tracer_thread_safety():
+    import threading
+
+    tr = Tracer()
+
+    def work():
+        for _ in range(100):
+            tr.record("x", 0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert tr.summary()["x"]["count"] == 800
+
+
+def test_global_tracer_singleton():
+    assert get_tracer() is get_tracer()
+
+
+def test_profiler_capture(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    logdir = str(tmp_path / "trace")
+    with capture(logdir):
+        with step_annotation(1):
+            jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+    produced = glob.glob(os.path.join(logdir, "**", "*"), recursive=True)
+    assert any(os.path.isfile(p) for p in produced), "no trace files written"
+
+
+def test_training_records_step_spans():
+    from serverless_learn_tpu.config import (
+        DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig, TrainConfig)
+    from serverless_learn_tpu.training.loop import run_training
+
+    tr = get_tracer()
+    tr.reset()
+    cfg = ExperimentConfig(
+        model="mlp_mnist",
+        mesh=MeshConfig(dp=8),
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.1),
+        train=TrainConfig(batch_size=16, num_steps=3),
+        data=DataConfig(),
+    )
+    run_training(cfg)
+    assert tr.summary()["train/step"]["count"] == 3
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture()
+def coordinator_addr():
+    from serverless_learn_tpu.control.daemons import start_coordinator
+
+    port = _free_port()
+    proc = start_coordinator(port=port)
+    yield f"127.0.0.1:{port}"
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def test_coordinator_rpc_latency_accounting(coordinator_addr):
+    from serverless_learn_tpu.control.client import CoordinatorClient
+
+    c = CoordinatorClient(coordinator_addr)
+    r = c.register("w1:9000", name="w1")
+    for _ in range(5):
+        c.heartbeat(r.worker_id)
+    stats = rpc_stats(c)
+    c.close()
+    assert stats["rpc/register"]["count"] == 1
+    assert stats["rpc/heartbeat"]["count"] == 5
+    hb = stats["rpc/heartbeat"]
+    assert hb["max_s"] >= hb["mean_s"] > 0
+
+
+def test_shard_server_rpc_latency_accounting(tmp_path):
+    from serverless_learn_tpu.control.client import ShardClient
+    from serverless_learn_tpu.control.daemons import start_shard_server
+
+    port = _free_port()
+    proc = start_shard_server(port=port, root=str(tmp_path))
+    try:
+        c = ShardClient(f"127.0.0.1:{port}")
+        c.put("ds/a", b"x" * 1024)
+        c.fetch("ds/a")
+        stats = rpc_stats(c)
+        c.close()
+        assert stats["rpc/put"]["count"] == 1
+        assert stats["rpc/fetch"]["count"] == 1
+        assert stats["rpc/fetch"]["total_s"] > 0
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_msg_type_names_match_framing_header():
+    # Names must track native/framing.h MsgType tags.
+    header = open(os.path.join(os.path.dirname(__file__), os.pardir,
+                               "native", "framing.h")).read()
+    tags = {"register": "MSG_REGISTER_REQ = 1",
+            "heartbeat": "MSG_HEARTBEAT_REQ = 3",
+            "fetch": "MSG_FETCH_REQ = 22",
+            "put": "MSG_PUT_REQ = 24"}
+    for name, decl in tags.items():
+        assert decl in header
+        tag = int(decl.split("=")[1])
+        assert MSG_TYPE_NAMES[tag] == name
