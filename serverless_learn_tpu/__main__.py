@@ -1,0 +1,7 @@
+"""``python -m serverless_learn_tpu`` — see cli.py."""
+
+import sys
+
+from serverless_learn_tpu.cli import main
+
+sys.exit(main())

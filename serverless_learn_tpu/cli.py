@@ -1,0 +1,357 @@
+"""Command-line entry points — the framework's L4 layer.
+
+Successor of the reference's process surface (``./master``, ``./worker ADDR``,
+``./file_server`` — reference ``src/Makefile:26-35``, ``src/worker.cc:233-258``),
+where the worker's address was the only CLI argument in the whole system and
+every interval change required recompiling (``src/serverless_learn.h:5-12``).
+Here one typed CLI fronts everything:
+
+    python -m serverless_learn_tpu train        # jitted training run
+    python -m serverless_learn_tpu worker       # elastic worker (joins a cluster)
+    python -m serverless_learn_tpu coordinator  # native membership daemon
+    python -m serverless_learn_tpu shard-server # native data-plane daemon
+    python -m serverless_learn_tpu publish      # push a dataset to the data plane
+    python -m serverless_learn_tpu stats        # scrape a daemon's load/RPC stats
+    python -m serverless_learn_tpu models       # list registered model families
+
+Configs come from ``--config FILE.json`` plus ``--set dotted.key=value``
+overrides plus dedicated flags (flags win).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _parse_mesh(spec: str) -> dict:
+    """'dp=8,tp=2' -> {'dp': 8, 'tp': 2}."""
+    out = {}
+    for part in spec.split(","):
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        out[k.strip()] = int(v)
+    return out
+
+
+def _coerce(text: str):
+    """Parse a --set value: JSON if it parses, else the raw string."""
+    try:
+        return json.loads(text)
+    except (json.JSONDecodeError, ValueError):
+        return text
+
+
+def _config_from_args(args) -> "ExperimentConfig":
+    from serverless_learn_tpu.config import ExperimentConfig
+
+    raw = {}
+    if getattr(args, "config", None):
+        with open(args.config) as f:
+            raw = json.load(f)
+    for item in getattr(args, "set", None) or []:
+        path, _, val = item.partition("=")
+        if not _:
+            raise SystemExit(f"--set expects dotted.key=value, got {item!r}")
+        node = raw
+        keys = path.split(".")
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = _coerce(val)
+
+    # Dedicated flags override both file and --set.
+    def put(path: List[str], val):
+        node = raw
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = val
+
+    if args.model:
+        put(["model"], args.model)
+    if args.mesh:
+        put(["mesh"], {**raw.get("mesh", {}), **_parse_mesh(args.mesh)})
+    if args.batch_size is not None:
+        put(["train", "batch_size"], args.batch_size)
+    if args.steps is not None:
+        put(["train", "num_steps"], args.steps)
+    if args.checkpoint_every is not None:
+        put(["train", "checkpoint_every"], args.checkpoint_every)
+    if args.lr is not None:
+        put(["optimizer", "learning_rate"], args.lr)
+    if args.optimizer:
+        put(["optimizer", "name"], args.optimizer)
+    if args.seq_len is not None:
+        put(["data", "seq_len"], args.seq_len)
+    if args.dataset:
+        put(["data", "dataset"], args.dataset)
+    if args.shard_server:
+        put(["data", "shard_server_addr"], args.shard_server)
+        put(["control", "shard_server_addr"], args.shard_server)
+    if getattr(args, "coordinator", None):
+        put(["control", "coordinator_addr"], args.coordinator)
+
+    cfg = ExperimentConfig.from_dict(raw)
+    if "mesh" not in raw or not raw["mesh"]:
+        # Default mesh: all local devices on the dp axis.
+        import jax
+
+        from serverless_learn_tpu.config import MeshConfig
+
+        cfg = cfg.override(mesh=MeshConfig(dp=len(jax.devices())))
+    return cfg
+
+
+def _add_train_flags(p: argparse.ArgumentParser):
+    p.add_argument("--config", help="JSON config file (ExperimentConfig)")
+    p.add_argument("--set", action="append", metavar="dotted.key=value",
+                   help="override any config field, e.g. --set train.seed=3")
+    p.add_argument("--model", help="registered model name (see `models`)")
+    p.add_argument("--mesh", help="mesh axes, e.g. dp=4,tp=2")
+    p.add_argument("--batch-size", type=int)
+    p.add_argument("--steps", type=int)
+    p.add_argument("--lr", type=float)
+    p.add_argument("--optimizer", help="adamw | sgd | adafactor")
+    p.add_argument("--seq-len", type=int)
+    p.add_argument("--dataset")
+    p.add_argument("--shard-server", metavar="ADDR",
+                   help="stream data from this shard server")
+    p.add_argument("--checkpoint-every", type=int, default=None)
+    p.add_argument("--checkpoint-dir", help="save checkpoints to a local dir")
+    p.add_argument("--checkpoint-store", metavar="ADDR",
+                   help="save checkpoints to a shard server")
+    p.add_argument("--profile-dir", help="capture a jax.profiler trace here")
+    p.add_argument("-v", "--verbose", action="store_true")
+
+
+def _make_checkpointer(args, name: str = "ckpt"):
+    from serverless_learn_tpu.training.checkpoint import (
+        Checkpointer, LocalStore, ShardServerStore)
+
+    if args.checkpoint_store:
+        return Checkpointer(ShardServerStore(args.checkpoint_store), name=name)
+    if args.checkpoint_dir:
+        return Checkpointer(LocalStore(args.checkpoint_dir), name=name)
+    return None
+
+
+def cmd_train(args) -> int:
+    import contextlib
+
+    import jax
+
+    from serverless_learn_tpu.training.loop import run_training
+    from serverless_learn_tpu.utils.metrics import log_json
+    from serverless_learn_tpu.utils.tracing import capture, get_tracer
+
+    cfg = _config_from_args(args)
+    ckpt = _make_checkpointer(args)
+    every = cfg.train.checkpoint_every
+
+    callback = None
+    if ckpt is not None and every:
+        def callback(step, state, stats):
+            if step % every == 0:
+                ckpt.save(state)
+
+    trace_ctx = (capture(args.profile_dir) if args.profile_dir
+                 else contextlib.nullcontext())
+    with trace_ctx:
+        state, meter = run_training(cfg, step_callback=callback,
+                                    verbose=args.verbose)
+    if ckpt is not None:
+        ckpt.save(state)
+        ckpt.wait()
+    summary = meter.steady_state()
+    log_json({"event": "done",
+              "final_step": int(jax.device_get(state.step)),
+              **{k: round(v, 3) for k, v in summary.items()},
+              "spans": get_tracer().summary()}, stream=sys.stdout)
+    return 0
+
+
+def cmd_worker(args) -> int:
+    """Elastic worker: register with the coordinator, train, re-mesh on
+    membership changes — the successor of ``./worker ADDR``."""
+    from serverless_learn_tpu.training.checkpoint import (
+        LocalStore, ShardServerStore)
+    from serverless_learn_tpu.training.elastic import ElasticTrainer
+    from serverless_learn_tpu.utils.metrics import log_json
+
+    cfg = _config_from_args(args)
+    if args.checkpoint_store:
+        store = ShardServerStore(args.checkpoint_store)
+    elif args.checkpoint_dir:
+        store = LocalStore(args.checkpoint_dir)
+    else:
+        store = ShardServerStore(cfg.control.shard_server_addr)
+    et = ElasticTrainer(
+        cfg, store,
+        coordinator_addr=cfg.control.coordinator_addr,
+        advertise_addr=args.advertise,
+        name=args.name,
+        verbose=args.verbose,
+    )
+    state, losses = et.run()
+    log_json({"event": "worker_done", "steps": len(losses),
+              "final_loss": losses[-1] if losses else None,
+              "transitions": len(et.transitions)}, stream=sys.stdout)
+    return 0
+
+
+def _exec_daemon(binary: str, argv: List[str]) -> int:
+    from serverless_learn_tpu.control.client import _BIN, ensure_native_built
+
+    if not ensure_native_built():
+        print("native build failed (see native/Makefile)", file=sys.stderr)
+        return 1
+    path = os.path.join(_BIN, binary)
+    os.execv(path, [path] + argv)  # replaces this process, like the reference
+
+
+def cmd_coordinator(args) -> int:
+    return _exec_daemon("coordinator", [
+        "--port", str(args.port),
+        "--lease_ttl_ms", str(args.lease_ttl_ms),
+        "--sweep_ms", str(args.sweep_ms)])
+
+
+def cmd_shard_server(args) -> int:
+    argv = ["--port", str(args.port)]
+    if args.root:
+        argv += ["--root", args.root]
+    return _exec_daemon("shard_server", argv)
+
+
+def cmd_publish(args) -> int:
+    from serverless_learn_tpu.config import DataConfig
+    from serverless_learn_tpu.data.shard_client import publish_from_bundle
+    from serverless_learn_tpu.models.registry import get_model
+
+    bundle = get_model(args.model)
+    data_cfg = DataConfig(seq_len=args.seq_len)
+    meta = publish_from_bundle(
+        args.shard_server, args.dataset, bundle.make_batch, data_cfg,
+        num_records=args.num_records,
+        records_per_shard=args.records_per_shard, seed=args.seed)
+    print(json.dumps({"dataset": args.dataset,
+                      "num_records": meta.num_records,
+                      "num_shards": meta.num_shards,
+                      "fields": [f.name for f in meta.fields]}))
+    return 0
+
+
+def cmd_stats(args) -> int:
+    from serverless_learn_tpu.control.client import (
+        CoordinatorClient, ShardClient)
+    from serverless_learn_tpu.utils.tracing import rpc_stats
+
+    cls = CoordinatorClient if args.kind == "coordinator" else ShardClient
+    c = cls(args.addr)
+    rep = c.stats()
+    out = {"rpc": rpc_stats(rep)}
+    if args.kind == "shard-server":
+        out["bytes_served"] = rep.bytes_served
+        out["bytes_stored"] = rep.bytes_stored
+        out["active_streams"] = rep.active_streams
+    c.close()
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_models(args) -> int:
+    from serverless_learn_tpu.models.registry import list_models
+
+    for name in list_models():
+        print(name)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="serverless_learn_tpu",
+        description="TPU-native elastic training framework")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="run a training job on local devices")
+    _add_train_flags(t)
+    t.set_defaults(fn=cmd_train)
+
+    w = sub.add_parser("worker", help="elastic worker: join a cluster & train")
+    _add_train_flags(w)
+    w.add_argument("--coordinator", metavar="ADDR",
+                   help="coordinator address (default from config)")
+    w.add_argument("--advertise", default="local:0",
+                   help="address advertised to peers")
+    w.add_argument("--name", default="worker")
+    w.set_defaults(fn=cmd_worker)
+
+    c = sub.add_parser("coordinator", help="run the membership daemon")
+    c.add_argument("--port", type=int, default=50052)
+    c.add_argument("--lease-ttl-ms", type=int, default=5000)
+    c.add_argument("--sweep-ms", type=int, default=500)
+    c.set_defaults(fn=cmd_coordinator)
+
+    s = sub.add_parser("shard-server", help="run the data-plane daemon")
+    s.add_argument("--port", type=int, default=50053)
+    s.add_argument("--root", help="blob root directory")
+    s.set_defaults(fn=cmd_shard_server)
+
+    pub = sub.add_parser("publish", help="publish a synthetic dataset")
+    pub.add_argument("--shard-server", required=True, metavar="ADDR")
+    pub.add_argument("--dataset", required=True)
+    pub.add_argument("--model", required=True,
+                     help="model whose batch schema to publish")
+    pub.add_argument("--num-records", type=int, default=4096)
+    pub.add_argument("--records-per-shard", type=int, default=512)
+    pub.add_argument("--seq-len", type=int, default=128)
+    pub.add_argument("--seed", type=int, default=0)
+    pub.set_defaults(fn=cmd_publish)
+
+    st = sub.add_parser("stats", help="scrape a daemon's load/RPC stats")
+    st.add_argument("--addr", required=True)
+    st.add_argument("--kind", choices=["coordinator", "shard-server"],
+                    default="shard-server")
+    st.set_defaults(fn=cmd_stats)
+
+    m = sub.add_parser("models", help="list registered model families")
+    m.set_defaults(fn=cmd_models)
+
+    return p
+
+
+def _honor_platform_env():
+    """The image's sitecustomize pre-imports jax bound to the TPU tunnel;
+    re-assert JAX_PLATFORMS from the environment so `JAX_PLATFORMS=cpu
+    python -m serverless_learn_tpu ...` works as documented (backends are
+    lazy, so this wins if set before first device use)."""
+    plat = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if plat:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    _honor_platform_env()
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # Downstream consumer (e.g. `| head`) closed the pipe — not an error.
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -128,13 +128,14 @@ def capture(logdir: str):
         jax.profiler.stop_trace()
 
 
-def rpc_stats(client) -> Dict[str, Dict[str, float]]:
+def rpc_stats(client_or_reply) -> Dict[str, Dict[str, float]]:
     """Scrape a daemon's per-RPC latency table into summary() shape.
 
-    ``client`` is a CoordinatorClient or ShardClient (both expose
-    ``stats()`` returning a StatsReply with repeated RpcStat).
+    Accepts a CoordinatorClient/ShardClient (issues the stats RPC) or an
+    already-fetched StatsReply (no extra round trip).
     """
-    rep = client.stats()
+    rep = (client_or_reply if hasattr(client_or_reply, "rpc")
+           else client_or_reply.stats())
     out: Dict[str, Dict[str, float]] = {}
     for s in rep.rpc:
         name = MSG_TYPE_NAMES.get(s.msg_type, f"msg_{s.msg_type}")
