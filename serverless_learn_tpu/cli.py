@@ -126,6 +126,19 @@ def _add_train_flags(p: argparse.ArgumentParser):
                    help="save checkpoints to a shard server")
     p.add_argument("--profile-dir", help="capture a jax.profiler trace here")
     p.add_argument("-v", "--verbose", action="store_true")
+    # Multi-host: either serverless bootstrap via the native coordinator
+    # (--world-size) or explicit topology (--num-processes/--process-id).
+    p.add_argument("--coordinator", metavar="ADDR",
+                   help="native coordinator address")
+    p.add_argument("--world-size", type=int,
+                   help="form a JAX process group of this many hosts via "
+                        "the native coordinator (requires --coordinator)")
+    p.add_argument("--advertise-host", default="127.0.0.1",
+                   help="host other processes can reach this one at")
+    p.add_argument("--jax-coordinator", metavar="ADDR",
+                   help="explicit JAX coordination service address")
+    p.add_argument("--num-processes", type=int)
+    p.add_argument("--process-id", type=int)
 
 
 def _make_checkpointer(args, name: str = "ckpt"):
@@ -148,29 +161,55 @@ def cmd_train(args) -> int:
     from serverless_learn_tpu.utils.metrics import log_json
     from serverless_learn_tpu.utils.tracing import capture, get_tracer
 
-    cfg = _config_from_args(args)
-    ckpt = _make_checkpointer(args)
-    every = cfg.train.checkpoint_every
+    # Form the multi-host process group BEFORE reading the config: the
+    # default mesh spans all *global* devices.
+    world = None
+    if args.world_size:
+        if not args.coordinator:
+            raise SystemExit("--world-size requires --coordinator")
+        from serverless_learn_tpu.parallel.multihost import (
+            bootstrap_via_coordinator)
 
-    callback = None
-    if ckpt is not None and every:
-        def callback(step, state, stats):
-            if step % every == 0:
-                ckpt.save(state)
+        world = bootstrap_via_coordinator(
+            args.coordinator, args.world_size,
+            advertise_host=args.advertise_host)
+    elif args.num_processes:
+        from serverless_learn_tpu.parallel.multihost import initialize
 
-    trace_ctx = (capture(args.profile_dir) if args.profile_dir
-                 else contextlib.nullcontext())
-    with trace_ctx:
-        state, meter = run_training(cfg, step_callback=callback,
-                                    verbose=args.verbose)
-    if ckpt is not None:
-        ckpt.save(state)
-        ckpt.wait()
-    summary = meter.steady_state()
-    log_json({"event": "done",
-              "final_step": int(jax.device_get(state.step)),
-              **{k: round(v, 3) for k, v in summary.items()},
-              "spans": get_tracer().summary()}, stream=sys.stdout)
+        if args.process_id is None or not args.jax_coordinator:
+            raise SystemExit(
+                "--num-processes requires --jax-coordinator and --process-id")
+        initialize(args.jax_coordinator, args.num_processes, args.process_id)
+
+    try:
+        cfg = _config_from_args(args)
+        ckpt = _make_checkpointer(args)
+        every = cfg.train.checkpoint_every
+
+        callback = None
+        if ckpt is not None and every:
+            def callback(step, state, stats):
+                if step % every == 0:
+                    ckpt.save(state)
+
+        trace_ctx = (capture(args.profile_dir) if args.profile_dir
+                     else contextlib.nullcontext())
+        with trace_ctx:
+            state, meter = run_training(cfg, step_callback=callback,
+                                        verbose=args.verbose)
+        if ckpt is not None:
+            ckpt.save(state)
+            ckpt.wait()
+        summary = meter.steady_state()
+        log_json({"event": "done",
+                  "final_step": int(jax.device_get(state.step)),
+                  **({"rank": world.rank, "world": world.num_processes}
+                     if world else {}),
+                  **{k: round(v, 3) for k, v in summary.items()},
+                  "spans": get_tracer().summary()}, stream=sys.stdout)
+    finally:
+        if world is not None:
+            world.shutdown()
     return 0
 
 
@@ -182,6 +221,11 @@ def cmd_worker(args) -> int:
     from serverless_learn_tpu.training.elastic import ElasticTrainer
     from serverless_learn_tpu.utils.metrics import log_json
 
+    if args.world_size or args.num_processes:
+        raise SystemExit(
+            "--world-size/--num-processes form a fixed multi-host group and "
+            "apply to `train`; `worker` is single-host elastic (it re-meshes "
+            "on membership changes instead)")
     cfg = _config_from_args(args)
     if args.checkpoint_store:
         store = ShardServerStore(args.checkpoint_store)
@@ -283,8 +327,6 @@ def build_parser() -> argparse.ArgumentParser:
 
     w = sub.add_parser("worker", help="elastic worker: join a cluster & train")
     _add_train_flags(w)
-    w.add_argument("--coordinator", metavar="ADDR",
-                   help="coordinator address (default from config)")
     w.add_argument("--advertise", default="local:0",
                    help="address advertised to peers")
     w.add_argument("--name", default="worker")
