@@ -7,6 +7,7 @@ every interval change required recompiling (``src/serverless_learn.h:5-12``).
 Here one typed CLI fronts everything:
 
     python -m serverless_learn_tpu train        # jitted training run
+    python -m serverless_learn_tpu eval         # forward-only evaluation
     python -m serverless_learn_tpu worker       # elastic worker (joins a cluster)
     python -m serverless_learn_tpu coordinator  # native membership daemon
     python -m serverless_learn_tpu shard-server # native data-plane daemon
@@ -213,6 +214,36 @@ def cmd_train(args) -> int:
     return 0
 
 
+def cmd_eval(args) -> int:
+    """Forward-only evaluation of a (possibly checkpointed) model."""
+    from serverless_learn_tpu.training.loop import run_eval
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    if args.world_size or args.num_processes:
+        raise SystemExit(
+            "--world-size/--num-processes form a multi-host group and apply "
+            "to `train`; `eval` is single-process")
+    cfg = _config_from_args(args)
+    trainer = build_trainer(cfg)
+    state = trainer.init()
+    ckpt = _make_checkpointer(args)
+    ckpt_step = None
+    if ckpt is not None:
+        ckpt_step = ckpt.latest_step()
+        if ckpt_step is None:
+            # Evaluating random init while the user pointed at a checkpoint
+            # store would print plausible-but-meaningless numbers.
+            raise SystemExit(
+                "no checkpoint found in the configured store; drop "
+                "--checkpoint-dir/--checkpoint-store to eval a fresh init")
+        state = ckpt.restore(state, shardings=trainer.state_shardings)
+    metrics = run_eval(cfg, trainer, state,
+                       num_batches=args.eval_steps or cfg.train.eval_steps)
+    print(json.dumps({"checkpoint_step": ckpt_step,
+                      **{k: round(float(v), 6) for k, v in metrics.items()}}))
+    return 0
+
+
 def cmd_worker(args) -> int:
     """Elastic worker: register with the coordinator, train, re-mesh on
     membership changes — the successor of ``./worker ADDR``."""
@@ -324,6 +355,12 @@ def build_parser() -> argparse.ArgumentParser:
     t = sub.add_parser("train", help="run a training job on local devices")
     _add_train_flags(t)
     t.set_defaults(fn=cmd_train)
+
+    e = sub.add_parser("eval", help="forward-only eval (optionally from ckpt)")
+    _add_train_flags(e)
+    e.add_argument("--eval-steps", type=int, default=None,
+                   help="eval batches (default: train.eval_steps)")
+    e.set_defaults(fn=cmd_eval)
 
     w = sub.add_parser("worker", help="elastic worker: join a cluster & train")
     _add_train_flags(w)

@@ -87,11 +87,23 @@ class TrainConfig:
     checkpoint_every: int = 0  # 0 => disabled
     remat: bool = False  # jax.checkpoint the model apply
     donate_state: bool = True
+    # Gradient accumulation: each step scans over `grad_accum` microbatches
+    # of batch_size/grad_accum samples, averaging grads before the single
+    # optimizer update. Trades step latency for a larger effective batch
+    # without growing live activation memory.
+    grad_accum: int = 1
+    eval_every: int = 0  # 0 => no in-loop eval
+    eval_steps: int = 10  # batches per eval pass
 
 
 @dataclass(frozen=True)
 class DataConfig:
     dataset: str = "synthetic_mnist"
+    # Held-out split for eval passes. With a shard server: the published
+    # dataset name to stream (falls back to `dataset` with a distinct
+    # shuffle seed if unset). Without: eval data is synthesized with a seed
+    # disjoint from training.
+    eval_dataset: Optional[str] = None
     shard_server_addr: Optional[str] = None  # None => generate locally
     prefetch: int = 2
     seq_len: int = 128  # LM/MLM datasets

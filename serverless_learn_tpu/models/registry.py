@@ -25,6 +25,9 @@ class ModelBundle:
     make_batch: Callable  # (rng, data_config, batch) -> batch pytree (host)
     task: str  # "classification" | "mlm" | "lm"
     trainable_mask: Optional[Callable] = None  # params -> bool pytree (LoRA)
+    # Inference-mode loss (e.g. BatchNorm running stats instead of batch
+    # stats). None => loss_fn is already deterministic and state-free.
+    eval_loss_fn: Optional[Callable] = None
 
 
 def register_model(name: str):

@@ -115,6 +115,12 @@ def _bundle(module, num_classes, image_shape):
         loss, metrics = softmax_cross_entropy(logits, batch["label"])
         return loss, {"metrics": metrics, "model_state": dict(updates)}
 
+    def eval_loss_fn(params, batch, rngs=None, model_state=None):
+        variables = {"params": params, **(model_state or {})}
+        logits = module.apply(variables, batch["image"], train=False)
+        loss, metrics = softmax_cross_entropy(logits, batch["label"])
+        return loss, {"metrics": metrics, "model_state": {}}
+
     def input_spec(data_config, batch_size):
         return {
             "image": jax.ShapeDtypeStruct((batch_size, *image_shape), jnp.float32),
@@ -129,7 +135,8 @@ def _bundle(module, num_classes, image_shape):
         }
 
     return ModelBundle(module=module, loss_fn=loss_fn, input_spec=input_spec,
-                       make_batch=make_batch, task="classification")
+                       make_batch=make_batch, task="classification",
+                       eval_loss_fn=eval_loss_fn)
 
 
 @register_model("resnet18_cifar")

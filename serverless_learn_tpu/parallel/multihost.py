@@ -35,13 +35,27 @@ MH_TAG = "mh!"
 
 
 def initialize(coordinator_address: str, num_processes: int,
-               process_id: int) -> None:
-    """Explicit-topology init (thin wrapper, kept for symmetry/logging)."""
+               process_id: int, timeout_s: Optional[float] = None) -> None:
+    """Explicit-topology init (thin wrapper, kept for symmetry/logging).
+
+    ``timeout_s`` bounds the coordination-service connect so a host whose
+    world view diverged fails with a clear error instead of hanging for
+    JAX's multi-minute default.
+    """
     import jax
 
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    kwargs = {}
+    if timeout_s is not None:
+        kwargs["initialization_timeout"] = int(timeout_s)
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id, **kwargs)
+    except TypeError:
+        # Older jax without initialization_timeout.
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
 
 
 def free_port(host: str = "127.0.0.1") -> int:
@@ -109,6 +123,18 @@ def bootstrap_via_coordinator(
     agent.start()
     try:
         deadline = time.time() + timeout_s
+        stable_view = None  # (my_id, tuple of ranked worker ids)
+        stable_since = 0.0
+        # Commit to a rank assignment only after the same view has held for
+        # a full stability window (a couple of lease heartbeats). A host
+        # whose lease lapses mid-wait re-registers under a new worker id;
+        # without the window, peers that already committed and this host
+        # would disagree on the rank order / rank-0 endpoint and deadlock
+        # in jax.distributed.initialize. The window doesn't close the race
+        # completely (a lapse *after* commit can still diverge views), so
+        # ``initialize`` additionally gets a bounded timeout below — a
+        # divergent world fails fast instead of hanging.
+        stability_s = max(2.0 * heartbeat_interval_ms / 1000.0, 0.3)
         while True:
             # Re-read each round: the agent transparently re-registers with
             # a fresh worker id if its lease ever lapses mid-wait.
@@ -117,9 +143,24 @@ def bootstrap_via_coordinator(
             hosts = [p for p in peers if p.name.startswith(MH_TAG)]
             if len(hosts) >= world_size:
                 ranked = sorted(hosts, key=lambda p: p.worker_id)[:world_size]
+                view = (my_id, tuple(p.worker_id for p in ranked))
                 if any(p.worker_id == my_id for p in ranked):
-                    break
+                    now = time.time()
+                    if view != stable_view:
+                        stable_view, stable_since = view, now
+                    elif now - stable_since >= stability_s:
+                        break
+                else:
+                    stable_view = None
+            else:
+                stable_view = None
             if time.time() > deadline:
+                if stable_view is not None:
+                    # A complete, consistent view exists right at the
+                    # deadline — commit to it rather than failing a world
+                    # that did form (the stability window is best-effort,
+                    # not part of the formation budget).
+                    break
                 raise TimeoutError(
                     f"world of {world_size} did not form within {timeout_s}s "
                     f"(have {len(hosts)} bootstrap hosts)")
@@ -129,7 +170,11 @@ def bootstrap_via_coordinator(
         jax_coordinator = ranked[0].addr
         hold.close()
         init = _initialize if _initialize is not None else initialize
-        init(jax_coordinator, world_size, rank)
+        try:
+            init(jax_coordinator, world_size, rank,
+                 timeout_s=max(deadline - time.time(), 30.0))
+        except TypeError:
+            init(jax_coordinator, world_size, rank)
         return World(rank=rank, num_processes=world_size,
                      jax_coordinator=jax_coordinator, worker_id=my_id,
                      agent=agent)

@@ -53,6 +53,65 @@ def make_source(config: ExperimentConfig, trainer: Trainer):
                            seed=config.train.seed + jax.process_index())
 
 
+def run_eval(
+    config: ExperimentConfig,
+    trainer: Trainer,
+    state,
+    source=None,
+    num_batches: Optional[int] = None,
+) -> dict:
+    """Forward-only pass over ``num_batches`` eval batches; returns mean metrics.
+
+    The eval step runs in inference mode (e.g. BatchNorm running statistics)
+    and never mutates ``state``. Default source follows the training data
+    config: with a shard server it streams ``data.eval_dataset`` (or, if
+    unset, the training dataset re-shuffled with a disjoint seed — flagged
+    in the metrics as ``eval_on_train_data``); otherwise a held-out
+    synthetic stream (seed offset from training so the data is disjoint).
+    """
+    num_batches = num_batches or config.train.eval_steps
+    created = source is None
+    eval_on_train = False
+    if source is None:
+        n_proc = jax.process_count()
+        eval_seed = config.train.seed + 995_801
+        if config.data.shard_server_addr:
+            from serverless_learn_tpu.data.shard_client import ShardStreamSource
+
+            name = config.data.eval_dataset
+            eval_on_train = name is None
+            source = ShardStreamSource(
+                config.data.shard_server_addr,
+                name or config.data.dataset,
+                config.train.batch_size // n_proc,
+                seed=eval_seed,
+                dp_rank=jax.process_index(),
+                dp_size=n_proc,
+            )
+        else:
+            source = SyntheticSource(
+                trainer.bundle.make_batch, config.data,
+                config.train.batch_size // n_proc,
+                seed=eval_seed + jax.process_index())
+    sums: dict = {}
+    n = 0
+    try:
+        it = iter(source)
+        for _ in range(num_batches):
+            batch = trainer.shard_batch(next(it))
+            metrics = jax.device_get(trainer.eval_step(state, batch))
+            for k, v in metrics.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+            n += 1
+    finally:
+        if created and hasattr(source, "close"):
+            source.close()
+    out = {f"eval_{k}": v / max(n, 1) for k, v in sums.items()}
+    if eval_on_train:
+        out["eval_on_train_data"] = 1.0
+    return out
+
+
 def run_training(
     config: ExperimentConfig,
     trainer: Optional[Trainer] = None,
@@ -93,6 +152,16 @@ def run_training(
                 log_json({"step": stats.step, "step_time_s": round(stats.step_time_s, 5),
                           "samples_per_sec": round(stats.samples_per_sec, 1),
                           **{k: round(v, 5) for k, v in metrics.items()}})
+            if (config.train.eval_every > 0
+                    and (i + 1) % config.train.eval_every == 0):
+                eval_metrics = run_eval(config, trainer, state)
+                if verbose:
+                    log_json({"step": i + 1,
+                              **{k: round(v, 5)
+                                 for k, v in eval_metrics.items()}})
+                # Eval wall time must not bleed into the next step's
+                # throughput window.
+                meter.start()
             if step_callback is not None:
                 step_callback(i + 1, state, stats)
     finally:

@@ -39,6 +39,7 @@ class Trainer:
     mesh: Mesh
     init_fn: Callable  # (seed:int) -> TrainState (sharded, on device)
     step_fn: Callable  # (TrainState, batch) -> (TrainState, metrics)
+    eval_fn: Callable  # (TrainState, batch) -> metrics (no state update)
     state_shardings: Any
     batch_shardings: Any
 
@@ -52,6 +53,11 @@ class Trainer:
         # even if another trainer was built since.
         self._bind_mesh()
         return self.step_fn(state, batch)
+
+    def eval_step(self, state: TrainState, batch):
+        """Forward-only metrics on one batch (inference mode, no state update)."""
+        self._bind_mesh()
+        return self.eval_fn(state, batch)
 
     def _bind_mesh(self):
         from serverless_learn_tpu.parallel.ring_attention import set_active_mesh
@@ -135,6 +141,69 @@ def build_trainer(
                                    model_state=model_state)
         return loss, aux
 
+    accum = config.train.grad_accum
+    if accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {accum}")
+    n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
+    if accum > 1 and batch_size % (accum * n_data):
+        # Each microbatch must itself divide evenly over the data axes, or
+        # the per-microbatch sharding is invalid / forces data movement.
+        raise ValueError(
+            f"batch_size {batch_size} not divisible by grad_accum {accum} "
+            f"x dp*fsdp {n_data}")
+    # Microbatches keep the per-sample sharding; the scan axis is unsharded.
+    micro_shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, P(None, *tuple(s.spec))), b_shardings)
+
+    def grads_and_aux(params, model_state, batch, rng):
+        """(mean grads, last model_state, mean loss, mean metrics).
+
+        accum == 1: single whole-batch backward. accum > 1: ``lax.scan`` over
+        microbatches — activations live only for one microbatch at a time,
+        so live memory is ~1/accum of the whole-batch backward; BatchNorm-style
+        state threads through the scan carry sequentially.
+        """
+        grad_fn = jax.value_and_grad(loss_for_grad, has_aux=True)
+        if accum == 1:
+            (loss, aux), grads = grad_fn(params, model_state, batch, rng)
+            return grads, (aux["model_state"] or model_state), loss, aux["metrics"]
+
+        def to_micro(x, s):
+            b = x.shape[0]
+            if n_data > 1:
+                # Communication-free microbatching: each device's contiguous
+                # batch block splits into `accum` sub-blocks and microbatch m
+                # takes sub-block m from every device. A naive
+                # reshape-to-(accum, b/accum) would need rows that live on
+                # other devices (an all-to-all of the whole batch every
+                # step); this is a pure sample permutation — harmless for
+                # i.i.d. batches, gradient mean unchanged — that keeps every
+                # row on the device that already holds it.
+                local = b // (n_data * accum)
+                mb = x.reshape((n_data, accum, local) + x.shape[1:])
+                mb = jnp.moveaxis(mb, 1, 0)
+                mb = mb.reshape((accum, n_data * local) + x.shape[1:])
+            else:
+                mb = x.reshape((accum, b // accum) + x.shape[1:])
+            return jax.lax.with_sharding_constraint(mb, s)
+
+        micro = jax.tree_util.tree_map(to_micro, batch, micro_shardings)
+
+        def body(carry, xs):
+            g_acc, mstate = carry
+            mb, idx = xs
+            (loss, aux), g = grad_fn(params, mstate,
+                                     mb, jax.random.fold_in(rng, idx))
+            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+            return (g_acc, aux["model_state"] or mstate), (loss, aux["metrics"])
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (g_sum, mstate), (losses, metrics) = jax.lax.scan(
+            body, (zeros, model_state), (micro, jnp.arange(accum)))
+        grads = jax.tree_util.tree_map(lambda g: g / accum, g_sum)
+        metrics = jax.tree_util.tree_map(lambda m: m.mean(), metrics)
+        return grads, mstate, losses.mean(), metrics
+
     donate = (0,) if config.train.donate_state else ()
 
     @partial(jax.jit, donate_argnums=donate,
@@ -143,13 +212,12 @@ def build_trainer(
     def step_fn(state: TrainState, batch):
         rng = jax.random.fold_in(jax.random.PRNGKey(config.train.seed),
                                  state.step)
-        grad_fn = jax.value_and_grad(loss_for_grad, has_aux=True)
-        (loss, aux), grads = grad_fn(state.params, state.model_state, batch, rng)
+        grads, new_model_state, loss, metrics = grads_and_aux(
+            state.params, state.model_state, batch, rng)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree_util.tree_map(
             lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
-        new_model_state = aux["model_state"] or state.model_state
-        metrics = dict(aux["metrics"])
+        metrics = dict(metrics)
         metrics["loss"] = loss
         metrics["grad_norm"] = jnp.sqrt(sum(
             jnp.sum(jnp.square(g.astype(jnp.float32)))
@@ -158,6 +226,17 @@ def build_trainer(
                                opt_state=new_opt, model_state=new_model_state)
         return new_state, metrics
 
+    eval_loss = bundle.eval_loss_fn or bundle.loss_fn
+
+    @partial(jax.jit, in_shardings=(state_shardings, b_shardings),
+             out_shardings=replicated(mesh))
+    def eval_fn(state: TrainState, batch):
+        loss, aux = eval_loss(state.params, batch, rngs=None,
+                              model_state=state.model_state)
+        metrics = dict(aux["metrics"])
+        metrics["loss"] = loss
+        return metrics
+
     return Trainer(config=config, bundle=bundle, mesh=mesh,
-                   init_fn=init_jit, step_fn=step_fn,
+                   init_fn=init_jit, step_fn=step_fn, eval_fn=eval_fn,
                    state_shardings=state_shardings, batch_shardings=b_shardings)
