@@ -8,6 +8,7 @@ Here one typed CLI fronts everything:
 
     python -m serverless_learn_tpu train        # jitted training run
     python -m serverless_learn_tpu eval         # forward-only evaluation
+    python -m serverless_learn_tpu generate     # KV-cache LM sampling
     python -m serverless_learn_tpu worker       # elastic worker (joins a cluster)
     python -m serverless_learn_tpu coordinator  # native membership daemon
     python -m serverless_learn_tpu shard-server # native data-plane daemon
@@ -116,7 +117,8 @@ def _add_train_flags(p: argparse.ArgumentParser):
     p.add_argument("--batch-size", type=int)
     p.add_argument("--steps", type=int)
     p.add_argument("--lr", type=float)
-    p.add_argument("--optimizer", help="adamw | sgd | adafactor")
+    p.add_argument("--optimizer",
+                   help="adamw | adam | sgd | adafactor | lion | rmsprop")
     p.add_argument("--seq-len", type=int)
     p.add_argument("--dataset")
     p.add_argument("--shard-server", metavar="ADDR",
@@ -244,6 +246,52 @@ def cmd_eval(args) -> int:
     return 0
 
 
+def cmd_generate(args) -> int:
+    """Autoregressive sampling from a (possibly checkpointed) causal LM."""
+    import jax
+    import jax.numpy as jnp
+
+    from serverless_learn_tpu.inference.generate import generate
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    if args.world_size or args.num_processes:
+        raise SystemExit(
+            "--world-size/--num-processes form a multi-host group and apply "
+            "to `train`; `generate` is single-process")
+    cfg = _config_from_args(args)
+    trainer = build_trainer(cfg)
+    state = trainer.init()
+    ckpt = _make_checkpointer(args)
+    ckpt_step = None
+    if ckpt is not None:
+        ckpt_step = ckpt.latest_step()
+        if ckpt_step is None:
+            raise SystemExit("no checkpoint found in the configured store")
+        state = ckpt.restore(state, shardings=trainer.state_shardings)
+    if args.prompt:
+        ids = [int(t) for t in args.prompt.split(",")]
+        prompt = jnp.asarray([ids], jnp.int32)
+    else:
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(args.seed), (1, args.prompt_len), 0,
+            trainer.bundle.module.cfg.vocab_size)
+    out = generate(trainer.bundle.module, state.params, prompt,
+                   max_new_tokens=args.max_new_tokens,
+                   temperature=args.temperature, top_k=args.top_k,
+                   eos_id=args.eos_id,
+                   rng=jax.random.PRNGKey(args.seed))
+    print(json.dumps({"checkpoint_step": ckpt_step,
+                      "prompt": np_tolist(prompt),
+                      "tokens": np_tolist(out)}))
+    return 0
+
+
+def np_tolist(x):
+    import numpy as np
+
+    return np.asarray(x).tolist()
+
+
 def cmd_worker(args) -> int:
     """Elastic worker: register with the coordinator, train, re-mesh on
     membership changes — the successor of ``./worker ADDR``."""
@@ -361,6 +409,18 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("--eval-steps", type=int, default=None,
                    help="eval batches (default: train.eval_steps)")
     e.set_defaults(fn=cmd_eval)
+
+    g = sub.add_parser("generate", help="sample tokens from a causal LM")
+    _add_train_flags(g)
+    g.add_argument("--prompt", help="comma-separated prompt token ids")
+    g.add_argument("--prompt-len", type=int, default=8,
+                   help="random prompt length when --prompt is unset")
+    g.add_argument("--max-new-tokens", type=int, default=32)
+    g.add_argument("--temperature", type=float, default=0.0)
+    g.add_argument("--top-k", type=int, default=0)
+    g.add_argument("--eos-id", type=int, default=None)
+    g.add_argument("--seed", type=int, default=0)
+    g.set_defaults(fn=cmd_generate)
 
     w = sub.add_parser("worker", help="elastic worker: join a cluster & train")
     _add_train_flags(w)

@@ -65,7 +65,7 @@ class MeshConfig:
 
 @dataclass(frozen=True)
 class OptimizerConfig:
-    name: str = "adamw"  # adamw | sgd | adafactor
+    name: str = "adamw"  # adamw | adam | sgd | adafactor | lion | rmsprop
     learning_rate: float = 1e-3
     weight_decay: float = 0.0
     b1: float = 0.9

@@ -1,0 +1,3 @@
+from serverless_learn_tpu.inference.generate import generate
+
+__all__ = ["generate"]

@@ -41,7 +41,17 @@ def _bundle(cfg: TransformerConfig, mask_rate: float = 0.15):
         loss, metrics = masked_lm_loss(logits, batch["labels"], batch["mlm_mask"])
         if cfg.n_experts > 0:
             metrics = dict(metrics, moe_aux_loss=aux)
-        return loss + aux, {"metrics": metrics, "model_state": {}}
+        # loss_weight: the masked-token count this loss normalized by.
+        # Gradient accumulation weights microbatch grads by it so accum runs
+        # reproduce the whole-batch MLM gradient exactly (microbatches hold
+        # different numbers of masked tokens). With n_experts > 0 the MoE
+        # router aux loss (uniformly normalized) rides the same weighting,
+        # so its gradient is approximate under accum — a deliberate trade:
+        # the task loss stays exact, and the aux term is a regularizer.
+        return loss + aux, {"metrics": metrics, "model_state": {},
+                            "loss_weight": jnp.maximum(
+                                batch["mlm_mask"].astype(jnp.float32).sum(),
+                                1.0)}
 
     def input_spec(data_config, batch_size):
         T = data_config.seq_len

@@ -80,6 +80,37 @@ def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
     return jnp.stack([r1, r2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
+def constrain_residual(x: jax.Array) -> jax.Array:
+    """Pin a [B, T, D] residual-stream activation to batch (+sp) sharding.
+
+    Without this, GSPMD propagates the embedding table's tp sharding into
+    the residual stream and then pays an "involuntary full rematerialization"
+    reshard in the backward pass (observed on dp×fsdp×tp meshes). The
+    residual stream is canonically batch-sharded; tp lives only inside the
+    projections.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from serverless_learn_tpu.parallel.ring_attention import get_active_mesh
+
+    mesh = get_active_mesh()
+    if mesh is None or x.ndim < 3:
+        return x
+    batch = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+    n_batch = 1
+    for a in batch:
+        n_batch *= mesh.shape[a]
+    if batch and x.shape[0] % n_batch:
+        batch = ()  # e.g. batch-1 decoding under a training mesh
+    seq = "sp" if mesh.shape.get("sp", 1) > 1 else None
+    if seq and x.shape[1] % mesh.shape["sp"]:
+        seq = None  # single-token decode steps can't shard the seq dim
+    if not batch and seq is None:
+        return x
+    spec = P(batch if batch else None, seq, None)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 class LoRAAdapter(nn.Module):
     """Low-rank delta added to a frozen projection's output: x @ A @ B * s."""
 
@@ -104,7 +135,8 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, *, mask=None, positions=None):
+    def __call__(self, x, *, mask=None, positions=None, decode=False,
+                 prefill=False):
         cfg = self.cfg
         H, K, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
         dense = lambda feats, name: nn.DenseGeneral(
@@ -118,15 +150,64 @@ class Attention(nn.Module):
                                 cfg.dtype, cfg.param_dtype, name="q_lora")(x)
             v = v + LoRAAdapter(cfg.lora_rank, cfg.lora_alpha, (K, D),
                                 cfg.dtype, cfg.param_dtype, name="v_lora")(x)
-        if cfg.use_rope:
+        causal = cfg.causal
+        if decode or prefill:
+            # Autoregressive KV cache. decode: x is the single newest token
+            # ([B, 1, d_model]); K/V land at slot `cache_index` and
+            # attention reads the whole cache under a <= index mask. RoPE
+            # must use the absolute position, which *is* the cache index —
+            # so rotation happens inside this branch. prefill: one batched
+            # causal forward over the whole prompt that bulk-writes the
+            # cache (slots [0, T)) instead of T sequential decode steps.
+            B = x.shape[0]
+            is_init = not self.has_variable("cache", "cached_k")
+            ck = self.variable("cache", "cached_k", jnp.zeros,
+                               (B, cfg.max_seq_len, K, D), k.dtype)
+            cv = self.variable("cache", "cached_v", jnp.zeros,
+                               (B, cfg.max_seq_len, K, D), v.dtype)
+            ci = self.variable("cache", "cache_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            if not is_init and prefill:
+                T = x.shape[1]
+                if cfg.use_rope:
+                    p = jnp.broadcast_to(
+                        jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+                    sin, cos = rope_angles(p, D, cfg.rope_theta)
+                    q = apply_rope(q, sin, cos)
+                    k = apply_rope(k, sin, cos)
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k, (0, 0, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v, (0, 0, 0, 0))
+                ci.value = jnp.asarray(T, jnp.int32)
+                # Attention runs causally over just the prompt-length K/V.
+            elif not is_init:
+                if x.shape[1] != 1:
+                    raise ValueError(
+                        f"decode feeds one token at a time, got T={x.shape[1]}")
+                pos = ci.value
+                if cfg.use_rope:
+                    p = jnp.full((B, 1), pos, jnp.int32)
+                    sin, cos = rope_angles(p, D, cfg.rope_theta)
+                    q = apply_rope(q, sin, cos)
+                    k = apply_rope(k, sin, cos)
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k, (0, pos, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v, (0, pos, 0, 0))
+                ci.value = pos + 1
+                k, v = ck.value, cv.value
+                mask = (jnp.arange(cfg.max_seq_len) <= pos)[None, None, None, :]
+                causal = False  # the index mask already encodes causality
+        elif cfg.use_rope:
             if positions is None:
                 positions = jnp.arange(x.shape[1])[None, :]
             sin, cos = rope_angles(positions, D, cfg.rope_theta)
             q = apply_rope(q, sin, cos)
             k = apply_rope(k, sin, cos)
         out = dot_product_attention(
-            q, k, v, causal=cfg.causal, mask=mask,
-            impl=cfg.attention_impl,
+            q, k, v, causal=causal, mask=mask,
+            impl="xla" if (decode or prefill) else cfg.attention_impl,
             axis_name=cfg.sp_axis or "sp")
         return nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=False,
                                name="o_proj", dtype=cfg.dtype,
@@ -154,13 +235,15 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, *, mask=None, positions=None):
+    def __call__(self, x, *, mask=None, positions=None, decode=False,
+                 prefill=False):
         cfg = self.cfg
         norm = (nn.RMSNorm if cfg.norm == "rms" else nn.LayerNorm)
         mk_norm = lambda name: norm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                                     name=name)
         x = x + Attention(cfg, name="attn")(
-            mk_norm("norm_attn")(x), mask=mask, positions=positions)
+            mk_norm("norm_attn")(x), mask=mask, positions=positions,
+            decode=decode, prefill=prefill)
         if cfg.n_experts > 0:
             x = x + MoELayer(cfg, name="moe")(mk_norm("norm_mlp")(x))
         else:
@@ -223,9 +306,26 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, *, mask=None, positions=None):
-        """tokens [B, T] int32 -> logits [B, T, vocab]."""
+    def __call__(self, tokens, *, mask=None, positions=None, decode=False,
+                 prefill=False):
+        """tokens [B, T] int32 -> logits [B, T, vocab].
+
+        ``decode=True``: autoregressive inference mode — ``tokens`` is the
+        single newest token per sequence ([B, 1]) and each attention layer
+        maintains a KV cache in the ``cache`` variable collection.
+        ``prefill=True``: one batched causal forward over the prompt that
+        bulk-writes the cache (see ``inference/generate.py`` for the driver).
+        """
         cfg = self.cfg
+        if decode and prefill:
+            raise ValueError("decode and prefill are mutually exclusive")
+        if (decode or prefill) and cfg.pipeline:
+            raise NotImplementedError("decode with pipeline=True")
+        if (decode or prefill) and not cfg.causal:
+            raise ValueError("decode requires a causal model")
+        if (decode or prefill) and not cfg.use_rope:
+            # Learned positions would need the cache index at this level.
+            raise NotImplementedError("decode requires use_rope=True")
         if cfg.pipeline and cfg.n_experts > 0:
             # GPipe stages re-apply Block under a nested module.apply that
             # does not thread the "losses" sow collection, which would
@@ -235,7 +335,7 @@ class Transformer(nn.Module):
                 "router aux loss cannot propagate out of pipeline stages")
         embed = nn.Embed(cfg.vocab_size, cfg.d_model, name="embedder",
                          dtype=cfg.dtype, param_dtype=cfg.param_dtype)
-        x = embed(tokens)
+        x = constrain_residual(embed(tokens))
         if not cfg.use_rope:
             pos = positions if positions is not None else (
                 jnp.arange(tokens.shape[1])[None, :])
@@ -247,11 +347,14 @@ class Transformer(nn.Module):
                                                       positions=positions)
         else:
             block = Block
-            if cfg.remat:
+            if cfg.remat and not (decode or prefill):
                 block = nn.remat(Block, static_argnums=())
             for i in range(cfg.n_layers):
-                x = block(cfg, name=f"layer_{i}")(x, mask=mask,
-                                                  positions=positions)
+                x = constrain_residual(
+                    block(cfg, name=f"layer_{i}")(x, mask=mask,
+                                                  positions=positions,
+                                                  decode=decode,
+                                                  prefill=prefill))
         norm = (nn.RMSNorm if cfg.norm == "rms" else nn.LayerNorm)
         x = norm(dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="norm_f")(x)
         if cfg.tie_embeddings:

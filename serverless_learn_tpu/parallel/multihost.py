@@ -44,15 +44,19 @@ def initialize(coordinator_address: str, num_processes: int,
     """
     import jax
 
-    kwargs = {}
-    if timeout_s is not None:
-        kwargs["initialization_timeout"] = int(timeout_s)
+    if timeout_s is None:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        return
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
-                                   process_id=process_id, **kwargs)
-    except TypeError:
-        # Older jax without initialization_timeout.
+                                   process_id=process_id,
+                                   initialization_timeout=int(timeout_s))
+    except TypeError as e:
+        if "initialization_timeout" not in str(e):
+            raise  # a real argument bug, not a missing-kwarg jax version
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)
@@ -170,11 +174,16 @@ def bootstrap_via_coordinator(
         jax_coordinator = ranked[0].addr
         hold.close()
         init = _initialize if _initialize is not None else initialize
-        try:
+        if _initialize is not None:
+            # Test hooks may not take the timeout keyword.
+            try:
+                init(jax_coordinator, world_size, rank,
+                     timeout_s=max(deadline - time.time(), 30.0))
+            except TypeError:
+                init(jax_coordinator, world_size, rank)
+        else:
             init(jax_coordinator, world_size, rank,
                  timeout_s=max(deadline - time.time(), 30.0))
-        except TypeError:
-            init(jax_coordinator, world_size, rank)
         return World(rank=rank, num_processes=world_size,
                      jax_coordinator=jax_coordinator, worker_id=my_id,
                      agent=agent)

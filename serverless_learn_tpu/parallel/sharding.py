@@ -96,7 +96,11 @@ DEFAULT_RULES = ShardingRules(
         (r"o_proj/kernel$", P("tp", None, "fsdp")),
         (r"(wi|wi_0|wi_1|up_proj|gate_proj)/kernel$", P("fsdp", "tp")),
         (r"(wo|down_proj)/kernel$", P("tp", "fsdp")),
-        (r"embed(der|ding)?/embedding$", P("tp", "fsdp")),
+        # Vocab over tp+fsdp, d_model unsharded: a d_model-sharded table
+        # propagates its sharding into the lookup's output and the SPMD
+        # partitioner pays an involuntary full-remat reshard moving it back
+        # to the batch-sharded residual stream.
+        (r"embed(der|ding)?/embedding$", P(("tp", "fsdp"), None)),
         (r"lm_head/kernel$", P("fsdp", "tp")),
         (r"lora_a/kernel$", P("fsdp", None)),
         (r"lora_b/kernel$", P(None, "tp")),

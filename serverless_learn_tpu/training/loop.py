@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 import jax
+import numpy as np
 
 from serverless_learn_tpu.config import ExperimentConfig
 from serverless_learn_tpu.data.datasets import Prefetcher, SyntheticSource
@@ -19,12 +20,14 @@ from serverless_learn_tpu.utils.metrics import ThroughputMeter, log_json
 from serverless_learn_tpu.utils.tracing import get_tracer, step_annotation
 
 
-def make_source(config: ExperimentConfig, trainer: Trainer):
-    """Pick the host batch source for a config.
+def make_source(config: ExperimentConfig, trainer: Trainer,
+                dataset: Optional[str] = None, seed: Optional[int] = None):
+    """Pick a host batch source for a config.
 
     ``data.shard_server_addr`` set => stream the named dataset from the
     native shard server (pull-based data plane); otherwise synthesize
-    batches locally from the model bundle.
+    batches locally from the model bundle. ``dataset``/``seed`` override
+    the config's training split — the eval path uses them.
     """
     # Each process handles only its 1/process_count slice of the global
     # batch; Trainer.shard_batch assembles the global array from the
@@ -34,15 +37,16 @@ def make_source(config: ExperimentConfig, trainer: Trainer):
         raise ValueError(
             f"batch_size {config.train.batch_size} not divisible by "
             f"process count {n_proc}")
+    seed = config.train.seed if seed is None else seed
     if config.data.shard_server_addr:
         from serverless_learn_tpu.data.shard_client import ShardStreamSource
 
         # Stream the named dataset from the worker's own stripe of shards.
         return ShardStreamSource(
             config.data.shard_server_addr,
-            config.data.dataset,
+            dataset or config.data.dataset,
             config.train.batch_size // n_proc,
-            seed=config.train.seed,
+            seed=seed,
             dp_rank=jax.process_index(),
             dp_size=n_proc,
         )
@@ -50,7 +54,22 @@ def make_source(config: ExperimentConfig, trainer: Trainer):
     # so hosts don't all produce identical data).
     return SyntheticSource(trainer.bundle.make_batch, config.data,
                            config.train.batch_size // n_proc,
-                           seed=config.train.seed + jax.process_index())
+                           seed=seed + jax.process_index())
+
+
+def eval_uses_train_data(config: ExperimentConfig) -> bool:
+    """True when eval batches come from the *training* split (shard server
+    configured but no ``data.eval_dataset`` published) — the single predicate
+    both the in-loop and standalone eval paths tag their metrics with."""
+    return bool(config.data.shard_server_addr) and not config.data.eval_dataset
+
+
+def make_eval_source(config: ExperimentConfig, trainer: Trainer):
+    """Held-out source for eval passes: ``data.eval_dataset`` from the shard
+    server if published, else the training source re-seeded disjointly."""
+    return make_source(config, trainer,
+                       dataset=config.data.eval_dataset or None,
+                       seed=config.train.seed + 995_801)
 
 
 def run_eval(
@@ -71,28 +90,9 @@ def run_eval(
     """
     num_batches = num_batches or config.train.eval_steps
     created = source is None
-    eval_on_train = False
+    eval_on_train = created and eval_uses_train_data(config)
     if source is None:
-        n_proc = jax.process_count()
-        eval_seed = config.train.seed + 995_801
-        if config.data.shard_server_addr:
-            from serverless_learn_tpu.data.shard_client import ShardStreamSource
-
-            name = config.data.eval_dataset
-            eval_on_train = name is None
-            source = ShardStreamSource(
-                config.data.shard_server_addr,
-                name or config.data.dataset,
-                config.train.batch_size // n_proc,
-                seed=eval_seed,
-                dp_rank=jax.process_index(),
-                dp_size=n_proc,
-            )
-        else:
-            source = SyntheticSource(
-                trainer.bundle.make_batch, config.data,
-                config.train.batch_size // n_proc,
-                seed=eval_seed + jax.process_index())
+        source = make_eval_source(config, trainer)
     sums: dict = {}
     n = 0
     try:
@@ -107,6 +107,10 @@ def run_eval(
         if created and hasattr(source, "close"):
             source.close()
     out = {f"eval_{k}": v / max(n, 1) for k, v in sums.items()}
+    if "eval_perplexity" in out:
+        # Derive from the mean loss; a mean of per-batch exp(loss) would be
+        # Jensen-biased and incomparable across eval_steps settings.
+        out["eval_perplexity"] = float(np.exp(out["eval_loss"]))
     if eval_on_train:
         out["eval_on_train_data"] = 1.0
     return out
@@ -138,6 +142,7 @@ def run_training(
     meter.start()
     start_step = int(jax.device_get(state.step))
     tracer = get_tracer()
+    eval_source = None  # created once at first eval pass, reused after
     try:
         for i, batch in zip(range(start_step, config.train.num_steps), prefetch):
             with step_annotation(i + 1), tracer.span("train/step",
@@ -154,7 +159,12 @@ def run_training(
                           **{k: round(v, 5) for k, v in metrics.items()}})
             if (config.train.eval_every > 0
                     and (i + 1) % config.train.eval_every == 0):
-                eval_metrics = run_eval(config, trainer, state)
+                if eval_source is None:
+                    eval_source = make_eval_source(config, trainer)
+                eval_metrics = run_eval(config, trainer, state,
+                                        source=eval_source)
+                if eval_uses_train_data(config):
+                    eval_metrics["eval_on_train_data"] = 1.0
                 if verbose:
                     log_json({"step": i + 1,
                               **{k: round(v, 5)
@@ -168,4 +178,6 @@ def run_training(
         prefetch.close()
         if created_source and hasattr(source, "close"):
             source.close()
+        if eval_source is not None and hasattr(eval_source, "close"):
+            eval_source.close()
     return state, meter

@@ -26,10 +26,17 @@ def make_optimizer(cfg: OptimizerConfig, trainable_mask=None) -> optax.GradientT
     if cfg.name == "adamw":
         core = optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2,
                            weight_decay=cfg.weight_decay)
+    elif cfg.name == "adam":
+        core = optax.adam(schedule, b1=cfg.b1, b2=cfg.b2)
     elif cfg.name == "sgd":
         core = optax.sgd(schedule, momentum=cfg.momentum)
     elif cfg.name == "adafactor":
         core = optax.adafactor(schedule)
+    elif cfg.name == "lion":
+        core = optax.lion(schedule, b1=cfg.b1, b2=cfg.b2,
+                          weight_decay=cfg.weight_decay)
+    elif cfg.name == "rmsprop":
+        core = optax.rmsprop(schedule, momentum=cfg.momentum)
     else:
         raise ValueError(f"unknown optimizer {cfg.name!r}")
     parts = []

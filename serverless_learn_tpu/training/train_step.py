@@ -87,7 +87,12 @@ def build_trainer(
     mesh: Optional[Mesh] = None,
     rules: Optional[ShardingRules] = None,
 ) -> Trainer:
-    bundle = get_model(config.model, **config.model_overrides)
+    # train.dtype / train.param_dtype are the config-level mixed-precision
+    # policy; explicit model_overrides win.
+    overrides = dict(config.model_overrides)
+    overrides.setdefault("dtype", jnp.dtype(config.train.dtype))
+    overrides.setdefault("param_dtype", jnp.dtype(config.train.param_dtype))
+    bundle = get_model(config.model, **overrides)
     if mesh is None:
         mesh = make_mesh(config.mesh)
     tx = make_optimizer(config.optimizer, bundle.trainable_mask)
@@ -190,19 +195,31 @@ def build_trainer(
         micro = jax.tree_util.tree_map(to_micro, batch, micro_shardings)
 
         def body(carry, xs):
-            g_acc, mstate = carry
+            g_acc, w_acc, mstate = carry
             mb, idx = xs
             (loss, aux), g = grad_fn(params, mstate,
                                      mb, jax.random.fold_in(rng, idx))
-            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
-            return (g_acc, aux["model_state"] or mstate), (loss, aux["metrics"])
+            # Losses with data-dependent normalization (MLM divides by the
+            # microbatch's masked-token count) report that denominator as
+            # aux["loss_weight"]; weighting each microbatch's gradient by it
+            # reproduces the whole-batch gradient exactly. Uniform losses
+            # omit it (weight 1) and reduce to a plain mean.
+            w = aux.get("loss_weight", jnp.float32(1.0))
+            g_acc = jax.tree_util.tree_map(
+                lambda a, gi: a + w * gi.astype(a.dtype), g_acc, g)
+            return ((g_acc, w_acc + w, aux["model_state"] or mstate),
+                    (loss * w, jax.tree_util.tree_map(lambda m: m * w,
+                                                      aux["metrics"])))
 
-        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
-        (g_sum, mstate), (losses, metrics) = jax.lax.scan(
-            body, (zeros, model_state), (micro, jnp.arange(accum)))
-        grads = jax.tree_util.tree_map(lambda g: g / accum, g_sum)
-        metrics = jax.tree_util.tree_map(lambda m: m.mean(), metrics)
-        return grads, mstate, losses.mean(), metrics
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (g_sum, w_sum, mstate), (losses, metrics) = jax.lax.scan(
+            body, (zeros, jnp.float32(0.0), model_state),
+            (micro, jnp.arange(accum)))
+        grads = jax.tree_util.tree_map(
+            lambda g, p: (g / w_sum).astype(p.dtype), g_sum, params)
+        metrics = jax.tree_util.tree_map(lambda m: m.sum() / w_sum, metrics)
+        return grads, mstate, losses.sum() / w_sum, metrics
 
     donate = (0,) if config.train.donate_state else ()
 
@@ -218,6 +235,11 @@ def build_trainer(
         new_params = jax.tree_util.tree_map(
             lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
         metrics = dict(metrics)
+        if "perplexity" in metrics:
+            # exp() is nonlinear: averaging per-microbatch perplexities
+            # (Jensen) would make the metric depend on grad_accum. The
+            # averaged loss is exact, so derive perplexity from it.
+            metrics["perplexity"] = jnp.exp(loss)
         metrics["loss"] = loss
         metrics["grad_norm"] = jnp.sqrt(sum(
             jnp.sum(jnp.square(g.astype(jnp.float32)))

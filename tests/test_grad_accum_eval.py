@@ -96,10 +96,38 @@ def test_run_eval_mean_metrics(devices):
     assert np.isfinite(out["eval_loss"])
 
 
-def test_in_loop_eval_fires(devices):
+def test_mlm_grad_accum_matches_whole_batch(devices):
+    """Masked-LM normalizes by the microbatch's masked-token count; the
+    loss_weight plumbing must still reproduce the whole-batch update."""
+    base = _cfg(model="bert_tiny", batch_size=32,
+                model_overrides={"dtype": jnp.float32})
+    p1, m1 = _one_step(base)
+    p4, m4 = _one_step(base.override(
+        train=TrainConfig(batch_size=32, num_steps=3, grad_accum=4)))
+    np.testing.assert_allclose(m1["loss"], m4["loss"], rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_in_loop_eval_fires(devices, monkeypatch):
+    import serverless_learn_tpu.training.loop as loop_mod
+
+    calls = []
+    real = loop_mod.run_eval
+
+    def spy(config, trainer, state, **kw):
+        out = real(config, trainer, state, **kw)
+        calls.append(out)
+        return out
+
+    monkeypatch.setattr(loop_mod, "run_eval", spy)
     cfg = _cfg(batch_size=16, num_steps=4, eval_every=2, eval_steps=2)
     state, meter = run_training(cfg)
     assert int(jax.device_get(state.step)) == 4
+    assert len(calls) == 2, "eval_every=2 over 4 steps must eval twice"
+    assert all(np.isfinite(c["eval_loss"]) for c in calls)
 
 
 def test_run_eval_streams_from_shard_server(devices, tmp_path):

@@ -111,6 +111,18 @@ def test_llama_tiny_fsdp_tp(devices):
     assert all(np.isfinite(l) for l in losses)
 
 
+def test_train_dtype_policy_reaches_model(devices):
+    """train.param_dtype flows into the model unless model_overrides says
+    otherwise."""
+    cfg = _cfg(mesh=MeshConfig(dp=8), batch_size=16)
+    cfg = cfg.override(train=TrainConfig(batch_size=16, num_steps=1,
+                                         param_dtype="bfloat16"))
+    trainer = build_trainer(cfg)
+    state = trainer.init()
+    leaves = jax.tree_util.tree_leaves(state.params)
+    assert all(l.dtype == jnp.bfloat16 for l in leaves)
+
+
 def test_llama_lora_freezes_base(devices):
     cfg = _cfg(model="llama_tiny", mesh=MeshConfig(dp=8), batch_size=8)
     cfg = cfg.override(model_overrides={"lora_rank": 4})
