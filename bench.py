@@ -16,7 +16,7 @@ import time
 
 CPU_WORKER_BASELINE_SPS = 12.09  # ResNet-18 CIFAR b128, JAX CPU, this image
 
-BATCH = 256
+BATCH = 512  # batch sweep on the v-chip: 256 -> ~26.9k, 512 -> ~29.8k sps
 WARMUP = 3
 STEPS = 20
 

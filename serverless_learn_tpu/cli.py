@@ -260,14 +260,26 @@ def cmd_generate(args) -> int:
             "to `train`; `generate` is single-process")
     cfg = _config_from_args(args)
     trainer = build_trainer(cfg)
-    state = trainer.init()
     ckpt = _make_checkpointer(args)
     ckpt_step = None
     if ckpt is not None:
+        # Params-only restore: deserialize the full TrainState on the host
+        # but place ONLY params on device — optimizer moments (~2x params
+        # for adamw) never touch HBM in a pure-forward workload.
         ckpt_step = ckpt.latest_step()
         if ckpt_step is None:
             raise SystemExit("no checkpoint found in the configured store")
-        state = ckpt.restore(state, shardings=trainer.state_shardings)
+        abstract = jax.eval_shape(lambda: trainer.init_fn(0))
+        host = ckpt.restore_host(abstract, step=ckpt_step)
+        params = jax.tree_util.tree_map(
+            jax.device_put, host.params, trainer.state_shardings.params)
+    else:
+        init_params = jax.jit(
+            lambda: trainer.bundle.module.init(
+                jax.random.PRNGKey(cfg.train.seed),
+                jnp.zeros((1, 8), jnp.int32))["params"],
+            out_shardings=trainer.state_shardings.params)
+        params = init_params()
     if args.prompt:
         ids = [int(t) for t in args.prompt.split(",")]
         prompt = jnp.asarray([ids], jnp.int32)
@@ -275,7 +287,7 @@ def cmd_generate(args) -> int:
         prompt = jax.random.randint(
             jax.random.PRNGKey(args.seed), (1, args.prompt_len), 0,
             trainer.bundle.module.cfg.vocab_size)
-    out = generate(trainer.bundle.module, state.params, prompt,
+    out = generate(trainer.bundle.module, params, prompt,
                    max_new_tokens=args.max_new_tokens,
                    temperature=args.temperature, top_k=args.top_k,
                    eos_id=args.eos_id,

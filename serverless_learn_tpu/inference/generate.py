@@ -56,21 +56,30 @@ def _generate_jit(module, params, cache, prompt, max_new_tokens: int,
     cache = updated["cache"]
     last_logits = prefill_logits[:, -1]
 
-    def step(carry, step_rng):
-        cache, logits, done = carry
+    def pick(logits, step_rng, done):
         tok = _sample(logits, step_rng, temperature, top_k)
         if eos_id is not None:
             tok = jnp.where(done, eos_id, tok)
             done = done | (tok == eos_id)
+        return tok, done
+
+    def step(carry, step_rng):
+        cache, logits, done = carry
+        tok, done = pick(logits, step_rng, done)
         cache, logits = one(cache, tok)
         return (cache, logits, done), tok
 
+    # Scan N-1 sample+forward steps, then sample the last token directly —
+    # a final in-scan forward would compute logits nobody reads (a whole
+    # wasted model invocation for short completions).
+    rngs = jax.random.split(rng, max_new_tokens)
     done0 = jnp.zeros((prompt.shape[0],), jnp.bool_)
-    (cache, _, _), new_tokens = jax.lax.scan(
-        step, (cache, last_logits, done0),
-        jax.random.split(rng, max_new_tokens))
-    return jnp.concatenate([prompt, jnp.swapaxes(new_tokens, 0, 1)],
-                           axis=1), cache
+    (cache, logits, done), new_tokens = jax.lax.scan(
+        step, (cache, last_logits, done0), rngs[:-1])
+    last_tok, _ = pick(logits, rngs[-1], done)
+    new_tokens = jnp.concatenate(
+        [jnp.swapaxes(new_tokens, 0, 1), last_tok[:, None]], axis=1)
+    return jnp.concatenate([prompt, new_tokens], axis=1), cache
 
 
 def init_cache(module, batch_size: int):

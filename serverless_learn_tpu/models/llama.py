@@ -35,13 +35,13 @@ def _llama_cfg(size: str, **overrides) -> TransformerConfig:
     return TransformerConfig(**kw)
 
 
-def _bundle(cfg: TransformerConfig):
+def _bundle(cfg: TransformerConfig, fused_ce: bool = False):
     module = Transformer(cfg)
 
     def loss_fn(params, batch, rngs=None, model_state=None):
         # apply_with_losses so n_experts model_overrides keep their aux loss
         logits, aux = apply_with_losses(module, params, batch["tokens"])
-        loss, metrics = causal_lm_loss(logits, batch["tokens"])
+        loss, metrics = causal_lm_loss(logits, batch["tokens"], fused=fused_ce)
         if cfg.n_experts > 0:
             metrics = dict(metrics, moe_aux_loss=aux)
         return loss + aux, {"metrics": metrics, "model_state": {}}
@@ -73,15 +73,17 @@ def lora_trainable_mask(params):
 
 
 @register_model("llama_tiny")
-def make_llama_tiny(**overrides):
-    return _bundle(_llama_cfg("tiny", **overrides))
+def make_llama_tiny(fused_ce=False, **overrides):
+    return _bundle(_llama_cfg("tiny", **overrides), fused_ce=fused_ce)
 
 
 @register_model("llama_1b")
-def make_llama_1b(**overrides):
-    return _bundle(_llama_cfg("1b", **overrides))
+def make_llama_1b(fused_ce=True, **overrides):
+    # Fused loss by default: at V=128256 the fp32 softmax round-trip is the
+    # dominant HBM cost of the step (ops/pallas/cross_entropy.py).
+    return _bundle(_llama_cfg("1b", **overrides), fused_ce=fused_ce)
 
 
 @register_model("llama_8b")
-def make_llama_8b(**overrides):
-    return _bundle(_llama_cfg("8b", **overrides))
+def make_llama_8b(fused_ce=True, **overrides):
+    return _bundle(_llama_cfg("8b", **overrides), fused_ce=fused_ce)

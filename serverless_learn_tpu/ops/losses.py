@@ -31,10 +31,21 @@ def masked_lm_loss(logits: jax.Array, labels: jax.Array, mask: jax.Array):
     return loss, {"accuracy": acc}
 
 
-def causal_lm_loss(logits: jax.Array, tokens: jax.Array):
-    """Next-token loss. logits [B, T, V], tokens [B, T]; predicts tokens[:, 1:]."""
-    logits = logits[:, :-1].astype(jnp.float32)
+def causal_lm_loss(logits: jax.Array, tokens: jax.Array, fused: bool = False):
+    """Next-token loss. logits [B, T, V], tokens [B, T]; predicts tokens[:, 1:].
+
+    ``fused=True`` streams the vocab axis through a Pallas kernel instead of
+    materializing fp32 probabilities in HBM (``ops/pallas/cross_entropy.py``)
+    — the win grows with vocab size.
+    """
     targets = tokens[:, 1:]
-    raw = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    if fused:
+        from serverless_learn_tpu.ops.pallas.cross_entropy import (
+            fused_cross_entropy_with_integer_labels)
+
+        raw = fused_cross_entropy_with_integer_labels(logits[:, :-1], targets)
+    else:
+        raw = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1].astype(jnp.float32), targets)
     loss = raw.mean()
     return loss, {"perplexity": jnp.exp(loss)}

@@ -1,0 +1,220 @@
+"""Fused softmax cross-entropy (integer labels) as a Pallas TPU kernel.
+
+The LM loss is the other HBM-bandwidth hot spot besides attention: the naive
+path upcasts the whole ``[B*T, V]`` logit matrix to fp32, writes softmax
+probabilities back to HBM, and reads them again in the backward — for a
+Llama-class vocab (128k) that round-trip dwarfs the matmul that produced the
+logits. Here the vocab axis streams through VMEM in tiles with an
+online-softmax reduction (same trick as flash attention,
+``ops/pallas/flash_attention.py``): the forward keeps only ``[N]``-sized
+running max / sum / picked-logit state, and the backward recomputes
+``softmax - onehot`` tile by tile from the saved logsumexp. fp32 exists only
+inside VMEM tiles; HBM traffic is the bf16 logits (read twice) plus O(N)
+vectors.
+
+Reference has no loss function at all (training is simulated,
+``src/worker.cc:221-231``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30
+DEFAULT_BLOCK_N = 128
+# 256 divides every vocab this framework ships: 512 (llama_tiny), 32000
+# (transformer default), and 128256 (llama_1b/8b — NOT a multiple of 512,
+# which would silently fall back on exactly the configs the kernel targets).
+DEFAULT_BLOCK_V = 256
+
+
+def _fwd_kernel(x_ref, lab_ref, loss_ref, lse_ref, m_ref, l_ref, xl_ref):
+    # Grid (n_row_blocks, n_vocab_blocks); vocab is the streamed (innermost)
+    # axis, scratch persists across it.
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+    block_n, block_v = x_ref.shape
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        xl_ref[...] = jnp.zeros_like(xl_ref)
+
+    x = x_ref[...].astype(jnp.float32)
+    m_prev = m_ref[:, 0]
+    m_new = jnp.maximum(m_prev, x.max(axis=1))
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_ref[:, 0] * alpha + jnp.exp(x - m_new[:, None]).sum(axis=1)
+    m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    # Pick x[row, label] when the label falls inside this vocab tile.
+    lab = lab_ref[...]  # [block_n] int32 (absolute vocab ids)
+    idx = lab - j * block_v
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block_n, block_v), 1)
+    picked = jnp.where(cols == idx[:, None], x, 0.0).sum(axis=1)
+    xl_ref[...] = xl_ref[...] + jnp.broadcast_to(
+        picked[:, None], xl_ref.shape)
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        lse = m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30))
+        loss_ref[...] = lse - xl_ref[:, 0]
+        lse_ref[...] = lse
+
+
+def _bwd_kernel(x_ref, lab_ref, lse_ref, g_ref, dx_ref):
+    j = pl.program_id(1)
+    block_n, block_v = x_ref.shape
+    x = x_ref[...].astype(jnp.float32)
+    p = jnp.exp(x - lse_ref[...][:, None])
+    lab = lab_ref[...]
+    idx = lab - j * block_v
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block_n, block_v), 1)
+    onehot = (cols == idx[:, None]).astype(jnp.float32)
+    dx_ref[...] = ((p - onehot) * g_ref[...][:, None]).astype(dx_ref.dtype)
+
+
+def _ce_fwd(logits, labels, block_n, block_v, interpret):
+    N, V = logits.shape
+    grid = (N // block_n, V // block_v)
+    from jax.experimental.pallas import tpu as pltpu
+
+    loss, lse = pl.pallas_call(
+        _fwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N,), jnp.float32),
+            jax.ShapeDtypeStruct((N,), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_n, 128), jnp.float32),  # running max
+            pltpu.VMEM((block_n, 128), jnp.float32),  # running sum
+            pltpu.VMEM((block_n, 128), jnp.float32),  # picked label logit
+        ],
+        interpret=interpret,
+    )(logits, labels)
+    return loss, lse
+
+
+def _ce_bwd_call(logits, labels, lse, g, block_n, block_v, interpret):
+    N, V = logits.shape
+    grid = (N // block_n, V // block_v)
+    return pl.pallas_call(
+        _bwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+        ],
+        out_specs=pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((N, V), logits.dtype),
+        interpret=interpret,
+    )(logits, labels, lse, g)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _ce_core(logits, labels, block_n, block_v, interpret):
+    loss, _ = _ce_fwd(logits, labels, block_n, block_v, interpret)
+    return loss
+
+
+def _ce_core_fwd(logits, labels, block_n, block_v, interpret):
+    loss, lse = _ce_fwd(logits, labels, block_n, block_v, interpret)
+    return loss, (logits, labels, lse)
+
+
+def _ce_core_bwd(block_n, block_v, interpret, res, g):
+    logits, labels, lse = res
+    dx = _ce_bwd_call(logits, labels, lse, g, block_n, block_v, interpret)
+    return dx, None
+
+
+_ce_core.defvjp(_ce_core_fwd, _ce_core_bwd)
+
+
+def fused_cross_entropy_with_integer_labels(
+    logits: jax.Array,  # [..., V], any float dtype
+    labels: jax.Array,  # [...], int
+    block_n: int = DEFAULT_BLOCK_N,
+    block_v: int = DEFAULT_BLOCK_V,
+    interpret=None,
+) -> jax.Array:
+    """Per-example loss [...] — drop-in for
+    ``optax.softmax_cross_entropy_with_integer_labels``, streaming the vocab
+    axis through VMEM instead of materializing fp32 probabilities in HBM.
+
+    Shapes the kernel can't tile (vocab not a multiple of ``block_v``) fall
+    back to optax; rows are padded up to ``block_n``.
+    """
+    import optax
+
+    V = logits.shape[-1]
+    lead = logits.shape[:-1]
+    backend = jax.default_backend()
+    if V % block_v or backend not in ("cpu", "tpu"):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels)
+    if interpret is None:
+        interpret = backend == "cpu"
+
+    def local(x, lab):
+        """Kernel over this shard's rows ([..., V] -> [...])."""
+        lshape = x.shape[:-1]
+        n = 1
+        for s in lshape:
+            n *= s
+        xf = x.reshape(n, V)
+        lf = lab.reshape(n).astype(jnp.int32)
+        pad = (-n) % block_n
+        if pad:
+            xf = jnp.pad(xf, ((0, pad), (0, 0)))
+            lf = jnp.pad(lf, (0, pad))
+        out = _ce_core(xf, lf, block_n, block_v, interpret)
+        if pad:
+            out = out[:n]
+        return out.reshape(lshape)
+
+    # GSPMD has no partitioning rule for pallas_call — without help it
+    # all-gathers the logits onto every device and runs the full kernel
+    # replicated. shard_map over the batch axes keeps each device's rows
+    # local (the vocab axis is replicated inside, so tp-sharded logits pay
+    # one all-gather of V — the same cost the unfused path pays to
+    # compute its softmax).
+    from serverless_learn_tpu.parallel.ring_attention import get_active_mesh
+    from jax.sharding import PartitionSpec as P
+
+    mesh = get_active_mesh()
+    n_batch = 1
+    if mesh is not None:
+        n_batch = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    if mesh is None or n_batch == 1 or not lead or lead[0] % n_batch:
+        return local(logits, labels)
+    try:  # JAX >= 0.6 promotes shard_map out of experimental
+        from jax import shard_map
+        no_check = {"check_vma": False}
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+        no_check = {"check_rep": False}  # the kwarg's pre-0.6 name
+
+    batch_axes = tuple(a for a in ("dp", "fsdp") if mesh.shape[a] > 1)
+    row_spec = P(batch_axes, *([None] * (len(lead) - 1)))
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(*row_spec, None), row_spec),
+                   out_specs=row_spec, **no_check)
+    return fn(logits, labels)

@@ -141,11 +141,12 @@ class Checkpointer:
             steps = self._steps()
             return max(steps) if steps else None
 
-    def restore(self, template: TrainState, step: Optional[int] = None,
-                shardings: Any = None) -> TrainState:
-        """Restore into the structure of ``template`` (can be the freshly
-        initialized state or an abstract eval_shape of it). With
-        ``shardings``, leaves are placed directly into their mesh layout."""
+    def restore_host(self, template: TrainState,
+                     step: Optional[int] = None) -> TrainState:
+        """Deserialize into host numpy arrays — no device placement.
+
+        Lets callers that need only a subtree (e.g. inference wants params
+        but not optimizer moments) place just that part on device."""
         if step is None:
             step = self.latest_step()
             if step is None:
@@ -154,7 +155,14 @@ class Checkpointer:
         host_template = jax.tree_util.tree_map(
             lambda x: np.zeros(x.shape, x.dtype), template,
             is_leaf=lambda x: hasattr(x, "shape"))
-        restored = serialization.from_bytes(host_template, blob)
+        return serialization.from_bytes(host_template, blob)
+
+    def restore(self, template: TrainState, step: Optional[int] = None,
+                shardings: Any = None) -> TrainState:
+        """Restore into the structure of ``template`` (can be the freshly
+        initialized state or an abstract eval_shape of it). With
+        ``shardings``, leaves are placed directly into their mesh layout."""
+        restored = self.restore_host(template, step)
         if shardings is not None:
             return jax.tree_util.tree_map(
                 lambda x, s: jax.device_put(x, s), restored, shardings)

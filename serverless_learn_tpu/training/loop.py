@@ -142,7 +142,6 @@ def run_training(
     meter.start()
     start_step = int(jax.device_get(state.step))
     tracer = get_tracer()
-    eval_source = None  # created once at first eval pass, reused after
     try:
         for i, batch in zip(range(start_step, config.train.num_steps), prefetch):
             with step_annotation(i + 1), tracer.span("train/step",
@@ -159,12 +158,12 @@ def run_training(
                           **{k: round(v, 5) for k, v in metrics.items()}})
             if (config.train.eval_every > 0
                     and (i + 1) % config.train.eval_every == 0):
-                if eval_source is None:
-                    eval_source = make_eval_source(config, trainer)
-                eval_metrics = run_eval(config, trainer, state,
-                                        source=eval_source)
-                if eval_uses_train_data(config):
-                    eval_metrics["eval_on_train_data"] = 1.0
+                # A fresh source per pass so every eval scores the SAME
+                # seeded batch set — eval-loss deltas stay comparable across
+                # the run (a reused source would advance between passes).
+                # Cost: one connect per eval pass, amortized over
+                # eval_every training steps.
+                eval_metrics = run_eval(config, trainer, state)
                 if verbose:
                     log_json({"step": i + 1,
                               **{k: round(v, 5)
@@ -178,6 +177,4 @@ def run_training(
         prefetch.close()
         if created_source and hasattr(source, "close"):
             source.close()
-        if eval_source is not None and hasattr(eval_source, "close"):
-            eval_source.close()
     return state, meter
