@@ -115,6 +115,8 @@ def generate(
     keep emitting it (no early exit — shapes stay static for jit).
     """
     cfg = module.cfg
+    if max_new_tokens <= 0:
+        return prompt.astype(jnp.int32)
     total = prompt.shape[1] + max_new_tokens
     if total > cfg.max_seq_len:
         raise ValueError(

@@ -78,12 +78,15 @@ def make_llama_tiny(fused_ce=False, **overrides):
 
 
 @register_model("llama_1b")
-def make_llama_1b(fused_ce=True, **overrides):
-    # Fused loss by default: at V=128256 the fp32 softmax round-trip is the
-    # dominant HBM cost of the step (ops/pallas/cross_entropy.py).
+def make_llama_1b(fused_ce=False, **overrides):
+    # fused_ce=True opts into the Pallas loss kernel. Off by default: on the
+    # v5e chip this was benchmarked on, XLA fuses the unfused loss into the
+    # lm_head matmul epilogue and wins (13.6 ms vs 14.9 ms for the kernel at
+    # N=8192, V=32000 — benchmarks/lm_bench.py --compare-fused). Re-measure
+    # per hardware/scale before enabling.
     return _bundle(_llama_cfg("1b", **overrides), fused_ce=fused_ce)
 
 
 @register_model("llama_8b")
-def make_llama_8b(fused_ce=True, **overrides):
+def make_llama_8b(fused_ce=False, **overrides):
     return _bundle(_llama_cfg("8b", **overrides), fused_ce=fused_ce)

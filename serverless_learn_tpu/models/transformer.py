@@ -346,15 +346,18 @@ class Transformer(nn.Module):
             x = PipelinedBlocks(cfg, name="pipeline")(x, mask=mask,
                                                       positions=positions)
         else:
-            block = Block
-            if cfg.remat and not (decode or prefill):
-                block = nn.remat(Block, static_argnums=())
+            use_remat = cfg.remat and not (decode or prefill)
+            block = nn.remat(Block, static_argnums=()) if use_remat else Block
             for i in range(cfg.n_layers):
-                x = constrain_residual(
-                    block(cfg, name=f"layer_{i}")(x, mask=mask,
-                                                  positions=positions,
-                                                  decode=decode,
-                                                  prefill=prefill))
+                blk = block(cfg, name=f"layer_{i}")
+                if use_remat:
+                    # remat traces every kwarg; the decode/prefill bools
+                    # must stay Python-static, and here they are both False.
+                    y = blk(x, mask=mask, positions=positions)
+                else:
+                    y = blk(x, mask=mask, positions=positions,
+                            decode=decode, prefill=prefill)
+                x = constrain_residual(y)
         norm = (nn.RMSNorm if cfg.norm == "rms" else nn.LayerNorm)
         x = norm(dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="norm_f")(x)
         if cfg.tie_embeddings:

@@ -26,12 +26,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # JAX >= 0.6 promotes shard_map out of experimental
-    from jax import shard_map as _shard_map
-    _NO_CHECK = {"check_vma": False}
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
-    _NO_CHECK = {"check_rep": False}  # the kwarg's pre-0.6 name
+from serverless_learn_tpu.parallel.compat import (
+    shard_map_no_check as _shard_map)
 
 
 def sequential_apply(block_apply: Callable, stacked_params, x, positions,
@@ -104,7 +100,6 @@ def gpipe_apply(
         mesh=mesh,
         in_specs=in_specs,
         out_specs=bspec,
-        **_NO_CHECK,
     )
     def run(params_local, x_local, pos_local, *rest):
         mask_local = rest[0] if rest else None

@@ -22,12 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # JAX >= 0.6 promotes shard_map out of experimental
-    from jax import shard_map as _shard_map
-    _NO_CHECK = {"check_vma": False}
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
-    _NO_CHECK = {"check_rep": False}  # the kwarg's pre-0.6 name
+from serverless_learn_tpu.parallel.compat import (
+    shard_map_no_check as _shard_map)
 
 _NEG = -1e30  # finite "minus infinity": avoids NaN from (-inf) - (-inf)
 
@@ -109,6 +105,5 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        **_NO_CHECK,
     )
     return fn(q, k, v)

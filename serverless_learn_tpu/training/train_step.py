@@ -92,6 +92,11 @@ def build_trainer(
     overrides = dict(config.model_overrides)
     overrides.setdefault("dtype", jnp.dtype(config.train.dtype))
     overrides.setdefault("param_dtype", jnp.dtype(config.train.param_dtype))
+    if config.train.remat:
+        # Only set when asked: model families without a remat knob (MLP,
+        # ResNet) should fail loudly on the unknown kwarg, not silently
+        # ignore the request.
+        overrides.setdefault("remat", True)
     bundle = get_model(config.model, **overrides)
     if mesh is None:
         mesh = make_mesh(config.mesh)

@@ -83,6 +83,13 @@ def test_eos_is_sticky(llama):
     assert (out[0, 4:] == eos).all(), out
 
 
+def test_zero_new_tokens_returns_prompt(llama):
+    module, params = llama
+    prompt = jnp.ones((2, 3), jnp.int32)
+    out = generate(module, params, prompt, max_new_tokens=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+
+
 def test_too_long_generation_rejected(llama):
     module, params = llama
     prompt = jnp.zeros((1, 60), jnp.int32)

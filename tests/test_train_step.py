@@ -111,6 +111,18 @@ def test_llama_tiny_fsdp_tp(devices):
     assert all(np.isfinite(l) for l in losses)
 
 
+def test_remat_matches_no_remat(devices):
+    """jax.checkpoint trades FLOPs for memory — it must not change the math."""
+    base = _cfg(model="llama_tiny", mesh=MeshConfig(dp=8), batch_size=8,
+                num_steps=2).override(
+        model_overrides={"dtype": jnp.float32})
+    _, plain = _run_steps(base, n=2)
+    remat = base.override(
+        model_overrides={"dtype": jnp.float32, "remat": True})
+    _, checkpointed = _run_steps(remat, n=2)
+    np.testing.assert_allclose(plain, checkpointed, rtol=2e-5)
+
+
 def test_train_dtype_policy_reaches_model(devices):
     """train.param_dtype flows into the model unless model_overrides says
     otherwise."""
