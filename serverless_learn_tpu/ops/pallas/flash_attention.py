@@ -86,7 +86,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     def _finalize():
         l = jnp.maximum(l_ref[:, 0], 1e-30)
         o_ref[0, 0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_ref[:, 0] + jnp.log(l)
+        # lse is laid out [1, 1, n_q, block_q] (whole (n_q, block_q) tail —
+        # Mosaic rejects (1, block_q) tails, and a dynamic LANE offset
+        # store is unimplemented; a dynamic SUBLANE index is fine).
+        lse_ref[0, 0, i, :] = m_ref[:, 0] + jnp.log(l)
 
 
 def _flash_fwd_bhsd(q, k, v, *, causal: bool, block_q: int, block_k: int,
@@ -109,11 +112,12 @@ def _flash_fwd_bhsd(q, k, v, *, causal: bool, block_q: int, block_k: int,
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, T // block_q, block_q),
+                         lambda b, h, i, j: (b, h, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, T), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, T // block_q, block_q), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),    # acc
@@ -122,7 +126,7 @@ def _flash_fwd_bhsd(q, k, v, *, causal: bool, block_q: int, block_k: int,
         ],
         interpret=interpret,
     )(q, k, v)
-    return out, lse
+    return out, lse.reshape(B, H, T)
 
 
 def _bwd_bhsd(q, k, v, out, lse, g, *, causal: bool, block_k: int):
@@ -193,7 +197,14 @@ def flash_attention(
 ) -> jax.Array:
     """Flash attention in the framework's [B, T, H, D] convention; GQA via
     KV-head expansion. Shapes the kernel can't tile (or additive masks) fall
-    back to dense XLA attention."""
+    back to dense XLA attention.
+
+    On a live multi-device mesh the kernel is shard_mapped over the batch
+    (dp/fsdp) and head (tp) axes — GSPMD has no partitioning rule for
+    ``pallas_call`` and would otherwise all-gather q/k/v onto every device
+    and run the kernel fully replicated. Layouts the wrapper can't keep
+    device-local (sp-sharded sequence, indivisible batch/heads) fall back
+    to XLA attention, which GSPMD partitions fine."""
     from serverless_learn_tpu.ops.attention import xla_attention
 
     B, T, H, D = q.shape
@@ -202,14 +213,43 @@ def flash_attention(
         return xla_attention(q, k, v, causal=causal, mask=mask)
     backend = jax.default_backend()
     if backend not in ("cpu", "tpu") and not os.environ.get("SLT_FORCE_PALLAS"):
-        # Tunneled/experimental platforms (e.g. "axon") have been observed to
-        # hang compiling Pallas kernels; dense attention is always correct.
+        # Tunneled/experimental platforms have been observed to hang
+        # compiling Pallas kernels; dense attention is always correct.
         return xla_attention(q, k, v, causal=causal, mask=mask)
-    if K != H:
-        k = jnp.repeat(k, H // K, axis=2)
-        v = jnp.repeat(v, H // K, axis=2)
     if interpret is None:
         interpret = backend == "cpu"
-    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    out = _flash_core(qt, kt, vt, causal, block_q, block_k, interpret)
-    return out.transpose(0, 2, 1, 3)
+
+    def local(ql, kl, vl):
+        if kl.shape[2] != ql.shape[2]:  # GQA: expand KV heads per shard
+            r = ql.shape[2] // kl.shape[2]
+            kl = jnp.repeat(kl, r, axis=2)
+            vl = jnp.repeat(vl, r, axis=2)
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (ql, kl, vl))
+        out = _flash_core(qt, kt, vt, causal, block_q, block_k, interpret)
+        return out.transpose(0, 2, 1, 3)
+
+    from serverless_learn_tpu.parallel.ring_attention import get_active_mesh
+
+    mesh = get_active_mesh()
+    if mesh is None or mesh.size == 1:
+        return local(q, k, v)
+    from jax.sharding import PartitionSpec as P
+
+    from serverless_learn_tpu.parallel.compat import shard_map_no_check
+
+    batch_axes = tuple(a for a in ("dp", "fsdp")
+                       if mesh.shape.get(a, 1) > 1)
+    n_batch = 1
+    for a in batch_axes:
+        n_batch *= mesh.shape[a]
+    tp = mesh.shape.get("tp", 1)
+    sp = mesh.shape.get("sp", 1)
+    if (sp > 1 or B % n_batch or H % tp or K % tp
+            or (K != H and (K // tp) == 0)):
+        # Can't keep every shard local (sp wants the seq dim sharded —
+        # that's ring attention's job) — let GSPMD partition dense attention.
+        return xla_attention(q, k, v, causal=causal, mask=mask)
+    spec = P(batch_axes or None, None, "tp" if tp > 1 else None, None)
+    fn = shard_map_no_check(local, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec)
+    return fn(q, k, v)

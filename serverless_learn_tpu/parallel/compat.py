@@ -17,6 +17,10 @@ except ImportError:  # pragma: no cover
     _NO_CHECK = {"check_rep": False}  # the kwarg's pre-0.6 name
 
 
+# The resolved shard_map, for callers that keep replication checking on.
+shard_map = _shard_map
+
+
 def shard_map_no_check(fn, *, mesh, in_specs, out_specs):
     """shard_map with replication checking off, on any supported JAX."""
     return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,

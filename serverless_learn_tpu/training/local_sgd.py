@@ -49,10 +49,7 @@ from serverless_learn_tpu.models.registry import get_model
 from serverless_learn_tpu.parallel.mesh import make_mesh
 from serverless_learn_tpu.training.optimizer import make_optimizer
 
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from serverless_learn_tpu.parallel.compat import shard_map as _shard_map
 
 import flax.struct
 

@@ -63,6 +63,38 @@ def test_flash_fallback_on_untileable_shapes():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("mesh_kw", [dict(dp=8), dict(dp=4, tp=2)])
+def test_flash_sharded_train_step_matches_xla(devices, mesh_kw):
+    """Under a live mesh, flash runs shard_mapped (batch/heads local) and
+    must reproduce the GSPMD-partitioned dense path."""
+    from serverless_learn_tpu.config import (
+        DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig, TrainConfig)
+    from serverless_learn_tpu.data.datasets import SyntheticSource
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    def run(impl):
+        cfg = ExperimentConfig(
+            model="llama_tiny",
+            model_overrides={"attention_impl": impl, "dtype": jnp.float32,
+                             "max_seq_len": 128},
+            mesh=MeshConfig(**mesh_kw),
+            optimizer=OptimizerConfig(name="sgd", learning_rate=0.1),
+            train=TrainConfig(batch_size=16, num_steps=2),
+            data=DataConfig(seq_len=128),
+        )
+        trainer = build_trainer(cfg)
+        state = trainer.init()
+        src = SyntheticSource(trainer.bundle.make_batch, cfg.data, 16, seed=9)
+        batch = trainer.shard_batch(next(iter(src)))
+        losses = []
+        for _ in range(2):
+            state, metrics = trainer.step(state, batch)
+            losses.append(float(jax.device_get(metrics["loss"])))
+        return losses
+
+    np.testing.assert_allclose(run("xla"), run("flash"), rtol=2e-5)
+
+
 def test_transformer_with_flash_impl():
     """llama_tiny forward with attention_impl='flash' (seq 256) matches the
     default dense implementation."""
