@@ -1,0 +1,108 @@
+"""Data-plane benchmark: shard-server streaming throughput.
+
+The reference's data plane re-pushed a 100 MB blob to every worker every 5 s
+— an implied ~20 MB/s per worker over localhost gRPC (BASELINE.md). This
+measures the successor: pull-based ranged chunk streaming from the native
+shard server through the Python client into decoded, typed host batches.
+
+    python benchmarks/data_bench.py [--mb 256] [--streams 4]
+
+Prints one JSON line per configuration: raw blob streaming and a
+decoded-dataset batch pipeline.
+"""
+
+import argparse
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def bench_raw(addr: str, total_mb: int, streams: int) -> dict:
+    """Parallel raw fetches of synthetic blobs (server-side generated)."""
+    from serverless_learn_tpu.control.client import ShardClient
+
+    per = total_mb // streams
+    key = f"synthetic:{per * 1000 * 1000}"
+    done = []
+
+    def one():
+        c = ShardClient(addr)
+        done.append(len(c.fetch(key)))
+        c.close()
+
+    threads = [threading.Thread(target=one) for _ in range(streams)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    mb = sum(done) / 1e6
+    return {"metric": "shard_server_raw_stream_mb_per_sec",
+            "streams": streams, "mb": round(mb, 1),
+            "value": round(mb / dt, 1), "unit": "MB/s",
+            "vs_reference_push": round(mb / dt / 20.0, 1)}
+
+
+def bench_dataset(addr: str, records: int) -> dict:
+    """Publish a CIFAR-shaped dataset, then stream+decode typed batches."""
+    from serverless_learn_tpu.config import DataConfig
+    from serverless_learn_tpu.data.shard_client import (
+        ShardStreamSource, publish_from_bundle)
+    from serverless_learn_tpu.models.registry import get_model
+
+    bundle = get_model("resnet18_cifar")
+    data_cfg = DataConfig()
+    publish_from_bundle(addr, "bench_cifar", bundle.make_batch, data_cfg,
+                        num_records=records, records_per_shard=1024)
+    src = ShardStreamSource(addr, "bench_cifar", batch_size=256)
+    it = iter(src)
+    next(it)  # warm the prefetch pipeline
+    n_batches = records // 256 - 2
+    t0 = time.perf_counter()
+    nbytes = 0
+    for _ in range(n_batches):
+        b = next(it)
+        nbytes += sum(v.nbytes for v in b.values())
+    dt = time.perf_counter() - t0
+    src.close()
+    return {"metric": "shard_dataset_decoded_mb_per_sec",
+            "value": round(nbytes / 1e6 / dt, 1), "unit": "MB/s",
+            "batches_per_sec": round(n_batches / dt, 1),
+            "samples_per_sec": round(n_batches * 256 / dt, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=256)
+    ap.add_argument("--streams", type=int, default=4)
+    ap.add_argument("--records", type=int, default=8192)
+    args = ap.parse_args()
+    from serverless_learn_tpu.control.daemons import start_shard_server
+
+    with tempfile.TemporaryDirectory() as root:
+        port = _free_port()
+        proc = start_shard_server(port=port, root=root)
+        addr = f"127.0.0.1:{port}"
+        try:
+            print(json.dumps(bench_raw(addr, args.mb, args.streams)))
+            print(json.dumps(bench_dataset(addr, args.records)))
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+
+if __name__ == "__main__":
+    main()

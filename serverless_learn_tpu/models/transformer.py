@@ -96,10 +96,9 @@ def constrain_residual(x: jax.Array) -> jax.Array:
     mesh = get_active_mesh()
     if mesh is None or x.ndim < 3:
         return x
-    batch = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
-    n_batch = 1
-    for a in batch:
-        n_batch *= mesh.shape[a]
+    from serverless_learn_tpu.parallel.mesh import live_batch_axes
+
+    batch, n_batch = live_batch_axes(mesh)
     if batch and x.shape[0] % n_batch:
         batch = ()  # e.g. batch-1 decoding under a training mesh
     seq = "sp" if mesh.shape.get("sp", 1) > 1 else None

@@ -86,10 +86,20 @@ def dot_product_attention(
     axis_name: Optional[str] = None,  # sp axis for ring attention
 ) -> jax.Array:
     if impl == "auto":
-        # flash_attention itself falls back to xla for masks, untileable
-        # shapes, and non-TPU/CPU backends, so "auto" only has to pick the
-        # length threshold.
-        impl = "flash" if q.shape[1] >= AUTO_FLASH_MIN_SEQ else "xla"
+        # On an sp>1 mesh the sequence dim is sharded and ring attention is
+        # the only impl that keeps it that way (flash would fall back to
+        # dense XLA and materialize the [T, T] scores). Otherwise flash
+        # above the measured threshold; flash itself falls back to xla for
+        # masks, untileable shapes, and non-TPU/CPU backends.
+        from serverless_learn_tpu.parallel.ring_attention import (
+            get_active_mesh)
+
+        mesh = get_active_mesh()
+        if (mesh is not None and mesh.shape.get("sp", 1) > 1
+                and mask is None and k.shape[1] % mesh.shape["sp"] == 0):
+            impl = "ring"
+        else:
+            impl = "flash" if q.shape[1] >= AUTO_FLASH_MIN_SEQ else "xla"
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal, mask=mask)
     if impl == "flash":

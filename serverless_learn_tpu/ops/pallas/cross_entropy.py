@@ -210,18 +210,16 @@ def fused_cross_entropy_with_integer_labels(
     # sp sequence) axes keeps each device's rows local; the vocab axis is
     # replicated inside, so tp-sharded logits pay one all-gather of V — the
     # same cost the unfused path pays to compute its softmax.
-    from serverless_learn_tpu.parallel.compat import shard_map_no_check
+    from serverless_learn_tpu.parallel.compat import (
+        in_manual_region, shard_map_no_check)
+    from serverless_learn_tpu.parallel.mesh import live_batch_axes
     from serverless_learn_tpu.parallel.ring_attention import get_active_mesh
     from jax.sharding import PartitionSpec as P
 
     mesh = get_active_mesh()
-    if mesh is None or not lead:
+    if mesh is None or not lead or in_manual_region():
         return local(logits, labels)
-    batch_axes = tuple(a for a in ("dp", "fsdp")
-                       if mesh.shape.get(a, 1) > 1)
-    n_batch = 1
-    for a in batch_axes:
-        n_batch *= mesh.shape[a]
+    batch_axes, n_batch = live_batch_axes(mesh)
     dim0 = batch_axes if (batch_axes and lead[0] % n_batch == 0) else None
     sp = mesh.shape.get("sp", 1)
     dim1 = ("sp" if (len(lead) > 1 and sp > 1 and lead[1] % sp == 0)

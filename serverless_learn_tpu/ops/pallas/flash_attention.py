@@ -228,24 +228,24 @@ def flash_attention(
         out = _flash_core(qt, kt, vt, causal, block_q, block_k, interpret)
         return out.transpose(0, 2, 1, 3)
 
+    from serverless_learn_tpu.parallel.compat import (
+        in_manual_region, shard_map_no_check)
     from serverless_learn_tpu.parallel.ring_attention import get_active_mesh
 
     mesh = get_active_mesh()
-    if mesh is None or mesh.size == 1:
+    if mesh is None or mesh.size == 1 or in_manual_region():
+        # Inside an enclosing shard_map (GPipe stage) the data is already
+        # device-local and nesting shard_map over the same mesh is an
+        # error — run the kernel directly.
         return local(q, k, v)
     from jax.sharding import PartitionSpec as P
 
-    from serverless_learn_tpu.parallel.compat import shard_map_no_check
+    from serverless_learn_tpu.parallel.mesh import live_batch_axes
 
-    batch_axes = tuple(a for a in ("dp", "fsdp")
-                       if mesh.shape.get(a, 1) > 1)
-    n_batch = 1
-    for a in batch_axes:
-        n_batch *= mesh.shape[a]
+    batch_axes, n_batch = live_batch_axes(mesh)
     tp = mesh.shape.get("tp", 1)
     sp = mesh.shape.get("sp", 1)
-    if (sp > 1 or B % n_batch or H % tp or K % tp
-            or (K != H and (K // tp) == 0)):
+    if sp > 1 or B % n_batch or H % tp or K % tp:
         # Can't keep every shard local (sp wants the seq dim sharded —
         # that's ring attention's job) — let GSPMD partition dense attention.
         return xla_attention(q, k, v, causal=causal, mask=mask)

@@ -61,6 +61,18 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def live_batch_axes(mesh: Mesh):
+    """(axes, total) — the >1-sized data axes of a mesh, tolerating meshes
+    that don't define dp/fsdp at all. The single source of truth for the
+    ops that shard_map themselves over the batch (flash attention, fused
+    cross-entropy) and for residual-stream constraints."""
+    axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    return axes, n
+
+
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:
     n = mesh.shape["dp"] * mesh.shape["fsdp"]
     if global_batch % n:

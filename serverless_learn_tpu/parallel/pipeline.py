@@ -102,6 +102,12 @@ def gpipe_apply(
         out_specs=bspec,
     )
     def run(params_local, x_local, pos_local, *rest):
+        from serverless_learn_tpu.parallel.compat import manual_region
+
+        with manual_region():
+            return _run_inner(params_local, x_local, pos_local, *rest)
+
+    def _run_inner(params_local, x_local, pos_local, *rest):
         mask_local = rest[0] if rest else None
         B = x_local.shape[0]
         if B % M:

@@ -33,3 +33,16 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(autouse=True)
+def _reset_active_mesh():
+    """The active mesh is process-global (set by build_trainer); a test that
+    builds a trainer must not leak it into the next test — a stale mesh
+    silently reroutes the pallas ops' mesh-aware dispatch (e.g. flash
+    falling back to dense for batch-indivisibility against a mesh the test
+    never asked for)."""
+    yield
+    from serverless_learn_tpu.parallel.ring_attention import set_active_mesh
+
+    set_active_mesh(None)

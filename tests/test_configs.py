@@ -1,0 +1,40 @@
+"""The shipped config ladder must parse, and the small rungs must build a
+real trainer on the virtual mesh."""
+
+import glob
+import os
+
+import jax
+import pytest
+
+from serverless_learn_tpu.config import ExperimentConfig
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIGS = sorted(glob.glob(os.path.join(ROOT, "configs", "*.json")))
+
+
+def test_ladder_present():
+    names = {os.path.basename(p) for p in CONFIGS}
+    assert {"mnist_mlp.json", "cifar_resnet18_dp4.json",
+            "imagenet_resnet50_v4_32.json", "bert_base_mlm.json",
+            "llama8b_lora_elastic.json"} <= names
+
+
+@pytest.mark.parametrize("path", CONFIGS, ids=os.path.basename)
+def test_config_parses(path):
+    cfg = ExperimentConfig.from_json(open(path).read())
+    assert cfg.mesh.size >= 1
+    assert cfg.train.batch_size % (cfg.mesh.dp * cfg.mesh.fsdp) == 0
+
+
+@pytest.mark.parametrize("name", ["mnist_mlp.json", "cifar_resnet18_dp4.json"])
+def test_small_rungs_build(devices, name):
+    from serverless_learn_tpu.parallel.mesh import make_mesh
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    cfg = ExperimentConfig.from_json(
+        open(os.path.join(ROOT, "configs", name)).read())
+    mesh = make_mesh(cfg.mesh, devices=devices[:cfg.mesh.size])
+    trainer = build_trainer(cfg, mesh=mesh)
+    state = trainer.init()
+    assert int(jax.device_get(state.step)) == 0

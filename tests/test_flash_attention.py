@@ -95,6 +95,31 @@ def test_flash_sharded_train_step_matches_xla(devices, mesh_kw):
     np.testing.assert_allclose(run("xla"), run("flash"), rtol=2e-5)
 
 
+def test_flash_inside_pipeline_stage(devices):
+    """flash inside a GPipe stage (enclosing shard_map) must run its local
+    kernel instead of nesting shard_map over the same mesh (trace error)."""
+    from serverless_learn_tpu.config import (
+        DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig, TrainConfig)
+    from serverless_learn_tpu.data.datasets import SyntheticSource
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    cfg = ExperimentConfig(
+        model="llama_tiny",
+        model_overrides={"attention_impl": "flash", "dtype": jnp.float32,
+                         "max_seq_len": 128, "pipeline": True,
+                         "pipeline_microbatches": 2, "n_layers": 4},
+        mesh=MeshConfig(dp=4, pp=2),
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.1),
+        train=TrainConfig(batch_size=16, num_steps=1),
+        data=DataConfig(seq_len=128),
+    )
+    trainer = build_trainer(cfg)
+    state = trainer.init()
+    src = SyntheticSource(trainer.bundle.make_batch, cfg.data, 16, seed=2)
+    state, metrics = trainer.step(state, trainer.shard_batch(next(iter(src))))
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+
 def test_transformer_with_flash_impl():
     """llama_tiny forward with attention_impl='flash' (seq 256) matches the
     default dense implementation."""
