@@ -1,0 +1,63 @@
+"""Inference benchmark: KV-cache decode throughput.
+
+    python benchmarks/gen_bench.py [--model llama_tiny] [--batch 8]
+        [--prompt 128] [--new 128]
+
+Prints one JSON line: decode tokens/sec (total and per sequence) plus
+prefill+decode wall time. Measures the jitted prefill+scan loop in
+``inference/generate.py``.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama_tiny")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt", type=int, default=128)
+    ap.add_argument("--new", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from serverless_learn_tpu.inference.generate import generate
+    from serverless_learn_tpu.models.registry import get_model
+
+    bundle = get_model(args.model)
+    module = bundle.module
+    params = jax.jit(lambda: module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])()
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (args.batch, args.prompt), 0,
+        module.cfg.vocab_size)
+
+    out = generate(module, params, prompt, args.new)  # compile
+    _ = jax.device_get(out)
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        out = generate(module, params, prompt, args.new,
+                       rng=jax.random.PRNGKey(i))
+        _ = jax.device_get(out)
+    dt = (time.perf_counter() - t0) / args.iters
+    total_new = args.batch * args.new
+    print(json.dumps({
+        "metric": f"{args.model}_decode_tokens_per_sec",
+        "batch": args.batch, "prompt_len": args.prompt,
+        "new_tokens": args.new,
+        "value": round(total_new / dt, 1), "unit": "tokens/sec",
+        "per_seq_tokens_per_sec": round(args.new / dt, 1),
+        "wall_ms": round(dt * 1e3, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

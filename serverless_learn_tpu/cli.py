@@ -9,6 +9,7 @@ Here one typed CLI fronts everything:
     python -m serverless_learn_tpu train        # jitted training run
     python -m serverless_learn_tpu eval         # forward-only evaluation
     python -m serverless_learn_tpu generate     # KV-cache LM sampling
+    python -m serverless_learn_tpu serve        # generation server (TCP/JSON)
     python -m serverless_learn_tpu worker       # elastic worker (joins a cluster)
     python -m serverless_learn_tpu coordinator  # native membership daemon
     python -m serverless_learn_tpu shard-server # native data-plane daemon
@@ -246,6 +247,32 @@ def cmd_eval(args) -> int:
     return 0
 
 
+def _load_inference_params(args, cfg, trainer):
+    """Params for a pure-forward workload: (params, checkpoint_step).
+
+    With a checkpoint store: deserialize the full TrainState on the host
+    but place ONLY params on device — optimizer moments (~2x params for
+    adamw) never touch HBM. Without: a jitted params-only init."""
+    import jax
+    import jax.numpy as jnp
+
+    ckpt = _make_checkpointer(args)
+    if ckpt is not None:
+        step = ckpt.latest_step()
+        if step is None:
+            raise SystemExit("no checkpoint found in the configured store")
+        abstract = jax.eval_shape(lambda: trainer.init_fn(0))
+        host = ckpt.restore_host(abstract, step=step)
+        return jax.tree_util.tree_map(
+            jax.device_put, host.params, trainer.state_shardings.params), step
+    init_params = jax.jit(
+        lambda: trainer.bundle.module.init(
+            jax.random.PRNGKey(cfg.train.seed),
+            jnp.zeros((1, 8), jnp.int32))["params"],
+        out_shardings=trainer.state_shardings.params)
+    return init_params(), None
+
+
 def cmd_generate(args) -> int:
     """Autoregressive sampling from a (possibly checkpointed) causal LM."""
     import jax
@@ -260,26 +287,7 @@ def cmd_generate(args) -> int:
             "to `train`; `generate` is single-process")
     cfg = _config_from_args(args)
     trainer = build_trainer(cfg)
-    ckpt = _make_checkpointer(args)
-    ckpt_step = None
-    if ckpt is not None:
-        # Params-only restore: deserialize the full TrainState on the host
-        # but place ONLY params on device — optimizer moments (~2x params
-        # for adamw) never touch HBM in a pure-forward workload.
-        ckpt_step = ckpt.latest_step()
-        if ckpt_step is None:
-            raise SystemExit("no checkpoint found in the configured store")
-        abstract = jax.eval_shape(lambda: trainer.init_fn(0))
-        host = ckpt.restore_host(abstract, step=ckpt_step)
-        params = jax.tree_util.tree_map(
-            jax.device_put, host.params, trainer.state_shardings.params)
-    else:
-        init_params = jax.jit(
-            lambda: trainer.bundle.module.init(
-                jax.random.PRNGKey(cfg.train.seed),
-                jnp.zeros((1, 8), jnp.int32))["params"],
-            out_shardings=trainer.state_shardings.params)
-        params = init_params()
+    params, ckpt_step = _load_inference_params(args, cfg, trainer)
     if args.prompt:
         ids = [int(t) for t in args.prompt.split(",")]
         prompt = jnp.asarray([ids], jnp.int32)
@@ -302,6 +310,30 @@ def np_tolist(x):
     import numpy as np
 
     return np.asarray(x).tolist()
+
+
+def cmd_serve(args) -> int:
+    """Serve generation requests (JSON lines over TCP) from a causal LM."""
+    from serverless_learn_tpu.inference.server import GenerationServer
+    from serverless_learn_tpu.training.train_step import build_trainer
+    from serverless_learn_tpu.utils.metrics import log_json
+
+    if args.world_size or args.num_processes:
+        raise SystemExit("`serve` is single-process")
+    cfg = _config_from_args(args)
+    trainer = build_trainer(cfg)
+    params, _ = _load_inference_params(args, cfg, trainer)
+    server = GenerationServer(trainer.bundle.module, params,
+                              host=args.host, port=args.port)
+    log_json({"event": "serving", "addr": server.addr,
+              "model": cfg.model}, stream=sys.stdout)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
 
 
 def cmd_worker(args) -> int:
@@ -433,6 +465,13 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--eos-id", type=int, default=None)
     g.add_argument("--seed", type=int, default=0)
     g.set_defaults(fn=cmd_generate)
+
+    sv = sub.add_parser("serve", help="serve LM generation over TCP (JSON lines)")
+    _add_train_flags(sv)
+    sv.add_argument("--host", default="127.0.0.1",
+                    help="bind address (0.0.0.0 to accept remote clients)")
+    sv.add_argument("--port", type=int, default=50060)
+    sv.set_defaults(fn=cmd_serve)
 
     w = sub.add_parser("worker", help="elastic worker: join a cluster & train")
     _add_train_flags(w)

@@ -1,0 +1,147 @@
+"""Minimal generation server: JSON-lines over TCP.
+
+Completes the framework's serving surface with zero dependencies beyond the
+stdlib: one process owns the model on device; clients send one JSON object
+per line and get one JSON object per line back.
+
+    request:  {"prompt": [5, 9, 11], "max_new_tokens": 32,
+               "temperature": 0.8, "top_k": 40, "eos_id": 2, "seed": 1}
+    reply:    {"tokens": [...], "new_tokens": [...], "latency_ms": 12.3}
+    errors:   {"error": "..."}
+
+Single-threaded by design: TPU generation is serialized on the device
+anyway, so requests queue at the accept loop instead of fighting over it.
+Repeated (prompt_len, max_new_tokens) shapes reuse the jit cache; new
+shapes pay one compile. The reference has no inference path at all — its
+model was a gossiped double vector (`src/protos/serverless_learn.proto:81-83`).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from serverless_learn_tpu.inference.generate import generate
+
+
+class GenerationServer:
+    """Owns (module, params) and serves generation requests."""
+
+    def __init__(self, module, params, host: str = "127.0.0.1",
+                 port: int = 0, conn_timeout_s: float = 60.0):
+        self.module = module
+        self.params = params
+        self.conn_timeout_s = conn_timeout_s
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.addr = f"{host}:{self._sock.getsockname()[1]}"
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.requests_served = 0
+
+    # -- request handling --------------------------------------------------
+
+    def handle(self, req: dict) -> dict:
+        t0 = time.perf_counter()
+        prompt = req.get("prompt")
+        if (not isinstance(prompt, list) or not prompt
+                or not all(isinstance(t, int) for t in prompt)):
+            return {"error": "prompt must be a non-empty list of token ids"}
+        vocab = self.module.cfg.vocab_size
+        if any(t < 0 or t >= vocab for t in prompt):
+            return {"error": f"prompt token out of range [0, {vocab})"}
+        max_new = int(req.get("max_new_tokens", 32))
+        if max_new < 0 or len(prompt) + max_new > self.module.cfg.max_seq_len:
+            return {"error": f"prompt+max_new_tokens exceeds max_seq_len "
+                             f"{self.module.cfg.max_seq_len}"}
+        try:
+            tokens = generate(
+                self.module, self.params,
+                jnp.asarray([prompt], jnp.int32), max_new,
+                temperature=float(req.get("temperature", 0.0)),
+                top_k=int(req.get("top_k", 0)),
+                eos_id=req.get("eos_id"),
+                rng=jax.random.PRNGKey(int(req.get("seed", 0))))
+        except Exception as e:  # surface as a reply, keep the server alive
+            return {"error": f"{type(e).__name__}: {e}"}
+        out = [int(t) for t in jax.device_get(tokens)[0]]
+        self.requests_served += 1
+        return {"tokens": out, "new_tokens": out[len(prompt):],
+                "latency_ms": round((time.perf_counter() - t0) * 1e3, 2)}
+
+    # -- socket loop -------------------------------------------------------
+
+    def _serve_conn(self, conn: socket.socket):
+        # An idle or half-open client must not hold the single-threaded
+        # accept loop hostage; time out reads and move on.
+        conn.settimeout(self.conn_timeout_s)
+        with conn, conn.makefile("rwb") as f:
+            while True:
+                try:
+                    line = f.readline()
+                except socket.timeout:
+                    return
+                if not line:
+                    return
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    req = json.loads(line)
+                    if not isinstance(req, dict):
+                        raise ValueError("request must be a JSON object")
+                    rep = self.handle(req)
+                except Exception as e:  # any bad request -> error reply,
+                    rep = {"error": f"{type(e).__name__}: {e}"}  # server lives
+                f.write(json.dumps(rep).encode() + b"\n")
+                f.flush()
+
+    def serve_forever(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                self._serve_conn(conn)
+            except (ConnectionResetError, BrokenPipeError):
+                continue  # client vanished mid-reply; next client please
+
+    def start(self):
+        """Serve on a background thread (tests, embedding)."""
+        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def request(addr: str, req: dict, timeout: float = 120.0) -> dict:
+    """One-shot client helper."""
+    host, _, port = addr.rpartition(":")
+    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+        with s.makefile("rwb") as f:
+            f.write(json.dumps(req).encode() + b"\n")
+            f.flush()
+            line = f.readline()
+    if not line:
+        raise ConnectionError("server closed connection without replying")
+    return json.loads(line)

@@ -1,0 +1,78 @@
+"""Generation server: greedy determinism over the wire, error replies that
+keep the daemon alive, and concurrent clients."""
+
+import json
+import socket
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.inference.generate import generate
+from serverless_learn_tpu.inference.server import GenerationServer, request
+from serverless_learn_tpu.models.registry import get_model
+
+
+@pytest.fixture(scope="module")
+def server(devices):
+    bundle = get_model("llama_tiny", dtype=jnp.float32,
+                       param_dtype=jnp.float32, max_seq_len=64)
+    params = bundle.module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer(bundle.module, params).start()
+    yield srv, bundle.module, params
+    srv.stop()
+
+
+def test_serve_matches_direct_generate(server):
+    srv, module, params = server
+    rep = request(srv.addr, {"prompt": [5, 9, 11], "max_new_tokens": 6})
+    direct = generate(module, params, jnp.asarray([[5, 9, 11]], jnp.int32), 6)
+    assert rep["tokens"] == [int(t) for t in jax.device_get(direct)[0]]
+    assert rep["new_tokens"] == rep["tokens"][3:]
+    assert rep["latency_ms"] > 0
+
+
+def test_serve_error_replies_keep_server_alive(server):
+    srv, _, _ = server
+    assert "error" in request(srv.addr, {"prompt": []})
+    assert "error" in request(srv.addr, {"prompt": [1, 2], "max_new_tokens": 999})
+    assert "error" in request(srv.addr, {"prompt": [999999]})
+    # Garbage line → error reply, connection stays usable for valid requests.
+    host, _, port = srv.addr.rpartition(":")
+    with socket.create_connection((host, int(port))) as s:
+        f = s.makefile("rwb")
+        f.write(b"this is not json\n")
+        f.flush()
+        assert "error" in json.loads(f.readline())
+        f.write(json.dumps({"prompt": [1, 2], "max_new_tokens": 2}).encode()
+                + b"\n")
+        f.flush()
+        assert "tokens" in json.loads(f.readline())
+
+
+def test_serve_survives_malformed_json_values(server):
+    """Valid JSON that isn't a valid request must get an error reply, not
+    kill the server: non-object payloads and uncoercible fields."""
+    srv, _, _ = server
+    host, _, port = srv.addr.rpartition(":")
+    with socket.create_connection((host, int(port))) as s:
+        f = s.makefile("rwb")
+        for bad in (b"[1,2,3]", b"\"str\"",
+                    json.dumps({"prompt": [1], "max_new_tokens": "lots"}).encode()):
+            f.write(bad + b"\n")
+            f.flush()
+            assert "error" in json.loads(f.readline()), bad
+    # Server still serves fresh connections.
+    assert "tokens" in request(srv.addr, {"prompt": [1], "max_new_tokens": 1})
+
+
+def test_serve_sequential_clients_and_sampling(server):
+    srv, _, _ = server
+    a = request(srv.addr, {"prompt": [7, 8], "max_new_tokens": 4,
+                           "temperature": 0.9, "top_k": 8, "seed": 1})
+    b = request(srv.addr, {"prompt": [7, 8], "max_new_tokens": 4,
+                           "temperature": 0.9, "top_k": 8, "seed": 1})
+    assert a["tokens"] == b["tokens"], "same seed must reproduce"
+    assert srv.requests_served >= 2
