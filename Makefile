@@ -1,0 +1,33 @@
+# Developer entry points. The Python package needs no build; `native/` holds
+# the C++ control/data-plane daemons.
+
+.PHONY: test native tsan bench lm-bench data-bench gen-bench dryrun clean
+
+test:
+	python -m pytest tests/ -q
+
+native:
+	$(MAKE) -C native
+
+tsan:
+	$(MAKE) -C native tsan
+
+bench:  ## headline benchmark (real TPU chip)
+	python bench.py
+
+lm-bench:
+	python benchmarks/lm_bench.py --compare-fused
+
+data-bench:
+	python benchmarks/data_bench.py
+
+gen-bench:
+	python benchmarks/gen_bench.py
+
+dryrun:  ## multichip sharding compile check on 8 virtual CPU devices
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		python __graft_entry__.py
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -40,7 +40,11 @@ def main():
         jax.random.PRNGKey(1), (args.batch, args.prompt), 0,
         module.cfg.vocab_size)
 
-    out = generate(module, params, prompt, args.new)  # compile
+    # Warm up with the SAME signature as the timed loop (rng passed): a
+    # None-rng warmup traces a different pytree and the first timed call
+    # would pay a recompile.
+    out = generate(module, params, prompt, args.new,
+                   rng=jax.random.PRNGKey(0))
     _ = jax.device_get(out)
     t0 = time.perf_counter()
     for i in range(args.iters):

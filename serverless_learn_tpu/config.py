@@ -70,10 +70,13 @@ class OptimizerConfig:
     weight_decay: float = 0.0
     b1: float = 0.9
     b2: float = 0.999
-    momentum: float = 0.9  # sgd only
+    momentum: float = 0.9  # sgd / rmsprop only
     warmup_steps: int = 0
     decay_steps: int = 0  # 0 => constant after warmup
     grad_clip_norm: float = 0.0  # 0 => no clipping
+    # Exempt 1-D params (biases, norm scales) from weight decay — the
+    # standard transformer recipe; decaying norm scales hurts.
+    decay_exclude_1d: bool = True
 
 
 @dataclass(frozen=True)

@@ -115,8 +115,11 @@ class GenerationServer:
                 break
             try:
                 self._serve_conn(conn)
-            except (ConnectionResetError, BrokenPipeError):
-                continue  # client vanished mid-reply; next client please
+            except OSError:
+                # Client vanished, reset the pipe, or stalled past the write
+                # timeout (send-buffer full on an unread reply) — drop that
+                # connection, keep the daemon serving.
+                continue
 
     def start(self):
         """Serve on a background thread (tests, embedding)."""

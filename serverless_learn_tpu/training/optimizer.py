@@ -23,9 +23,15 @@ def make_schedule(cfg: OptimizerConfig):
 
 def make_optimizer(cfg: OptimizerConfig, trainable_mask=None) -> optax.GradientTransformation:
     schedule = make_schedule(cfg)
+    decay_mask = None
+    if cfg.weight_decay > 0 and cfg.decay_exclude_1d:
+        # Decay only matrices/embeddings; biases and norm scales (ndim <= 1)
+        # are exempt, per the standard transformer recipe.
+        decay_mask = lambda params: jax.tree_util.tree_map(
+            lambda p: getattr(p, "ndim", 0) >= 2, params)
     if cfg.name == "adamw":
         core = optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2,
-                           weight_decay=cfg.weight_decay)
+                           weight_decay=cfg.weight_decay, mask=decay_mask)
     elif cfg.name == "adam":
         core = optax.adam(schedule, b1=cfg.b1, b2=cfg.b2)
     elif cfg.name == "sgd":
@@ -34,7 +40,7 @@ def make_optimizer(cfg: OptimizerConfig, trainable_mask=None) -> optax.GradientT
         core = optax.adafactor(schedule)
     elif cfg.name == "lion":
         core = optax.lion(schedule, b1=cfg.b1, b2=cfg.b2,
-                          weight_decay=cfg.weight_decay)
+                          weight_decay=cfg.weight_decay, mask=decay_mask)
     elif cfg.name == "rmsprop":
         core = optax.rmsprop(schedule, momentum=cfg.momentum)
     else:

@@ -26,7 +26,8 @@ from serverless_learn_tpu.config import ExperimentConfig
 from serverless_learn_tpu.models.registry import ModelBundle, get_model
 from serverless_learn_tpu.parallel.mesh import batch_sharding, make_mesh, replicated
 from serverless_learn_tpu.parallel.sharding import ShardingRules, shardings_for_tree
-from serverless_learn_tpu.training.optimizer import make_optimizer
+from serverless_learn_tpu.training.optimizer import (
+    make_optimizer, make_schedule)
 from serverless_learn_tpu.training.train_state import TrainState
 
 
@@ -240,6 +241,9 @@ def build_trainer(
         new_params = jax.tree_util.tree_map(
             lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
         metrics = dict(metrics)
+        schedule = make_schedule(config.optimizer)
+        metrics["lr"] = (schedule(state.step) if callable(schedule)
+                         else jnp.float32(schedule))
         if "perplexity" in metrics:
             # exp() is nonlinear: averaging per-microbatch perplexities
             # (Jensen) would make the metric depend on grad_accum. The

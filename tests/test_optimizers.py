@@ -2,6 +2,7 @@
 on a fixed batch within a few iterations."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -35,6 +36,44 @@ def test_optimizer_reduces_loss_on_fixed_batch(devices, name):
         losses.append(float(jax.device_get(metrics["loss"])))
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0], (name, losses)
+
+
+def test_weight_decay_skips_1d_params(devices):
+    """adamw's decay must not touch norm scales/biases by default."""
+    import optax
+
+    params = {"dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))},
+              "norm": {"scale": jnp.ones((4,))}}
+    tx = make_optimizer(OptimizerConfig(name="adamw", learning_rate=0.0,
+                                        weight_decay=0.5))
+    state = tx.init(params)
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    updates, _ = tx.update(zeros, state, params)
+    # lr=0 => schedule contributes nothing; with zero grads only the decay
+    # term could move params — and it must only hit the 2-D kernel.
+    assert float(jnp.abs(updates["dense"]["bias"]).max()) == 0.0
+    assert float(jnp.abs(updates["norm"]["scale"]).max()) == 0.0
+
+
+def test_lr_reported_in_metrics(devices):
+    from serverless_learn_tpu.config import (
+        DataConfig, ExperimentConfig, MeshConfig, TrainConfig)
+    from serverless_learn_tpu.data.datasets import SyntheticSource
+
+    cfg = ExperimentConfig(
+        model="mlp_mnist", mesh=MeshConfig(dp=8),
+        optimizer=OptimizerConfig(name="adamw", learning_rate=1e-2,
+                                  warmup_steps=10),
+        train=TrainConfig(batch_size=16, num_steps=2), data=DataConfig())
+    trainer = build_trainer(cfg)
+    state = trainer.init()
+    src = SyntheticSource(trainer.bundle.make_batch, cfg.data, 16, seed=0)
+    batch = trainer.shard_batch(next(iter(src)))
+    state, m0 = trainer.step(state, batch)
+    lr0 = float(jax.device_get(m0["lr"]))
+    state, m1 = trainer.step(state, batch)
+    lr1 = float(jax.device_get(m1["lr"]))
+    assert 0.0 <= lr0 < lr1 <= 1e-2, (lr0, lr1)  # warming up
 
 
 def test_unknown_optimizer_rejected():
