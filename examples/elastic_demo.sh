@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Elastic-cluster demo on one machine — the reference's three-binary demo
+# (./file_server, ./master, ./worker ADDR) rebuilt: native daemons, a
+# published typed dataset, and an elastic worker that registers, streams
+# shards, forms a device mesh, and trains.
+#
+#   bash examples/elastic_demo.sh
+#
+# Runs on the virtual 8-device CPU mesh so it works anywhere; drop the two
+# JAX_* exports to use real TPU chips. Workers can be added (re-run the
+# worker line in another shell) or killed at any time: the coordinator bumps
+# the membership epoch and live workers checkpoint, re-mesh, and resume.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+COORD_PORT=52252
+SHARD_PORT=52253
+STORE=$(mktemp -d)
+trap 'kill $(jobs -p) 2>/dev/null || true; rm -rf "$STORE"' EXIT
+
+make -C native -s
+
+native/bin/coordinator --port $COORD_PORT --lease_ttl_ms 2000 --sweep_ms 200 &
+native/bin/shard_server --port $SHARD_PORT --root "$STORE" &
+sleep 0.5
+
+python -m serverless_learn_tpu publish \
+    --shard-server 127.0.0.1:$SHARD_PORT --dataset mnist --model mlp_mnist \
+    --num-records 2048 --records-per-shard 256
+
+python -m serverless_learn_tpu worker \
+    --model mlp_mnist --mesh dp=8 --batch-size 64 --steps 40 \
+    --coordinator 127.0.0.1:$COORD_PORT \
+    --shard-server 127.0.0.1:$SHARD_PORT --dataset mnist \
+    --name demo-worker -v
+
+python -m serverless_learn_tpu stats --addr 127.0.0.1:$SHARD_PORT

@@ -35,9 +35,9 @@ import jax
 
 from serverless_learn_tpu.config import ExperimentConfig, MeshConfig
 from serverless_learn_tpu.control.client import WorkerAgent
-from serverless_learn_tpu.data.datasets import SyntheticSource
 from serverless_learn_tpu.parallel.mesh import make_mesh
 from serverless_learn_tpu.training.checkpoint import Checkpointer
+from serverless_learn_tpu.training.loop import make_source
 from serverless_learn_tpu.training.train_step import build_trainer
 from serverless_learn_tpu.utils.metrics import log_json
 
@@ -117,6 +117,7 @@ class ElasticTrainer:
             self._agent.start()
         losses: List[float] = []
         state = None
+        source = None
         source_iter = None
         try:
             while True:
@@ -127,9 +128,13 @@ class ElasticTrainer:
                 mesh = make_mesh(mesh_cfg, devices=devices)
                 trainer = build_trainer(cfg, mesh=mesh)
                 if source_iter is None:
-                    source_iter = iter(SyntheticSource(
-                        trainer.bundle.make_batch, cfg.data,
-                        cfg.train.batch_size, seed=cfg.train.seed))
+                    # Honor the configured data plane: a shard server means
+                    # the worker streams the published dataset (the CLI's
+                    # --shard-server/--dataset), not synthetic batches. The
+                    # source survives re-meshing (it feeds host batches;
+                    # only shard_batch's placement changes per mesh).
+                    source = make_source(cfg, trainer)
+                    source_iter = iter(source)
                 # restore (or cold-start) into the new world's shardings
                 template = trainer.init()
                 if self.ckpt.latest_step() is not None:
@@ -163,5 +168,7 @@ class ElasticTrainer:
                 if step >= num_steps or self._stop.is_set():
                     return state, losses
         finally:
+            if source is not None and hasattr(source, "close"):
+                source.close()
             if self._agent is not None:
                 self._agent.stop()
