@@ -9,11 +9,15 @@ per line and get one JSON object per line back.
     reply:    {"tokens": [...], "new_tokens": [...], "latency_ms": 12.3}
     errors:   {"error": "..."}
 
-Single-threaded by design: TPU generation is serialized on the device
-anyway, so requests queue at the accept loop instead of fighting over it.
-Repeated (prompt_len, max_new_tokens) shapes reuse the jit cache; new
-shapes pay one compile. The reference has no inference path at all — its
-model was a gossiped double vector (`src/protos/serverless_learn.proto:81-83`).
+Connections are handled on per-connection threads, but generation itself is
+serialized by a device lock: TPU generation is sequential on the chip
+anyway, so concurrency buys fairness (an idle keepalive client cannot
+starve the accept loop) without device contention. Request lines are
+capped at MAX_LINE bytes — a newline-free stream gets an error reply and a
+dropped connection instead of unbounded buffering. Repeated
+(prompt_len, max_new_tokens) shapes reuse the jit cache; new shapes pay one
+compile. The reference has no inference path at all — its model was a
+gossiped double vector (`src/protos/serverless_learn.proto:81-83`).
 """
 
 from __future__ import annotations
@@ -28,6 +32,10 @@ import jax
 import jax.numpy as jnp
 
 from serverless_learn_tpu.inference.generate import generate
+
+# Longest accepted request line. A 128k-token prompt of 7-digit ids is
+# ~1 MB; 4 MB leaves headroom while bounding per-connection memory.
+MAX_LINE = 4 * 1024 * 1024
 
 
 class GenerationServer:
@@ -45,6 +53,10 @@ class GenerationServer:
         self.addr = f"{host}:{self._sock.getsockname()[1]}"
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._device_lock = threading.Lock()  # serializes generate() calls
+        self._conns = {}  # live connection thread -> socket, for stop()
+        self._conns_lock = threading.Lock()
+        self.max_connections = 64  # bounds threads and total line buffers
         self.requests_served = 0
 
     # -- request handling --------------------------------------------------
@@ -80,16 +92,24 @@ class GenerationServer:
     # -- socket loop -------------------------------------------------------
 
     def _serve_conn(self, conn: socket.socket):
-        # An idle or half-open client must not hold the single-threaded
-        # accept loop hostage; time out reads and move on.
+        # The read timeout bounds each connection thread's lifetime; an
+        # idle or half-open client gets dropped, not held forever.
         conn.settimeout(self.conn_timeout_s)
         with conn, conn.makefile("rwb") as f:
             while True:
                 try:
-                    line = f.readline()
+                    line = f.readline(MAX_LINE + 2)
                 except socket.timeout:
                     return
                 if not line:
+                    return
+                if len(line.rstrip(b"\r\n")) > MAX_LINE:
+                    # Oversized or newline-free stream: reply once, hang up —
+                    # never buffer without bound.
+                    f.write(json.dumps(
+                        {"error": f"request line exceeds {MAX_LINE} bytes"}
+                    ).encode() + b"\n")
+                    f.flush()
                     return
                 line = line.strip()
                 if not line:
@@ -98,7 +118,8 @@ class GenerationServer:
                     req = json.loads(line)
                     if not isinstance(req, dict):
                         raise ValueError("request must be a JSON object")
-                    rep = self.handle(req)
+                    with self._device_lock:
+                        rep = self.handle(req)
                 except Exception as e:  # any bad request -> error reply,
                     rep = {"error": f"{type(e).__name__}: {e}"}  # server lives
                 f.write(json.dumps(rep).encode() + b"\n")
@@ -113,13 +134,37 @@ class GenerationServer:
                 continue
             except OSError:
                 break
-            try:
-                self._serve_conn(conn)
-            except OSError:
-                # Client vanished, reset the pipe, or stalled past the write
-                # timeout (send-buffer full on an unread reply) — drop that
-                # connection, keep the daemon serving.
-                continue
+            # Per-connection thread: a slow or idle keepalive client blocks
+            # only its own thread; generation is serialized by _device_lock.
+            with self._conns_lock:
+                if len(self._conns) >= self.max_connections:
+                    # At the cap the total buffer memory bound
+                    # (max_connections * MAX_LINE) would break; refuse
+                    # rather than queue without bound.
+                    try:
+                        conn.sendall(json.dumps(
+                            {"error": "server at connection capacity"}
+                        ).encode() + b"\n")
+                        conn.close()
+                    except OSError:
+                        pass
+                    continue
+                t = threading.Thread(
+                    target=self._serve_conn_safe, args=(conn,), daemon=True)
+                self._conns[t] = conn
+            t.start()
+
+    def _serve_conn_safe(self, conn: socket.socket):
+        try:
+            self._serve_conn(conn)
+        except OSError:
+            # Client vanished, reset the pipe, or stalled past the write
+            # timeout (send-buffer full on an unread reply) — drop that
+            # connection, keep the daemon serving.
+            pass
+        finally:
+            with self._conns_lock:
+                self._conns.pop(threading.current_thread(), None)
 
     def start(self):
         """Serve on a background thread (tests, embedding)."""
@@ -135,6 +180,18 @@ class GenerationServer:
             pass
         if self._thread is not None:
             self._thread.join(timeout=5)
+        # Unblock idle readers, then wait for in-flight requests: tearing
+        # down device state while a connection thread is inside generate()
+        # can crash the runtime.
+        with self._conns_lock:
+            live = list(self._conns.items())
+        for _, c in live:
+            try:
+                c.shutdown(socket.SHUT_RD)
+            except OSError:
+                pass
+        for t, _ in live:
+            t.join(timeout=30.0)
 
 
 def request(addr: str, req: dict, timeout: float = 120.0) -> dict:

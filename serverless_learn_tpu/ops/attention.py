@@ -91,11 +91,13 @@ def dot_product_attention(
         # dense XLA and materialize the [T, T] scores). Otherwise flash
         # above the measured threshold; flash itself falls back to xla for
         # masks, untileable shapes, and non-TPU/CPU backends.
+        from serverless_learn_tpu.parallel.compat import in_manual_region
         from serverless_learn_tpu.parallel.ring_attention import (
             get_active_mesh)
 
         mesh = get_active_mesh()
         if (mesh is not None and mesh.shape.get("sp", 1) > 1
+                and not in_manual_region()
                 and mask is None and k.shape[1] % mesh.shape["sp"] == 0):
             impl = "ring"
         else:

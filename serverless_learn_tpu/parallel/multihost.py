@@ -129,6 +129,8 @@ def bootstrap_via_coordinator(
         deadline = time.time() + timeout_s
         stable_view = None  # (my_id, tuple of ranked worker ids)
         stable_since = 0.0
+        stable_polls = 0  # consecutive polls the current view has held
+        extended = False  # one-time deadline extension for a fresh view
         # Commit to a rank assignment only after the same view has held for
         # a full stability window (a couple of lease heartbeats). A host
         # whose lease lapses mid-wait re-registers under a new worker id;
@@ -152,22 +154,33 @@ def bootstrap_via_coordinator(
                     now = time.time()
                     if view != stable_view:
                         stable_view, stable_since = view, now
-                    elif now - stable_since >= stability_s:
-                        break
+                        stable_polls = 1
+                    else:
+                        stable_polls += 1
+                        if now - stable_since >= stability_s:
+                            break
                 else:
-                    stable_view = None
+                    stable_view, stable_polls = None, 0
             else:
-                stable_view = None
+                stable_view, stable_polls = None, 0
             if time.time() > deadline:
-                if stable_view is not None:
-                    # A complete, consistent view exists right at the
-                    # deadline — commit to it rather than failing a world
-                    # that did form (the stability window is best-effort,
-                    # not part of the formation budget).
+                if stable_view is not None and stable_polls >= 2:
+                    # A complete view exists at the deadline AND held for at
+                    # least two consecutive polls — commit to it rather than
+                    # failing a world that did form (the full stability
+                    # window is best-effort, not part of the formation
+                    # budget). A single-poll view is exactly the churn case
+                    # the window exists for, so it never short-circuits.
                     break
-                raise TimeoutError(
-                    f"world of {world_size} did not form within {timeout_s}s "
-                    f"(have {len(hosts)} bootstrap hosts)")
+                if stable_view is not None and not extended:
+                    # Fresh view right at the deadline: grant one stability
+                    # window to confirm it instead of committing blind.
+                    deadline += stability_s
+                    extended = True
+                else:
+                    raise TimeoutError(
+                        f"world of {world_size} did not form within "
+                        f"{timeout_s}s (have {len(hosts)} bootstrap hosts)")
             time.sleep(0.05)
 
         rank = next(i for i, p in enumerate(ranked) if p.worker_id == my_id)

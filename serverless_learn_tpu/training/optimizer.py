@@ -45,6 +45,12 @@ def make_optimizer(cfg: OptimizerConfig, trainable_mask=None) -> optax.GradientT
         core = optax.rmsprop(schedule, momentum=cfg.momentum)
     else:
         raise ValueError(f"unknown optimizer {cfg.name!r}")
+    if cfg.weight_decay > 0 and cfg.name not in ("adamw", "lion"):
+        # Only adamw/lion implement decoupled decay; silently dropping the
+        # configured decay would quietly diverge from intent.
+        raise ValueError(
+            f"weight_decay={cfg.weight_decay} is ignored by optimizer "
+            f"{cfg.name!r}; use 'adamw' or 'lion', or set weight_decay=0")
     parts = []
     if cfg.grad_clip_norm > 0:
         parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))

@@ -81,6 +81,14 @@ def test_unknown_optimizer_rejected():
         make_optimizer(OptimizerConfig(name="nope"))
 
 
+@pytest.mark.parametrize("name", ["adam", "sgd", "adafactor", "rmsprop"])
+def test_ignored_weight_decay_rejected(name):
+    """Optimizers without decoupled decay must fail loudly, not silently
+    train with no decay (ADVICE.md round 1)."""
+    with pytest.raises(ValueError, match="weight_decay"):
+        make_optimizer(OptimizerConfig(name=name, weight_decay=0.1))
+
+
 def test_schedule_warmup_and_decay(devices):
     from serverless_learn_tpu.training.optimizer import make_schedule
 

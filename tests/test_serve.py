@@ -68,6 +68,35 @@ def test_serve_survives_malformed_json_values(server):
     assert "tokens" in request(srv.addr, {"prompt": [1], "max_new_tokens": 1})
 
 
+def test_serve_oversized_line_rejected(server):
+    """A newline-free flood must get one error reply + hangup, not
+    unbounded buffering (ADVICE.md round 1)."""
+    from serverless_learn_tpu.inference import server as srv_mod
+
+    srv, _, _ = server
+    host, _, port = srv.addr.rpartition(":")
+    with socket.create_connection((host, int(port))) as s:
+        f = s.makefile("rwb")
+        f.write(b"x" * (srv_mod.MAX_LINE + 2) + b"\n")
+        f.flush()
+        assert "error" in json.loads(f.readline())
+        assert f.readline() == b""  # server hung up
+    # Fresh connections still served.
+    assert "tokens" in request(srv.addr, {"prompt": [1], "max_new_tokens": 1})
+
+
+def test_serve_idle_client_does_not_starve_others(server):
+    """An open idle connection must not block other clients (per-connection
+    threads; ADVICE.md round 1)."""
+    srv, _, _ = server
+    host, _, port = srv.addr.rpartition(":")
+    with socket.create_connection((host, int(port))):
+        # Idle keepalive held open; a second client must still get served.
+        rep = request(srv.addr, {"prompt": [3], "max_new_tokens": 2},
+                      timeout=30.0)
+        assert "tokens" in rep
+
+
 def test_serve_sequential_clients_and_sampling(server):
     srv, _, _ = server
     a = request(srv.addr, {"prompt": [7, 8], "max_new_tokens": 4,
