@@ -44,6 +44,7 @@ struct WorkerRec {
   uint64_t last_seen_ms;
   uint64_t step = 0;
   double metric = 0.0;
+  uint32_t flow = 0;  // input backpressure from HeartbeatRequest.flow
 };
 
 uint64_t now_ms() {
@@ -88,6 +89,7 @@ class Coordinator {
     it->second.last_seen_ms = now_ms();
     it->second.step = req.step();
     it->second.metric = req.metric();
+    it->second.flow = req.flow();
     rep.set_ok(true);
     rep.set_epoch(epoch_);
     FillPeersLocked(rep.mutable_peers());
@@ -110,6 +112,19 @@ class Coordinator {
       ack.set_error("unknown worker");
     }
     return ack;
+  }
+
+  // Per-worker flow/progress rows for the stats RPC — where the reserved
+  // FlowFeedback of the reference (proto :73-75) becomes observable.
+  void FillFlows(slt::StatsReply* rep) {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (const auto& [id, rec] : workers_) {
+      auto* f = rep->add_flows();
+      f->set_worker_id(id);
+      f->set_flow(rec.flow);
+      f->set_step(rec.step);
+      f->set_metric(rec.metric);
+    }
   }
 
   slt::MembershipReply Membership() {
@@ -201,6 +216,7 @@ void serve_conn(Coordinator* coord, int fd) {
       case slt::MSG_STATS_REQ: {
         slt::StatsReply rep;
         g_rpc_stats.Fill(&rep);
+        coord->FillFlows(&rep);
         rep.SerializeToString(&out);
         out_type = slt::MSG_STATS_REP;
         break;

@@ -45,6 +45,7 @@ struct Stats {
   std::atomic<uint64_t> bytes_served{0};
   std::atomic<uint64_t> bytes_stored{0};
   std::atomic<uint32_t> active_streams{0};
+  std::atomic<uint64_t> crc_failures{0};
 };
 
 Stats g_stats;
@@ -54,10 +55,69 @@ bool key_ok(const std::string& key) {
   // Keys are relative paths; forbid traversal and absolute paths.
   if (key.empty() || key[0] == '/') return false;
   if (key.find("..") != std::string::npos) return false;
+  // Reserve the checksum-sidecar namespace (suffix defined below).
+  if (key.size() >= 8 && key.compare(key.size() - 8, 8, ".slt-crc") == 0)
+    return false;
   return true;
 }
 
 std::string key_path(const std::string& key) { return g_root + "/" + key; }
+
+// PUT-time CRC-32 persists in a sidecar next to the blob, so fetches and
+// manifests can expose it without rescanning (a re-read per manifest row
+// would turn every manifest into a full-store read). The suffix is filtered
+// from manifests and is not a legal shard/checkpoint key shape.
+//
+// Blob and sidecar are renamed independently, so concurrent puts to one key
+// can pair one put's blob with another's sidecar. The sidecar therefore
+// records the inode of the blob it describes (captured via fstat on the put
+// tmp fd — inodes survive rename), and readers TRUST a sidecar only when
+// its inode matches the blob they actually read. A lost race degrades to
+// "verification skipped", never to a false corruption verdict.
+const char kCrcSuffix[] = ".slt-crc";
+
+std::string crc_path(const std::string& key) {
+  return key_path(key) + kCrcSuffix;
+}
+
+bool read_sidecar_crc(const std::string& key, uint64_t blob_ino,
+                      uint32_t* crc) {
+  int fd = ::open(crc_path(key).c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  char buf[48];
+  ssize_t r = ::read(fd, buf, sizeof(buf) - 1);
+  ::close(fd);
+  if (r <= 0) return false;
+  buf[r] = 0;
+  unsigned long long ino = 0;
+  unsigned int c = 0;
+  if (sscanf(buf, "%x %llu", &c, &ino) != 2) return false;
+  if (static_cast<uint64_t>(ino) != blob_ino) return false;
+  *crc = c;
+  return true;
+}
+
+void write_sidecar_crc(const std::string& key, uint32_t crc,
+                       uint64_t blob_ino) {
+  // Atomic like the blob itself: a torn sidecar would be unparseable and
+  // read as "no checksum", not as a mismatch.
+  std::string path = crc_path(key);
+  static std::atomic<uint64_t> seq{0};
+  std::string tmp = path + ".tmp." + std::to_string(::getpid()) + "." +
+                    std::to_string(seq.fetch_add(1));
+  int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return;
+  char buf[48];
+  int n = snprintf(buf, sizeof(buf), "%08x %llu\n", crc,
+                   (unsigned long long)blob_ino);
+  if (::write(fd, buf, n) != n) {
+    ::close(fd);
+    ::unlink(tmp.c_str());
+    return;
+  }
+  ::close(fd);
+  ::rename(tmp.c_str(), path.c_str());
+}
 
 void mkdirs_for(const std::string& path) {
   for (size_t i = 1; i < path.size(); i++) {
@@ -135,11 +195,17 @@ void handle_fetch(int fd, const slt::FetchRequest& req) {
     ::fstat(file_fd, &st);
     total = static_cast<uint64_t>(st.st_size);
   }
-  uint64_t offset = std::min(req.offset(), total);
+  uint64_t begin = std::min(req.offset(), total);
+  uint64_t offset = begin;
   uint64_t end = req.length() ? std::min(offset + req.length(), total) : total;
   // Every fetch MUST end with a last=true (or error) chunk — a stream with
-  // no terminator leaves the client blocked in read_frame forever.
+  // no terminator leaves the client blocked in read_frame forever. Data
+  // chunks never carry last=true; the terminator is a dedicated frame that
+  // also carries the CRC-32 of the served range, and for a full-file fetch
+  // of a stored blob the running checksum is compared against the PUT-time
+  // sidecar first — silent disk corruption becomes a loud fetch error.
   bool terminated = false;
+  uint32_t crc = crc32(0L, Z_NULL, 0);
   std::string buf;
   while (offset < end) {
     size_t n = static_cast<size_t>(
@@ -157,11 +223,10 @@ void handle_fetch(int fd, const slt::FetchRequest& req) {
       buf.resize(static_cast<size_t>(r));
       n = static_cast<size_t>(r);
     }
+    crc = crc32(crc, reinterpret_cast<const Bytef*>(buf.data()), n);
     slt::ChunkMsg c;
     c.set_offset(offset);
     offset += n;
-    c.set_last(offset >= end);
-    terminated = c.last();
     c.set_data(std::move(buf));
     std::string out;
     c.SerializeToString(&out);
@@ -173,14 +238,28 @@ void handle_fetch(int fd, const slt::FetchRequest& req) {
     buf.clear();
   }
   if (!terminated) {
-    // Empty range (offset >= end, zero-size file, offset past EOF): send a
-    // bare terminator chunk so the client returns 0 bytes instead of hanging.
-    slt::ChunkMsg c;
-    c.set_offset(offset);
-    c.set_last(true);
-    std::string out;
-    c.SerializeToString(&out);
-    slt::write_frame(fd, slt::MSG_CHUNK, out);
+    uint32_t stored_crc = 0;
+    uint64_t ino = 0;
+    if (file_fd >= 0) {
+      struct stat st;
+      if (::fstat(file_fd, &st) == 0) ino = st.st_ino;
+    }
+    if (!synthetic && begin == 0 && end == total &&
+        read_sidecar_crc(req.key(), ino, &stored_crc) && stored_crc != crc) {
+      g_stats.crc_failures++;
+      slt::log_error("shard", "crc mismatch key=%s stored=%08x read=%08x",
+                     req.key().c_str(), stored_crc, crc);
+      send_error_chunk(fd, "crc mismatch: blob corrupted on disk");
+    } else {
+      slt::ChunkMsg c;
+      c.set_offset(offset);
+      c.set_last(true);
+      c.set_crc32(crc);
+      c.set_crc_present(true);
+      std::string out;
+      c.SerializeToString(&out);
+      slt::write_frame(fd, slt::MSG_CHUNK, out);
+    }
   }
   if (file_fd >= 0) ::close(file_fd);
 }
@@ -207,7 +286,9 @@ void handle_put(int fd, const slt::PutRequest& req) {
     tmp_path = final_path + ".tmp." + std::to_string(::getpid()) + "." +
                std::to_string(put_seq.fetch_add(1));
     mkdirs_for(final_path);
-    out_fd = ::open(tmp_path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    // O_RDWR, not O_WRONLY: the out-of-order-put path re-reads this fd to
+    // recompute the checksum before the verdict.
+    out_fd = ::open(tmp_path.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
     if (out_fd < 0) {
       ack.set_ok(false);
       ack.set_error("cannot open " + tmp_path);
@@ -215,6 +296,11 @@ void handle_put(int fd, const slt::PutRequest& req) {
   }
   uint64_t written = 0;
   bool done = false, failed = false;
+  // Running CRC over the received bytes; valid only while chunks arrive in
+  // order (both shipped clients stream sequentially). An out-of-order put
+  // falls back to re-reading the tmp file before the verdict.
+  uint32_t crc = crc32(0L, Z_NULL, 0);
+  bool crc_sequential = true;
   uint8_t type;
   std::string payload;
   while (!done && slt::read_frame(fd, &type, &payload)) {
@@ -236,19 +322,48 @@ void handle_put(int fd, const slt::PutRequest& req) {
         ::unlink(tmp_path.c_str());
         out_fd = -1;
       } else {
+        if (c.offset() != written) crc_sequential = false;
+        if (crc_sequential) {
+          crc = crc32(crc, reinterpret_cast<const Bytef*>(c.data().data()),
+                      c.data().size());
+        }
         written += c.data().size();
       }
     }
     done = c.last();
   }
   if (out_fd >= 0) {
+    if (done && !failed && !crc_sequential) {
+      // Recompute from the tmp file (rare path; offsets interleaved).
+      crc = crc32(0L, Z_NULL, 0);
+      std::string rbuf(slt::kChunkSize, 0);
+      off_t pos = 0;
+      ssize_t r;
+      while ((r = ::pread(out_fd, &rbuf[0], rbuf.size(), pos)) > 0) {
+        crc = crc32(crc, reinterpret_cast<const Bytef*>(rbuf.data()), r);
+        pos += r;
+      }
+    }
+    uint64_t tmp_ino = 0;
+    struct stat st;
+    if (::fstat(out_fd, &st) == 0) tmp_ino = st.st_ino;
     ::close(out_fd);
-    if (done && !failed) {
+    if (done && !failed && req.crc_present() && req.crc32() != crc) {
+      g_stats.crc_failures++;
+      ::unlink(tmp_path.c_str());
+      ack.set_ok(false);
+      char msg[96];
+      snprintf(msg, sizeof(msg), "crc mismatch: sent %08x received %08x",
+               req.crc32(), crc);
+      ack.set_error(msg);
+      slt::log_error("shard", "put key=%s %s", req.key().c_str(), msg);
+    } else if (done && !failed) {
       ::rename(tmp_path.c_str(), final_path.c_str());
+      write_sidecar_crc(req.key(), crc, tmp_ino);
       g_stats.bytes_stored += written;
       ack.set_ok(true);
-      slt::log_info("shard", "put key=%s bytes=%llu", req.key().c_str(),
-                    (unsigned long long)written);
+      slt::log_info("shard", "put key=%s bytes=%llu crc=%08x",
+                    req.key().c_str(), (unsigned long long)written, crc);
     } else {
       ::unlink(tmp_path.c_str());
       ack.set_ok(false);
@@ -269,6 +384,10 @@ void list_dir(const std::string& dir, const std::string& rel,
     std::string name = e->d_name;
     if (name == "." || name == "..") continue;
     if (name.size() > 4 && name.find(".tmp.") != std::string::npos) continue;
+    size_t crc_len = sizeof(kCrcSuffix) - 1;
+    if (name.size() > crc_len &&
+        name.compare(name.size() - crc_len, crc_len, kCrcSuffix) == 0)
+      continue;  // checksum sidecars are metadata, not blobs
     std::string full = dir + "/" + name;
     std::string r = rel.empty() ? name : rel + "/" + name;
     struct stat st;
@@ -279,6 +398,8 @@ void list_dir(const std::string& dir, const std::string& rel,
       auto* b = rep->add_blobs();
       b->set_key(r);
       b->set_size(static_cast<uint64_t>(st.st_size));
+      uint32_t crc = 0;
+      if (read_sidecar_crc(r, st.st_ino, &crc)) b->set_crc32(crc);
     }
   }
   ::closedir(d);
@@ -342,6 +463,7 @@ void serve_conn(int fd) {
           ack.set_ok(false);
           ack.set_error("bad key");
         } else if (::unlink(key_path(req.key()).c_str()) == 0) {
+          ::unlink(crc_path(req.key()).c_str());  // sidecar goes with blob
           ack.set_ok(true);
         } else {
           ack.set_ok(false);
@@ -357,6 +479,7 @@ void serve_conn(int fd) {
         rep.set_bytes_served(g_stats.bytes_served.load());
         rep.set_bytes_stored(g_stats.bytes_stored.load());
         rep.set_active_streams(g_stats.active_streams.load());
+        rep.set_crc_failures(g_stats.crc_failures.load());
         g_rpc_stats.Fill(&rep);
         std::string out;
         rep.SerializeToString(&out);

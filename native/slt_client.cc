@@ -14,6 +14,7 @@
 #include <cstring>
 #include <mutex>
 #include <string>
+#include <zlib.h>
 
 #include "framing.h"
 #include "slt.pb.h"
@@ -92,7 +93,9 @@ long long slt_call(void* h, unsigned char req_type, const void* req,
 }
 
 // Fetch [offset, offset+length) of `key` into dst (cap bytes). length==0
-// means to EOF. Returns bytes written or -1. Error chunks return -1.
+// means to EOF. Returns bytes written, -1 on transport failure / error
+// chunk (including server-detected disk corruption), or -3 when the
+// terminator's CRC-32 disagrees with the bytes received (wire corruption).
 long long slt_fetch_into(void* h, const char* key, unsigned long long offset,
                          unsigned long long length, void* dst, size_t cap) {
   auto* c = static_cast<Conn*>(h);
@@ -109,6 +112,7 @@ long long slt_fetch_into(void* h, const char* key, unsigned long long offset,
     return -1;
   }
   uint64_t written = 0;
+  uint32_t crc = crc32(0L, Z_NULL, 0);
   while (true) {
     uint8_t type;
     std::string out;
@@ -127,6 +131,10 @@ long long slt_fetch_into(void* h, const char* key, unsigned long long offset,
     }
     if (!chunk.error().empty()) return -1;
     if (!chunk.data().empty()) {
+      // CRC over the bytes as served (pre-truncation): it must mirror the
+      // server's running checksum of the range, not the caller's buffer.
+      crc = crc32(crc, reinterpret_cast<const Bytef*>(chunk.data().data()),
+                  chunk.data().size());
       uint64_t rel = chunk.offset() - offset;
       size_t n = chunk.data().size();
       if (rel + n > cap) n = rel < cap ? static_cast<size_t>(cap - rel) : 0;
@@ -135,7 +143,10 @@ long long slt_fetch_into(void* h, const char* key, unsigned long long offset,
         written = std::max<uint64_t>(written, rel + n);
       }
     }
-    if (chunk.last()) break;
+    if (chunk.last()) {
+      if (chunk.crc_present() && chunk.crc32() != crc) return -3;
+      break;
+    }
   }
   return static_cast<long long>(written);
 }
@@ -148,6 +159,10 @@ int slt_put(void* h, const char* key, const void* src, size_t len) {
   slt::PutRequest req;
   req.set_key(key);
   req.set_total_size(len);
+  // crc32_z takes size_t (plain crc32's uInt would wrap past 4 GiB).
+  req.set_crc32(crc32_z(crc32(0L, Z_NULL, 0),
+                        static_cast<const Bytef*>(src), len));
+  req.set_crc_present(true);
   std::string payload;
   req.SerializeToString(&payload);
   if (!slt::write_frame(c->fd, slt::MSG_PUT_REQ, payload)) {

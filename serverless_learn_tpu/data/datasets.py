@@ -78,5 +78,31 @@ class Prefetcher:
                     raise StopIteration
                 continue
 
-    def close(self):
+    def depth(self) -> int:
+        """Batches currently ready — the worker's flow/backpressure signal
+        (HeartbeatRequest.flow): 0 while training = input-starved; full =
+        the device, not the data plane, is the bottleneck."""
+        return self.q.qsize()
+
+    def close(self, timeout: float = 30.0) -> int:
+        """Stop the producer; returns the number of ready batches discarded.
+
+        Joins the producer so the underlying iterator is safe to hand to a
+        successor (the elastic loop re-wraps one long-lived source per
+        re-mesh). If the join times out (producer stuck inside
+        ``next(source)``), the iterator is NOT safe to reuse — check
+        ``stopped`` before re-wrapping it.
+        """
         self._stop.set()
+        self.thread.join(timeout=timeout)
+        dropped = 0
+        while True:
+            try:
+                self.q.get_nowait()
+                dropped += 1
+            except queue.Empty:
+                return dropped
+
+    @property
+    def stopped(self) -> bool:
+        return not self.thread.is_alive()

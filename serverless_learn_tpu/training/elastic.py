@@ -35,6 +35,7 @@ import jax
 
 from serverless_learn_tpu.config import ExperimentConfig, MeshConfig
 from serverless_learn_tpu.control.client import WorkerAgent
+from serverless_learn_tpu.data.datasets import Prefetcher
 from serverless_learn_tpu.parallel.mesh import make_mesh
 from serverless_learn_tpu.training.checkpoint import Checkpointer
 from serverless_learn_tpu.training.loop import make_source
@@ -150,16 +151,41 @@ class ElasticTrainer:
                     log_json({"event": "mesh_formed", "epoch": epoch,
                               "n_devices": len(devices), "step": step})
 
-                while (step < num_steps and not self._remesh.is_set()
-                       and not self._stop.is_set()):
-                    batch = next(source_iter)
-                    state, metrics = trainer.step(
-                        state, trainer.shard_batch(batch))
-                    loss = float(jax.device_get(metrics["loss"]))
-                    losses.append(loss)
-                    step += 1
-                    if self._agent is not None:
-                        self._agent.report(step, loss)
+                # Per-mesh prefetcher over the long-lived raw iterator:
+                # overlaps host batch production with device steps, and its
+                # queue depth is the flow signal heartbeats carry to the
+                # coordinator (successor of the reference's reserved
+                # FlowFeedback, proto :73-75). Rebuilt each epoch because
+                # shard_batch's placement is mesh-specific.
+                prefetch = Prefetcher(source_iter, trainer.shard_batch,
+                                      depth=cfg.data.prefetch)
+                try:
+                    while (step < num_steps and not self._remesh.is_set()
+                           and not self._stop.is_set()):
+                        batch = next(prefetch)
+                        state, metrics = trainer.step(state, batch)
+                        loss = float(jax.device_get(metrics["loss"]))
+                        losses.append(loss)
+                        step += 1
+                        if self._agent is not None:
+                            self._agent.report(step, loss,
+                                               flow=prefetch.depth())
+                finally:
+                    # Re-meshing forfeits batches already pulled off the
+                    # source but not yet trained on (queue + in-flight) —
+                    # accounted here, never silent.
+                    dropped = prefetch.close()
+                    if dropped and self.verbose:
+                        log_json({"event": "remesh_dropped_batches",
+                                  "n": dropped})
+                    if not prefetch.stopped:
+                        # Producer is stuck inside next(source_iter); the
+                        # iterator is unsafe to share with a successor.
+                        # Rebuild the source from scratch next epoch.
+                        if hasattr(source, "close"):
+                            source.close()
+                        source = None
+                        source_iter = None
 
                 # drain is implicit (the step above completed); save before
                 # tearing the mesh down

@@ -145,3 +145,21 @@ def test_agent_rejoins_after_lease_loss(coordinator):
     assert len(c.membership().peers) == 1
     agent.stop()
     c.close()
+
+
+def test_heartbeat_flow_surfaces_in_stats(coordinator):
+    """HeartbeatRequest.flow (successor of the reference's reserved
+    FlowFeedback, proto :73-75) must round-trip into the coordinator's
+    stats RPC — the slow-consumer observability path (VERDICT item 6)."""
+    c = CoordinatorClient(coordinator)
+    rep = c.register("w:1", name="flowtest")
+    # A starved worker (flow=0) and a healthy one side by side.
+    c.heartbeat(rep.worker_id, step=7, metric=0.5, flow=0)
+    rep2 = c.register("w:2", name="flowtest2")
+    c.heartbeat(rep2.worker_id, step=9, metric=0.25, flow=3)
+    flows = {f.worker_id: f for f in c.stats().flows}
+    assert flows[rep.worker_id].flow == 0
+    assert flows[rep.worker_id].step == 7
+    assert flows[rep2.worker_id].flow == 3
+    assert flows[rep2.worker_id].metric == pytest.approx(0.25)
+    c.close()

@@ -219,3 +219,94 @@ def test_stats_counters(shard_server):
     st = c.stats()
     assert st.bytes_stored >= 1000 and st.bytes_served >= 1000
     c.close()
+
+
+def test_corrupted_blob_fails_fetch_loudly(shard_server):
+    """Wire the dead crc32 field (VERDICT round 1 item 6): flipping one byte
+    of a stored blob on disk must fail the next full fetch with a crc error,
+    not silently serve garbage — and count in stats.crc_failures."""
+    addr, root = shard_server
+    c = ShardClient(addr)
+    data = os.urandom(2 * 1024 * 1024 + 5)
+    c.put("ckpt/weights", data)
+    path = root / "ckpt" / "weights"
+    raw = bytearray(path.read_bytes())
+    raw[12345] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    with pytest.raises(IOError):
+        c.fetch("ckpt/weights")
+    assert c.stats().crc_failures >= 1
+    # Ranged fetches skip the whole-file disk check (no crc over a range to
+    # compare with) but still verify transit integrity.
+    assert len(c.fetch("ckpt/weights", offset=0, length=1000)) == 1000
+    c.close()
+
+
+def test_put_with_bad_crc_rejected(shard_server):
+    """A put whose payload doesn't match its declared crc must be rejected
+    (simulated in-transit corruption) and leave no blob behind."""
+    import struct as _struct
+    import zlib as _zlib
+
+    from serverless_learn_tpu.control.client import (
+        MSG_ACK, MSG_CHUNK, MSG_PUT_REQ, _pb2)
+
+    addr, root = shard_server
+    pb = _pb2()
+    host, _, port = addr.rpartition(":")
+    data = b"payload-bytes" * 1000
+    with socket.create_connection((host, int(port))) as s:
+        req = pb.PutRequest(key="bad", total_size=len(data),
+                            crc32=_zlib.crc32(data) ^ 0xDEADBEEF,
+                            crc_present=True)
+        payload = req.SerializeToString()
+        s.sendall(_struct.pack(">IB", len(payload), MSG_PUT_REQ) + payload)
+        chunk = pb.ChunkMsg(data=data, offset=0, last=True)
+        payload = chunk.SerializeToString()
+        s.sendall(_struct.pack(">IB", len(payload), MSG_CHUNK) + payload)
+        hdr = b""
+        while len(hdr) < 5:
+            hdr += s.recv(5 - len(hdr))
+        length, mtype = _struct.unpack(">IB", hdr)
+        body = b""
+        while len(body) < length:
+            body += s.recv(length - len(body))
+        assert mtype == MSG_ACK
+        ack = pb.Ack()
+        ack.ParseFromString(body)
+        assert not ack.ok and "crc" in ack.error
+    assert not (root / "bad").exists()
+
+
+def test_manifest_reports_put_crc(shard_server):
+    import zlib as _zlib
+
+    addr, _ = shard_server
+    c = ShardClient(addr)
+    data = b"shard-data" * 5000
+    c.put("ds2/shard-0", data)
+    blobs = {b.key: b for b in c.manifest("ds2")}
+    assert blobs["ds2/shard-0"].crc32 == _zlib.crc32(data)
+    c.close()
+
+
+def test_crc_sidecars_hidden_and_key_namespace_reserved(shard_server):
+    addr, root = shard_server
+    c = ShardClient(addr)
+    c.put("ds3/shard-0", b"x" * 100)
+    assert (root / "ds3" / "shard-0.slt-crc").exists()
+    keys = {b.key for b in c.manifest("")}
+    assert keys == {"ds3/shard-0"}, "sidecar leaked into manifest"
+    with pytest.raises(IOError):
+        c.put("evil.slt-crc", b"y")
+    c.close()
+
+
+def test_pure_python_transport_crc_roundtrip(shard_server):
+    """The socket fallback path computes and verifies crc too."""
+    addr, _ = shard_server
+    c = ShardClient(addr, prefer_native=False)
+    data = os.urandom(1_500_000)
+    c.put("pp/blob", data)
+    assert c.fetch("pp/blob") == data
+    c.close()
