@@ -1,9 +1,12 @@
 # Developer entry points. The Python package needs no build; `native/` holds
 # the C++ control/data-plane daemons.
 
-.PHONY: test native tsan bench lm-bench data-bench gen-bench dryrun clean
+.PHONY: test test-all native tsan bench lm-bench data-bench gen-bench dryrun clean
 
-test:
+test:  ## fast tier (<2 min on CPU); compile-heavy tests are marked slow
+	python -m pytest tests/ -q -m "not slow"
+
+test-all:  ## the full suite (~13 min on CPU)
 	python -m pytest tests/ -q
 
 native:

@@ -46,3 +46,92 @@ def _reset_active_mesh():
     from serverless_learn_tpu.parallel.ring_attention import set_active_mesh
 
     set_active_mesh(None)
+
+
+# -- fast/slow tiers ---------------------------------------------------------
+#
+# The full suite takes ~13 min on the 8-device CPU mesh (VERDICT round 1:
+# "split the suite so rounds 2+ can actually run it"). Tests measured >=3 s
+# are tier "slow"; `make test` runs the fast tier (<2 min), `make test-all`
+# runs everything. Node ids, not file-level marks, so every subsystem keeps
+# fast-tier coverage. Re-measure with `pytest --durations=0` when adding
+# compile-heavy tests.
+
+SLOW_TESTS = {
+    "tests/test_checkpoint.py::test_checkpoint_via_shard_server",
+    "tests/test_checkpoint.py::test_latest_and_gc",
+    "tests/test_checkpoint.py::test_resume_is_exact",
+    "tests/test_cli.py::test_publish_stats_and_train_from_shard_server",
+    "tests/test_cli.py::test_train_end_to_end",
+    "tests/test_configs.py::test_small_rungs_build[cifar_resnet18_dp4.json]",
+    "tests/test_configs.py::test_small_rungs_build[mnist_mlp.json]",
+    "tests/test_elastic.py::test_join_grows_mesh_and_crash_shrinks_it",
+    "tests/test_elastic.py::test_solo_run_without_coordinator",
+    "tests/test_elastic.py::test_state_survives_remesh_exactly",
+    "tests/test_elastic_shard_data.py::test_elastic_worker_streams_from_shard_server",
+    "tests/test_flash_attention.py::test_flash_inside_pipeline_stage",
+    "tests/test_flash_attention.py::test_flash_sharded_train_step_matches_xla[mesh_kw0]",
+    "tests/test_flash_attention.py::test_flash_sharded_train_step_matches_xla[mesh_kw1]",
+    "tests/test_flash_attention.py::test_transformer_with_flash_impl",
+    "tests/test_fused_ce.py::test_bf16_logits",
+    "tests/test_fused_ce.py::test_fused_train_step_matches_unfused",
+    "tests/test_fused_ce.py::test_matches_optax_forward_and_grad[shape0-512]",
+    "tests/test_fused_ce.py::test_matches_optax_forward_and_grad[shape1-1024]",
+    "tests/test_fused_ce.py::test_matches_optax_forward_and_grad[shape2-512]",
+    "tests/test_generate.py::test_decode_matches_full_forward",
+    "tests/test_generate.py::test_eos_is_sticky",
+    "tests/test_generate.py::test_greedy_generation_matches_full_forward_argmax",
+    "tests/test_grad_accum_eval.py::test_grad_accum_matches_whole_batch",
+    "tests/test_grad_accum_eval.py::test_grad_accum_sharded_transformer_runs",
+    "tests/test_grad_accum_eval.py::test_in_loop_eval_fires",
+    "tests/test_grad_accum_eval.py::test_mlm_grad_accum_matches_whole_batch",
+    "tests/test_grad_accum_eval.py::test_resnet_eval_uses_running_stats_and_keeps_state",
+    "tests/test_grad_accum_eval.py::test_run_eval_mean_metrics",
+    "tests/test_grad_accum_eval.py::test_run_eval_streams_from_shard_server",
+    "tests/test_local_sgd.py::test_replicas_diverge_then_gossip_reconverges",
+    "tests/test_moe.py::test_moe_aux_loss_reported",
+    "tests/test_moe.py::test_moe_group_size_bounds_capacity_without_changing_math",
+    "tests/test_moe.py::test_moe_init_state_has_no_losses_collection",
+    "tests/test_moe.py::test_moe_layer_matches_manual_dense_top1",
+    "tests/test_moe.py::test_moe_trains_ep_matches_dp[mesh_cfg0]",
+    "tests/test_moe.py::test_moe_trains_ep_matches_dp[mesh_cfg1]",
+    "tests/test_moe.py::test_n_experts_override_keeps_aux_loss",
+    "tests/test_multihost.py::test_two_process_training",
+    "tests/test_optimizers.py::test_lr_reported_in_metrics",
+    "tests/test_optimizers.py::test_optimizer_reduces_loss_on_fixed_batch[adafactor]",
+    "tests/test_optimizers.py::test_optimizer_reduces_loss_on_fixed_batch[adam]",
+    "tests/test_optimizers.py::test_optimizer_reduces_loss_on_fixed_batch[adamw]",
+    "tests/test_optimizers.py::test_optimizer_reduces_loss_on_fixed_batch[lion]",
+    "tests/test_optimizers.py::test_optimizer_reduces_loss_on_fixed_batch[rmsprop]",
+    "tests/test_optimizers.py::test_optimizer_reduces_loss_on_fixed_batch[sgd]",
+    "tests/test_pipeline.py::test_gpipe_matches_sequential_forward",
+    "tests/test_pipeline.py::test_pipelined_train_step_matches_dp",
+    "tests/test_ring_attention.py::test_llama_trains_with_sp_axis",
+    "tests/test_ring_attention.py::test_ring_grad_matches_dense",
+    "tests/test_ring_attention.py::test_ring_matches_dense_gqa",
+    "tests/test_serve.py::test_serve_matches_direct_generate",
+    "tests/test_serve.py::test_serve_survives_malformed_json_values",
+    "tests/test_shard_datasets.py::test_publish_from_bundle_and_training",
+    "tests/test_tracing.py::test_training_records_step_spans",
+    "tests/test_train_step.py::test_bert_tiny_mlm_step",
+    "tests/test_train_step.py::test_dp8_matches_single_device_exactly",
+    "tests/test_train_step.py::test_dp_tp_matches_dp_only",
+    "tests/test_train_step.py::test_llama_lora_freezes_base",
+    "tests/test_train_step.py::test_llama_tiny_fsdp_tp",
+    "tests/test_train_step.py::test_mlp_overfits_fixed_batch_single_device",
+    "tests/test_train_step.py::test_remat_matches_no_remat",
+    "tests/test_train_step.py::test_resnet18_step_runs_and_updates_batchstats",
+    "tests/test_train_step.py::test_train_dtype_policy_reaches_model",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: compile-heavy test (excluded from `make test`)")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        nodeid = item.nodeid.replace("\\", "/")
+        if nodeid in SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
