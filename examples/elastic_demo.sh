@@ -8,8 +8,11 @@
 #
 # Runs on the virtual 8-device CPU mesh so it works anywhere; drop the two
 # JAX_* exports to use real TPU chips. Workers can be added (re-run the
-# worker line in another shell) or killed at any time: the coordinator bumps
-# the membership epoch and live workers checkpoint, re-mesh, and resume.
+# worker line in another shell with a DIFFERENT --name, or omit --name for a
+# unique default — the name is the worker's checkpoint namespace and live
+# duplicates are refused) or killed at any time: the coordinator bumps the
+# membership epoch and live workers checkpoint, re-mesh, re-stripe the
+# dataset's shards across the survivors, and resume.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -60,6 +60,27 @@ class Coordinator {
 
   slt::RegisterReply Register(const slt::RegisterRequest& req) {
     std::lock_guard<std::mutex> lk(mu_);
+    if (req.exclusive_name()) {
+      // Names are checkpoint namespaces for elastic workers; the registry
+      // is the single authority, so refusal here is atomic — no
+      // client-side polling race, and a lease-lapsed worker re-registering
+      // after its replacement took over is refused the same way.
+      for (const auto& [id, rec] : workers_) {
+        if (rec.name == req.name()) {
+          slt::log_warn("coord",
+                        "register refused: name '%s' held by worker=%llu",
+                        req.name().c_str(), (unsigned long long)id);
+          slt::RegisterReply rep;
+          rep.set_ok(false);
+          rep.set_epoch(epoch_);
+          rep.set_error("name '" + req.name() + "' already held by live "
+                        "worker " + std::to_string(id) +
+                        "; pick a unique name (it is the checkpoint "
+                        "namespace), or wait out the holder's lease");
+          return rep;
+        }
+      }
+    }
     uint64_t id = next_id_++;
     WorkerRec rec{id, req.addr(), req.name(), req.n_chips(), now_ms()};
     workers_[id] = rec;

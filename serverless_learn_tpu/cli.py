@@ -360,7 +360,7 @@ def cmd_worker(args) -> int:
         cfg, store,
         coordinator_addr=cfg.control.coordinator_addr,
         advertise_addr=args.advertise,
-        name=args.name,
+        name=args.name or f"worker-{os.getpid()}",
         verbose=args.verbose,
     )
     state, losses = et.run()
@@ -477,7 +477,12 @@ def build_parser() -> argparse.ArgumentParser:
     _add_train_flags(w)
     w.add_argument("--advertise", default="local:0",
                    help="address advertised to peers")
-    w.add_argument("--name", default="worker")
+    w.add_argument("--name", default=None,
+                   help="worker name = checkpoint namespace. Default is "
+                        "unique per process (worker-<pid>); pass a stable "
+                        "name to resume a predecessor's checkpoints. Two "
+                        "LIVE workers may never share a name (refused at "
+                        "startup)")
     w.set_defaults(fn=cmd_worker)
 
     c = sub.add_parser("coordinator", help="run the membership daemon")

@@ -28,6 +28,7 @@ sharding-aware restore below are exactly what that path reuses.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
@@ -60,6 +61,7 @@ class EpochTransition:
     epoch: int
     step: int
     n_devices: int
+    stripe: tuple = (0, 1)  # (rank, size) in the live membership
 
 
 class ElasticTrainer:
@@ -76,12 +78,22 @@ class ElasticTrainer:
         device_policy: Callable = default_device_policy,
         mesh_policy: Callable = default_mesh_policy,
         verbose: bool = False,
+        name_wait_s: float = 15.0,
     ):
         self.config = config
+        self.name = name
+        # The worker's name is its checkpoint namespace: two live workers
+        # sharing a name would silently clobber each other's state (guarded
+        # at startup in run()).
         self.ckpt = Checkpointer(store, name=name, async_save=False)
         self.device_policy = device_policy
         self.mesh_policy = mesh_policy
         self.verbose = verbose
+        # How long to keep retrying an exclusive-name registration before
+        # giving up — long enough to outlive a dead predecessor's lease
+        # (default TTL 5 s) plus the eviction sweep, so a legitimate
+        # restart under a stable name succeeds without racing the sweeper.
+        self.name_wait_s = name_wait_s
         self.transitions: List[EpochTransition] = []
         self._remesh = threading.Event()
         self._stop = threading.Event()
@@ -91,7 +103,8 @@ class ElasticTrainer:
                 coordinator_addr, advertise_addr, name=name,
                 n_chips=n_chips if n_chips is not None else len(jax.devices()),
                 heartbeat_interval_ms=config.control.heartbeat_interval_ms,
-                on_epoch_change=self._on_epoch_change)
+                on_epoch_change=self._on_epoch_change,
+                exclusive_name=True)
 
     # -- membership hook ---------------------------------------------------
 
@@ -108,6 +121,41 @@ class ElasticTrainer:
         epoch, peers = self._agent.snapshot()
         return epoch, self.device_policy(peers, jax.devices())
 
+    def _stripe(self):
+        """(rank, size) in the live membership, ordered by worker id — the
+        data stripe. Concurrent workers on one coordinator divide the
+        dataset's shards instead of everyone reading everything (each
+        trains its own full batch; striping governs which records feed
+        it). Without a coordinator — or while the agent's own id is
+        transiently absent mid re-registration — fall back to this
+        process's slot in the fixed SPMD world, preserving make_source's
+        default striping."""
+        fallback = (jax.process_index(), jax.process_count())
+        if self._agent is None:
+            return fallback
+        _, peers = self._agent.snapshot()
+        ids = sorted(p.worker_id for p in peers)
+        wid = self._agent.worker_id
+        if wid not in ids:
+            return fallback
+        return ids.index(wid), len(ids)
+
+    def _start_agent(self):
+        """Register under the exclusive name, retrying long enough for a
+        dead predecessor's lease to be swept — the coordinator is the
+        single authority on name ownership (no client-side polling race),
+        so a refusal here means a LIVE worker holds the name."""
+        assert self._agent is not None
+        deadline = time.time() + self.name_wait_s
+        while True:
+            try:
+                self._agent.start()
+                return
+            except RuntimeError as e:
+                if "name" not in str(e) or time.time() > deadline:
+                    raise
+                time.sleep(0.3)
+
     # -- main loop ---------------------------------------------------------
 
     def run(self, num_steps: Optional[int] = None):
@@ -115,11 +163,12 @@ class ElasticTrainer:
         membership epoch change. Returns (final_state, losses)."""
         num_steps = num_steps or self.config.train.num_steps
         if self._agent is not None:
-            self._agent.start()
+            self._start_agent()
         losses: List[float] = []
         state = None
         source = None
         source_iter = None
+        stripe = None
         try:
             while True:
                 self._remesh.clear()
@@ -128,13 +177,20 @@ class ElasticTrainer:
                 cfg = self.config.override(mesh=mesh_cfg)
                 mesh = make_mesh(mesh_cfg, devices=devices)
                 trainer = build_trainer(cfg, mesh=mesh)
-                if source_iter is None:
+                rank, size = self._stripe()
+                if source_iter is None or (rank, size) != stripe:
                     # Honor the configured data plane: a shard server means
                     # the worker streams the published dataset (the CLI's
                     # --shard-server/--dataset), not synthetic batches. The
-                    # source survives re-meshing (it feeds host batches;
-                    # only shard_batch's placement changes per mesh).
-                    source = make_source(cfg, trainer)
+                    # source is striped by this worker's rank in the LIVE
+                    # membership — concurrent workers read disjoint shards —
+                    # and rebuilt whenever the stripe changes (join/leave),
+                    # not on every re-mesh.
+                    if source is not None and hasattr(source, "close"):
+                        source.close()
+                    stripe = (rank, size)
+                    source = make_source(cfg, trainer,
+                                         dp_rank=rank, dp_size=size)
                     source_iter = iter(source)
                 # restore (or cold-start) into the new world's shardings
                 template = trainer.init()
@@ -146,10 +202,12 @@ class ElasticTrainer:
                 step = int(jax.device_get(state.step))
                 self.transitions.append(
                     EpochTransition(epoch=epoch, step=step,
-                                    n_devices=len(devices)))
+                                    n_devices=len(devices),
+                                    stripe=(rank, size)))
                 if self.verbose:
                     log_json({"event": "mesh_formed", "epoch": epoch,
-                              "n_devices": len(devices), "step": step})
+                              "n_devices": len(devices), "step": step,
+                              "stripe_rank": rank, "stripe_size": size})
 
                 # Per-mesh prefetcher over the long-lived raw iterator:
                 # overlaps host batch production with device steps, and its
@@ -162,6 +220,14 @@ class ElasticTrainer:
                 try:
                     while (step < num_steps and not self._remesh.is_set()
                            and not self._stop.is_set()):
+                        if (self._agent is not None
+                                and self._agent.fatal is not None):
+                            # Our exclusive name was taken over during a
+                            # lease lapse: the namespace belongs to a live
+                            # successor now. Do NOT save — that would
+                            # clobber its checkpoints.
+                            raise RuntimeError(
+                                f"worker fenced out: {self._agent.fatal}")
                         batch = next(prefetch)
                         state, metrics = trainer.step(state, batch)
                         loss = float(jax.device_get(metrics["loss"]))

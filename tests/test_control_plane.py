@@ -163,3 +163,48 @@ def test_heartbeat_flow_surfaces_in_stats(coordinator):
     assert flows[rep2.worker_id].flow == 3
     assert flows[rep2.worker_id].metric == pytest.approx(0.25)
     c.close()
+
+
+def test_exclusive_name_enforced_by_coordinator(coordinator):
+    """Name uniqueness is atomic at the registry (the single authority) —
+    no client-side polling race. Non-exclusive names may still be shared
+    (multihost bootstrap peers all register under one tag)."""
+    c = CoordinatorClient(coordinator)
+    a = c.register("w:1", name="job", exclusive_name=True)
+    assert a.ok
+    b = c.register("w:2", name="job", exclusive_name=True)
+    assert not b.ok and "already held" in b.error
+    # Exclusive claim also blocks against a non-exclusive holder, and
+    # non-exclusive registration ignores collisions entirely.
+    s1 = c.register("w:3", name="shared")
+    s2 = c.register("w:4", name="shared")
+    assert s1.ok and s2.ok
+    s3 = c.register("w:5", name="shared", exclusive_name=True)
+    assert not s3.ok
+    # Deregistration frees the name.
+    c.deregister(a.worker_id)
+    again = c.register("w:6", name="job", exclusive_name=True)
+    assert again.ok
+    c.close()
+
+
+def test_agent_fenced_out_when_name_taken_over(coordinator):
+    """A lease-lapsed agent whose exclusive name was claimed by a successor
+    must go fatal instead of silently re-registering into the successor's
+    checkpoint namespace."""
+    agent = WorkerAgent(coordinator, "w:1", name="fence",
+                        heartbeat_interval_ms=100, exclusive_name=True)
+    agent.start()
+    old_id = agent.worker_id
+    # Simulate a lease lapse + takeover: evict the agent's registration and
+    # let a successor claim the name while the agent still heartbeats.
+    c = CoordinatorClient(coordinator)
+    c.deregister(old_id)
+    succ = c.register("w:2", name="fence", exclusive_name=True)
+    assert succ.ok
+    deadline = time.time() + 5
+    while agent.fatal is None and time.time() < deadline:
+        time.sleep(0.05)
+    assert agent.fatal is not None and "already held" in agent.fatal
+    agent.stop(deregister=False)
+    c.close()

@@ -1,0 +1,174 @@
+"""Two concurrent elastic workers on one coordinator must coexist:
+disjoint checkpoint namespaces (no clobbering) and disjoint data stripes
+(shards divided by rank-in-membership) — VERDICT round 1 item 7.
+
+The reference's workers all received the SAME 100 MB push
+(``src/master.cc:220-237``); here concurrent workers divide the published
+dataset between themselves and keep independent training state.
+"""
+
+import socket
+import tempfile
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from serverless_learn_tpu.config import (
+    ControlConfig, DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig,
+    TrainConfig)
+from serverless_learn_tpu.control.daemons import (
+    start_coordinator, start_shard_server)
+from serverless_learn_tpu.data.shard_client import ShardStreamSource
+from serverless_learn_tpu.models.registry import get_model
+from serverless_learn_tpu.training.checkpoint import LocalStore
+from serverless_learn_tpu.training.elastic import ElasticTrainer
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture()
+def coordinator():
+    port = _free_port()
+    proc = start_coordinator(port=port, lease_ttl_ms=800, sweep_ms=100)
+    yield f"127.0.0.1:{port}"
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+@pytest.fixture()
+def shard_server():
+    port = _free_port()
+    with tempfile.TemporaryDirectory() as root:
+        proc = start_shard_server(port=port, root=root)
+        yield f"127.0.0.1:{port}"
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def _config(num_steps, shard_addr=None, dataset=""):
+    return ExperimentConfig(
+        model="mlp_mnist",
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.05),
+        train=TrainConfig(batch_size=16, num_steps=num_steps),
+        data=DataConfig(shard_server_addr=shard_addr or "", dataset=dataset),
+        control=ControlConfig(heartbeat_interval_ms=100),
+        model_overrides={"dtype": jnp.float32},
+    )
+
+
+@pytest.mark.slow
+def test_two_workers_disjoint_namespaces_and_stripes(
+        tmp_path, coordinator, shard_server, devices):
+    from serverless_learn_tpu.data.shard_client import publish_from_bundle
+
+    cfg = _config(30, shard_addr=shard_server, dataset="mw")
+    bundle = get_model("mlp_mnist")
+    publish_from_bundle(shard_server, "mw", bundle.make_batch, cfg.data,
+                        num_records=512, records_per_shard=64)  # 8 shards
+
+    stores = [LocalStore(str(tmp_path / "a")), LocalStore(str(tmp_path / "b"))]
+    trainers = [
+        ElasticTrainer(cfg, stores[i], coordinator_addr=coordinator,
+                       name=f"w{i}", n_chips=4)
+        for i in range(2)
+    ]
+    results = [None, None]
+    errors = []
+
+    def run(i):
+        try:
+            results[i] = trainers[i].run()
+        except BaseException as e:  # surfaced below, not swallowed
+            errors.append((i, e))
+
+    # Staggered start so registration order (and so stripe ranks) is
+    # deterministic: w0 -> rank 0, w1 -> rank 1.
+    t0 = threading.Thread(target=run, args=(0,))
+    t0.start()
+    time.sleep(1.0)
+    t1 = threading.Thread(target=run, args=(1,))
+    t1.start()
+    t0.join(timeout=180)
+    t1.join(timeout=180)
+    assert not errors, errors
+    assert results[0] is not None and results[1] is not None
+
+    # Independent progress, independent state.
+    for i, (state, losses) in enumerate(results):
+        assert int(jax.device_get(state.step)) == 30, f"worker {i}"
+    # Checkpoints landed in disjoint namespaces (separate stores here;
+    # the NAME provides the separation when they share one store).
+    assert stores[0].list("w0"), "w0 checkpoint missing"
+    assert stores[1].list("w1"), "w1 checkpoint missing"
+    assert not stores[0].list("w1") and not stores[1].list("w0")
+
+    # Both workers saw the 2-worker stripe at some point, with distinct
+    # ranks — by the striping rule (shard i -> rank i % size) their shard
+    # sets are disjoint.
+    stripes0 = {t.stripe for t in trainers[0].transitions}
+    stripes1 = {t.stripe for t in trainers[1].transitions}
+    assert (0, 2) in stripes0, trainers[0].transitions
+    assert (1, 2) in stripes1, trainers[1].transitions
+    a = ShardStreamSource(shard_server, "mw", 16, dp_rank=0, dp_size=2)
+    b = ShardStreamSource(shard_server, "mw", 16, dp_rank=1, dp_size=2)
+    try:
+        assert set(a._my_shards).isdisjoint(b._my_shards)
+        assert set(a._my_shards) | set(b._my_shards) == set(range(8))
+    finally:
+        a.close()
+        b.close()
+
+
+@pytest.mark.slow
+def test_same_name_refused(tmp_path, coordinator, devices):
+    """The worker name is the checkpoint namespace: a second live worker
+    under the same name must be refused atomically by the coordinator, not
+    allowed to clobber."""
+    cfg = _config(2000)
+    first = ElasticTrainer(cfg, LocalStore(str(tmp_path)),
+                           coordinator_addr=coordinator, name="dup")
+    t = threading.Thread(target=first.run)
+    t.start()
+    try:
+        deadline = time.time() + 10
+        while not first.transitions and time.time() < deadline:
+            time.sleep(0.05)
+        assert first.transitions, "first worker never formed a mesh"
+        second = ElasticTrainer(cfg, LocalStore(str(tmp_path)),
+                                coordinator_addr=coordinator, name="dup",
+                                name_wait_s=2.0)
+        with pytest.raises(RuntimeError, match="already held"):
+            second.run()
+    finally:
+        first.request_stop()
+        t.join(timeout=60)
+
+
+def test_restart_under_stable_name_succeeds_after_lease_sweep(
+        tmp_path, coordinator, devices):
+    """A crashed worker's replacement under the SAME stable name must get in
+    once the dead lease is swept (the resume flow), within the retry
+    window — a live holder is the only thing that may refuse it."""
+    from serverless_learn_tpu.control.client import WorkerAgent
+
+    # A "crashed" predecessor: registered exclusively, never heartbeats.
+    ghost = WorkerAgent(coordinator, "g:0", name="stable",
+                        heartbeat_interval_ms=10_000, exclusive_name=True)
+    rep = ghost.client.register("g:0", "stable", 1, True)
+    assert rep.ok
+    cfg = _config(3)
+    et = ElasticTrainer(cfg, LocalStore(str(tmp_path)),
+                        coordinator_addr=coordinator, name="stable",
+                        name_wait_s=10.0)
+    t0 = time.time()
+    state, losses = et.run()  # must wait out the 800ms lease, then proceed
+    assert len(losses) == 3
+    assert time.time() - t0 >= 0.5, "should have waited for the sweep"
+    ghost.client.close()
