@@ -27,6 +27,7 @@ import argparse
 import dataclasses
 import json
 import os
+import socket
 import sys
 from typing import List, Optional
 
@@ -360,7 +361,7 @@ def cmd_worker(args) -> int:
         cfg, store,
         coordinator_addr=cfg.control.coordinator_addr,
         advertise_addr=args.advertise,
-        name=args.name or f"worker-{os.getpid()}",
+        name=args.name or f"worker-{socket.gethostname()}-{os.getpid()}",
         verbose=args.verbose,
     )
     state, losses = et.run()

@@ -192,13 +192,14 @@ class ElasticTrainer:
                     source = make_source(cfg, trainer,
                                          dp_rank=rank, dp_size=size)
                     source_iter = iter(source)
-                # restore (or cold-start) into the new world's shardings
-                template = trainer.init()
+                # restore (or cold-start) into the new world's shardings;
+                # the restore template is abstract — no wasted init
                 if self.ckpt.latest_step() is not None:
                     state = self.ckpt.restore(
-                        template, shardings=trainer.state_shardings)
+                        trainer.abstract_state(),
+                        shardings=trainer.state_shardings)
                 elif state is None:
-                    state = template
+                    state = trainer.init()
                 step = int(jax.device_get(state.step))
                 self.transitions.append(
                     EpochTransition(epoch=epoch, step=step,
@@ -254,7 +255,14 @@ class ElasticTrainer:
                         source_iter = None
 
                 # drain is implicit (the step above completed); save before
-                # tearing the mesh down
+                # tearing the mesh down. The fatal fence applies here too:
+                # the loop can exit via its while-condition (remesh/stop/
+                # step budget) without re-checking it, and a fenced-out
+                # worker writing this save would clobber the live successor
+                # that now owns the namespace.
+                if self._agent is not None and self._agent.fatal is not None:
+                    raise RuntimeError(
+                        f"worker fenced out: {self._agent.fatal}")
                 self.ckpt.save(state)
                 self.ckpt.wait()
                 if step >= num_steps or self._stop.is_set():

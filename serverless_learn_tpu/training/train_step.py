@@ -48,6 +48,13 @@ class Trainer:
         self._bind_mesh()
         return self.init_fn(seed if seed is not None else self.config.train.seed)
 
+    def abstract_state(self) -> TrainState:
+        """Shape/dtype skeleton of the TrainState — a restore template that
+        costs nothing. Re-meshing used to pay a full random init (8B scale:
+        tens of GB of HBM churn) just to have a structure to restore into."""
+        self._bind_mesh()
+        return jax.eval_shape(lambda: self.init_fn(self.config.train.seed))
+
     def step(self, state: TrainState, batch) -> tuple:
         # (Re)tracing can happen at any step call; bind this trainer's mesh
         # so mesh-dependent ops (ring attention's shard_map) trace against it

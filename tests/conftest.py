@@ -59,6 +59,11 @@ def _reset_active_mesh():
 
 SLOW_TESTS = {
     "tests/test_checkpoint.py::test_checkpoint_via_shard_server",
+    "tests/test_checkpoint_sharded.py::test_save_dp_restore_fsdp_tp_bit_exact",
+    "tests/test_checkpoint_sharded.py::test_restore_fetches_ranges_not_blobs",
+    "tests/test_checkpoint_sharded.py::test_bf16_leaves_roundtrip",
+    "tests/test_checkpoint_sharded.py::test_latest_gc_and_layout_autodetect",
+    "tests/test_checkpoint_sharded.py::test_sharded_checkpoint_via_shard_server",
     "tests/test_checkpoint.py::test_latest_and_gc",
     "tests/test_checkpoint.py::test_resume_is_exact",
     "tests/test_cli.py::test_publish_stats_and_train_from_shard_server",

@@ -1,0 +1,210 @@
+"""Sharded checkpointing: per-process chunk blobs + manifest, restore-time
+resharding (VERDICT round 1 item 2).
+
+The blob path gathers the whole TrainState through one host — fine for MNIST,
+impossible for the Llama-8B rung (~100 GB through one TCP PUT) and wrong on a
+real multi-host mesh where non-addressable shards can't be device_get at all.
+These tests pin the sharded layout's contract: save under one mesh, restore
+bit-exact under a different one, fetching only the byte ranges the target
+shards need."""
+
+import json
+import socket
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.config import (
+    DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig, TrainConfig)
+from serverless_learn_tpu.training.checkpoint import (
+    Checkpointer, LocalStore, ShardServerStore)
+from serverless_learn_tpu.training.train_step import build_trainer
+
+
+def _cfg(mesh, **overrides):
+    model_overrides = {"dtype": jnp.float32}
+    model_overrides.update(overrides.pop("model_overrides", {}))
+    return ExperimentConfig(
+        model="mlp_mnist",
+        mesh=mesh,
+        optimizer=OptimizerConfig(name="adamw", learning_rate=1e-3),
+        train=TrainConfig(batch_size=16),
+        data=DataConfig(),
+        model_overrides=model_overrides,
+        **overrides)
+
+
+def _assert_state_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(jax.device_get(a)),
+                    jax.tree_util.tree_leaves(jax.device_get(b))):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class CountingStore(LocalStore):
+    """LocalStore that records fetch traffic, to pin the ranged-read claim."""
+
+    def __init__(self, root):
+        super().__init__(root)
+        self.full_gets = []
+        self.range_bytes = 0
+
+    def get(self, key):
+        self.full_gets.append(key)
+        return super().get(key)
+
+    def get_range(self, key, offset, length):
+        self.range_bytes += length
+        return super().get_range(key, offset, length)
+
+
+def test_save_dp_restore_fsdp_tp_bit_exact(tmp_path, devices):
+    trainer = build_trainer(_cfg(MeshConfig(dp=8)))
+    state = trainer.init()
+    ckpt = Checkpointer(LocalStore(str(tmp_path)), sharded=True,
+                        async_save=False)
+    ckpt.save(state)
+    assert ckpt._is_sharded(0)
+
+    t2 = build_trainer(_cfg(MeshConfig(fsdp=4, tp=2)))
+    restored = ckpt.restore(t2.abstract_state(), shardings=t2.state_shardings)
+    _assert_state_equal(state, restored)
+    # and it actually landed in the new layout
+    leaf = restored.params["dense_0"]["kernel"]
+    assert {s.data.shape[0] for s in leaf.addressable_shards} == \
+        {leaf.shape[0] // 4}
+
+
+def test_save_sharded_restore_onto_same_mesh(tmp_path, devices):
+    trainer = build_trainer(_cfg(MeshConfig(dp=2, fsdp=4)))
+    state = trainer.init()
+    ckpt = Checkpointer(LocalStore(str(tmp_path)), sharded=True,
+                        async_save=False)
+    ckpt.save(state)
+    restored = ckpt.restore(trainer.abstract_state(),
+                            shardings=trainer.state_shardings)
+    _assert_state_equal(state, restored)
+
+
+def test_restore_fetches_ranges_not_blobs(tmp_path, devices):
+    """The resharded restore must ranged-fetch chunk data, never pull whole
+    .dat blobs, and move roughly one state's worth of bytes (the per-leaf
+    chunk cache dedupes the replicated-leaf callbacks)."""
+    trainer = build_trainer(_cfg(MeshConfig(dp=8)))
+    state = trainer.init()
+    store = CountingStore(str(tmp_path))
+    ckpt = Checkpointer(store, sharded=True, async_save=False)
+    ckpt.save(state)
+
+    state_bytes = sum(np.asarray(x).nbytes for x in
+                      jax.tree_util.tree_leaves(jax.device_get(state)))
+    store.full_gets.clear()
+    store.range_bytes = 0
+    t2 = build_trainer(_cfg(MeshConfig(fsdp=4, tp=2)))
+    ckpt.restore(t2.abstract_state(), shardings=t2.state_shardings)
+    assert not any(k.endswith(".dat") for k in store.full_gets), \
+        f"whole-blob fetches during resharded restore: {store.full_gets}"
+    assert store.range_bytes <= 1.05 * state_bytes + 4096
+
+
+def test_bf16_leaves_roundtrip(tmp_path, devices):
+    trainer = build_trainer(_cfg(
+        MeshConfig(dp=8), model_overrides={"dtype": jnp.bfloat16,
+                                           "param_dtype": jnp.bfloat16}))
+    state = trainer.init()
+    ckpt = Checkpointer(LocalStore(str(tmp_path)), sharded=True,
+                        async_save=False)
+    ckpt.save(state)
+    restored = ckpt.restore(trainer.abstract_state(),
+                            shardings=trainer.state_shardings)
+    _assert_state_equal(state, restored)
+    kinds = {str(np.asarray(x).dtype) for x in
+             jax.tree_util.tree_leaves(jax.device_get(restored.params))}
+    assert "bfloat16" in kinds
+
+
+def test_latest_gc_and_layout_autodetect(tmp_path, devices):
+    """Blob and sharded steps coexist under one name; LATEST/GC/restore see
+    both, and restore dispatches per-step on the COMMIT marker."""
+    trainer = build_trainer(_cfg(MeshConfig(dp=8)))
+    state = trainer.init()
+    store = LocalStore(str(tmp_path))
+    blob = Checkpointer(store, keep=10, async_save=False)
+    shard = Checkpointer(store, keep=10, async_save=False, sharded=True)
+    blob.save(state, step=1)
+    shard.save(state, step=2)
+    assert blob._steps() == [1, 2]
+    assert shard.latest_step() == 2
+    assert not shard._is_sharded(1) and shard._is_sharded(2)
+    for s in (1, 2):
+        restored = shard.restore(trainer.abstract_state(), step=s,
+                                 shardings=trainer.state_shardings)
+        _assert_state_equal(state, restored)
+
+    gc = Checkpointer(store, keep=1, async_save=False, sharded=True)
+    gc.save(state, step=3)
+    assert gc._steps() == [3], "GC must remove blob AND sharded dirs"
+    assert not store.list(f"{gc.name}/step-0000000002"), \
+        "sharded step dir must be fully deleted"
+
+
+def test_uncommitted_step_is_invisible(tmp_path, devices):
+    """A crash between PUTs and COMMIT must leave no restorable step."""
+    trainer = build_trainer(_cfg(MeshConfig(dp=8)))
+    state = trainer.init()
+    store = LocalStore(str(tmp_path))
+    ckpt = Checkpointer(store, async_save=False, sharded=True)
+    ckpt.save(state, step=5)
+    store.delete(f"{ckpt.name}/step-{5:010d}/COMMIT")
+    store.delete(f"{ckpt.name}/LATEST")
+    assert ckpt.latest_step() is None
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_sharded_checkpoint_via_shard_server(tmp_path, devices):
+    """The native data plane serves sharded checkpoints: ranged fetches ride
+    the same offset/length chunk protocol as training shards."""
+    from serverless_learn_tpu.control.daemons import start_shard_server
+
+    port = _free_port()
+    proc = start_shard_server(port=port, root=str(tmp_path / "store"))
+    try:
+        trainer = build_trainer(_cfg(MeshConfig(dp=2, fsdp=4)))
+        state = trainer.init()
+        store = ShardServerStore(f"127.0.0.1:{port}")
+        ckpt = Checkpointer(store, name="sharded", async_save=False,
+                            sharded=True)
+        ckpt.save(state)
+        assert ckpt.latest_step() == 0
+
+        t2 = build_trainer(_cfg(MeshConfig(dp=8)))
+        restored = ckpt.restore(t2.abstract_state(),
+                                shardings=t2.state_shardings)
+        _assert_state_equal(state, restored)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_manifest_records_paths_and_shapes(tmp_path, devices):
+    trainer = build_trainer(_cfg(MeshConfig(dp=8)))
+    state = trainer.init()
+    store = LocalStore(str(tmp_path))
+    ckpt = Checkpointer(store, async_save=False, sharded=True)
+    ckpt.save(state)
+    meta = json.loads(store.get(f"{ckpt.name}/step-{0:010d}/META"))
+    assert meta["n_procs"] == 1
+    paths = [l["path"] for l in meta["leaves"]]
+    assert any("dense_0" in p and "kernel" in p for p in paths)
+    kernel = next(l for l in meta["leaves"]
+                  if "dense_0" in l["path"] and "kernel" in l["path"]
+                  and "params" in l["path"])
+    leaf = state.params["dense_0"]["kernel"]
+    assert tuple(kernel["shape"]) == leaf.shape
+    assert kernel["dtype"] == str(np.dtype(leaf.dtype))

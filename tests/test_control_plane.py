@@ -201,6 +201,13 @@ def test_agent_fenced_out_when_name_taken_over(coordinator):
     c = CoordinatorClient(coordinator)
     c.deregister(old_id)
     succ = c.register("w:2", name="fence", exclusive_name=True)
+    # The agent heartbeats every 100 ms: one can fire in the gap above, see
+    # not-ok, and legitimately re-register before the successor claims the
+    # name. Evict again and retry until the successor wins the race.
+    deadline = time.time() + 5
+    while not succ.ok and time.time() < deadline:
+        c.deregister(agent.worker_id)
+        succ = c.register("w:2", name="fence", exclusive_name=True)
     assert succ.ok
     deadline = time.time() + 5
     while agent.fatal is None and time.time() < deadline:
