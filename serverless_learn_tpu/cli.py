@@ -339,17 +339,24 @@ def cmd_serve(args) -> int:
 
 def cmd_worker(args) -> int:
     """Elastic worker: register with the coordinator, train, re-mesh on
-    membership changes — the successor of ``./worker ADDR``."""
+    membership changes — the successor of ``./worker ADDR``.
+
+    Two elasticity scopes:
+    * default: single-host — the worker trains alone and resizes over its
+      own local devices on membership epochs (independent trainee).
+    * ``--multihost RUN``: this host joins the named multi-host elastic
+      run — all tagged hosts form ONE SPMD world that re-forms (via
+      coordinated checkpoint-restart) as hosts join or die.
+    """
     from serverless_learn_tpu.training.checkpoint import (
         LocalStore, ShardServerStore)
-    from serverless_learn_tpu.training.elastic import ElasticTrainer
     from serverless_learn_tpu.utils.metrics import log_json
 
     if args.world_size or args.num_processes:
         raise SystemExit(
             "--world-size/--num-processes form a fixed multi-host group and "
-            "apply to `train`; `worker` is single-host elastic (it re-meshes "
-            "on membership changes instead)")
+            "apply to `train`; `worker` is elastic (it re-meshes on "
+            "membership changes instead — see --multihost)")
     cfg = _config_from_args(args)
     if args.checkpoint_store:
         store = ShardServerStore(args.checkpoint_store)
@@ -357,6 +364,29 @@ def cmd_worker(args) -> int:
         store = LocalStore(args.checkpoint_dir)
     else:
         store = ShardServerStore(cfg.control.shard_server_addr)
+
+    if args.multihost:
+        from serverless_learn_tpu.training.elastic_multihost import (
+            ElasticHostSupervisor)
+
+        sup = ElasticHostSupervisor(
+            cfg, store,
+            coordinator_addr=cfg.control.coordinator_addr,
+            run_name=args.multihost,
+            label=args.name or None,
+            advertise_host=args.advertise_host,
+            min_hosts=args.min_hosts,
+            verbose=args.verbose,
+        )
+        gens = sup.run()
+        log_json({"event": "worker_done", "multihost": args.multihost,
+                  "generations": len(gens),
+                  "final_step": gens[-1].end_step if gens else None},
+                 stream=sys.stdout)
+        return 0
+
+    from serverless_learn_tpu.training.elastic import ElasticTrainer
+
     et = ElasticTrainer(
         cfg, store,
         coordinator_addr=cfg.control.coordinator_addr,
@@ -480,10 +510,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="address advertised to peers")
     w.add_argument("--name", default=None,
                    help="worker name = checkpoint namespace. Default is "
-                        "unique per process (worker-<pid>); pass a stable "
+                        "unique per host+process; pass a stable "
                         "name to resume a predecessor's checkpoints. Two "
                         "LIVE workers may never share a name (refused at "
                         "startup)")
+    w.add_argument("--multihost", metavar="RUN", default=None,
+                   help="join the named multi-host elastic run: all hosts "
+                        "tagged with RUN form one SPMD world that re-forms "
+                        "(checkpoint-restart) as hosts join or die")
+    w.add_argument("--min-hosts", type=int, default=1,
+                   help="with --multihost: wait for at least this many "
+                        "hosts before forming the first world")
     w.set_defaults(fn=cmd_worker)
 
     c = sub.add_parser("coordinator", help="run the membership daemon")

@@ -110,6 +110,11 @@ class DataConfig:
     shard_server_addr: Optional[str] = None  # None => generate locally
     prefetch: int = 2
     seq_len: int = 128  # LM/MLM datasets
+    # Synthetic classification data only: derive labels from a fixed random
+    # projection of the input instead of sampling them independently, so the
+    # task is learnable and loss curves mean something (the elastic tests
+    # assert decreasing loss across world re-formations).
+    learnable: bool = False
 
 
 @dataclass(frozen=True)

@@ -52,13 +52,27 @@ def make_mlp_mnist(features=(512, 512), num_classes=10,
             "label": jax.ShapeDtypeStruct((batch_size,), jnp.int32),
         }
 
+    # Labels for data_config.learnable come from a FIXED random projection
+    # (seed independent of any stream seed): every stripe/worker sees the
+    # same ground-truth function, so the task is learnable and loss
+    # trajectories are meaningful across elastic re-formations. Built once,
+    # lazily — it is constant across batches.
+    proj_cache: list = []
+
     def make_batch(rng: np.random.Generator, data_config, batch_size):
-        return {
-            "image": rng.standard_normal(
-                (batch_size, *image_shape), dtype=np.float32),
-            "label": rng.integers(
-                0, num_classes, (batch_size,)).astype(np.int32),
-        }
+        image = rng.standard_normal(
+            (batch_size, *image_shape), dtype=np.float32)
+        if getattr(data_config, "learnable", False):
+            if not proj_cache:
+                proj_cache.append(np.random.default_rng(771).standard_normal(
+                    (int(np.prod(image_shape)), num_classes))
+                    .astype(np.float32))
+            label = np.argmax(
+                image.reshape(batch_size, -1) @ proj_cache[0],
+                axis=-1).astype(np.int32)
+        else:
+            label = rng.integers(0, num_classes, (batch_size,)).astype(np.int32)
+        return {"image": image, "label": label}
 
     return ModelBundle(module=module, loss_fn=loss_fn, input_spec=input_spec,
                        make_batch=make_batch, task="classification")

@@ -84,6 +84,7 @@ class ShardServerStore:
     def __init__(self, addr: str):
         from serverless_learn_tpu.control.client import ShardClient
 
+        self.addr = addr
         self.client = ShardClient(addr)
 
     def put(self, key: str, data: bytes):
@@ -364,21 +365,29 @@ class Checkpointer:
         return f"{self.name}/step-{step:010d}"
 
     def _steps(self):
+        return self._steps_from(self.store.list(self.name))
+
+    @staticmethod
+    def _steps_from(keys):
         out = set()
-        for key in self.store.list(self.name):
+        for key in keys:
             m = re.search(r"step-(\d+)($|/COMMIT$)", key)
             if m:
                 out.add(int(m.group(1)))
         return sorted(out)
 
     def _gc(self, current: int):
-        steps = self._steps()
+        # One namespace listing for the whole GC: on a ShardServerStore
+        # each list() is a recursive manifest RPC, and process 0 runs this
+        # inside the save-commit barrier with every other process waiting.
+        keys = self.store.list(self.name)
+        steps = self._steps_from(keys)
         # Also sweep *uncommitted* step dirs older than the step just
         # committed — debris from a crash between the proc PUTs and COMMIT.
         # They are invisible to restore (no COMMIT) but each holds a full
         # local-state blob; a crash-restart loop would leak unboundedly.
         seen = set()
-        for key in self.store.list(self.name):
+        for key in keys:
             m = re.search(r"step-(\d+)/", key)
             if m:
                 seen.add(int(m.group(1)))
@@ -386,7 +395,7 @@ class Checkpointer:
         for old in list(steps[:-self.keep] if self.keep > 0 else []) + dead:
             prefix = self._key(old)
             # A sharded step is a directory of keys; a blob step is one key.
-            victims = [k for k in self.store.list(self.name)
+            victims = [k for k in keys
                        if k == prefix or k.startswith(prefix + "/")]
             # COMMIT first: a fetch racing the GC sees the step vanish
             # atomically instead of finding a committed step with holes.

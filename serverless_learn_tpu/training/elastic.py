@@ -190,7 +190,9 @@ class ElasticTrainer:
                         source.close()
                     stripe = (rank, size)
                     source = make_source(cfg, trainer,
-                                         dp_rank=rank, dp_size=size)
+                                         dp_rank=rank, dp_size=size,
+                                         start_step=self.ckpt.latest_step()
+                                         or 0)
                     source_iter = iter(source)
                 # restore (or cold-start) into the new world's shardings;
                 # the restore template is abstract — no wasted init

@@ -22,7 +22,8 @@ from serverless_learn_tpu.utils.tracing import get_tracer, step_annotation
 
 def make_source(config: ExperimentConfig, trainer: Trainer,
                 dataset: Optional[str] = None, seed: Optional[int] = None,
-                dp_rank: Optional[int] = None, dp_size: Optional[int] = None):
+                dp_rank: Optional[int] = None, dp_size: Optional[int] = None,
+                start_step: int = 0):
     """Pick a host batch source for a config.
 
     ``data.shard_server_addr`` set => stream the named dataset from the
@@ -35,6 +36,12 @@ def make_source(config: ExperimentConfig, trainer: Trainer,
     elastic controller instead passes its rank in the *live membership*, so
     concurrent workers on one coordinator read disjoint shards
     (VERDICT round 1 item 7) instead of everyone streaming everything.
+
+    ``start_step`` is folded into the stream seed: a source (re)built at a
+    resume/re-mesh boundary must NOT replay the batches the restored model
+    already trained on — the replayed, partially-memorized data would show
+    up as a bogus loss cliff (observed, not hypothetical: the elastic
+    multi-host bring-up dropped from 2.4 to 0.97 at a re-mesh before this).
     """
     # Each process handles only its 1/process_count slice of the global
     # batch; Trainer.shard_batch assembles the global array from the
@@ -46,6 +53,7 @@ def make_source(config: ExperimentConfig, trainer: Trainer,
             f"batch_size {config.train.batch_size} not divisible by "
             f"process count {n_proc}")
     seed = config.train.seed if seed is None else seed
+    seed = seed + 100_003 * start_step  # fresh stream per resume point
     if dp_rank is None:
         dp_rank = jax.process_index()
     if dp_size is None:

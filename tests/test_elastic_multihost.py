@@ -1,0 +1,191 @@
+"""Multi-host elastic re-meshing (VERDICT round 1 item 1, the top ask).
+
+Process-level: N real OS processes, each a supervisor + inner-trainer chain
+(training/elastic_multihost.py), a real native coordinator for membership,
+and a shared local store for rendezvous + sharded checkpoints. The scenario
+is the one the verdict prescribes: a 2-process world grows to 3 on a join,
+then shrinks back to 2 on a SIGKILL, with step continuity and decreasing
+loss asserted across both transitions.
+
+Each host process gets 2 virtual CPU devices, so world sizes 2/3/2 exercise
+4-, 6- and 4-device global meshes with restore-time resharding in between.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HOST = os.path.join(REPO, "tests", "emh_host.py")
+
+
+def _spawn_host(label, coordinator, store_root, min_hosts, steps=60,
+                step_delay=0.35):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    return subprocess.Popen(
+        [sys.executable, "-u", HOST,
+         "--coordinator", coordinator, "--store-root", store_root,
+         "--label", label, "--steps", str(steps),
+         "--min-hosts", str(min_hosts), "--ckpt-every", "4",
+         "--step-delay", str(step_delay)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, start_new_session=True, cwd=REPO)
+
+
+def _read_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (IOError, OSError, ValueError):
+        return None
+
+
+def _wait_for(pred, timeout, what, poll=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(poll)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _result(proc, label):
+    out, err = proc.communicate(timeout=30)
+    for line in out.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise AssertionError(
+        f"host {label} produced no RESULT (rc={proc.returncode})\n"
+        f"--- stdout ---\n{out[-2000:]}\n--- stderr ---\n{err[-3000:]}")
+
+
+@pytest.mark.slow
+def test_world_grows_then_survives_kill(tmp_path):
+    from serverless_learn_tpu.control.daemons import start_coordinator
+
+    import socket as socket_mod
+
+    with socket_mod.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = start_coordinator(port=port, lease_ttl_ms=1200, sweep_ms=200)
+    coordinator = f"127.0.0.1:{port}"
+    store = str(tmp_path / "store")
+    latest_path = os.path.join(store, "emh-t", "LATEST")
+    form_path = os.path.join(store, "emh-t", "FORM")
+    procs = []
+    try:
+        a = _spawn_host("A", coordinator, store, min_hosts=2)
+        b = _spawn_host("B", coordinator, store, min_hosts=2)
+        procs += [a, b]
+
+        # Phase 1: the two hosts form a world and make committed progress.
+        _wait_for(lambda: (_read_json(latest_path) or {}).get("step", -1) >= 4,
+                  timeout=120, what="world-2 progress")
+        form = _read_json(form_path)
+        assert form and len(form["ids"]) == 2
+
+        # Phase 2: a third host joins; survivors drain and re-form at 3.
+        c = _spawn_host("C", coordinator, store, min_hosts=1)
+        procs.append(c)
+        _wait_for(lambda: len((_read_json(form_path) or {}).get("ids", []))
+                  == 3, timeout=120, what="world-3 formation")
+        step3 = (_read_json(latest_path) or {}).get("step", 0)
+        _wait_for(lambda: (_read_json(latest_path) or {}).get("step", -1)
+                  >= step3 + 8, timeout=120, what="world-3 progress")
+
+        # Phase 3: SIGKILL the joiner's whole process tree (supervisor +
+        # wedgeable inner). Lease eviction must shrink the world to 2.
+        os.killpg(c.pid, signal.SIGKILL)
+        c.wait(timeout=10)
+        _wait_for(lambda: (lambda f: f and len(f["ids"]) == 2 and
+                           f["gen"] > 2)(_read_json(form_path)),
+                  timeout=120, what="post-kill world-2 re-formation")
+
+        ra = _result(a, "A")
+        rb = _result(b, "B")
+        assert a.returncode == 0 and b.returncode == 0
+
+        for r in (ra, rb):
+            gens = [g for g in r["generations"] if g["start_step"] >= 0]
+            worlds = [g["world"] for g in gens]
+            # 2 -> 3 -> 2 (formation retries may interleave, but every
+            # *formed* world must follow the membership trajectory)
+            assert worlds[0] == 2, worlds
+            assert 3 in worlds, worlds
+            assert worlds[-1] == 2, worlds
+            i3 = worlds.index(3)
+            assert all(w == 2 for w in worlds[:i3]), worlds
+
+            # Step continuity: each world resumes from a committed step of
+            # its predecessor — never from scratch, never from the future.
+            for prev, nxt in zip(gens, gens[1:]):
+                if prev["end_step"] >= 0:
+                    assert nxt["start_step"] <= prev["end_step"], (prev, nxt)
+                assert nxt["start_step"] >= prev["start_step"], (prev, nxt)
+            # The kill may roll back to the last commit, but by at most the
+            # checkpoint interval (ckpt-every=4 plus the in-flight step).
+            g3 = gens[i3]
+            after = gens[i3 + 1:]
+            assert after, "no world formed after the kill"
+            if g3["end_step"] >= 0:  # inner reported before wedging
+                assert after[0]["start_step"] >= g3["end_step"] - 5
+
+            # The run completed its full step budget.
+            assert gens[-1]["status"] == "complete"
+            assert gens[-1]["end_step"] == 60
+
+            # Decreasing loss across both transitions: the learnable
+            # synthetic task must show real training progress end to end.
+            losses = dict(tuple(x) for x in r["losses"])
+            first = [losses[s] for s in sorted(losses)[:5]]
+            last = [losses[s] for s in sorted(losses)[-5:]]
+            assert sum(last) / 5 < 0.6 * (sum(first) / 5), (first, last)
+
+        # Both surviving hosts observed the same committed trajectory.
+        assert ra["generations"][-1]["end_step"] == \
+            rb["generations"][-1]["end_step"]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    pass
+        coord.terminate()
+        coord.wait(timeout=5)
+
+
+@pytest.mark.slow
+def test_single_host_world_completes(tmp_path):
+    """Degenerate case: one host forms a world of 1 and trains to the step
+    budget — the elastic path must not require peers."""
+    from serverless_learn_tpu.control.daemons import start_coordinator
+
+    import socket as socket_mod
+
+    with socket_mod.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = start_coordinator(port=port, lease_ttl_ms=2000, sweep_ms=500)
+    store = str(tmp_path / "store")
+    try:
+        a = _spawn_host("solo", f"127.0.0.1:{port}", store, min_hosts=1,
+                        steps=6, step_delay=0.0)
+        ra = _result(a, "solo")
+        assert a.returncode == 0
+        gens = ra["generations"]
+        assert gens[-1]["status"] == "complete"
+        assert gens[-1]["end_step"] == 6
+        assert gens[-1]["world"] == 1
+    finally:
+        coord.terminate()
+        coord.wait(timeout=5)
