@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
 # Elastic-cluster demo on one machine — the reference's three-binary demo
-# (./file_server, ./master, ./worker ADDR) rebuilt: native daemons, a
-# published typed dataset, and an elastic worker that registers, streams
-# shards, forms a device mesh, and trains.
+# (./file_server, ./master, ./worker ADDR) rebuilt: native daemons, a real
+# dataset in CIFAR-10's binary on-disk format published to the data plane,
+# and an elastic worker that registers, streams shards, forms a device
+# mesh, and trains with host-side augmentation — then an eval pass restores
+# the checkpoint and reports accuracy.
 #
 #   bash examples/elastic_demo.sh
 #
@@ -12,7 +14,14 @@
 # unique default — the name is the worker's checkpoint namespace and live
 # duplicates are refused) or killed at any time: the coordinator bumps the
 # membership epoch and live workers checkpoint, re-mesh, re-stripe the
-# dataset's shards across the survivors, and resume.
+# dataset's shards across the survivors, and resume. For a single SPMD world
+# spanning several hosts that re-forms on joins/deaths, use
+# `worker --multihost RUN` instead.
+#
+# This image has no network egress, so the script synthesizes files in the
+# exact CIFAR-10 binary layout (labels from a fixed projection so accuracy
+# is meaningful); with the real distribution downloaded, point --path at
+# your cifar-10-batches-bin directory instead.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -22,7 +31,8 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 COORD_PORT=52252
 SHARD_PORT=52253
 STORE=$(mktemp -d)
-trap 'kill $(jobs -p) 2>/dev/null || true; rm -rf "$STORE"' EXIT
+RAW=$(mktemp -d)
+trap 'kill $(jobs -p) 2>/dev/null || true; rm -rf "$STORE" "$RAW"' EXIT
 
 make -C native -s
 
@@ -30,14 +40,40 @@ native/bin/coordinator --port $COORD_PORT --lease_ttl_ms 2000 --sweep_ms 200 &
 native/bin/shard_server --port $SHARD_PORT --root "$STORE" &
 sleep 0.5
 
+python - "$RAW" <<'PYEOF'
+import os, sys
+import numpy as np
+root = os.path.join(sys.argv[1], "cifar-10-batches-bin"); os.makedirs(root)
+rng = np.random.default_rng(0)
+imgs = rng.integers(0, 256, (4096, 32, 32, 3), dtype=np.uint8)
+proj = np.random.default_rng(7).standard_normal((3072, 10)).astype(np.float32)
+labs = np.argmax((imgs.reshape(len(imgs), -1) / 255.0) @ proj, 1).astype(np.uint8)
+recs = np.concatenate([labs[:, None],
+                       imgs.transpose(0, 3, 1, 2).reshape(len(imgs), -1)], 1)
+open(os.path.join(root, "data_batch_1.bin"), "wb").write(
+    recs.astype(np.uint8).tobytes())
+print(f"wrote {len(imgs)} CIFAR-binary records to {root}")
+PYEOF
+
 python -m serverless_learn_tpu publish \
-    --shard-server 127.0.0.1:$SHARD_PORT --dataset mnist --model mlp_mnist \
-    --num-records 2048 --records-per-shard 256
+    --shard-server 127.0.0.1:$SHARD_PORT --dataset cifar \
+    --format cifar10 --path "$RAW" --records-per-shard 512
 
 python -m serverless_learn_tpu worker \
-    --model mlp_mnist --mesh dp=8 --batch-size 64 --steps 40 \
+    --model mlp_mnist --mesh dp=8 --batch-size 256 --steps 40 \
+    --set model_overrides.image_shape='[32,32,3]' \
+    --set model_overrides.num_classes=10 \
+    --set data.augment=true \
     --coordinator 127.0.0.1:$COORD_PORT \
-    --shard-server 127.0.0.1:$SHARD_PORT --dataset mnist \
+    --shard-server 127.0.0.1:$SHARD_PORT --dataset cifar \
     --name demo-worker -v
+
+python -m serverless_learn_tpu eval \
+    --model mlp_mnist --mesh dp=8 --batch-size 256 \
+    --set model_overrides.image_shape='[32,32,3]' \
+    --set model_overrides.num_classes=10 \
+    --shard-server 127.0.0.1:$SHARD_PORT --dataset cifar \
+    --checkpoint-store 127.0.0.1:$SHARD_PORT \
+    --set train.eval_steps=4 --checkpoint-name demo-worker
 
 python -m serverless_learn_tpu stats --addr 127.0.0.1:$SHARD_PORT

@@ -129,6 +129,9 @@ def _add_train_flags(p: argparse.ArgumentParser):
     p.add_argument("--checkpoint-dir", help="save checkpoints to a local dir")
     p.add_argument("--checkpoint-store", metavar="ADDR",
                    help="save checkpoints to a shard server")
+    p.add_argument("--checkpoint-name", default="ckpt",
+                   help="checkpoint namespace inside the store (an elastic "
+                        "worker saves under its --name)")
     p.add_argument("--profile-dir", help="capture a jax.profiler trace here")
     p.add_argument("-v", "--verbose", action="store_true")
     # Multi-host: either serverless bootstrap via the native coordinator
@@ -146,10 +149,11 @@ def _add_train_flags(p: argparse.ArgumentParser):
     p.add_argument("--process-id", type=int)
 
 
-def _make_checkpointer(args, name: str = "ckpt"):
+def _make_checkpointer(args, name: Optional[str] = None):
     from serverless_learn_tpu.training.checkpoint import (
         Checkpointer, LocalStore, ShardServerStore)
 
+    name = name or getattr(args, "checkpoint_name", None) or "ckpt"
     if args.checkpoint_store:
         return Checkpointer(ShardServerStore(args.checkpoint_store), name=name)
     if args.checkpoint_dir:
@@ -229,7 +233,6 @@ def cmd_eval(args) -> int:
             "to `train`; `eval` is single-process")
     cfg = _config_from_args(args)
     trainer = build_trainer(cfg)
-    state = trainer.init()
     ckpt = _make_checkpointer(args)
     ckpt_step = None
     if ckpt is not None:
@@ -240,7 +243,10 @@ def cmd_eval(args) -> int:
             raise SystemExit(
                 "no checkpoint found in the configured store; drop "
                 "--checkpoint-dir/--checkpoint-store to eval a fresh init")
-        state = ckpt.restore(state, shardings=trainer.state_shardings)
+        state = ckpt.restore(trainer.abstract_state(),
+                             shardings=trainer.state_shardings)
+    else:
+        state = trainer.init()
     metrics = run_eval(cfg, trainer, state,
                        num_batches=args.eval_steps or cfg.train.eval_steps)
     print(json.dumps({"checkpoint_step": ckpt_step,
@@ -427,15 +433,31 @@ def cmd_shard_server(args) -> int:
 
 def cmd_publish(args) -> int:
     from serverless_learn_tpu.config import DataConfig
-    from serverless_learn_tpu.data.shard_client import publish_from_bundle
-    from serverless_learn_tpu.models.registry import get_model
+    from serverless_learn_tpu.data.shard_client import (
+        publish_dataset, publish_from_bundle)
 
-    bundle = get_model(args.model)
-    data_cfg = DataConfig(seq_len=args.seq_len)
-    meta = publish_from_bundle(
-        args.shard_server, args.dataset, bundle.make_batch, data_cfg,
-        num_records=args.num_records,
-        records_per_shard=args.records_per_shard, seed=args.seed)
+    if args.format == "synthetic":
+        from serverless_learn_tpu.models.registry import get_model
+
+        if not args.model:
+            raise SystemExit("--format synthetic requires --model")
+        bundle = get_model(args.model)
+        data_cfg = DataConfig(seq_len=args.seq_len)
+        meta = publish_from_bundle(
+            args.shard_server, args.dataset, bundle.make_batch, data_cfg,
+            num_records=args.num_records,
+            records_per_shard=args.records_per_shard, seed=args.seed)
+    else:
+        from serverless_learn_tpu.data import raw
+
+        if not args.path:
+            raise SystemExit(f"--format {args.format} requires --path")
+        if args.format == "tokens":
+            arrays = raw.load_token_corpus(args.path, seq_len=args.seq_len)
+        else:
+            arrays = raw.LOADERS[args.format](args.path, split=args.split)
+        meta = publish_dataset(args.shard_server, args.dataset, arrays,
+                               records_per_shard=args.records_per_shard)
     print(json.dumps({"dataset": args.dataset,
                       "num_records": meta.num_records,
                       "num_shards": meta.num_shards,
@@ -534,12 +556,24 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--root", help="blob root directory")
     s.set_defaults(fn=cmd_shard_server)
 
-    pub = sub.add_parser("publish", help="publish a synthetic dataset")
+    pub = sub.add_parser("publish",
+                         help="publish a dataset to the data plane")
     pub.add_argument("--shard-server", required=True, metavar="ADDR")
     pub.add_argument("--dataset", required=True)
-    pub.add_argument("--model", required=True,
-                     help="model whose batch schema to publish")
-    pub.add_argument("--num-records", type=int, default=4096)
+    pub.add_argument("--format", default="synthetic",
+                     choices=["synthetic", "mnist", "cifar10", "tokens"],
+                     help="synthetic: sample a model's batch schema; "
+                          "mnist/cifar10: parse the standard raw-file "
+                          "distributions under --path; tokens: chunk a "
+                          "corpus file (.bin token dump or raw text)")
+    pub.add_argument("--path", help="raw dataset directory/file "
+                                    "(non-synthetic formats)")
+    pub.add_argument("--split", default="train", choices=["train", "test"])
+    pub.add_argument("--model", default=None,
+                     help="synthetic format: model whose batch schema to "
+                          "publish")
+    pub.add_argument("--num-records", type=int, default=4096,
+                     help="synthetic format: how many records")
     pub.add_argument("--records-per-shard", type=int, default=512)
     pub.add_argument("--seq-len", type=int, default=128)
     pub.add_argument("--seed", type=int, default=0)

@@ -115,6 +115,12 @@ class DataConfig:
     # task is learnable and loss curves mean something (the elastic tests
     # assert decreasing loss across world re-formations).
     learnable: bool = False
+    # Host-pipeline image augmentation (pad-4 random crop + horizontal
+    # flip) on training sources streamed from the data plane. Eval sources
+    # never augment.
+    augment: bool = False
+    # Dynamic MLM masking rate for token-corpus datasets feeding MLM models.
+    mask_rate: float = 0.15
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,191 @@
+"""Raw dataset-file loaders: MNIST IDX, CIFAR-10 binary, token corpora.
+
+The ingestion path the reference never had — its "dataset" was 100 MB of
+``std::independent_bits_engine`` output synthesized at startup
+(``src/file_server.cc:150-156``). Here the canonical on-disk formats of the
+BASELINE.md ladder's datasets parse into typed numpy arrays, which
+``publish_dataset`` (data/shard_client.py) turns into shard-server datasets:
+
+    disk files -> load_*() -> {field: [N, ...] array} -> shards on the
+    data plane -> ShardStreamSource -> host transforms -> device
+
+Images are kept **uint8 on the wire and in shards** (4x smaller than f32 —
+the shard server and DCN carry a quarter of the bytes); conversion to the
+model's float dtype plus augmentation happen in the host pipeline
+(data/transforms.py) where they overlap device compute.
+
+This machine has zero egress, so tests synthesize format-exact files and
+round-trip them; the parsers implement the published formats:
+* IDX: http://yann.lecun.com/exdb/mnist/ — magic ``0x00 0x00 <dtype> <ndim>``
+  then big-endian uint32 dims, then row-major payload.
+* CIFAR-10 binary: per record 1 label byte + 3072 bytes of 32x32 RGB in
+  CHW plane order (https://www.cs.toronto.edu/~kriz/cifar.html).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+# IDX type byte -> numpy dtype (big-endian where multi-byte).
+_IDX_DTYPES = {
+    0x08: np.dtype(np.uint8),
+    0x09: np.dtype(np.int8),
+    0x0B: np.dtype(">i2"),
+    0x0C: np.dtype(">i4"),
+    0x0D: np.dtype(">f4"),
+    0x0E: np.dtype(">f8"),
+}
+
+
+def _open_maybe_gz(path: str):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def load_idx(path: str) -> np.ndarray:
+    """Parse one IDX file (optionally gzipped) into a numpy array."""
+    with _open_maybe_gz(path) as f:
+        raw = f.read()
+    if len(raw) < 4 or raw[0] != 0 or raw[1] != 0:
+        raise ValueError(f"{path}: not an IDX file (bad magic {raw[:4]!r})")
+    dtype = _IDX_DTYPES.get(raw[2])
+    if dtype is None:
+        raise ValueError(f"{path}: unknown IDX dtype byte 0x{raw[2]:02x}")
+    ndim = raw[3]
+    header = 4 + 4 * ndim
+    dims = tuple(int(n) for n in np.frombuffer(raw, ">u4", ndim, offset=4))
+    count = int(np.prod(dims)) if dims else 0
+    expect = header + count * dtype.itemsize
+    if len(raw) != expect:
+        raise ValueError(
+            f"{path}: payload is {len(raw) - header} bytes, dims {dims} "
+            f"require {expect - header}")
+    arr = np.frombuffer(raw, dtype, count, offset=header).reshape(dims)
+    # Native byte order for downstream tobytes()/frombuffer symmetry.
+    return arr.astype(dtype.newbyteorder("="), copy=False)
+
+
+def _find_file(root: str, candidates) -> str:
+    for name in candidates:
+        for suffix in ("", ".gz"):
+            p = os.path.join(root, name + suffix)
+            if os.path.isfile(p):
+                return p
+    raise FileNotFoundError(
+        f"none of {list(candidates)} (or .gz) under {root!r}")
+
+
+def load_mnist(root: str, split: str = "train") -> Dict[str, np.ndarray]:
+    """Load an MNIST-layout directory (the standard 4-file distribution)
+    into {"image": [N, 28, 28, 1] uint8, "label": [N] int32}."""
+    prefix = {"train": "train", "test": "t10k"}[split]
+    images = load_idx(_find_file(root, (f"{prefix}-images-idx3-ubyte",
+                                        f"{prefix}-images.idx3-ubyte")))
+    labels = load_idx(_find_file(root, (f"{prefix}-labels-idx1-ubyte",
+                                        f"{prefix}-labels.idx1-ubyte")))
+    if images.ndim != 3:
+        raise ValueError(f"expected rank-3 image tensor, got {images.shape}")
+    if len(images) != len(labels):
+        raise ValueError(f"{len(images)} images vs {len(labels)} labels")
+    return {"image": images[..., None],
+            "label": labels.astype(np.int32)}
+
+
+CIFAR_RECORD = 1 + 3 * 32 * 32
+
+
+def load_cifar10_file(path: str) -> Dict[str, np.ndarray]:
+    """One CIFAR-10 binary batch file -> HWC uint8 images + int32 labels."""
+    with _open_maybe_gz(path) as f:
+        raw = f.read()
+    if len(raw) % CIFAR_RECORD:
+        raise ValueError(
+            f"{path}: {len(raw)} bytes is not a multiple of the "
+            f"{CIFAR_RECORD}-byte CIFAR record")
+    rec = np.frombuffer(raw, np.uint8).reshape(-1, CIFAR_RECORD)
+    labels = rec[:, 0].astype(np.int32)
+    images = rec[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return {"image": np.ascontiguousarray(images), "label": labels}
+
+
+def load_cifar10(root: str, split: str = "train") -> Dict[str, np.ndarray]:
+    """Load the CIFAR-10 binary distribution (data_batch_1..5.bin or
+    test_batch.bin under ``root``, possibly in a cifar-10-batches-bin/
+    subdirectory)."""
+    for base in (root, os.path.join(root, "cifar-10-batches-bin")):
+        if not os.path.isdir(base):
+            continue
+        names = ([f"data_batch_{i}.bin" for i in range(1, 6)]
+                 if split == "train" else ["test_batch.bin"])
+        parts = []
+        for n in names:
+            for suffix in ("", ".gz"):
+                p = os.path.join(base, n + suffix)
+                if os.path.isfile(p):
+                    parts.append(load_cifar10_file(p))
+                    break
+        if parts:
+            return {k: np.concatenate([p[k] for p in parts])
+                    for k in parts[0]}
+    raise FileNotFoundError(f"no CIFAR-10 binary batches under {root!r}")
+
+
+# -- token corpora -----------------------------------------------------------
+
+# Byte-level vocabulary: ids 0..3 are specials, byte b maps to b + 4. No
+# external tokenizer artifacts (this image has no egress), yet real text
+# round-trips losslessly and the vocab is model-agnostic.
+PAD_ID, MASK_ID, BOS_ID, EOS_ID = 0, 1, 2, 3
+BYTE_OFFSET = 4
+BYTE_VOCAB = 256 + BYTE_OFFSET
+
+
+def tokenize_bytes(text: bytes) -> np.ndarray:
+    return np.frombuffer(text, np.uint8).astype(np.int32) + BYTE_OFFSET
+
+
+def detokenize_bytes(ids: np.ndarray) -> bytes:
+    ids = np.asarray(ids)
+    return (ids[ids >= BYTE_OFFSET] - BYTE_OFFSET).astype(np.uint8).tobytes()
+
+
+def load_token_corpus(path: str, seq_len: int,
+                      dtype: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Turn a corpus file into fixed-length records {"input_ids": [N, T]}.
+
+    Two on-disk layouts:
+    * ``.bin`` / ``.tokens``: a flat array of already-tokenized ids
+      (uint16 by default, ``dtype`` overrides) — the layout used by
+      nanoGPT-style preprocessed corpora.
+    * anything else: raw text, byte-level tokenized here (vocab 260).
+
+    The stream is chunked into ``[N, seq_len]`` rows with BOS prepended to
+    each row; the tail that doesn't fill a row is dropped.
+    """
+    stem = path[:-3] if path.endswith(".gz") else path
+    if stem.endswith((".bin", ".tokens")):
+        with _open_maybe_gz(path) as f:
+            ids = np.frombuffer(f.read(), dtype or np.uint16).astype(np.int32)
+    else:
+        with _open_maybe_gz(path) as f:
+            ids = tokenize_bytes(f.read())
+    body = seq_len - 1  # room for BOS
+    n = len(ids) // body
+    if n == 0:
+        raise ValueError(
+            f"{path}: corpus has {len(ids)} tokens, fewer than one "
+            f"{seq_len}-token record")
+    rows = ids[:n * body].reshape(n, body)
+    bos = np.full((n, 1), BOS_ID, np.int32)
+    return {"input_ids": np.concatenate([bos, rows], axis=1)}
+
+
+LOADERS = {
+    "mnist": load_mnist,
+    "cifar10": load_cifar10,
+}

@@ -1,0 +1,145 @@
+"""Host-side batch transforms: decode, normalize, augment, MLM-mask.
+
+These run on the host between the shard stream and the device prefetcher —
+exactly the slot where the work overlaps device compute for free (the
+``Prefetcher`` keeps batches in flight while the chip steps). Shards carry
+storage dtypes (uint8 images, int32 token ids); models want float tensors
+and task-shaped fields. The bridge:
+
+    image classification   uint8 [B,H,W,C] -> float32 in [0,1), with
+                           train-time pad+random-crop and horizontal flip
+                           (the standard CIFAR recipe)
+    masked LM              {"input_ids"} -> {tokens, labels, mlm_mask,
+                           attn_mask} with DYNAMIC masking: each epoch's
+                           pass re-masks the same text differently
+                           (RoBERTa-style), which static pre-masked shards
+                           cannot do
+    causal LM              {"input_ids"} -> {"tokens"}
+
+``make_source`` (training/loop.py) applies these automatically by comparing
+the shard schema against the model bundle's input spec — publishing real
+CIFAR bytes and training on them needs no extra flags.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from serverless_learn_tpu.data.raw import MASK_ID
+
+
+class TransformedSource:
+    """Wrap a batch source with a per-batch transform; forwards close()."""
+
+    def __init__(self, source, fn: Callable[[Dict[str, np.ndarray]],
+                                            Dict[str, np.ndarray]]):
+        self.source = source
+        self.fn = fn
+
+    def __iter__(self) -> Iterator:
+        for batch in self.source:
+            yield self.fn(batch)
+
+    def close(self):
+        if hasattr(self.source, "close"):
+            self.source.close()
+
+
+def image_transform(train: bool, seed: int = 0, crop_pad: int = 4,
+                    flip: bool = True, dtype=np.float32) -> Callable:
+    """uint8 images -> float in [0,1); train mode adds pad+random-crop and
+    horizontal flip. Labels pass through."""
+    rng = np.random.default_rng((seed, 0xA46))
+
+    def fn(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        img = batch["image"]
+        if train and crop_pad > 0:
+            b, h, w = img.shape[:3]
+            padded = np.pad(
+                img, ((0, 0), (crop_pad, crop_pad), (crop_pad, crop_pad),
+                      (0, 0)))
+            ys = rng.integers(0, 2 * crop_pad + 1, b)
+            xs = rng.integers(0, 2 * crop_pad + 1, b)
+            # Gather per-sample crops via a strided view: windows[i] indexed
+            # at (ys[i], xs[i]) — one fancy-index, no Python loop.
+            s = padded.strides
+            windows = np.lib.stride_tricks.as_strided(
+                padded, shape=(b, 2 * crop_pad + 1, 2 * crop_pad + 1, h, w,
+                               img.shape[3]),
+                strides=(s[0], s[1], s[2], s[1], s[2], s[3]))
+            img = windows[np.arange(b), ys, xs]
+        if train and flip:
+            do = rng.random(len(img)) < 0.5
+            img = np.where(do[:, None, None, None], img[:, :, ::-1], img)
+        if img.dtype == np.uint8:
+            img = img.astype(dtype) / np.array(255.0, dtype)
+        else:
+            img = img.astype(dtype, copy=False)
+        out = dict(batch)
+        out["image"] = np.ascontiguousarray(img)
+        return out
+
+    return fn
+
+
+def mlm_transform(vocab_size: int, mask_rate: float = 0.15, seed: int = 0,
+                  mask_token: int = MASK_ID, pad_id: int = 0) -> Callable:
+    """{"input_ids"} -> BERT-style dynamically masked batch.
+
+    Standard 80/10/10 corruption: of the selected positions, 80% become
+    [MASK], 10% a random token, 10% keep the original. ``attn_mask`` marks
+    non-pad positions; pads are never selected."""
+    rng = np.random.default_rng((seed, 0xB3A7))
+
+    def fn(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        ids = batch["input_ids"].astype(np.int32)
+        attn = (ids != pad_id).astype(np.int32)
+        sel = (rng.random(ids.shape) < mask_rate) & (attn == 1)
+        roll = rng.random(ids.shape)
+        corrupted = np.where(roll < 0.8, mask_token,
+                             np.where(roll < 0.9,
+                                      rng.integers(0, vocab_size, ids.shape),
+                                      ids)).astype(np.int32)
+        tokens = np.where(sel, corrupted, ids)
+        return {"tokens": tokens, "labels": ids,
+                "mlm_mask": sel.astype(np.int32), "attn_mask": attn}
+
+    return fn
+
+
+def lm_transform() -> Callable:
+    def fn(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return {"tokens": batch["input_ids"].astype(np.int32)}
+
+    return fn
+
+
+def auto_transform(meta_fields, input_spec, task: str, train: bool,
+                   seed: int = 0, augment: bool = False,
+                   mask_rate: float = 0.15,
+                   vocab_size: Optional[int] = None) -> Optional[Callable]:
+    """Pick the shard-schema -> model-input bridge, or None if batches
+    already match the spec (e.g. a pre-materialized synthetic dataset)."""
+    names = {f.name for f in meta_fields}
+    want = set(input_spec)
+    if names == want:
+        # Schema matches; images may still need dtype conversion/augment.
+        if "image" in names:
+            stored = next(f.dtype for f in meta_fields if f.name == "image")
+            spec_dtype = str(input_spec["image"].dtype)
+            if stored != spec_dtype or (train and augment):
+                return image_transform(train=train and augment, seed=seed,
+                                       dtype=np.dtype(spec_dtype))
+        return None
+    if names == {"input_ids"}:
+        if task == "mlm":
+            if vocab_size is None:
+                raise ValueError("mlm transform needs the model vocab size")
+            return mlm_transform(vocab_size, mask_rate=mask_rate, seed=seed)
+        if task == "lm":
+            return lm_transform()
+    raise ValueError(
+        f"dataset fields {sorted(names)} do not match the model's expected "
+        f"inputs {sorted(want)} and no transform bridges them")

@@ -23,7 +23,7 @@ from serverless_learn_tpu.utils.tracing import get_tracer, step_annotation
 def make_source(config: ExperimentConfig, trainer: Trainer,
                 dataset: Optional[str] = None, seed: Optional[int] = None,
                 dp_rank: Optional[int] = None, dp_size: Optional[int] = None,
-                start_step: int = 0):
+                start_step: int = 0, train: bool = True):
     """Pick a host batch source for a config.
 
     ``data.shard_server_addr`` set => stream the named dataset from the
@@ -60,9 +60,11 @@ def make_source(config: ExperimentConfig, trainer: Trainer,
         dp_size = n_proc
     if config.data.shard_server_addr:
         from serverless_learn_tpu.data.shard_client import ShardStreamSource
+        from serverless_learn_tpu.data.transforms import (
+            TransformedSource, auto_transform)
 
         # Stream the named dataset from the worker's own stripe of shards.
-        return ShardStreamSource(
+        source = ShardStreamSource(
             config.data.shard_server_addr,
             dataset or config.data.dataset,
             config.train.batch_size // n_proc,
@@ -70,6 +72,18 @@ def make_source(config: ExperimentConfig, trainer: Trainer,
             dp_rank=dp_rank,
             dp_size=dp_size,
         )
+        # Bridge storage schema -> model inputs (uint8 decode + augment for
+        # images, dynamic MLM masking / field rename for token corpora).
+        # ``train=False`` (eval sources) converts dtypes but never augments.
+        bundle = trainer.bundle
+        model_cfg = getattr(bundle.module, "cfg", None)
+        fn = auto_transform(
+            source.meta.fields,
+            bundle.input_spec(config.data, config.train.batch_size // n_proc),
+            task=bundle.task, train=train, seed=seed + dp_rank,
+            augment=config.data.augment, mask_rate=config.data.mask_rate,
+            vocab_size=getattr(model_cfg, "vocab_size", None))
+        return TransformedSource(source, fn) if fn is not None else source
     # Synthetic: each stripe rank generates its own slice (distinct seed so
     # consumers don't all produce identical data).
     return SyntheticSource(trainer.bundle.make_batch, config.data,
@@ -86,10 +100,11 @@ def eval_uses_train_data(config: ExperimentConfig) -> bool:
 
 def make_eval_source(config: ExperimentConfig, trainer: Trainer):
     """Held-out source for eval passes: ``data.eval_dataset`` from the shard
-    server if published, else the training source re-seeded disjointly."""
+    server if published, else the training source re-seeded disjointly.
+    Eval sources convert dtypes but never augment."""
     return make_source(config, trainer,
                        dataset=config.data.eval_dataset or None,
-                       seed=config.train.seed + 995_801)
+                       seed=config.train.seed + 995_801, train=False)
 
 
 def run_eval(

@@ -67,6 +67,8 @@ SLOW_TESTS = {
     "tests/test_checkpoint.py::test_latest_and_gc",
     "tests/test_checkpoint.py::test_resume_is_exact",
     "tests/test_cli.py::test_publish_stats_and_train_from_shard_server",
+    "tests/test_real_data.py::test_cifar_bytes_to_rising_accuracy",
+    "tests/test_real_data.py::test_corpus_to_bert_mlm_training",
     "tests/test_cli.py::test_train_end_to_end",
     "tests/test_configs.py::test_small_rungs_build[cifar_resnet18_dp4.json]",
     "tests/test_configs.py::test_small_rungs_build[mnist_mlp.json]",
