@@ -96,6 +96,13 @@ def mlm_transform(vocab_size: int, mask_rate: float = 0.15, seed: int = 0,
     def fn(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         ids = batch["input_ids"].astype(np.int32)
         attn = (ids != pad_id).astype(np.int32)
+        # Suffix contract: BERT bundles set suffix_padding_mask=True and
+        # derive kv_lengths = attn.sum(-1); an interior pad would make
+        # that silently mask real trailing tokens. Fail loudly instead.
+        if not (attn[:, :-1] >= attn[:, 1:]).all():
+            raise ValueError(
+                "input_ids contain interior padding; the MLM pipeline "
+                "requires suffix-padded rows (valid prefix, padded tail)")
         sel = (rng.random(ids.shape) < mask_rate) & (attn == 1)
         roll = rng.random(ids.shape)
         corrupted = np.where(roll < 0.8, mask_token,

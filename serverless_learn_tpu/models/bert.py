@@ -24,6 +24,11 @@ def _bert_cfg(size: str, **overrides) -> TransformerConfig:
     kw = dict(
         vocab_size=30522, max_seq_len=512, causal=False, use_rope=False,
         norm="layer", activation="gelu", tie_embeddings=False,
+        # Both BERT data paths honor the suffix contract: the synthetic
+        # make_batch emits all-ones masks, and the corpus pipeline's
+        # mlm_transform derives attn_mask from suffix-padded rows — so
+        # attention can run the flash kernel's kv_lengths path.
+        suffix_padding_mask=True,
     )
     kw.update(presets[size])
     kw.update(overrides)

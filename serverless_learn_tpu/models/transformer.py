@@ -50,6 +50,14 @@ class TransformerConfig:
     param_dtype: jnp.dtype = jnp.float32
     attention_impl: str = "auto"  # "auto" | "xla" | "flash" | "ring"
     sp_axis: Optional[str] = None  # mesh axis for ring attention
+    # The model's attention masks are pure SUFFIX padding (valid prefix,
+    # padded tail). Attention then derives per-row valid lengths from the
+    # mask and takes the flash kernel's near-free kv_lengths path instead
+    # of dense fallback. This is a data-pipeline CONTRACT: the bundle that
+    # sets it must feed suffix-padded batches (mlm_transform checks it at
+    # batch-build time; masks from other sources are trusted). An interior
+    # pad would silently mask real trailing tokens.
+    suffix_padding_mask: bool = False
     remat: bool = False
     pipeline: bool = False  # stack blocks [L,...] and GPipe over the pp axis
     pipeline_microbatches: int = 4
@@ -204,8 +212,19 @@ class Attention(nn.Module):
             sin, cos = rope_angles(positions, D, cfg.rope_theta)
             q = apply_rope(q, sin, cos)
             k = apply_rope(k, sin, cos)
+        kv_lengths = None
+        if (cfg.suffix_padding_mask and mask is not None
+                and not (decode or prefill) and mask.ndim == 4
+                and mask.shape[1] == 1 and mask.shape[2] == 1
+                and (jnp.issubdtype(mask.dtype, jnp.integer)
+                     or jnp.issubdtype(mask.dtype, jnp.bool_))):
+            # Contract (cfg.suffix_padding_mask): the mask is a valid
+            # prefix + padded tail, so its row sum IS the valid length.
+            # Float masks are excluded — they could be additive (0 = KEEP),
+            # whose row sum would be garbage lengths.
+            kv_lengths = mask[:, 0, 0, :].astype(jnp.int32).sum(-1)
         out = dot_product_attention(
-            q, k, v, causal=causal, mask=mask,
+            q, k, v, causal=causal, mask=mask, kv_lengths=kv_lengths,
             impl="xla" if (decode or prefill) else cfg.attention_impl,
             axis_name=cfg.sp_axis or "sp")
         return nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=False,

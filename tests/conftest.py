@@ -77,6 +77,7 @@ SLOW_TESTS = {
     "tests/test_elastic.py::test_state_survives_remesh_exactly",
     "tests/test_elastic_shard_data.py::test_elastic_worker_streams_from_shard_server",
     "tests/test_flash_attention.py::test_flash_inside_pipeline_stage",
+    "tests/test_flash_masks.py::test_bert_step_executes_flash_path",
     "tests/test_flash_attention.py::test_flash_sharded_train_step_matches_xla[mesh_kw0]",
     "tests/test_flash_attention.py::test_flash_sharded_train_step_matches_xla[mesh_kw1]",
     "tests/test_flash_attention.py::test_transformer_with_flash_impl",

@@ -1,0 +1,173 @@
+"""Flash attention padding masks + Pallas backward (VERDICT round 1 item 4).
+
+Covers the two kernel-resident padding mechanisms (arbitrary [B, S] masks
+and suffix-padding kv_lengths), their gradients (the backward is a pair of
+Pallas kernels, not an XLA scan), GQA without KV expansion, and the proof
+that a BERT train step with a padding mask actually executes the flash
+path instead of silently falling back to dense (the round-1 gap)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.ops.attention import xla_attention
+from serverless_learn_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _rand(rng, *shape):
+    return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+
+def _suffix_mask(lens, T):
+    return (np.arange(T)[None, :] < np.asarray(lens)[:, None]).astype(np.int32)
+
+
+@pytest.fixture
+def qkv():
+    rng = np.random.default_rng(0)
+    B, T, H, D = 2, 256, 4, 64
+    return tuple(_rand(rng, B, T, H, D) for _ in range(3))
+
+
+def _check_grads(f_flash, f_dense, args, weight, tol=2e-4):
+    gf = jax.grad(lambda *a: (f_flash(*a) * weight).sum(),
+                  tuple(range(len(args))))(*args)
+    gx = jax.grad(lambda *a: (f_dense(*a) * weight).sum(),
+                  tuple(range(len(args))))(*args)
+    for name, a, b in zip("qkv", gf, gx):
+        err = float(jnp.abs(a - b).max())
+        assert err < tol, f"d{name} err {err}"
+        assert not bool(jnp.isnan(a).any())
+
+
+@pytest.mark.parametrize("how", ["rows", "len"])
+def test_padding_parity_and_grads(qkv, how):
+    q, k, v = qkv
+    B, T = q.shape[:2]
+    lens = [T, 100]  # one full row, one padded row (incl. an empty K block)
+    mask2 = _suffix_mask(lens, T)
+    m4 = jnp.asarray(mask2)[:, None, None, :]
+    w = jnp.asarray(mask2)[:, :, None, None]  # score only valid queries
+    kwargs = (dict(mask=m4) if how == "rows"
+              else dict(kv_lengths=jnp.asarray(lens, jnp.int32)))
+
+    o_f = flash_attention(q, k, v, **kwargs)
+    o_x = xla_attention(q, k, v, mask=m4)
+    assert float(jnp.abs((o_f - o_x) * w).max()) < 1e-5
+    _check_grads(lambda *a: flash_attention(*a, **kwargs),
+                 lambda *a: xla_attention(*a, mask=m4), (q, k, v), w)
+
+
+@pytest.mark.parametrize("how", ["rows", "len"])
+def test_padding_composes_with_causal(qkv, how):
+    q, k, v = qkv
+    T = q.shape[1]
+    lens = [200, 100]
+    mask2 = _suffix_mask(lens, T)
+    m4 = jnp.asarray(mask2)[:, None, None, :]
+    w = jnp.asarray(mask2)[:, :, None, None]
+    kwargs = (dict(mask=m4) if how == "rows"
+              else dict(kv_lengths=jnp.asarray(lens, jnp.int32)))
+    o_f = flash_attention(q, k, v, causal=True, **kwargs)
+    o_x = xla_attention(q, k, v, causal=True, mask=m4)
+    assert float(jnp.abs((o_f - o_x) * w).max()) < 1e-5
+
+
+def test_non_suffix_rows_mask_is_exact(qkv):
+    """The rows path handles arbitrary (non-contiguous) key masks — the
+    case kv_lengths must NOT be used for."""
+    q, k, v = qkv
+    B, T = q.shape[:2]
+    rng = np.random.default_rng(3)
+    mask2 = (rng.random((B, T)) < 0.7).astype(np.int32)
+    mask2[:, 0] = 1  # every query keeps at least one valid key
+    m4 = jnp.asarray(mask2)[:, None, None, :]
+    o_f = flash_attention(q, k, v, mask=m4)
+    o_x = xla_attention(q, k, v, mask=m4)
+    assert float(jnp.abs(o_f - o_x).max()) < 1e-5
+    _check_grads(lambda *a: flash_attention(*a, mask=m4),
+                 lambda *a: xla_attention(*a, mask=m4), (q, k, v),
+                 jnp.float32(1.0))
+
+
+def test_gqa_with_padding_no_kv_expansion(qkv):
+    q, _, _ = qkv
+    rng = np.random.default_rng(1)
+    B, T = q.shape[:2]
+    kg, vg = _rand(rng, B, T, 2, 64), _rand(rng, B, T, 2, 64)
+    lens = [T, 128]
+    mask2 = _suffix_mask(lens, T)
+    m4 = jnp.asarray(mask2)[:, None, None, :]
+    w = jnp.asarray(mask2)[:, :, None, None]
+    o_f = flash_attention(q, kg, vg, kv_lengths=jnp.asarray(lens, jnp.int32))
+    o_x = xla_attention(q, kg, vg, mask=m4)
+    assert float(jnp.abs((o_f - o_x) * w).max()) < 1e-5
+    _check_grads(
+        lambda *a: flash_attention(*a, kv_lengths=jnp.asarray(lens, jnp.int32)),
+        lambda *a: xla_attention(*a, mask=m4), (q, kg, vg), w)
+
+
+def test_float_masks_fall_back_to_dense(qkv):
+    """A float mask could be additive (zeros mean KEEP); only bool/int
+    masks may enter the kernel's nonzero-means-keep contract."""
+    from serverless_learn_tpu.ops.pallas.flash_attention import as_kv_mask
+
+    B, T = 2, 256
+    assert as_kv_mask(jnp.ones((B, 1, 1, T), jnp.float32), B, T) is None
+    assert as_kv_mask(jnp.ones((B, 1, T, T), jnp.int32), B, T) is None
+    assert as_kv_mask(jnp.ones((B, 1, 1, T), jnp.int32), B, T) is not None
+    assert as_kv_mask(jnp.ones((B, T), jnp.bool_), B, T) is not None
+
+
+def test_bert_step_executes_flash_path(devices):
+    """The round-1 gap: BERT always passes a padding mask, which silently
+    forced dense attention. Prove the masked train-step now lowers through
+    pallas_call (suffix_padding_mask contract -> kv_lengths path)."""
+    from serverless_learn_tpu.config import (
+        DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig,
+        TrainConfig)
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    cfg = ExperimentConfig(
+        model="bert_tiny",
+        model_overrides={"max_seq_len": 512},
+        mesh=MeshConfig(dp=8),
+        optimizer=OptimizerConfig(name="adamw", learning_rate=1e-4),
+        train=TrainConfig(batch_size=8, dtype="float32",
+                          param_dtype="float32"),
+        data=DataConfig(seq_len=512))
+    trainer = build_trainer(cfg)
+    rng = np.random.default_rng(0)
+    batch = trainer.bundle.make_batch(rng, cfg.data, 8)
+    batch["attn_mask"][:, 400:] = 0  # suffix padding
+    batch["mlm_mask"][:, 400:] = 0
+
+    def loss(params):
+        l, _ = trainer.bundle.loss_fn(params, batch)
+        return l
+
+    state = trainer.init()
+    jaxpr = str(jax.make_jaxpr(jax.grad(loss))(state.params))
+    assert "pallas_call" in jaxpr, \
+        "masked BERT fwd+bwd must lower through the flash kernels"
+    # and it trains without NaNs through the masked backward
+    g = jax.grad(loss)(state.params)
+    assert not any(bool(jnp.isnan(x).any())
+                   for x in jax.tree_util.tree_leaves(g))
+
+
+def test_fully_padded_row_is_nan_free(qkv):
+    """A row with zero valid keys must produce output 0 and, with zero
+    upstream gradient (the loss masks it), NaN-free input gradients."""
+    q, k, v = qkv
+    B, T = q.shape[:2]
+    lens = [T, 0]
+    mask2 = _suffix_mask(lens, T)
+    w = jnp.asarray(mask2)[:, :, None, None]
+    out = flash_attention(q, k, v, kv_lengths=jnp.asarray(lens, jnp.int32))
+    assert float(jnp.abs(out[1]).max()) == 0.0
+    g = jax.grad(lambda *a: (flash_attention(
+        *a, kv_lengths=jnp.asarray(lens, jnp.int32)) * w).sum(),
+        (0, 1, 2))(q, k, v)
+    assert not any(bool(jnp.isnan(x).any()) for x in g)
