@@ -177,8 +177,10 @@ def run_training(
     meter.start()
     start_step = int(jax.device_get(state.step))
     tracer = get_tracer()
+    last_batch = None
     try:
         for i, batch in zip(range(start_step, config.train.num_steps), prefetch):
+            last_batch = batch
             with step_annotation(i + 1), tracer.span("train/step",
                                                      annotate_device=False):
                 state, metrics = trainer.step(state, batch)
@@ -212,4 +214,13 @@ def run_training(
         prefetch.close()
         if created_source and hasattr(source, "close"):
             source.close()
+    if last_batch is not None:
+        # Attach the compiled step's FLOPs so steady_state can report MFU.
+        # lower() retraces but compile() hits the executable cache; cost is
+        # one trace at end-of-run, not a second compilation.
+        from serverless_learn_tpu.utils.flops import compiled_step_flops
+
+        meter.flops_per_step = compiled_step_flops(
+            trainer.step_fn, state, last_batch,
+            n_devices=trainer.mesh.size)
     return state, meter

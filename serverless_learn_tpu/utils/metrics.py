@@ -26,6 +26,9 @@ class StepStats:
 class ThroughputMeter:
     batch_size: int
     n_chips: int = 1
+    # Whole-mesh FLOPs of one compiled step (utils/flops.compiled_step_flops).
+    # When set, steady_state reports MFU and achieved TFLOP/s.
+    flops_per_step: Optional[float] = None
     history: List[StepStats] = field(default_factory=list)
     _t_last: Optional[float] = None
 
@@ -49,11 +52,21 @@ class ThroughputMeter:
             return {"samples_per_sec": 0.0, "step_time_s": 0.0}
         times = [s.step_time_s for s in usable]
         sps = self.batch_size * len(usable) / sum(times)
-        return {
+        out = {
             "samples_per_sec": sps,
             "samples_per_sec_per_chip": sps / max(self.n_chips, 1),
             "step_time_s": sum(times) / len(times),
         }
+        if self.flops_per_step:
+            from serverless_learn_tpu.utils.flops import mfu
+
+            out["tflops_per_sec_per_chip"] = (
+                self.flops_per_step / out["step_time_s"] / 1e12
+                / max(self.n_chips, 1))
+            u = mfu(self.flops_per_step, out["step_time_s"], self.n_chips)
+            if u is not None:
+                out["mfu"] = u
+        return out
 
 
 def log_json(record: dict, stream=None):
