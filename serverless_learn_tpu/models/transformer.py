@@ -61,6 +61,22 @@ class TransformerConfig:
     remat: bool = False
     pipeline: bool = False  # stack blocks [L,...] and GPipe over the pp axis
     pipeline_microbatches: int = 4
+    # Interleaved schedule: each stage owns this many non-adjacent layer
+    # chunks and microbatches make that many laps around a cyclic stage
+    # ring — bubble (S-1)/(V*M+S-1) vs GPipe's (S-1)/(M+S-1). Requires
+    # n_microbatches >= pp stages. 1 = classic GPipe.
+    pipeline_interleave: int = 1
+    # With interleave > 1 the layer EXECUTION order depends on the stage
+    # count, so it must be pinned in the config (not read off whatever mesh
+    # happens to be active) — a checkpoint trained interleaved on pp=S must
+    # replay the same layer order when later run sequentially on pp=1.
+    pipeline_stages: int = 0  # required when pipeline_interleave > 1
+    # Megatron-style manual tensor parallelism INSIDE a pipeline stage's
+    # shard_map: this config describes the LOCAL slice (n_heads/tp,
+    # d_ff/tp), and Attention / MlpBlock psum their row-parallel outputs
+    # over this axis. Set by PipelinedBlocks, never by users.
+    manual_tp_axis: Optional[str] = None
+    head_dim_override: Optional[int] = None  # local-slice cfgs must pin it
 
     @property
     def kv_heads(self) -> int:
@@ -68,6 +84,8 @@ class TransformerConfig:
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.d_model // self.n_heads
 
 
@@ -115,6 +133,27 @@ def constrain_residual(x: jax.Array) -> jax.Array:
     if not batch and seq is None:
         return x
     spec = P(batch if batch else None, seq, None)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def _shard_head_over_pp(x: jax.Array) -> jax.Array:
+    """Shard a pipeline's [B, T, D] output over pp along the sequence dim,
+    so the final norm + lm head (and the loss behind them) run 1/S of the
+    tokens per stage instead of replicating the whole tail computation on
+    every stage. No-op off a pp mesh or when T doesn't divide."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from serverless_learn_tpu.parallel.mesh import live_batch_axes
+    from serverless_learn_tpu.parallel.ring_attention import get_active_mesh
+
+    mesh = get_active_mesh()
+    if (mesh is None or mesh.shape.get("pp", 1) == 1
+            or x.shape[1] % mesh.shape["pp"]):
+        return x
+    batch, n_batch = live_batch_axes(mesh)
+    if batch and x.shape[0] % n_batch:
+        batch = ()
+    spec = P(batch if batch else None, "pp", None)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
@@ -227,9 +266,14 @@ class Attention(nn.Module):
             q, k, v, causal=causal, mask=mask, kv_lengths=kv_lengths,
             impl="xla" if (decode or prefill) else cfg.attention_impl,
             axis_name=cfg.sp_axis or "sp")
-        return nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=False,
-                               name="o_proj", dtype=cfg.dtype,
-                               param_dtype=cfg.param_dtype)(out)
+        y = nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=False,
+                            name="o_proj", dtype=cfg.dtype,
+                            param_dtype=cfg.param_dtype)(out)
+        if cfg.manual_tp_axis:
+            # Row-parallel output projection: each tp member contracted its
+            # local heads; the partial sums combine here.
+            y = jax.lax.psum(y, cfg.manual_tp_axis)
+        return y
 
 
 class MlpBlock(nn.Module):
@@ -244,9 +288,14 @@ class MlpBlock(nn.Module):
         if cfg.activation == "swiglu":
             gate = nn.silu(dense(cfg.d_ff, "gate_proj")(x))
             up = dense(cfg.d_ff, "up_proj")(x)
-            return dense(cfg.d_model, "down_proj")(gate * up)
-        h = nn.gelu(dense(cfg.d_ff, "wi")(x))
-        return dense(cfg.d_model, "wo")(h)
+            y = dense(cfg.d_model, "down_proj")(gate * up)
+        else:
+            h = nn.gelu(dense(cfg.d_ff, "wi")(x))
+            y = dense(cfg.d_model, "wo")(h)
+        if cfg.manual_tp_axis:
+            # Row-parallel down projection (each member holds d_ff/tp).
+            y = jax.lax.psum(y, cfg.manual_tp_axis)
+        return y
 
 
 class Block(nn.Module):
@@ -302,22 +351,70 @@ class PipelinedBlocks(nn.Module):
 
         stacked = self.param("pipe_blocks", init_stack)
 
+        from serverless_learn_tpu.parallel.ring_attention import (
+            get_active_mesh)
+
+        mesh = get_active_mesh()
+        tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+        pp_live = mesh is not None and mesh.shape.get("pp", 1) > 1
+        block_cfg = cfg
+        param_specs = None
+        if pp_live and tp > 1:
+            # Megatron-style manual tp inside the pipeline's shard_map:
+            # each tp member applies a LOCAL slice of every layer (heads
+            # and d_ff divided by tp; the rule table shards the stacked
+            # leaves to match) and psums its row-parallel outputs.
+            H, K = cfg.n_heads, cfg.kv_heads
+            if H % tp or K % tp or cfg.d_ff % tp:
+                raise ValueError(
+                    f"pp x tp needs n_heads ({H}), kv_heads ({K}) and "
+                    f"d_ff ({cfg.d_ff}) divisible by tp={tp}")
+            block_cfg = dataclasses.replace(
+                cfg, n_heads=H // tp, n_kv_heads=K // tp,
+                d_ff=cfg.d_ff // tp, manual_tp_axis="tp",
+                head_dim_override=cfg.head_dim)
+            from serverless_learn_tpu.parallel.sharding import (
+                DEFAULT_RULES, _path_str)
+
+            def spec_of(path, leaf):
+                return DEFAULT_RULES.spec_for(
+                    "pipe_blocks/" + _path_str(path), leaf.ndim, mesh)
+
+            param_specs = jax.tree_util.tree_map_with_path(spec_of, stacked)
+
         def block_apply(p, h, pos, m):
-            fn = lambda pp_, h_, pos_, m_: Block(cfg).apply(
+            fn = lambda pp_, h_, pos_, m_: Block(block_cfg).apply(
                 {"params": pp_}, h_, mask=m_, positions=pos_)
             if cfg.remat:
                 fn = jax.checkpoint(fn)
             return fn(p, h, pos, m)
 
         from serverless_learn_tpu.parallel.pipeline import (
-            gpipe_apply, sequential_apply)
-        from serverless_learn_tpu.parallel.ring_attention import get_active_mesh
+            gpipe_apply, layer_execution_order, sequential_apply)
 
-        mesh = get_active_mesh()
+        V = cfg.pipeline_interleave
+        order = None
+        if V > 1:
+            if cfg.pipeline_stages <= 0:
+                raise ValueError(
+                    "pipeline_interleave > 1 requires pipeline_stages: the "
+                    "layer execution order is a function of the stage count "
+                    "and must not drift with whatever mesh is active")
+            order = layer_execution_order(cfg.n_layers, cfg.pipeline_stages,
+                                          V)
         if mesh is None or mesh.shape.get("pp", 1) == 1:
-            return sequential_apply(block_apply, stacked, x, positions, mask)
+            # Sequential path replays the exact layer order the interleaved
+            # schedule trains with (identity for GPipe).
+            return sequential_apply(block_apply, stacked, x, positions, mask,
+                                    layer_order=order)
+        if V > 1 and mesh.shape["pp"] != cfg.pipeline_stages:
+            raise ValueError(
+                f"mesh pp={mesh.shape['pp']} != config pipeline_stages="
+                f"{cfg.pipeline_stages}; an interleaved checkpoint's layer "
+                "order is tied to its stage count")
         return gpipe_apply(block_apply, stacked, x, positions, mask, mesh=mesh,
-                           n_microbatches=cfg.pipeline_microbatches)
+                           n_microbatches=cfg.pipeline_microbatches,
+                           n_virtual=V, param_specs=param_specs)
 
 
 class Transformer(nn.Module):
@@ -363,6 +460,11 @@ class Transformer(nn.Module):
         if cfg.pipeline:
             x = PipelinedBlocks(cfg, name="pipeline")(x, mask=mask,
                                                       positions=positions)
+            # The pipeline's output is replicated over pp; without a
+            # constraint the final norm + lm head would run REDUNDANTLY on
+            # every stage (round-1 verdict). Sharding the sequence dim over
+            # pp makes GSPMD split that tail across stages instead.
+            x = _shard_head_over_pp(x)
         else:
             use_remat = cfg.remat and not (decode or prefill)
             block = nn.remat(Block, static_argnums=()) if use_remat else Block

@@ -1,15 +1,47 @@
-"""Pipeline parallelism — GPipe-style microbatched stages over the ``pp`` axis.
+"""Pipeline parallelism — microbatched stages over the ``pp`` mesh axis.
 
 A capability the reference never had (its model state is one flat vector on a
 single process, ``src/master.cc:58``; SURVEY.md §2.9 lists PP as absent).
 TPU-native design: transformer blocks are stacked along a leading layer axis
-and sharded over the ``pp`` mesh axis, so each pipeline stage owns a
-contiguous slice of layers in its own HBM. Execution runs under ``shard_map``:
-every tick each stage applies its layer slice to one microbatch and hands the
+and sharded over the ``pp`` mesh axis, so each pipeline stage owns a slice of
+layers in its own HBM. Execution runs under ``shard_map``: every tick each
+stage applies one of its layer chunks to one microbatch and hands the
 activation to the next stage with a nearest-neighbor ``lax.ppermute`` over
-ICI. The schedule is plain GPipe (fill, steady state, drain — bubble fraction
-(S-1)/(M+S-1)); the backward pipeline falls out of JAX autodiff through the
-``lax.scan`` of ticks, so one forward definition yields both directions.
+ICI. The backward pipeline falls out of JAX autodiff through the ``lax.scan``
+of ticks, so one forward definition yields both directions.
+
+Two schedules, one implementation (``n_virtual`` = V):
+
+* V=1 — classic GPipe: each stage owns one contiguous chunk of L/S layers;
+  bubble fraction (S-1)/(M+S-1) per direction.
+* V>1 — interleaved ("looping") pipeline, Megatron's interleaved-1F1B idea
+  applied to the forward (the backward re-runs the schedule in reverse via
+  autodiff): each stage owns V smaller chunks of L/(S·V) layers, and every
+  microbatch makes V laps around a CYCLIC stage ring. Ticks per direction:
+  V·M + S - 1 over V·M units of work, i.e. bubble (S-1)/(V·M+S-1) — smaller
+  than GPipe's because the idle fill/drain is amortized over V× more,
+  smaller ticks. The price: V× more ppermute hops (cheap on ICI) and one
+  M-slot activation buffer per stage for the ring wrap-around.
+
+Chunk-to-stage mapping: storage rows are layer-major per stage — stage s
+holds storage chunks [s·V, (s+1)·V) (what a contiguous ``P('pp')`` sharding
+of the stacked leaves gives) — and the EXECUTED layer order visits chunks
+round-robin across stages: execution step k runs storage chunk
+(k mod S)·V + k//S. ``layer_execution_order`` exposes that permutation so
+the sequential golden model (pp=1 path, tests) applies layers in exactly the
+same order; a from-scratch init has no canonical order to preserve, it only
+has to be CONSISTENT across the pipelined and sequential paths.
+
+Tensor parallelism composes the Megatron way, fully manual: the shard_map
+is manual over {pp, dp, fsdp, tp}; each tp member holds a heads/d_ff slice
+of every layer (the rule table's tp shardings on the stacked leaves —
+parallel/sharding.py) and the caller's ``block_apply`` runs a LOCALLY-SHAPED
+block (n_heads/tp, d_ff/tp) with explicit psums after its row-parallel
+projections (``TransformerConfig.manual_tp_axis``). A partial-auto
+shard_map (tp left to GSPMD) would be the elegant alternative and works on
+toy bodies, but the full transformer step crashes this XLA version's
+partitioner (CHECK failure "Invalid binary instruction opcode copy"), so
+the manual form is the one that ships.
 
 No framework networking is involved: stage hand-off is an XLA collective on
 ICI, keeping BASELINE.md's "zero gRPC bytes on the gradient/activation path"
@@ -19,25 +51,48 @@ invariant.
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Optional
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from serverless_learn_tpu.parallel.compat import (
-    shard_map_no_check as _shard_map)
+from serverless_learn_tpu.parallel.compat import shard_map_no_check
+
+
+def layer_execution_order(n_layers: int, n_stages: int,
+                          n_virtual: int) -> np.ndarray:
+    """Storage row index applied at each execution position (length L).
+
+    Identity for V=1. For V>1, execution chunk k lives at storage chunk
+    (k mod S)·V + k//S; rows inside a chunk stay in order."""
+    if n_stages < 1 or n_virtual < 1 or n_layers % (n_stages * n_virtual):
+        raise ValueError(
+            f"n_layers={n_layers} not divisible by stages*virtual="
+            f"{n_stages}*{n_virtual}")
+    csize = n_layers // (n_stages * n_virtual)
+    order = []
+    for k in range(n_stages * n_virtual):
+        c = (k % n_stages) * n_virtual + k // n_stages
+        order.extend(range(c * csize, (c + 1) * csize))
+    return np.asarray(order, dtype=np.int32)
 
 
 def sequential_apply(block_apply: Callable, stacked_params, x, positions,
-                     mask=None):
+                     mask=None, layer_order: Optional[np.ndarray] = None):
     """Reference semantics: apply the stacked layers one after another.
 
-    Used when ``pp == 1`` (single stage) and by tests as the golden model for
-    the pipelined schedule. ``stacked_params`` leaves have a leading layer
-    dim; ``block_apply(params_one_layer, x, positions, mask) -> x``.
-    """
+    Used when ``pp == 1`` (single stage) and by tests as the golden model
+    for the pipelined schedule. ``stacked_params`` leaves have a leading
+    layer dim; ``block_apply(params_one_layer, x, positions, mask) -> x``.
+    ``layer_order`` permutes the storage rows into execution order (the
+    interleaved schedule's round-robin; identity/None for GPipe)."""
+    if layer_order is not None:
+        idx = jnp.asarray(layer_order)
+        stacked_params = jax.tree_util.tree_map(lambda a: a[idx],
+                                                stacked_params)
 
     def layer(h, p):
         return block_apply(p, h, positions, mask), None
@@ -55,52 +110,77 @@ def gpipe_apply(
     *,
     mesh: Mesh,
     n_microbatches: int,
+    n_virtual: int = 1,
     axis_name: str = "pp",
-    batch_axes=("dp", "fsdp"),
+    batch_axes: Sequence[str] = ("dp", "fsdp"),
+    param_specs=None,
 ):
-    """Run the stacked layers as a GPipe pipeline over ``mesh.shape[pp]`` stages.
+    """Run the stacked layers as a pipeline over ``mesh.shape[pp]`` stages.
 
     Args:
       block_apply: ``(params_one_layer, h, positions, mask) -> h`` per block.
       stacked_params: pytree with leading dim ``n_layers`` on every leaf,
-        sharded ``P('pp')`` so each stage holds ``n_layers / S`` layers.
+        sharded ``P('pp')`` so each stage holds ``n_layers / S`` rows
+        (its V chunks, stored contiguously).
       x: activations ``[B_global, T, D]``, batch-sharded over ``batch_axes``.
       positions: ``[B_global, T]`` int32 token positions (RoPE), same batch
         sharding as ``x``.
       mask: optional attention mask with leading batch dim (e.g.
         ``[B, 1, 1, T]``), same batch sharding; microbatched alongside ``x``.
-      n_microbatches: M; the per-device batch must divide by M.
+      n_microbatches: M; the per-device batch must divide by M, and the
+        interleaved schedule additionally needs M >= S (the wrap-around
+        item must have drained before its next lap starts).
+      n_virtual: V layer chunks per stage (1 = GPipe).
+      param_specs: optional pytree of PartitionSpecs for ``stacked_params``
+        (leading dim must be ``axis_name``); defaults to P(axis_name) on
+        every leaf. Needed for pp x tp, where weight dims additionally
+        shard over tp and block_apply runs the local-shape block.
 
     Returns activations ``[B_global, T, D]``, batch-sharded, replicated over
-    ``pp`` (every stage ends with the final output — the unsharded logits
-    head that follows runs redundantly per stage, the standard trade).
-    """
+    ``pp``."""
     S = mesh.shape[axis_name]
     if S == 1:
-        return sequential_apply(block_apply, stacked_params, x, positions,
-                                mask)
-    for ax in ("ep", "tp", "sp"):
+        if int(n_virtual) > 1:
+            # The interleaved layer order depends on the stage count, which
+            # a pp=1 mesh cannot supply — the caller must apply
+            # layer_execution_order(L, S_config, V) via sequential_apply
+            # (PipelinedBlocks does exactly that).
+            raise ValueError(
+                "gpipe_apply(n_virtual > 1) on a pp=1 mesh is ambiguous; "
+                "use sequential_apply with layer_execution_order instead")
+        return sequential_apply(
+            block_apply, stacked_params, x, positions, mask,
+            layer_order=None)
+    for ax in ("ep", "sp"):
         if mesh.shape.get(ax, 1) > 1:
             raise NotImplementedError(
-                f"pipeline parallelism composes with dp/fsdp; mesh axis "
+                f"pipeline parallelism composes with dp/fsdp/tp; mesh axis "
                 f"'{ax}' must be 1 (got {mesh.shape[ax]})")
     n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
-    if n_layers % S:
+    V = int(n_virtual)
+    if V < 1:
+        raise ValueError(f"n_virtual must be >= 1, got {V}")
+    if n_layers % (S * V):
         raise ValueError(
-            f"n_layers={n_layers} not divisible by pp={S} pipeline stages")
-
+            f"n_layers={n_layers} not divisible by pp*virtual={S}*{V}")
     M = int(n_microbatches)
-    bspec = P(batch_axes)
+    if V > 1 and M < S:
+        raise ValueError(
+            f"interleaved schedule needs n_microbatches >= pp stages "
+            f"(got M={M} < S={S}): the ring wrap-around reuses the "
+            f"microbatch buffer slot after S ticks")
+
+    live_batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    bspec = P(live_batch if live_batch else None)
     have_mask = mask is not None
     operands = (stacked_params, x, positions) + ((mask,) if have_mask else ())
     in_specs = (P(axis_name), bspec, bspec) + ((bspec,) if have_mask else ())
+    if param_specs is not None:
+        in_specs = (param_specs,) + in_specs[1:]
+    smap = partial(shard_map_no_check, mesh=mesh, in_specs=in_specs,
+                   out_specs=bspec)
 
-    @partial(
-        _shard_map,
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=bspec,
-    )
+    @smap
     def run(params_local, x_local, pos_local, *rest):
         from serverless_learn_tpu.parallel.compat import manual_region
 
@@ -118,39 +198,80 @@ def gpipe_apply(
         mb_pos = mb(pos_local)
         mb_mask = mb(mask_local) if mask_local is not None else None
         stage = lax.axis_index(axis_name)
+        csize = n_layers // (S * V)
 
-        def stage_fn(h, pos, m):
+        def chunk_fn(h, pos, m, v):
+            """Apply this stage's v-th layer chunk (storage rows
+            [v*csize, (v+1)*csize) of the local slice)."""
+            chunk = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_slice_in_dim(a, v * csize, csize, 0),
+                params_local)
+
             def layer(carry, p):
                 return block_apply(p, carry, pos, m), None
 
-            out, _ = lax.scan(layer, h, params_local)
+            out, _ = lax.scan(layer, h, chunk)
             return out
 
-        # Non-cyclic ring: stage i feeds i+1; the last stage's send is dropped.
-        perm = [(i, i + 1) for i in range(S - 1)]
-        T_ticks = M + S - 1
+        # Cyclic ring: the last stage's send wraps to stage 0, carrying a
+        # microbatch into its next lap (dropped unused when V == 1).
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        T_ticks = V * M + S - 1
 
         def tick(carry, t):
-            recv, out_buf = carry
-            read = jnp.clip(t - stage, 0, M - 1)
-            take = lambda a: lax.dynamic_index_in_dim(a, read, 0,
+            if V > 1:
+                recv, buf, out_buf = carry
+            else:
+                recv, out_buf = carry
+                buf = None
+            # Stream position of the item this stage works on (clipped;
+            # out-of-range ticks compute garbage that is never banked).
+            q = jnp.clip(t - stage, 0, V * M - 1)
+            m = q % M
+            v = q // M
+            take = lambda a: lax.dynamic_index_in_dim(a, m, 0,
                                                       keepdims=False)
+            fresh = jnp.logical_and(stage == 0, v == 0)
+            if V > 1:
+                # Arrival from the previous tick's ppermute: stages > 0
+                # consume it this very tick; stage 0 banks it for the NEXT
+                # lap (it arrives S ticks after the item entered the ring,
+                # but is consumed M ticks later — the buffer bridges the
+                # wrap-around).
+                q_arr = jnp.where(stage == 0, t - S, t - stage)
+                m_arr = jnp.clip(q_arr, 0, V * M - 1) % M
+                keep = lax.dynamic_index_in_dim(buf, m_arr, 0,
+                                                keepdims=False)
+                buf = lax.dynamic_update_index_in_dim(
+                    buf, jnp.where(q_arr >= 0, recv, keep), m_arr, 0)
+                buffered = lax.dynamic_index_in_dim(buf, m, 0,
+                                                    keepdims=False)
+                my_in = jnp.where(fresh, take(mb_x), buffered)
+            else:
+                # Classic GPipe: stage 0 always reads fresh input, stages
+                # > 0 consume the arrival directly — no wrap, no buffer.
+                my_in = jnp.where(fresh, take(mb_x), recv)
             my_pos = take(mb_pos)
             my_mask = take(mb_mask) if mb_mask is not None else None
-            my_in = jnp.where(stage == 0, take(mb_x), recv)
-            out = stage_fn(my_in, my_pos, my_mask)
-            # Last stage banks microbatch t-(S-1) once the pipeline is full.
-            w = jnp.clip(t - (S - 1), 0, M - 1)
+            out = chunk_fn(my_in, my_pos, my_mask, v)
+            # Last stage banks the item's final lap (v == V-1).
+            w = jnp.clip(t - (S - 1) - (V - 1) * M, 0, M - 1)
             prev = lax.dynamic_index_in_dim(out_buf, w, 0, keepdims=False)
-            write = jnp.logical_and(stage == S - 1, t >= S - 1)
+            write = jnp.logical_and(stage == S - 1,
+                                    t >= (S - 1) + (V - 1) * M)
             out_buf = lax.dynamic_update_index_in_dim(
                 out_buf, jnp.where(write, out, prev), w, 0)
             nxt = lax.ppermute(out, axis_name, perm)
+            if V > 1:
+                return (nxt, buf, out_buf), None
             return (nxt, out_buf), None
 
+        zero_mb = jnp.zeros_like(mb_x[0])
         out_buf0 = jnp.zeros_like(mb_x)
-        (_, out_buf), _ = lax.scan(
-            tick, (jnp.zeros_like(mb_x[0]), out_buf0), jnp.arange(T_ticks))
+        carry0 = ((zero_mb, jnp.zeros_like(mb_x), out_buf0) if V > 1
+                  else (zero_mb, out_buf0))
+        carry_out, _ = lax.scan(tick, carry0, jnp.arange(T_ticks))
+        out_buf = carry_out[-1]
         # Only the last stage holds real outputs; psum broadcasts them so the
         # result is truly replicated over pp (out_specs says so).
         out_buf = lax.psum(

@@ -83,9 +83,21 @@ def _prune_spec(spec: P, ndim: int, mesh: Mesh) -> P:
 #  - everything additionally shards dim 0 over fsdp (ZeRO-3) when fsdp > 1.
 DEFAULT_RULES = ShardingRules(
     rules=[
-        # GPipe block stacks: leading layer dim shards over pp; inner dims
-        # stay unsharded (stage math runs whole-layer inside shard_map, so
-        # fsdp/tp sharding inside the stack is deliberately not composed).
+        # GPipe block stacks: leading layer dim shards over pp; weight dims
+        # additionally carry tp (heads / d_ff) for the pipeline's MANUAL
+        # Megatron-style tensor parallelism — each tp member holds a local
+        # slice of every layer and block code psums its row-parallel
+        # outputs (models/transformer.py manual_tp_axis; a partial-auto
+        # shard_map leaving tp to GSPMD crashes this XLA's partitioner, see
+        # parallel/pipeline.py). fsdp is deliberately NOT composed into the
+        # stack: under the pipeline it shards the batch, and ZeRO-gathering
+        # per stage tick would serialize against the schedule.
+        (r"pipe_blocks/.*(q_proj|k_proj|v_proj|lora_b)/kernel$",
+         P("pp", None, "tp")),
+        (r"pipe_blocks/.*o_proj/kernel$", P("pp", "tp")),
+        (r"pipe_blocks/.*(wi|wi_0|wi_1|up_proj|gate_proj)/kernel$",
+         P("pp", None, "tp")),
+        (r"pipe_blocks/.*(wo|down_proj)/kernel$", P("pp", "tp")),
         (r"pipe_blocks/", P("pp")),
         # MoE (ops/moe.py): experts stacked on dim 0 shard over ep; inner
         # dims follow the dense-MLP tp/fsdp convention. Router replicated.

@@ -124,8 +124,72 @@ def test_gpipe_rejects_indivisible_layers(pp_mesh):
         gpipe_apply(_toy_block, W, x, pos, mesh=pp_mesh, n_microbatches=4)
 
 
-def test_pipeline_rejects_tp(devices):
-    mesh = make_mesh(MeshConfig(dp=1, tp=2, pp=4))
+def test_pipeline_rejects_sp(devices):
+    mesh = make_mesh(MeshConfig(dp=1, sp=2, pp=4))
     W, x, pos, *_ = _toy_inputs(make_mesh(MeshConfig(dp=2, pp=4)))
     with pytest.raises(NotImplementedError):
         gpipe_apply(_toy_block, W, x, pos, mesh=mesh, n_microbatches=4)
+
+
+def _train_losses(mesh_cfg, extra=None, steps=3):
+    ov = dict(pipeline=True, pipeline_microbatches=4, n_layers=4)
+    ov.update(extra or {})
+    cfg = ExperimentConfig(
+        model="llama_tiny", model_overrides=ov, mesh=mesh_cfg,
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.1),
+        train=TrainConfig(batch_size=16), data=DataConfig(seq_len=32))
+    trainer = build_trainer(cfg)
+    state = trainer.init()
+    src = iter(SyntheticSource(trainer.bundle.make_batch, cfg.data, 16,
+                               seed=0))
+    batch = trainer.shard_batch(next(src))
+    for _ in range(steps):
+        state, metrics = trainer.step(state, batch)
+    return float(jax.device_get(metrics["loss"]))
+
+
+def test_pp_tp_train_step_matches_dp(devices):
+    """VERDICT round 1 item 8: a pp=2 x tp=2 llama step must track the dp
+    golden model — Megatron-style manual tp inside pipeline stages."""
+    l_dp = _train_losses(MeshConfig(dp=8))
+    l_tp = _train_losses(MeshConfig(dp=2, pp=2, tp=2))
+    assert abs(l_dp - l_tp) < 5e-3, (l_dp, l_tp)
+
+
+def test_interleaved_schedule_matches_dp(devices):
+    """The interleaved (V=2) circular schedule trains the same model as the
+    sequential golden (which replays the pinned layer order)."""
+    extra = dict(pipeline_interleave=2, pipeline_stages=2)
+    l_dp = _train_losses(MeshConfig(dp=8), extra)
+    l_iv = _train_losses(MeshConfig(dp=4, pp=2), extra)
+    l_iv_tp = _train_losses(MeshConfig(dp=2, pp=2, tp=2), extra)
+    assert abs(l_dp - l_iv) < 5e-3, (l_dp, l_iv)
+    assert abs(l_dp - l_iv_tp) < 5e-3, (l_dp, l_iv_tp)
+
+
+def test_interleave_needs_pinned_stages(devices):
+    with pytest.raises(ValueError, match="pipeline_stages"):
+        _train_losses(MeshConfig(dp=4, pp=2),
+                      dict(pipeline_interleave=2), steps=1)
+
+
+def test_interleave_needs_enough_microbatches(pp_mesh):
+    W, x, pos, W_s, x_s, pos_s = _toy_inputs(pp_mesh)
+    with pytest.raises(ValueError, match="n_microbatches >= pp"):
+        gpipe_apply(_toy_block, W_s, x_s, pos_s, mesh=pp_mesh,
+                    n_microbatches=2, n_virtual=2)
+
+
+def test_interleaved_toy_matches_permuted_sequential(pp_mesh):
+    """V=2 over the toy block: pipeline output equals sequential application
+    in the schedule's layer order."""
+    from serverless_learn_tpu.parallel.pipeline import layer_execution_order
+
+    W, x, pos, W_s, x_s, pos_s = _toy_inputs(pp_mesh)
+    order = layer_execution_order(8, 4, 2)
+    ref = jax.jit(lambda w, h, p: sequential_apply(
+        _toy_block, w, h, p, layer_order=order))(W, x, pos)
+    out = jax.jit(lambda w, h, p: gpipe_apply(
+        _toy_block, w, h, p, mesh=pp_mesh, n_microbatches=4,
+        n_virtual=2))(W_s, x_s, pos_s)
+    assert jnp.allclose(ref, jax.device_get(out), atol=1e-5)
