@@ -356,9 +356,16 @@ def _bwd_dkv_kernel(*refs, scale: float, causal: bool, mask_mode: str):
 
 def _flash_bwd_bhsd(q, k, v, mask_arg, mask_mode, lse, g, out, *,
                     causal: bool, block_q: int, block_k: int,
-                    interpret: bool):
+                    interpret: bool, g_lse=None):
     """Pallas backward. q,g,out [B,H,T,D]; k,v [B,K,S,D]. Returns
-    (dq [B,H,T,D], dk, dv [B,K,S,D])."""
+    (dq [B,H,T,D], dk, dv [B,K,S,D]).
+
+    ``g_lse`` is the cotangent of the forward's logsumexp output (same
+    [B, H, n_q, block_q] layout), for callers that consume lse (ring
+    attention's cross-hop merge). It folds into the existing kernels for
+    free: d lse_i / d s_ij = p_ij, so the ds term p*(dp - delta) becomes
+    p*(dp - delta + g_lse) — i.e. delta_eff = delta - g_lse.
+    """
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, T, D = q.shape
@@ -368,6 +375,8 @@ def _flash_bwd_bhsd(q, k, v, mask_arg, mask_mode, lse, g, out, *,
     # delta = rowsum(dO * O), laid out like lse: [B, H, n_q, block_q].
     delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
     delta = delta.reshape(B, H, T // block_q, block_q)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     sf, sb, af, ab = _mask_operand(mask_arg, mask_mode, B, S, block_k)
 
     qspec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
@@ -453,6 +462,41 @@ def _flash_core_bwd(mask_mode, causal, block_q, block_k, interpret, res, g):
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_with_lse_bhsd(q, k, v, causal, block_q, block_k, interpret):
+    """Forward flash in [B,H,T,D]/[B,K,S,D] layout returning BOTH the
+    output and the logsumexp [B, H, T] — the building block ring attention
+    merges across hops. Differentiable in q/k/v including through lse
+    (the lse cotangent folds into the backward's delta, see
+    ``_flash_bwd_bhsd``). No mask modes: ring hops mask by hop
+    visibility, outside the kernel."""
+    out_lse, _ = _flash_with_lse_fwd(q, k, v, causal, block_q, block_k,
+                                     interpret)
+    return out_lse
+
+
+def _flash_with_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_bhsd(q, k, v, None, "none", causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               interpret=interpret)
+    B, H, T, _ = q.shape
+    return (out, lse.reshape(B, H, T)), (q, k, v, out, lse)
+
+
+def _flash_with_lse_bwd(causal, block_q, block_k, interpret, res, cts):
+    q, k, v, out, lse = res
+    g_out, g_lse = cts
+    B, H, T, _ = q.shape
+    dq, dk, dv = _flash_bwd_bhsd(
+        q, k, v, None, "none", lse, g_out, out, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        g_lse=g_lse.reshape(B, H, T // block_q, block_q))
+    return dq, dk, dv
+
+
+flash_with_lse_bhsd.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
 
 
 def as_kv_mask(mask: Optional[jax.Array], B: int, S: int

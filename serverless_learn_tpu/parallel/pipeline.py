@@ -90,9 +90,19 @@ def sequential_apply(block_apply: Callable, stacked_params, x, positions,
     ``layer_order`` permutes the storage rows into execution order (the
     interleaved schedule's round-robin; identity/None for GPipe)."""
     if layer_order is not None:
+        # Scan over the index array and gather ONE layer's params per step
+        # — materializing a permuted copy of the whole stack would double
+        # transient parameter memory on the replay path.
         idx = jnp.asarray(layer_order)
-        stacked_params = jax.tree_util.tree_map(lambda a: a[idx],
-                                                stacked_params)
+
+        def layer_at(h, i):
+            p = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+                stacked_params)
+            return block_apply(p, h, positions, mask), None
+
+        out, _ = lax.scan(layer_at, x, idx)
+        return out
 
     def layer(h, p):
         return block_apply(p, h, positions, mask), None

@@ -4,9 +4,30 @@ Long-context support the reference never had (its "model" is a flat double
 vector, ``src/protos/serverless_learn.proto:81-83``; SURVEY.md §5 records
 long-context as absent). Design: the sequence dimension is sharded over the
 ``sp`` axis; each device holds a [B, T/n, H, D] shard of Q and streams K/V
-shards around an ICI ring with ``lax.ppermute`` while maintaining online
-(flash-style) softmax statistics, so the full [T, T] score matrix never
+shards around an ICI ring with ``lax.ppermute`` while merging per-hop
+softmax statistics online, so the full [T, T] score matrix never
 materializes and each hop is nearest-neighbor.
+
+Round-2 redesign (VERDICT round 1 item 9):
+
+* Each hop runs the BLOCKED flash kernel (``flash_with_lse_bhsd``) on the
+  resident K/V shard instead of a dense [T_loc, T_loc] fp32 einsum —
+  per-device attention memory drops from O(T_loc^2) to O(T_loc x block),
+  which is the entire point at 32k+ context. Hops combine by logsumexp
+  merge of (out, lse); the merge is differentiable and the kernel's custom
+  VJP folds the lse cotangent into its existing backward.
+* GQA K/V stay UNEXPANDED on the wire: the ring carries [B, T_loc, K, D]
+  shards (K = kv heads), cutting ring traffic by H/K; the flash kernel
+  reads the shared head through its BlockSpec index map, and the dense
+  fallback uses a grouped einsum.
+* Shapes the kernel can't tile (T_loc not 128-divisible) or non-TPU/CPU
+  backends fall back to a grouped-dense hop — same math, old memory.
+
+Causal masking across hops: the diagonal hop runs the kernel's causal
+mask; every other hop is either fully visible or fully hidden (contiguous
+shards), so its contribution is gated in the merge by hop visibility.
+Hidden hops still compute (the schedule is static) — the classic ring
+causal load imbalance; a zigzag layout would fix it and is future work.
 
 Works inside ``jit``: the public entry wraps the per-shard kernel in
 ``shard_map`` over the active mesh (registered by ``build_trainer``), so the
@@ -15,6 +36,7 @@ same model code runs sp=1 (no-op) or sp=N by changing the mesh shape.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional
 
@@ -44,66 +66,131 @@ def get_active_mesh() -> Optional[Mesh]:
     return _ACTIVE_MESH
 
 
+def _dense_hop(q, k, v, *, causal: bool, scale: float):
+    """Grouped-dense hop: (normalized out [B,T,H,D], lse [B,H,T]) without
+    expanding GQA K/V. Fallback for shapes the flash kernel can't tile."""
+    B, T, H, D = q.shape
+    K = k.shape[2]
+    G = H // K
+    qg = q.reshape(B, T, K, G, D)
+    s = jnp.einsum("btkgd,bskd->bkgts", qg.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    s = s.reshape(B, H, T, T)
+    if causal:
+        keep = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(keep[None, None], s, _NEG)
+    m = s.max(axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = p.sum(axis=-1)
+    pk = p.reshape(B, K, G, T, T)
+    o = jnp.einsum("bkgts,bskd->btkgd", pk, v.astype(jnp.float32))
+    o = o.reshape(B, T, H, D) / jnp.maximum(l, 1e-30).transpose(
+        0, 2, 1)[..., None]
+    return o, m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _flash_hop(q, k, v, *, causal: bool, block: int, interpret: bool):
+    """Blocked hop via the Pallas kernel (GQA through the index map)."""
+    from serverless_learn_tpu.ops.pallas.flash_attention import (
+        flash_with_lse_bhsd)
+
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out, lse = flash_with_lse_bhsd(qt, kt, vt, causal, block, block,
+                                   interpret)
+    return out.transpose(0, 2, 1, 3).astype(jnp.float32), lse
+
+
+def _merge(o, lse, o_h, lse_h):
+    """Combine two normalized partial attentions by their logsumexps."""
+    m = jnp.maximum(lse, lse_h)
+    a = jnp.exp(lse - m)
+    b = jnp.exp(lse_h - m)
+    denom = jnp.maximum(a + b, 1e-30)
+    w_a = (a / denom).transpose(0, 2, 1)[..., None]  # [B,T,H,1]
+    w_b = (b / denom).transpose(0, 2, 1)[..., None]
+    return o * w_a + o_h * w_b, m + jnp.log(denom)
+
+
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
-                          softmax_scale: float):
-    """Per-device kernel. q,k,v: local shards [B, T_loc, H, D] (kv heads
-    already expanded to H). Sequence blocks are contiguous in axis order."""
+                          hop_fn):
+    """Per-device kernel. q [B, T_loc, H, D]; k,v [B, T_loc, K, D] — GQA
+    K/V ride the ring unexpanded. Sequence blocks are contiguous in axis
+    order."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
-    B, T, H, D = q.shape
-    qf = q.astype(jnp.float32)
-    q_pos = idx * T + jnp.arange(T)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Hop 0: the resident (diagonal) block — the only hop where causal
+    # masking is positional rather than all-or-nothing.
+    o, lse = hop_fn(q, k, v, causal=causal)
 
     def step(carry, s):
-        o, m, l, k_cur, v_cur = carry
-        block_idx = (idx - s) % n
-        scores = jnp.einsum("bthd,bshd->bhts", qf,
-                            k_cur.astype(jnp.float32)) * softmax_scale
+        o, lse, k_cur, v_cur = carry
+        # Rotate first: hop s sees the block that started s devices behind.
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        o_h, lse_h = hop_fn(q, k_cur, v_cur, causal=False)
         if causal:
-            kv_pos = block_idx * T + jnp.arange(T)
-            keep = kv_pos[None, :] <= q_pos[:, None]
-            scores = jnp.where(keep[None, None], scores, _NEG)
-        m_new = jnp.maximum(m, scores.max(axis=-1))
-        p = jnp.exp(scores - m_new[..., None])
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + p.sum(axis=-1)
-        o_new = o * alpha[..., None] + jnp.einsum(
-            "bhts,bshd->bhtd", p, v_cur.astype(jnp.float32))
-        # Rotate K/V one hop around the ring (nearest-neighbor on ICI).
-        perm = [(i, (i + 1) % n) for i in range(n)]
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+            # Contiguous shards: an off-diagonal block is fully visible iff
+            # it lies before this device's block. Hidden hops contribute
+            # -inf lse, which the merge zero-weights.
+            block_idx = (idx - s) % n
+            visible = block_idx < idx
+            lse_h = jnp.where(visible, lse_h, _NEG)
+        o, lse = _merge(o, lse, o_h, lse_h)
+        return (o, lse, k_cur, v_cur), None
 
-    o0 = jnp.zeros((B, H, T, D), jnp.float32)
-    m0 = jnp.full((B, H, T), _NEG, jnp.float32)
-    l0 = jnp.zeros((B, H, T), jnp.float32)
-    (o, m, l, _, _), _ = jax.lax.scan(
-        step, (o0, m0, l0, k, v), jnp.arange(n))
-    out = o / jnp.maximum(l[..., None], 1e-30)
-    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+    if n > 1:
+        (o, lse, _, _), _ = jax.lax.scan(
+            step, (o, lse, k, v), jnp.arange(1, n))
+    return o.astype(q.dtype)
 
 
 def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
                    mesh: Optional[Mesh] = None):
     """Sequence-parallel attention. q [B,T,H,D], k/v [B,T,K,D] (global
     logical shapes; T sharded over ``axis_name``)."""
+    from serverless_learn_tpu.ops.pallas.flash_attention import _pick_block
+
     mesh = mesh or _ACTIVE_MESH
     if mesh is None:
         raise RuntimeError(
             "ring_attention needs an active mesh; call set_active_mesh() "
             "(build_trainer does this automatically)")
     H, K = q.shape[2], k.shape[2]
-    if K != H:  # GQA: expand KV heads so the ring carries uniform shards
-        k = jnp.repeat(k, H // K, axis=2)
-        v = jnp.repeat(v, H // K, axis=2)
-    softmax_scale = q.shape[-1] ** -0.5
-    spec = P(("dp", "fsdp"), axis_name, "tp", None)
+    if H % K:
+        raise ValueError(f"n_heads {H} not divisible by kv_heads {K}")
+    scale = q.shape[-1] ** -0.5
+    n = mesh.shape[axis_name]
+    T_loc = q.shape[1] // n
+    backend = jax.default_backend()
+    block = _pick_block(T_loc)
+    use_flash = (block is not None
+                 and (backend in ("cpu", "tpu")
+                      or os.environ.get("SLT_FORCE_PALLAS")))
+    if use_flash:
+        hop_fn = partial(_flash_hop, block=block,
+                         interpret=backend == "cpu")
+    else:
+        hop_fn = partial(_dense_hop, scale=scale)
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1 and K > 1 and K % tp:
+        # Replicating kv over tp here would silently mis-group: each tp
+        # member's LOCAL q heads are a slice of the global heads, but the
+        # hop kernels derive the q->kv grouping from local indices starting
+        # at kv head 0. MQA (K == 1) is the only safe replication.
+        raise NotImplementedError(
+            f"ring attention with tp={tp} needs kv_heads ({K}) divisible "
+            f"by tp (or kv_heads == 1)")
+    qspec = P(("dp", "fsdp"), axis_name, "tp", None)
+    kvspec = P(("dp", "fsdp"), axis_name, "tp" if K > 1 else None, None)
     fn = _shard_map(
         partial(_ring_attention_local, axis_name=axis_name, causal=causal,
-                softmax_scale=softmax_scale),
+                hop_fn=hop_fn),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+        in_specs=(qspec, kvspec, kvspec),
+        out_specs=qspec,
     )
     return fn(q, k, v)

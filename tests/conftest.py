@@ -118,6 +118,9 @@ SLOW_TESTS = {
     "tests/test_pipeline.py::test_interleaved_schedule_matches_dp",
     "tests/test_pipeline.py::test_interleaved_toy_matches_permuted_sequential",
     "tests/test_ring_attention.py::test_llama_trains_with_sp_axis",
+    "tests/test_ring_attention.py::test_ring_flash_hops_selected_and_match",
+    "tests/test_ring_attention.py::test_ring_flash_hops_gqa_unexpanded",
+    "tests/test_ring_attention.py::test_ring_flash_hops_noncausal_grad",
     "tests/test_ring_attention.py::test_ring_grad_matches_dense",
     "tests/test_ring_attention.py::test_ring_matches_dense_gqa",
     "tests/test_serve.py::test_serve_matches_direct_generate",

@@ -151,8 +151,10 @@ def test_bert_step_executes_flash_path(devices):
     jaxpr = str(jax.make_jaxpr(jax.grad(loss))(state.params))
     assert "pallas_call" in jaxpr, \
         "masked BERT fwd+bwd must lower through the flash kernels"
-    # and it trains without NaNs through the masked backward
-    g = jax.grad(loss)(state.params)
+    # and it trains without NaNs through the masked backward (jitted: the
+    # eager op-by-op dispatch of this graph has aborted the CPU backend
+    # with memory churn on the 8-device mesh)
+    g = jax.jit(jax.grad(loss))(state.params)
     assert not any(bool(jnp.isnan(x).any())
                    for x in jax.tree_util.tree_leaves(g))
 

@@ -100,3 +100,56 @@ def test_llama_trains_with_sp_axis(devices):
     l_ring = run(MeshConfig(dp=2, sp=4),
                  dict(base, attention_impl="ring"))
     np.testing.assert_allclose(l_dense, l_ring, rtol=2e-4)
+
+
+@pytest.fixture()
+def sp2_mesh(devices):
+    """sp=2 with T=256 gives T_loc=128 — large enough for the blocked
+    (flash) hop path instead of the dense fallback."""
+    mesh = make_mesh(MeshConfig(dp=4, sp=2))
+    set_active_mesh(mesh)
+    yield mesh
+    set_active_mesh(None)
+
+
+def test_ring_flash_hops_selected_and_match(sp2_mesh):
+    """VERDICT round 1 item 9: hops must run the blocked Pallas kernel
+    (O(T_loc x block) memory), proven on the jaxpr, with dense parity."""
+    q, k, v = _qkv(jax.random.PRNGKey(3), 4, 256, 4, 64)
+    fn = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, causal=True, mesh=sp2_mesh))
+    jaxpr = str(jax.make_jaxpr(fn)(q, k, v))
+    assert "pallas_call" in jaxpr, "ring hops must use the flash kernel"
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(fn(q, k, v)), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_flash_hops_gqa_unexpanded(sp2_mesh):
+    """GQA K/V ride the ring unexpanded; the kernel's index map reads the
+    shared head. Parity + gradient against the dense reference."""
+    q, k, v = _qkv(jax.random.PRNGKey(4), 4, 256, 8, 64, K=2)
+    ref = xla_attention(q, k, v, causal=True)
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, causal=True, mesh=sp2_mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    gf = jax.jit(jax.grad(lambda q, k, v: ring_attention(
+        q, k, v, causal=True, mesh=sp2_mesh).sum(), (0, 1, 2)))(q, k, v)
+    gr = jax.grad(lambda q, k, v: xla_attention(
+        q, k, v, causal=True).astype(jnp.float32).sum(), (0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4, err_msg=f"d{name}")
+
+
+def test_ring_flash_hops_noncausal_grad(sp2_mesh):
+    q, k, v = _qkv(jax.random.PRNGKey(5), 4, 256, 4, 64)
+    gf = jax.jit(jax.grad(lambda q, k, v: ring_attention(
+        q, k, v, causal=False, mesh=sp2_mesh).sum(), (0, 1, 2)))(q, k, v)
+    gr = jax.grad(lambda q, k, v: xla_attention(
+        q, k, v, causal=False).astype(jnp.float32).sum(), (0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4, err_msg=f"d{name}")
