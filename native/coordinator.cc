@@ -16,16 +16,29 @@
 //    (src/master.cc:95-114); that entire plane moved to XLA collectives.
 //
 // Usage: coordinator [--port 50052] [--lease_ttl_ms 5000] [--sweep_ms 500]
+//                    [--state_file PATH]
+//
+// --state_file makes membership durable: every change snapshots
+// {next_id, epoch, workers} to PATH (atomic tmp+rename), and a restarted
+// coordinator resumes the same epoch and worker ids — heartbeating workers
+// carry on without re-registration or a spurious re-mesh. Restored workers
+// get one fresh lease of grace to heartbeat before the sweeper may evict
+// them. SIGTERM/SIGINT shut down gracefully: stop accepting, join the
+// sweeper, flush the final snapshot.
 
 #include <atomic>
+#include <csignal>
 #include <cstdarg>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <map>
 #include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
+
+#include <unistd.h>
 
 #include "framing.h"
 #include "log.h"
@@ -56,7 +69,10 @@ uint64_t now_ms() {
 
 class Coordinator {
  public:
-  Coordinator(uint32_t lease_ttl_ms) : lease_ttl_ms_(lease_ttl_ms) {}
+  Coordinator(uint32_t lease_ttl_ms, std::string state_file = "")
+      : lease_ttl_ms_(lease_ttl_ms), state_file_(std::move(state_file)) {
+    LoadState();
+  }
 
   slt::RegisterReply Register(const slt::RegisterRequest& req) {
     std::lock_guard<std::mutex> lk(mu_);
@@ -85,6 +101,7 @@ class Coordinator {
     WorkerRec rec{id, req.addr(), req.name(), req.n_chips(), now_ms()};
     workers_[id] = rec;
     epoch_++;
+    SaveStateLocked();
     slt::log_info("coord", "register worker=%llu addr=%s name=%s epoch=%llu",
                   (unsigned long long)id, req.addr().c_str(),
                   req.name().c_str(), (unsigned long long)epoch_);
@@ -127,6 +144,7 @@ class Coordinator {
                     (unsigned long long)(epoch_ + 1));
       workers_.erase(it);
       epoch_++;
+      SaveStateLocked();
       ack.set_ok(true);
     } else {
       ack.set_ok(false);
@@ -176,9 +194,15 @@ class Coordinator {
     }
     if (changed) {
       epoch_++;
+      SaveStateLocked();
       slt::log_info("coord", "membership epoch -> %llu (%zu workers)",
                     (unsigned long long)epoch_, workers_.size());
     }
+  }
+
+  void Flush() {
+    std::lock_guard<std::mutex> lk(mu_);
+    SaveStateLocked();
   }
 
  private:
@@ -193,11 +217,79 @@ class Coordinator {
     }
   }
 
+  // Snapshot the registry to --state_file (atomic tmp+rename). Runs under
+  // mu_ on every membership change — a small synchronous write; membership
+  // churn is control-plane rate, not data-plane rate, so durability is
+  // worth the syscall.
+  void SaveStateLocked() {
+    if (state_file_.empty()) return;
+    slt::CoordinatorState st;
+    st.set_next_id(next_id_);
+    st.set_epoch(epoch_);
+    FillPeersLocked(st.mutable_peers());
+    std::string blob;
+    st.SerializeToString(&blob);
+    std::string tmp = state_file_ + ".tmp";
+    FILE* f = ::fopen(tmp.c_str(), "wb");
+    if (!f) {
+      slt::log_error("coord", "cannot write state file %s", tmp.c_str());
+      return;
+    }
+    // Every step checked, fsync before rename: a short write (disk full)
+    // or power loss must never replace the last GOOD snapshot with a
+    // truncated one — protobuf would parse a truncation as a valid prefix
+    // and silently restore a smaller membership.
+    size_t wrote = ::fwrite(blob.data(), 1, blob.size(), f);
+    bool ok = (wrote == blob.size()) && (::fflush(f) == 0) &&
+              (::fsync(::fileno(f)) == 0);
+    ok = (::fclose(f) == 0) && ok;
+    if (!ok) {
+      slt::log_error("coord", "short write to %s; keeping previous snapshot",
+                     tmp.c_str());
+      ::unlink(tmp.c_str());
+      return;
+    }
+    if (::rename(tmp.c_str(), state_file_.c_str()) != 0)
+      slt::log_error("coord", "cannot commit state file %s",
+                     state_file_.c_str());
+  }
+
+  void LoadState() {
+    if (state_file_.empty()) return;
+    FILE* f = ::fopen(state_file_.c_str(), "rb");
+    if (!f) return;  // first boot
+    std::string blob;
+    char buf[4096];
+    size_t n;
+    while ((n = ::fread(buf, 1, sizeof(buf), f)) > 0) blob.append(buf, n);
+    ::fclose(f);
+    slt::CoordinatorState st;
+    if (!st.ParseFromString(blob)) {
+      slt::log_error("coord", "state file %s is corrupt; starting fresh",
+                     state_file_.c_str());
+      return;
+    }
+    next_id_ = st.next_id();
+    epoch_ = st.epoch();
+    // A full lease of grace: restored workers must get a chance to
+    // heartbeat before the sweeper may judge them dead.
+    uint64_t seen = now_ms();
+    for (const auto& p : st.peers()) {
+      WorkerRec rec{p.worker_id(), p.addr(), p.name(), p.n_chips(), seen};
+      workers_[p.worker_id()] = rec;
+    }
+    slt::log_info("coord",
+                  "restored state: epoch=%llu next_id=%llu workers=%zu",
+                  (unsigned long long)epoch_, (unsigned long long)next_id_,
+                  workers_.size());
+  }
+
   std::mutex mu_;
   std::map<uint64_t, WorkerRec> workers_;
   uint64_t next_id_ = 1;
   uint64_t epoch_ = 0;
   const uint32_t lease_ttl_ms_;
+  const std::string state_file_;
 };
 
 void serve_conn(Coordinator* coord, int fd) {
@@ -256,34 +348,72 @@ void serve_conn(Coordinator* coord, int fd) {
   ::close(fd);
 }
 
+std::atomic<bool> g_stop{false};
+
+void handle_signal(int) { g_stop.store(true); }
+
 }  // namespace
 
 int main(int argc, char** argv) {
   int port = 50052;
   uint32_t lease_ttl_ms = 5000;
   uint32_t sweep_ms = 500;
+  std::string state_file;
   for (int i = 1; i < argc - 1; i++) {
     if (!strcmp(argv[i], "--port")) port = atoi(argv[++i]);
     else if (!strcmp(argv[i], "--lease_ttl_ms")) lease_ttl_ms = atoi(argv[++i]);
     else if (!strcmp(argv[i], "--sweep_ms")) sweep_ms = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--state_file")) state_file = argv[++i];
   }
-  Coordinator coord(lease_ttl_ms);
+  // Heap-allocated and deliberately leaked: detached connection threads
+  // may still hold the pointer when main returns — destroying the
+  // coordinator (and its mutex) under them would be use-after-free. The
+  // process is exiting anyway; any thread killed mid-snapshot leaves only
+  // a .tmp file behind (the committed snapshot is rename-atomic).
+  Coordinator* coord = new Coordinator(lease_ttl_ms, state_file);
   int lfd = slt::listen_on(port);
   if (lfd < 0) {
     slt::log_error("coord", "cannot listen on port %d", port);
     return 1;
   }
-  slt::log_info("coord", "listening on :%d lease_ttl=%ums", port, lease_ttl_ms);
-  std::thread sweeper([&coord, sweep_ms] {
-    while (true) {
+  // Deliver SIGTERM/SIGINT to the MAIN thread only: the kernel may pick
+  // any unblocking thread, and only main's blocking accept() is
+  // EINTR-interruptible by the handler. Spawned threads inherit the
+  // blocked mask.
+  sigset_t sigs;
+  sigemptyset(&sigs);
+  sigaddset(&sigs, SIGTERM);
+  sigaddset(&sigs, SIGINT);
+  pthread_sigmask(SIG_BLOCK, &sigs, nullptr);
+  struct sigaction sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_handler = handle_signal;  // no SA_RESTART: accept must EINTR
+  sigaction(SIGTERM, &sa, nullptr);
+  sigaction(SIGINT, &sa, nullptr);
+  slt::log_info("coord", "listening on :%d lease_ttl=%ums%s%s", port,
+                lease_ttl_ms, state_file.empty() ? "" : " state_file=",
+                state_file.c_str());
+  std::thread sweeper([coord, sweep_ms] {
+    while (!g_stop.load()) {
       std::this_thread::sleep_for(std::chrono::milliseconds(sweep_ms));
-      coord.Sweep();
+      coord->Sweep();
     }
   });
-  sweeper.detach();
-  while (true) {
+  pthread_sigmask(SIG_UNBLOCK, &sigs, nullptr);  // main thread only
+  while (!g_stop.load()) {
     int fd = ::accept(lfd, nullptr, nullptr);
-    if (fd < 0) continue;
-    std::thread(serve_conn, &coord, fd).detach();
+    if (fd < 0) {
+      if (g_stop.load()) break;
+      continue;
+    }
+    std::thread(serve_conn, coord, fd).detach();
   }
+  ::close(lfd);
+  // Graceful shutdown: join the sweeper, flush the final snapshot. (Every
+  // membership change snapshots itself, so even a post-flush registration
+  // race is persisted by its own Register call.)
+  sweeper.join();
+  coord->Flush();
+  slt::log_info("coord", "shut down cleanly");
+  return 0;
 }

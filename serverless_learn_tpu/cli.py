@@ -418,10 +418,12 @@ def _exec_daemon(binary: str, argv: List[str]) -> int:
 
 
 def cmd_coordinator(args) -> int:
-    return _exec_daemon("coordinator", [
-        "--port", str(args.port),
-        "--lease_ttl_ms", str(args.lease_ttl_ms),
-        "--sweep_ms", str(args.sweep_ms)])
+    argv = ["--port", str(args.port),
+            "--lease_ttl_ms", str(args.lease_ttl_ms),
+            "--sweep_ms", str(args.sweep_ms)]
+    if args.state_file:
+        argv += ["--state_file", args.state_file]
+    return _exec_daemon("coordinator", argv)
 
 
 def cmd_shard_server(args) -> int:
@@ -549,6 +551,10 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--port", type=int, default=50052)
     c.add_argument("--lease-ttl-ms", type=int, default=5000)
     c.add_argument("--sweep-ms", type=int, default=500)
+    c.add_argument("--state-file", default=None,
+                   help="persist membership here: a restarted coordinator "
+                        "resumes the same epoch and worker ids, so "
+                        "heartbeating workers carry on without re-mesh churn")
     c.set_defaults(fn=cmd_coordinator)
 
     s = sub.add_parser("shard-server", help="run the data-plane daemon")

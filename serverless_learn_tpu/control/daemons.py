@@ -28,12 +28,15 @@ def _wait_port(port: int, host: str = "127.0.0.1", timeout: float = 10.0):
 
 
 def start_coordinator(port: int = 50052, lease_ttl_ms: int = 5000,
-                      sweep_ms: int = 200) -> subprocess.Popen:
+                      sweep_ms: int = 200,
+                      state_file: Optional[str] = None) -> subprocess.Popen:
     assert ensure_native_built(), "native build failed"
-    proc = subprocess.Popen(
-        [os.path.join(_BIN, "coordinator"), "--port", str(port),
-         "--lease_ttl_ms", str(lease_ttl_ms), "--sweep_ms", str(sweep_ms)],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    cmd = [os.path.join(_BIN, "coordinator"), "--port", str(port),
+           "--lease_ttl_ms", str(lease_ttl_ms), "--sweep_ms", str(sweep_ms)]
+    if state_file:
+        cmd += ["--state_file", state_file]
+    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
     _wait_port(port)
     return proc
 

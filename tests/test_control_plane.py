@@ -215,3 +215,48 @@ def test_agent_fenced_out_when_name_taken_over(coordinator):
     assert agent.fatal is not None and "already held" in agent.fatal
     agent.stop(deregister=False)
     c.close()
+
+
+def test_coordinator_state_survives_restart(tmp_path):
+    """--state_file durability (round-1 backlog: 'a restart loses all
+    leases/epochs'): a SIGTERM'd coordinator restarts with the same epoch
+    and worker ids, so existing workers' heartbeats remain valid and new
+    registrations never reuse an id."""
+    from serverless_learn_tpu.control.daemons import start_coordinator
+
+    state = str(tmp_path / "coord.state")
+    port = _free_port()
+    proc = start_coordinator(port=port, lease_ttl_ms=60000, sweep_ms=200,
+                             state_file=state)
+    addr = f"127.0.0.1:{port}"
+    try:
+        c = CoordinatorClient(addr)
+        r1 = c.register("w:1", name="alpha", n_chips=2)
+        r2 = c.register("w:2", name="beta", n_chips=4)
+        epoch_before = c.membership().epoch
+        c.close()
+    finally:
+        proc.terminate()
+        assert proc.wait(timeout=5) == 0, "SIGTERM must exit cleanly"
+
+    proc = start_coordinator(port=port, lease_ttl_ms=60000, sweep_ms=200,
+                             state_file=state)
+    try:
+        c = CoordinatorClient(addr)
+        m = c.membership()
+        assert m.epoch == epoch_before
+        assert sorted(p.worker_id for p in m.peers) == [r1.worker_id,
+                                                        r2.worker_id]
+        assert sorted(p.name for p in m.peers) == ["alpha", "beta"]
+        # an existing worker's id is still honored
+        assert c.heartbeat(r1.worker_id, 7, 0.1, 0).ok
+        # ids keep monotonically increasing across the restart
+        r3 = c.register("w:3", name="gamma", n_chips=1)
+        assert r3.worker_id > r2.worker_id
+        # exclusive names are still enforced against restored workers
+        refused = c.register("w:4", name="alpha", exclusive_name=True)
+        assert not refused.ok
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
