@@ -262,7 +262,15 @@ class Coordinator {
     char buf[4096];
     size_t n;
     while ((n = ::fread(buf, 1, sizeof(buf), f)) > 0) blob.append(buf, n);
+    bool read_err = ::ferror(f) != 0;
     ::fclose(f);
+    if (read_err) {
+      // A short read would protobuf-parse as a valid PREFIX (fewer
+      // workers, stale next_id -> id reuse) — refuse it like corruption.
+      slt::log_error("coord", "I/O error reading %s; starting fresh",
+                     state_file_.c_str());
+      return;
+    }
     slt::CoordinatorState st;
     if (!st.ParseFromString(blob)) {
       slt::log_error("coord", "state file %s is corrupt; starting fresh",
@@ -350,8 +358,6 @@ void serve_conn(Coordinator* coord, int fd) {
 
 std::atomic<bool> g_stop{false};
 
-void handle_signal(int) { g_stop.store(true); }
-
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -376,20 +382,25 @@ int main(int argc, char** argv) {
     slt::log_error("coord", "cannot listen on port %d", port);
     return 1;
   }
-  // Deliver SIGTERM/SIGINT to the MAIN thread only: the kernel may pick
-  // any unblocking thread, and only main's blocking accept() is
-  // EINTR-interruptible by the handler. Spawned threads inherit the
-  // blocked mask.
+  // Shutdown signals via the sigwait pattern: SIGTERM/SIGINT are blocked
+  // in EVERY thread (mask set before any thread exists and never
+  // unblocked, so connection threads can't steal a delivery), and one
+  // dedicated waiter thread sigwait()s, flips g_stop, and shutdown()s the
+  // listening socket — which reliably pops main out of a blocked
+  // accept() (unlike close() from another thread). No handler, no EINTR
+  // races.
   sigset_t sigs;
   sigemptyset(&sigs);
   sigaddset(&sigs, SIGTERM);
   sigaddset(&sigs, SIGINT);
   pthread_sigmask(SIG_BLOCK, &sigs, nullptr);
-  struct sigaction sa;
-  memset(&sa, 0, sizeof(sa));
-  sa.sa_handler = handle_signal;  // no SA_RESTART: accept must EINTR
-  sigaction(SIGTERM, &sa, nullptr);
-  sigaction(SIGINT, &sa, nullptr);
+  std::thread sigwaiter([lfd, &sigs] {
+    int sig = 0;
+    sigwait(&sigs, &sig);
+    g_stop.store(true);
+    ::shutdown(lfd, SHUT_RDWR);
+  });
+  sigwaiter.detach();  // blocked in sigwait at exit; nothing to join
   slt::log_info("coord", "listening on :%d lease_ttl=%ums%s%s", port,
                 lease_ttl_ms, state_file.empty() ? "" : " state_file=",
                 state_file.c_str());
@@ -399,7 +410,6 @@ int main(int argc, char** argv) {
       coord->Sweep();
     }
   });
-  pthread_sigmask(SIG_UNBLOCK, &sigs, nullptr);  // main thread only
   while (!g_stop.load()) {
     int fd = ::accept(lfd, nullptr, nullptr);
     if (fd < 0) {

@@ -195,6 +195,24 @@ def cmd_train(args) -> int:
         ckpt = _make_checkpointer(args)
         every = cfg.train.checkpoint_every
 
+        if cfg.local_sgd.outer:
+            # Gossip / DiLoCo outer-sync training over the dp replicas.
+            if world is not None:
+                raise SystemExit("local SGD is single-process (replicas are "
+                                 "the dp mesh axis)")
+            from serverless_learn_tpu.training.local_sgd import run_local_sgd
+
+            with (capture(args.profile_dir) if args.profile_dir
+                  else contextlib.nullcontext()):
+                state, meter = run_local_sgd(cfg, checkpointer=ckpt,
+                                             verbose=args.verbose)
+            summary = meter.steady_state()
+            log_json({"event": "done", "mode": f"local_sgd/{cfg.local_sgd.outer}",
+                      "final_step": int(jax.device_get(state.step)),
+                      **{k: round(v, 3) for k, v in summary.items()}},
+                     stream=sys.stdout)
+            return 0
+
         callback = None
         if ckpt is not None and every:
             def callback(step, state, stats):

@@ -124,6 +124,20 @@ class DataConfig:
 
 
 @dataclass(frozen=True)
+class LocalSGDConfig:
+    """Gossip / DiLoCo outer-sync training (training/local_sgd.py) — the
+    faithful TPU descendant of the reference's asynchronous model gossip
+    (``src/worker.cc:194-219``), selected per run instead of per code path.
+    """
+
+    outer: str = ""  # "" = disabled | "gossip" | "average" (DiLoCo)
+    inner_steps: int = 8  # local steps between outer syncs
+    mix_rate: float = 0.5  # gossip mix toward the partner (reference rate)
+    outer_lr: float = 0.7  # DiLoCo outer SGD learning rate
+    outer_momentum: float = 0.9
+
+
+@dataclass(frozen=True)
 class ControlConfig:
     """Control-plane endpoints & intervals.
 
@@ -147,6 +161,7 @@ class ExperimentConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     data: DataConfig = field(default_factory=DataConfig)
     control: ControlConfig = field(default_factory=ControlConfig)
+    local_sgd: LocalSGDConfig = field(default_factory=LocalSGDConfig)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
@@ -171,6 +186,7 @@ class ExperimentConfig:
             train=build(TrainConfig, raw.get("train")),
             data=build(DataConfig, raw.get("data")),
             control=build(ControlConfig, raw.get("control")),
+            local_sgd=build(LocalSGDConfig, raw.get("local_sgd")),
         )
 
     def override(self, **kwargs: Any) -> "ExperimentConfig":

@@ -97,6 +97,7 @@ SLOW_TESTS = {
     "tests/test_grad_accum_eval.py::test_run_eval_mean_metrics",
     "tests/test_grad_accum_eval.py::test_run_eval_streams_from_shard_server",
     "tests/test_local_sgd.py::test_replicas_diverge_then_gossip_reconverges",
+    "tests/test_local_sgd.py::test_run_local_sgd_integrated_with_checkpoint",
     "tests/test_moe.py::test_moe_aux_loss_reported",
     "tests/test_moe.py::test_moe_group_size_bounds_capacity_without_changing_math",
     "tests/test_moe.py::test_moe_init_state_has_no_losses_collection",
