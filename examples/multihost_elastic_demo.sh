@@ -1,0 +1,95 @@
+#!/usr/bin/env bash
+# Multi-host elastic training on one machine: three "hosts" (processes,
+# 2 virtual CPU devices each) form ONE SPMD world that re-forms as hosts
+# join and die — the full realization of the reference's "any process can
+# join anytime" (src/master.cc:79-91) under synchronous SPMD.
+#
+#   bash examples/multihost_elastic_demo.sh
+#
+# Timeline: hosts A+B form a 4-device world and train; host C joins
+# mid-run (world drains at an agreed step, checkpoints sharded, re-forms
+# with 6 devices); C is then SIGKILLed (lease eviction -> survivors'
+# supervisors kill their wedged inner trainers -> the next generation
+# restores the last committed checkpoint on 4 devices) and the run
+# completes. Watch the world reshape in the worker logs
+# ("world_formed" events) and the committed step advance in
+# $STORE/emh-demo/LATEST.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=2"
+# Pace the inner step loops so the join/kill phases land mid-run (the MLP
+# step is sub-second on CPU; unpaced, the first world finishes before
+# host C has even imported jax).
+export SLT_STEP_DELAY_S=0.35
+
+COORD_PORT=$(python -c "import socket; s=socket.socket(); s.bind(('',0)); print(s.getsockname()[1])")
+STORE=$(mktemp -d)
+trap 'kill -9 -- -$$ 2>/dev/null || true; rm -rf "$STORE"' EXIT
+
+make -C native -s
+native/bin/coordinator --port $COORD_PORT --lease_ttl_ms 1500 --sweep_ms 200 \
+    --state_file "$STORE/coord.state" &
+sleep 0.5
+
+worker() {  # worker <label> <min-hosts>
+  python -m serverless_learn_tpu worker --multihost demo --min-hosts "$2" \
+      --coordinator 127.0.0.1:$COORD_PORT --checkpoint-dir "$STORE" \
+      --model mlp_mnist --batch-size 96 --steps 60 \
+      --set model_overrides.features='[256]' \
+      --set model_overrides.num_classes=4 \
+      --set train.dtype=float32 --set train.param_dtype=float32 \
+      --set train.checkpoint_every=4 --set data.learnable=true \
+      --set control.heartbeat_interval_ms=200 --name "$1" -v
+}
+
+export COORD_PORT STORE
+export -f worker  # host C runs under setsid, which needs an exported fn
+
+worker A 2 2>"$STORE/A.log" & PA=$!
+worker B 2 2>"$STORE/B.log" & PB=$!
+
+# wait for committed world-2 progress, then add host C
+python - "$STORE" <<'PYEOF'
+import json, sys, time
+for _ in range(600):
+    try:
+        if json.load(open(sys.argv[1] + "/emh-demo/LATEST"))["step"] >= 8:
+            print("phase 1: world of 2 hosts made committed progress")
+            break
+    except Exception:
+        pass
+    time.sleep(0.2)
+else:
+    raise SystemExit("phase 1 never reached step 8")
+PYEOF
+
+setsid bash -c 'worker C 1' 2>"$STORE/C.log" & PC=$!
+
+# wait for the 3-host world to commit progress, then kill C's process tree
+python - "$STORE" <<'PYEOF'
+import json, sys, time
+base = None
+for _ in range(900):
+    try:
+        form = json.load(open(sys.argv[1] + "/emh-demo/FORM"))
+        step = json.load(open(sys.argv[1] + "/emh-demo/LATEST"))["step"]
+        if len(form["ids"]) == 3:
+            base = step if base is None else base
+            if step >= base + 4:
+                print("phase 2: world of 3 hosts formed and progressed")
+                break
+    except Exception:
+        pass
+    time.sleep(0.2)
+else:
+    raise SystemExit("phase 2: 3-host world never progressed")
+PYEOF
+
+kill -9 -- -"$PC" 2>/dev/null || true
+echo "phase 3: host C SIGKILLed; survivors re-form and finish"
+
+wait $PA $PB
+echo "=== A's world history ==="
+grep -E "world_formed|generation_done" "$STORE/A.log"
