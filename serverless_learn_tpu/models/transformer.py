@@ -359,6 +359,10 @@ class PipelinedBlocks(nn.Module):
         pp_live = mesh is not None and mesh.shape.get("pp", 1) > 1
         block_cfg = cfg
         param_specs = None
+        if cfg.n_experts > 0 and tp > 1:
+            raise NotImplementedError(
+                "pipeline + tp + MoE is unsupported: expert weights are "
+                "not tp-sliced by the pipeline's local-shape scheme")
         if pp_live and tp > 1:
             # Megatron-style manual tp inside the pipeline's shard_map:
             # each tp member applies a LOCAL slice of every layer (heads
@@ -382,9 +386,26 @@ class PipelinedBlocks(nn.Module):
 
             param_specs = jax.tree_util.tree_map_with_path(spec_of, stacked)
 
+        moe_aux = cfg.n_experts > 0
+
         def block_apply(p, h, pos, m):
-            fn = lambda pp_, h_, pos_, m_: Block(block_cfg).apply(
-                {"params": pp_}, h_, mask=m_, positions=pos_)
+            if moe_aux:
+                # Thread the MoE router loss out of the nested apply: the
+                # sow collection cannot cross a module.apply boundary, so
+                # each block returns its summed sown losses explicitly and
+                # the pipeline/sequential scan accumulates them.
+                def fn(pp_, h_, pos_, m_):
+                    out, mut = Block(block_cfg).apply(
+                        {"params": pp_}, h_, mask=m_, positions=pos_,
+                        mutable=["losses"])
+                    leaves = jax.tree_util.tree_leaves(
+                        mut.get("losses", {}))
+                    aux = (sum(jnp.sum(l) for l in leaves) if leaves
+                           else jnp.float32(0.0))
+                    return out, aux
+            else:
+                fn = lambda pp_, h_, pos_, m_: Block(block_cfg).apply(
+                    {"params": pp_}, h_, mask=m_, positions=pos_)
             if cfg.remat:
                 fn = jax.checkpoint(fn)
             return fn(p, h, pos, m)
@@ -405,16 +426,29 @@ class PipelinedBlocks(nn.Module):
         if mesh is None or mesh.shape.get("pp", 1) == 1:
             # Sequential path replays the exact layer order the interleaved
             # schedule trains with (identity for GPipe).
-            return sequential_apply(block_apply, stacked, x, positions, mask,
-                                    layer_order=order)
+            out = sequential_apply(block_apply, stacked, x, positions, mask,
+                                   layer_order=order, with_aux=moe_aux)
+            if moe_aux:
+                out, aux = out
+                self.sow("losses", "pipeline_moe_aux", aux)
+            return out
         if V > 1 and mesh.shape["pp"] != cfg.pipeline_stages:
             raise ValueError(
                 f"mesh pp={mesh.shape['pp']} != config pipeline_stages="
                 f"{cfg.pipeline_stages}; an interleaved checkpoint's layer "
                 "order is tied to its stage count")
-        return gpipe_apply(block_apply, stacked, x, positions, mask, mesh=mesh,
-                           n_microbatches=cfg.pipeline_microbatches,
-                           n_virtual=V, param_specs=param_specs)
+        out = gpipe_apply(block_apply, stacked, x, positions, mask,
+                          mesh=mesh,
+                          n_microbatches=cfg.pipeline_microbatches,
+                          n_virtual=V, param_specs=param_specs,
+                          with_aux=moe_aux)
+        if moe_aux:
+            out, aux = out
+            # aux carries one entry per batch shard; the mean over shards
+            # is the global router loss (shards saw disjoint data). Re-sown
+            # so apply_with_losses consumes it like any in-line MoE layer.
+            self.sow("losses", "pipeline_moe_aux", jnp.mean(aux))
+        return out
 
 
 class Transformer(nn.Module):
@@ -441,13 +475,6 @@ class Transformer(nn.Module):
         if (decode or prefill) and not cfg.use_rope:
             # Learned positions would need the cache index at this level.
             raise NotImplementedError("decode requires use_rope=True")
-        if cfg.pipeline and cfg.n_experts > 0:
-            # GPipe stages re-apply Block under a nested module.apply that
-            # does not thread the "losses" sow collection, which would
-            # silently drop the MoE load-balance loss — reject instead.
-            raise NotImplementedError(
-                "pipeline=True with n_experts>0 is not supported: the MoE "
-                "router aux loss cannot propagate out of pipeline stages")
         embed = nn.Embed(cfg.vocab_size, cfg.d_model, name="embedder",
                          dtype=cfg.dtype, param_dtype=cfg.param_dtype)
         x = constrain_residual(embed(tokens))

@@ -81,34 +81,47 @@ def layer_execution_order(n_layers: int, n_stages: int,
 
 
 def sequential_apply(block_apply: Callable, stacked_params, x, positions,
-                     mask=None, layer_order: Optional[np.ndarray] = None):
+                     mask=None, layer_order: Optional[np.ndarray] = None,
+                     with_aux: bool = False):
     """Reference semantics: apply the stacked layers one after another.
 
     Used when ``pp == 1`` (single stage) and by tests as the golden model
     for the pipelined schedule. ``stacked_params`` leaves have a leading
-    layer dim; ``block_apply(params_one_layer, x, positions, mask) -> x``.
-    ``layer_order`` permutes the storage rows into execution order (the
-    interleaved schedule's round-robin; identity/None for GPipe)."""
+    layer dim; ``block_apply(params_one_layer, x, positions, mask) -> x``
+    (or ``-> (x, aux_scalar)`` when ``with_aux`` — MoE blocks return their
+    sown router loss, summed over layers here). ``layer_order`` permutes
+    the storage rows into execution order (the interleaved schedule's
+    round-robin; identity/None for GPipe)."""
+
+    def call(p, h):
+        if with_aux:
+            return block_apply(p, h, positions, mask)
+        return block_apply(p, h, positions, mask), jnp.float32(0.0)
+
     if layer_order is not None:
         # Scan over the index array and gather ONE layer's params per step
         # — materializing a permuted copy of the whole stack would double
         # transient parameter memory on the replay path.
         idx = jnp.asarray(layer_order)
 
-        def layer_at(h, i):
+        def layer_at(carry, i):
+            h, acc = carry
             p = jax.tree_util.tree_map(
                 lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
                 stacked_params)
-            return block_apply(p, h, positions, mask), None
+            h, aux = call(p, h)
+            return (h, acc + aux), None
 
-        out, _ = lax.scan(layer_at, x, idx)
-        return out
+        (out, aux), _ = lax.scan(layer_at, (x, jnp.float32(0.0)), idx)
+        return (out, aux) if with_aux else out
 
-    def layer(h, p):
-        return block_apply(p, h, positions, mask), None
+    def layer(carry, p):
+        h, acc = carry
+        h, aux = call(p, h)
+        return (h, acc + aux), None
 
-    out, _ = lax.scan(layer, x, stacked_params)
-    return out
+    (out, aux), _ = lax.scan(layer, (x, jnp.float32(0.0)), stacked_params)
+    return (out, aux) if with_aux else out
 
 
 def gpipe_apply(
@@ -124,6 +137,7 @@ def gpipe_apply(
     axis_name: str = "pp",
     batch_axes: Sequence[str] = ("dp", "fsdp"),
     param_specs=None,
+    with_aux: bool = False,
 ):
     """Run the stacked layers as a pipeline over ``mesh.shape[pp]`` stages.
 
@@ -145,6 +159,12 @@ def gpipe_apply(
         (leading dim must be ``axis_name``); defaults to P(axis_name) on
         every leaf. Needed for pp x tp, where weight dims additionally
         shard over tp and block_apply runs the local-shape block.
+      with_aux: block_apply returns ``(h, aux_scalar)`` (MoE router loss);
+        the pipeline sums aux over every layer chunk and averages over
+        microbatches, returning ``(out, aux)`` where ``aux`` has one entry
+        per batch-shard (shape [n_batch_shards]; mean it for the global
+        term — the shards saw disjoint data, exactly like the sown loss
+        under plain data parallelism).
 
     Returns activations ``[B_global, T, D]``, batch-sharded, replicated over
     ``pp``."""
@@ -160,7 +180,7 @@ def gpipe_apply(
                 "use sequential_apply with layer_execution_order instead")
         return sequential_apply(
             block_apply, stacked_params, x, positions, mask,
-            layer_order=None)
+            layer_order=None, with_aux=with_aux)
     for ax in ("ep", "sp"):
         if mesh.shape.get(ax, 1) > 1:
             raise NotImplementedError(
@@ -188,7 +208,7 @@ def gpipe_apply(
     if param_specs is not None:
         in_specs = (param_specs,) + in_specs[1:]
     smap = partial(shard_map_no_check, mesh=mesh, in_specs=in_specs,
-                   out_specs=bspec)
+                   out_specs=(bspec, bspec) if with_aux else bspec)
 
     @smap
     def run(params_local, x_local, pos_local, *rest):
@@ -212,16 +232,22 @@ def gpipe_apply(
 
         def chunk_fn(h, pos, m, v):
             """Apply this stage's v-th layer chunk (storage rows
-            [v*csize, (v+1)*csize) of the local slice)."""
+            [v*csize, (v+1)*csize) of the local slice). Returns
+            (out, summed aux of the chunk's layers)."""
             chunk = jax.tree_util.tree_map(
                 lambda a: lax.dynamic_slice_in_dim(a, v * csize, csize, 0),
                 params_local)
 
             def layer(carry, p):
-                return block_apply(p, carry, pos, m), None
+                h, acc = carry
+                if with_aux:
+                    h, aux = block_apply(p, h, pos, m)
+                else:
+                    h, aux = block_apply(p, h, pos, m), jnp.float32(0.0)
+                return (h, acc + aux), None
 
-            out, _ = lax.scan(layer, h, chunk)
-            return out
+            (out, aux), _ = lax.scan(layer, (h, jnp.float32(0.0)), chunk)
+            return out, aux
 
         # Cyclic ring: the last stage's send wraps to stage 0, carrying a
         # microbatch into its next lap (dropped unused when V == 1).
@@ -230,9 +256,9 @@ def gpipe_apply(
 
         def tick(carry, t):
             if V > 1:
-                recv, buf, out_buf = carry
+                recv, buf, out_buf, aux_prev = carry
             else:
-                recv, out_buf = carry
+                recv, out_buf, aux_prev = carry
                 buf = None
             # Stream position of the item this stage works on (clipped;
             # out-of-range ticks compute garbage that is never banked).
@@ -263,7 +289,11 @@ def gpipe_apply(
                 my_in = jnp.where(fresh, take(mb_x), recv)
             my_pos = take(mb_pos)
             my_mask = take(mb_mask) if mb_mask is not None else None
-            out = chunk_fn(my_in, my_pos, my_mask, v)
+            out, aux = chunk_fn(my_in, my_pos, my_mask, v)
+            # Garbage ticks (pipeline fill/drain) compute on clipped
+            # indices; their aux must not pollute the sum.
+            valid = jnp.logical_and(t - stage >= 0, t - stage < V * M)
+            aux_acc = aux_prev + jnp.where(valid, aux, 0.0)
             # Last stage banks the item's final lap (v == V-1).
             w = jnp.clip(t - (S - 1) - (V - 1) * M, 0, M - 1)
             prev = lax.dynamic_index_in_dim(out_buf, w, 0, keepdims=False)
@@ -273,20 +303,29 @@ def gpipe_apply(
                 out_buf, jnp.where(write, out, prev), w, 0)
             nxt = lax.ppermute(out, axis_name, perm)
             if V > 1:
-                return (nxt, buf, out_buf), None
-            return (nxt, out_buf), None
+                return (nxt, buf, out_buf, aux_acc), None
+            return (nxt, out_buf, aux_acc), None
 
         zero_mb = jnp.zeros_like(mb_x[0])
         out_buf0 = jnp.zeros_like(mb_x)
-        carry0 = ((zero_mb, jnp.zeros_like(mb_x), out_buf0) if V > 1
-                  else (zero_mb, out_buf0))
+        aux0 = jnp.float32(0.0)
+        carry0 = ((zero_mb, jnp.zeros_like(mb_x), out_buf0, aux0) if V > 1
+                  else (zero_mb, out_buf0, aux0))
         carry_out, _ = lax.scan(tick, carry0, jnp.arange(T_ticks))
-        out_buf = carry_out[-1]
+        out_buf, aux_sum = carry_out[-2], carry_out[-1]
         # Only the last stage holds real outputs; psum broadcasts them so the
         # result is truly replicated over pp (out_specs says so).
         out_buf = lax.psum(
             jnp.where(stage == S - 1, out_buf, jnp.zeros_like(out_buf)),
             axis_name)
-        return out_buf.reshape(B, *x_local.shape[1:])
+        out = out_buf.reshape(B, *x_local.shape[1:])
+        if not with_aux:
+            return out
+        # Every stage accumulated its own layers' aux for every valid
+        # (microbatch, lap); the psum totals the layer sum and /M averages
+        # over microbatches — matching the sequential full-batch semantics
+        # when routing groups don't cross microbatch boundaries.
+        aux = lax.psum(aux_sum, axis_name) / M
+        return out, aux.reshape(1)
 
     return run(*operands)
