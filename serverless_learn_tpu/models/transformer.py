@@ -44,7 +44,11 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
-    moe_group_size: int = 1024  # routing-subgroup token count (0 => full row)
+    # Routing-subgroup token count (0 => full row). Bounds slot competition
+    # and dispatch memory; ALSO sets the granularity of the load-balance aux
+    # loss (a mean of per-group Switch terms, ops/moe.py), so changing it
+    # perturbs the aux value/gradient, not just memory.
+    moe_group_size: int = 1024
     tie_embeddings: bool = False
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32

@@ -44,7 +44,13 @@ def top_k_routing(router_logits: jax.Array, n_experts: int, top_k: int,
       combine:  [G, S, E, C] float32 — dispatch weighted by the (renormalized)
         gate probability.
       aux: scalar load-balance loss (Switch-style: E * Σ_e frac_e · prob_e,
-        computed over all groups).
+        computed PER GROUP and averaged over groups). Per-group computation
+        is the GShard formulation and — unlike a joint mean over all groups —
+        is *linear in any even batch split*: splitting the G groups into M
+        equal microbatches and averaging their per-microbatch aux reproduces
+        the full-batch value exactly, which is what makes pipelined MoE
+        (parallel/pipeline.py's per-microbatch aux sum / M) match the dp
+        semantics bit-for-bit instead of approximately.
     """
     G, S, E = router_logits.shape
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), -1)  # [G, S, E]
@@ -68,10 +74,13 @@ def top_k_routing(router_logits: jax.Array, n_experts: int, top_k: int,
     dispatch = (disp_k.sum(axis=2) > 0).astype(jnp.float32)
 
     # Load-balance: fraction of tokens whose FIRST choice is e, times mean
-    # router prob for e; minimized (== 1) when routing is uniform.
-    frac = onehot[:, :, 0, :].mean(axis=(0, 1))  # [E]
-    mean_prob = probs.mean(axis=(0, 1))  # [E]
-    aux = n_experts * jnp.sum(frac * mean_prob)
+    # router prob for e; minimized (== 1) when routing is uniform. Computed
+    # per group then averaged so the loss is linear in a group-aligned batch
+    # split (see docstring) — a joint mean over all groups would make
+    # pipelined microbatch averaging diverge from the full-batch value.
+    frac = onehot[:, :, 0, :].mean(axis=1)  # [G, E]
+    mean_prob = probs.mean(axis=1)  # [G, E]
+    aux = n_experts * jnp.mean(jnp.sum(frac * mean_prob, axis=-1))
     return dispatch, combine, aux
 
 

@@ -323,8 +323,10 @@ def gpipe_apply(
             return out
         # Every stage accumulated its own layers' aux for every valid
         # (microbatch, lap); the psum totals the layer sum and /M averages
-        # over microbatches — matching the sequential full-batch semantics
-        # when routing groups don't cross microbatch boundaries.
+        # over microbatches. This matches the sequential full-batch value
+        # EXACTLY because top_k_routing's load-balance loss is a mean of
+        # per-group terms (ops/moe.py) and routing groups never span
+        # microbatch boundaries (groups subdivide single batch rows).
         aux = lax.psum(aux_sum, axis_name) / M
         return out, aux.reshape(1)
 
