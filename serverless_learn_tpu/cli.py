@@ -399,6 +399,7 @@ def cmd_worker(args) -> int:
             run_name=args.multihost,
             label=args.name or None,
             advertise_host=args.advertise_host,
+            n_chips=args.chips,
             min_hosts=args.min_hosts,
             verbose=args.verbose,
         )
@@ -563,6 +564,12 @@ def build_parser() -> argparse.ArgumentParser:
     w.add_argument("--min-hosts", type=int, default=1,
                    help="with --multihost: wait for at least this many "
                         "hosts before forming the first world")
+    w.add_argument("--chips", type=int, default=1,
+                   help="with --multihost: TPU chips this host contributes. "
+                        "Registered with the coordinator so every supervisor "
+                        "can size satisfiable worlds for the configured mesh "
+                        "WITHOUT touching the local chips itself (the inner "
+                        "trainer must be the only libtpu owner)")
     w.set_defaults(fn=cmd_worker)
 
     c = sub.add_parser("coordinator", help="run the membership daemon")

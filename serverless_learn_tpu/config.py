@@ -55,12 +55,64 @@ class MeshConfig:
             n *= s
         return n
 
+    def nontrivial_axes(self) -> dict:
+        """{axis: size} for axes of size > 1 — the compact human/log form."""
+        return {a: s for a, s in zip(self.AXIS_NAMES, self.shape) if s > 1}
+
     def validate(self, n_devices: int) -> None:
         if self.size != n_devices:
             raise ValueError(
                 f"Mesh shape {dict(zip(self.AXIS_NAMES, self.shape))} has size "
                 f"{self.size} but {n_devices} devices are available."
             )
+
+
+class UnsatisfiableMeshError(ValueError):
+    """A device count cannot host the configured mesh's model axes."""
+
+
+def scale_mesh(base: "MeshConfig", n_devices: int) -> "MeshConfig":
+    """Scale a configured mesh to an elastic world of ``n_devices`` devices.
+
+    The elastic contract (reference ``src/master.cc:79-91`` — any worker can
+    join anytime) meets model sharding here: when the world re-forms, the
+    *model* axes must keep their configured sizes (tp/pp/sp/ep change the
+    program's collectives and, for pp, the checkpoint layout), while the
+    *data* plane stretches to absorb whatever devices the new world has:
+
+    * ``tp``/``pp``/``sp``/``ep`` — fixed at the configured size. A world
+      whose device count isn't a multiple of their product is rejected.
+    * ``fsdp`` — the configured value is a MEMORY FLOOR (the state provably
+      fits at that sharding, e.g. an 8B state needs fsdp>=4); the actual
+      axis is the smallest divisor of the remaining plane that is >= the
+      floor, so growth beyond the floor goes to ``dp`` first (cheaper
+      collectives) but never below the floor.
+    * ``dp`` — absorbs the rest.
+
+    Raises ``UnsatisfiableMeshError`` (loudly, per VERDICT r2 item 2) when
+    no such assignment exists; elastic supervisors treat that world size as
+    not-formable and wait for membership to change rather than silently
+    falling back to dp-only.
+    """
+    model = base.tp * base.pp * base.sp * base.ep
+    if n_devices < 1 or n_devices % model != 0:
+        raise UnsatisfiableMeshError(
+            f"{n_devices} devices cannot host model axes "
+            f"tp={base.tp} pp={base.pp} sp={base.sp} ep={base.ep} "
+            f"(need a positive multiple of {model})")
+    plane = n_devices // model
+    if base.fsdp > 1:
+        fsdp = next((d for d in range(base.fsdp, plane + 1)
+                     if plane % d == 0), None)
+        if fsdp is None:
+            raise UnsatisfiableMeshError(
+                f"data plane of {plane} devices cannot satisfy the "
+                f"fsdp>={base.fsdp} memory floor (model axes consume "
+                f"{model} of {n_devices})")
+    else:
+        fsdp = 1
+    return MeshConfig(dp=plane // fsdp, fsdp=fsdp, ep=base.ep, tp=base.tp,
+                      sp=base.sp, pp=base.pp)
 
 
 @dataclass(frozen=True)

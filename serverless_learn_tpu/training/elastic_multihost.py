@@ -72,7 +72,8 @@ import time
 from dataclasses import dataclass
 from typing import List, Optional
 
-from serverless_learn_tpu.config import ExperimentConfig, MeshConfig
+from serverless_learn_tpu.config import (ExperimentConfig,
+                                          UnsatisfiableMeshError, scale_mesh)
 from serverless_learn_tpu.control.client import WorkerAgent
 from serverless_learn_tpu.training.checkpoint import (
     Checkpointer, LocalStore, ShardServerStore)
@@ -82,10 +83,6 @@ from serverless_learn_tpu.utils.metrics import log_json
 # multihost.MH_TAG (fixed-size bootstrap) so the two rendezvous protocols
 # never rank each other's processes.
 EMH_TAG = "emh!"
-
-
-def default_mesh_policy(n_devices: int) -> MeshConfig:
-    return MeshConfig(dp=n_devices)
 
 
 def store_spec(store) -> dict:
@@ -116,6 +113,7 @@ class Generation:
     start_step: int = -1
     end_step: int = -1
     status: str = "formed"  # formed | complete | remesh | killed | error
+    mesh: Optional[dict] = None  # axis sizes the inner actually formed
 
 
 # ---------------------------------------------------------------------------
@@ -179,6 +177,33 @@ class ElasticHostSupervisor:
     def _current_ids(self) -> List[int]:
         return self._tagged_ids(self.agent.snapshot()[1])
 
+    def _tagged_chips(self) -> dict:
+        """worker_id -> registered chip count, for tagged peers."""
+        return {p.worker_id: max(1, p.n_chips)
+                for p in self.agent.snapshot()[1]
+                if p.name.startswith(self._tag)}
+
+    def _active_ids(self, ids: List[int]) -> Optional[List[int]]:
+        """The subset of a stable membership that actually forms the world.
+
+        The configured mesh makes some world sizes unusable (model axes need
+        a divisible device total, fsdp has a memory floor — config.
+        scale_mesh). Every supervisor deterministically picks the LARGEST
+        prefix of the id-ordered membership whose total registered chips is
+        satisfiable; members beyond it stand by as hot spares and join at
+        the next membership change. Returns None when no prefix (of at
+        least min_hosts hosts) works.
+        """
+        chips = self._tagged_chips()
+        for k in range(len(ids), max(self.min_hosts, 1) - 1, -1):
+            total = sum(chips.get(i, 1) for i in ids[:k])
+            try:
+                scale_mesh(self.config.mesh, total)
+            except UnsatisfiableMeshError:
+                continue
+            return ids[:k]
+        return None
+
     def _stable_view(self, deadline: float) -> List[int]:
         """Wait until the set of tagged peers (incl. us) holds still for a
         stability window. Untagged workers sharing the coordinator churn
@@ -213,6 +238,42 @@ class ElasticHostSupervisor:
             return json.loads(self.store.get(self._form_key()))
         except (IOError, OSError, ValueError):
             return None
+
+    def _committed_step(self) -> int:
+        """Latest committed checkpoint step, observed via the data plane —
+        how standby hosts (and the completion fast path) track a world they
+        are not part of."""
+        try:
+            meta = json.loads(self.store.get(f"emh-{self.run_name}/LATEST"))
+            return int(meta["step"])
+        except (IOError, OSError, ValueError, KeyError):
+            return -1
+
+    def _standby(self, deadline: Optional[float], why: str) -> str:
+        """Wait out a world this host is not part of.
+
+        deadline=None: an active world is running without us (hot spare) —
+        wait indefinitely for membership churn or run completion. With a
+        deadline: NO satisfiable world exists; if membership still hasn't
+        produced one by the deadline, raise (loudly — never fall back to a
+        mesh the config doesn't describe).
+        """
+        if self.verbose:
+            log_json({"event": "standby", "why": why,
+                      "rank0_world": None if deadline is None else "none"})
+        while True:
+            if self._committed_step() >= self.config.train.num_steps:
+                return "complete"
+            # Event-wait gives instant membership wakeups while the LATEST
+            # store read (a network RPC on ShardServerStore) stays at 1 Hz —
+            # a spare can idle for hours without hammering the data plane.
+            if self._membership_changed.wait(timeout=1.0):
+                self._membership_changed.clear()
+                return "standby"
+            if deadline is not None and time.time() > deadline:
+                raise UnsatisfiableMeshError(
+                    f"no satisfiable world within {self.form_timeout_s}s: "
+                    f"{why}")
 
     # -- inner process ------------------------------------------------------
 
@@ -255,8 +316,8 @@ class ElasticHostSupervisor:
                 status = self._one_generation()
                 if status == "complete":
                     return self.generations
-                if status in ("remesh", "killed"):
-                    failures = 0  # real membership churn, not a fault
+                if status in ("remesh", "killed", "standby"):
+                    failures = 0  # real membership churn / waiting, not a fault
                 else:
                     failures += 1
                     if failures >= max_consecutive_failures:
@@ -271,9 +332,18 @@ class ElasticHostSupervisor:
     def _one_generation(self) -> str:
         deadline = time.time() + self.form_timeout_s
         self._membership_changed.clear()
+        if self._committed_step() >= self.config.train.num_steps:
+            return "complete"  # run finished while we were between worlds
         ids = self._stable_view(deadline)
-        rank = ids.index(self.agent.worker_id)
-        world = len(ids)
+        active = self._active_ids(ids)
+        if active is None:
+            return self._standby(
+                deadline, f"membership {ids} (chips {self._tagged_chips()}) "
+                          f"cannot host mesh {self.config.mesh}")
+        if self.agent.worker_id not in active:
+            return self._standby(None, f"hot spare behind active {active}")
+        rank = active.index(self.agent.worker_id)
+        world = len(active)
 
         inner: Optional[_InnerHandle] = None
         if rank == 0:
@@ -286,13 +356,15 @@ class ElasticHostSupervisor:
                 inner.kill()
                 return "retry"
             self.store.put(self._form_key(), json.dumps(
-                {"gen": gen, "ids": ids, "addr": addr["addr"]}).encode())
+                {"gen": gen, "ids": active, "addr": addr["addr"]}).encode())
         else:
-            # Follower: wait for a FORM that matches our exact view.
+            # Follower: wait for a FORM that matches our computed active set
+            # (every supervisor derives the same one from the same stable
+            # view + registered chip counts).
             form = None
             while time.time() < deadline:
                 form = self._read_form()
-                if (form and form["ids"] == ids
+                if (form and form["ids"] == active
                         and form["gen"] > self._last_gen):
                     break
                 if self._current_ids() != ids:
@@ -307,7 +379,7 @@ class ElasticHostSupervisor:
         self._last_gen = gen
         g = Generation(gen=gen, world=world, rank=rank)
         self.generations.append(g)
-        status = self._monitor(inner, g, ids)
+        status = self._monitor(inner, g, ids, active)
         g.status = status
         if self.verbose:
             log_json({"event": "generation_done", "gen": gen, "rank": rank,
@@ -316,9 +388,15 @@ class ElasticHostSupervisor:
         return status
 
     def _monitor(self, inner: "_InnerHandle", g: Generation,
-                 ids: List[int]) -> str:
+                 ids: List[int], active: List[int]) -> str:
         """Relay inner progress into heartbeats; react to membership
-        changes; decide drain-vs-kill. Returns the generation's outcome."""
+        changes; decide drain-vs-kill. Returns the generation's outcome.
+
+        ``ids`` is the full stable view the world was formed from; ``active``
+        is the subset actually IN the world. Only an active member's loss
+        breaks collectives (-> kill); spare churn either offers growth
+        (join -> drain) or is irrelevant (spare departure -> ignore).
+        """
         drain_sent = False
         kill_at: Optional[float] = None
         while True:
@@ -326,10 +404,11 @@ class ElasticHostSupervisor:
             if ev is not None:
                 if ev["event"] == "inner_up":
                     g.start_step = ev["step"]
+                    g.mesh = ev.get("mesh")
                     if self.verbose:
                         log_json({"event": "world_formed", "gen": g.gen,
                                   "world": g.world, "rank": g.rank,
-                                  "step": ev["step"],
+                                  "step": ev["step"], "mesh": ev.get("mesh"),
                                   "devices": ev.get("devices")})
                 elif ev["event"] == "step":
                     self.step_losses[ev["step"]] = ev.get("loss", 0.0)
@@ -350,6 +429,7 @@ class ElasticHostSupervisor:
                         break
                     if tail["event"] == "inner_up":
                         g.start_step = tail["step"]
+                        g.mesh = tail.get("mesh")
                     elif tail["event"] == "step":
                         self.step_losses[tail["step"]] = tail.get("loss", 0.0)
                 rc = inner.returncode()
@@ -363,19 +443,30 @@ class ElasticHostSupervisor:
                 self._membership_changed.clear()
                 cur = self._current_ids()
                 if cur != ids:
-                    lost = set(ids) - set(cur)
-                    if not drain_sent:
-                        inner.send_drain()
-                        drain_sent = True
-                    if lost:
+                    lost_active = set(active) - set(cur)
+                    joined = set(cur) - set(ids)
+                    if lost_active:
                         # World broken: no collective (not even the drain
                         # agreement) can complete; the inner is wedged or
                         # about to be. Short grace, then kill — shortening
                         # any longer drain deadline a prior join set.
+                        if not drain_sent:
+                            inner.send_drain()
+                            drain_sent = True
                         ka = time.time() + self.kill_grace_s
                         kill_at = ka if kill_at is None else min(kill_at, ka)
-                    elif kill_at is None:
-                        kill_at = time.time() + self.drain_timeout_s
+                    elif joined:
+                        # Growth opportunity: drain cleanly and re-form to
+                        # absorb the newcomer.
+                        if not drain_sent:
+                            inner.send_drain()
+                            drain_sent = True
+                        if kill_at is None:
+                            kill_at = time.time() + self.drain_timeout_s
+                    # A departure that only touched hot spares changes
+                    # nothing for the running world: don't drain a healthy
+                    # inner for it.
+                    ids = cur
             if kill_at is not None and time.time() > kill_at:
                 inner.kill()
                 inner.wait()
@@ -529,7 +620,11 @@ def inner_main(argv: Optional[List[str]] = None) -> int:
     ckpt = Checkpointer(store, name=f"emh-{args.run_name}",
                         async_save=False, sharded=True)
 
-    mesh_cfg = default_mesh_policy(len(jax.devices()))
+    # Honor the configured mesh at every world size: model axes fixed, fsdp
+    # floor respected, dp stretched (config.scale_mesh). The supervisor only
+    # forms worlds it believes satisfiable; this raise is the backstop for a
+    # supervisor whose chip accounting was wrong (loud, not dp-fallback).
+    mesh_cfg = scale_mesh(config.mesh, len(jax.devices()))
     cfg = config.override(mesh=mesh_cfg)
     mesh = make_mesh(mesh_cfg, devices=list(jax.devices()))
     trainer = build_trainer(cfg, mesh=mesh)
@@ -541,7 +636,8 @@ def inner_main(argv: Optional[List[str]] = None) -> int:
     step = int(jax.device_get(state.step))
     _emit({"event": "inner_up", "gen": args.gen, "step": step,
            "rank": args.rank, "world": args.world,
-           "devices": len(jax.devices())})
+           "devices": len(jax.devices()),
+           "mesh": mesh_cfg.nontrivial_axes()})
 
     # Drain requests arrive on stdin from the supervisor.
     drain = threading.Event()

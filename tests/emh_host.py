@@ -13,7 +13,8 @@ import sys
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 from serverless_learn_tpu.config import (  # noqa: E402
-    ControlConfig, DataConfig, ExperimentConfig, OptimizerConfig, TrainConfig)
+    ControlConfig, DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig,
+    TrainConfig)
 from serverless_learn_tpu.training.checkpoint import LocalStore  # noqa: E402
 from serverless_learn_tpu.training.elastic_multihost import (  # noqa: E402
     ElasticHostSupervisor)
@@ -30,10 +31,21 @@ def main() -> int:
     p.add_argument("--ckpt-every", type=int, default=4)
     p.add_argument("--min-hosts", type=int, default=1)
     p.add_argument("--step-delay", type=float, default=0.0)
+    p.add_argument("--chips", type=int, default=1,
+                   help="local device count to register (must match the "
+                        "inner's XLA_FLAGS-forced device count for the "
+                        "supervisor's satisfiability math to be truthful)")
+    p.add_argument("--mesh", default=None,
+                   help="JSON MeshConfig overrides, e.g. "
+                        '\'{"fsdp": 2, "tp": 2}\' — the config mesh the '
+                        "elastic world must honor at every generation")
     args = p.parse_args()
 
+    mesh = (MeshConfig(**json.loads(args.mesh)) if args.mesh
+            else MeshConfig())
     cfg = ExperimentConfig(
         model="mlp_mnist",
+        mesh=mesh,
         # Hyperparameters chosen so the learnable synthetic task shows a
         # clear fresh-data loss decrease within the test's step budget
         # (1.5 -> ~0.66 in 60 steps measured on the CPU mesh).
@@ -49,6 +61,7 @@ def main() -> int:
     sup = ElasticHostSupervisor(
         cfg, LocalStore(args.store_root), args.coordinator,
         run_name=args.run_name, label=args.label,
+        n_chips=args.chips,
         min_hosts=args.min_hosts,
         form_timeout_s=90.0, init_timeout_s=30.0,
         drain_timeout_s=60.0, kill_grace_s=3.0,
@@ -59,7 +72,8 @@ def main() -> int:
         "label": args.label,
         "generations": [{"gen": g.gen, "world": g.world, "rank": g.rank,
                          "start_step": g.start_step, "end_step": g.end_step,
-                         "status": g.status} for g in gens],
+                         "status": g.status, "mesh": g.mesh}
+                        for g in gens],
         "losses": sorted(((int(s), l) for s, l in sup.step_losses.items())),
     }), flush=True)
     return 0

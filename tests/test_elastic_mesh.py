@@ -1,0 +1,114 @@
+"""Elastic worlds honor the configured mesh (VERDICT r2 item 2).
+
+Round 2's elastic paths hardcoded dp-only meshes, silently discarding the
+config — an 8B state cannot fit dp-only, so the Llama-8B LoRA elastic rung
+was unrunnable. Round 3 threads ``config.scale_mesh`` through both elastic
+paths: model axes (tp/pp/sp/ep) stay fixed, fsdp is a memory floor, dp
+stretches with the world, and unsatisfiable shapes are rejected loudly.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.config import (
+    DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig, TrainConfig,
+    UnsatisfiableMeshError, scale_mesh)
+from serverless_learn_tpu.training.checkpoint import LocalStore
+from serverless_learn_tpu.training.elastic import ElasticTrainer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- scale_mesh unit behavior -------------------------------------------------
+
+
+def test_trivial_mesh_scales_dp_only():
+    for n in (1, 3, 8):
+        assert scale_mesh(MeshConfig(), n) == MeshConfig(dp=n)
+    # a configured dp value is elastic — overridden by the world size
+    assert scale_mesh(MeshConfig(dp=4), 8) == MeshConfig(dp=8)
+
+
+def test_model_axes_fixed_dp_stretches():
+    base = MeshConfig(tp=2)
+    assert scale_mesh(base, 2) == MeshConfig(dp=1, tp=2)
+    assert scale_mesh(base, 8) == MeshConfig(dp=4, tp=2)
+    base = MeshConfig(tp=2, pp=2)
+    assert scale_mesh(base, 8) == MeshConfig(dp=2, tp=2, pp=2)
+
+
+def test_fsdp_is_a_memory_floor():
+    base = MeshConfig(fsdp=4, tp=2)
+    # exactly the floor
+    assert scale_mesh(base, 8) == MeshConfig(dp=1, fsdp=4, tp=2)
+    # growth beyond the floor goes to dp first
+    assert scale_mesh(base, 16) == MeshConfig(dp=2, fsdp=4, tp=2)
+    # plane of 6 has no divisor in [4, 6] except 6: fsdp grows past the floor
+    assert scale_mesh(MeshConfig(fsdp=4), 6) == MeshConfig(dp=1, fsdp=6)
+
+
+def test_unsatisfiable_shapes_rejected_loudly():
+    with pytest.raises(UnsatisfiableMeshError):
+        scale_mesh(MeshConfig(tp=2), 3)  # not a multiple of the model axes
+    with pytest.raises(UnsatisfiableMeshError):
+        scale_mesh(MeshConfig(fsdp=4, tp=2), 4)  # plane 2 under the floor
+    with pytest.raises(UnsatisfiableMeshError):
+        scale_mesh(MeshConfig(tp=2), 0)
+
+
+def test_llama8b_elastic_config_mesh_honored():
+    """The exact config the verdict named: fsdp=4,tp=2 must survive elastic
+    scaling instead of being discarded for dp-only."""
+    with open(os.path.join(REPO, "configs", "llama8b_lora_elastic.json")) as f:
+        cfg = ExperimentConfig.from_json(f.read())
+    assert cfg.mesh == MeshConfig(fsdp=4, tp=2)
+    assert scale_mesh(cfg.mesh, 8) == MeshConfig(dp=1, fsdp=4, tp=2)
+    assert scale_mesh(cfg.mesh, 32) == MeshConfig(dp=4, fsdp=4, tp=2)
+    with pytest.raises(UnsatisfiableMeshError):
+        scale_mesh(cfg.mesh, 4)  # half a pod slice below the memory floor
+
+
+# -- single-host elastic trainer ---------------------------------------------
+
+
+def _config(num_steps, mesh):
+    return ExperimentConfig(
+        model="mlp_mnist",
+        mesh=mesh,
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.05),
+        train=TrainConfig(batch_size=16, num_steps=num_steps),
+        data=DataConfig(),
+        model_overrides={"dtype": jnp.float32},
+    )
+
+
+def test_solo_trainer_forms_config_mesh(tmp_path, devices):
+    et = ElasticTrainer(_config(3, MeshConfig(fsdp=2, tp=2)),
+                        LocalStore(str(tmp_path)))
+    state, losses = et.run()
+    assert len(losses) == 3 and np.isfinite(losses).all()
+    assert int(jax.device_get(state.step)) == 3
+    assert et.transitions[0].mesh == {"dp": 2, "fsdp": 2, "tp": 2}
+
+
+def test_solo_trainer_trims_unsatisfiable_world(tmp_path, devices):
+    """5 visible devices with tp=2: the trainer idles one device rather than
+    dying (or silently dropping tp)."""
+    et = ElasticTrainer(_config(2, MeshConfig(tp=2)), LocalStore(str(tmp_path)),
+                        device_policy=lambda peers, devs: list(devs)[:5])
+    state, losses = et.run()
+    assert len(losses) == 2
+    assert et.transitions[0].n_devices == 4
+    assert et.transitions[0].mesh == {"dp": 2, "tp": 2}
+
+
+def test_solo_trainer_unsatisfiable_world_raises(tmp_path, devices):
+    """A memory floor no local subset can satisfy must be a loud failure."""
+    et = ElasticTrainer(_config(2, MeshConfig(fsdp=16)),
+                        LocalStore(str(tmp_path)))
+    with pytest.raises(UnsatisfiableMeshError):
+        et.run()

@@ -120,15 +120,20 @@ def test_moe_trains_ep_matches_dp(devices, mesh_cfg):
 
 
 def test_pipeline_plus_moe_initializes(devices):
-    """pipeline stages thread the sown aux loss (round 2); init must work and
-    the losses collection must not leak into the param tree. Full dp-parity
-    is covered by tests/test_pipeline.py::test_moe_pipeline_matches_dp."""
+    """pipeline stages thread the sown aux loss (round 2); init must work,
+    with the router loss sown into its own collection — never mixed into the
+    param tree (the TrainState builder strips "losses"; see
+    test_moe_init_state_has_no_losses_collection). Full dp-parity is covered
+    by tests/test_pipeline.py::test_moe_pipeline_matches_dp."""
     from serverless_learn_tpu.models.registry import get_model
 
     bundle = get_model("moe_tiny", pipeline=True)
     tokens = jnp.zeros((2, 8), jnp.int32)
     variables = bundle.module.init(jax.random.PRNGKey(0), tokens)
-    assert set(variables) == {"params"}
+    assert set(variables) == {"params", "losses"}
+    param_paths = [str(p) for p, _ in
+                   jax.tree_util.tree_leaves_with_path(variables["params"])]
+    assert not any("moe_aux" in p for p in param_paths)
 
 
 def test_moe_group_size_bounds_capacity_without_changing_math():
