@@ -1,0 +1,177 @@
+"""8B-scale validation without 8B hardware (VERDICT r2 item 3).
+
+``llama_8b`` had never been instantiated beyond config parsing. These tests
+pin down, on abstract shapes (zero materialization):
+
+* the parameter census and the LoRA trainable mask at 8B scale,
+* that the fsdp=4,tp=2 sharding actually shards every large tensor and the
+  per-device resident state fits a v5e (16 GB) / v4 (32 GB) HBM budget with
+  headroom for grads + remat'd activations,
+* the sharded-checkpoint chunk-index math (manifest size, per-device byte
+  balance, exact partition coverage) at 8B leaf shapes,
+* (slow) that the FULL jitted train step at 8B widths — 2-layer override —
+  AOT-compiles against the virtual 8-device mesh, with XLA's own per-device
+  memory accounting bounded. Execution is deliberately not attempted:
+  XLA:CPU's in-process collectives have a hardcoded 40 s rendezvous abort,
+  and on a 1-core host the 8 virtual devices serialize past it at these
+  widths. Compilation exercises everything sharding-related (GSPMD
+  partitioning, collective insertion, memory planning); the numerics of the
+  same step are covered at tiny widths by the rest of the suite.
+"""
+
+import json
+import math
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.config import (
+    DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig, TrainConfig,
+    scale_mesh)
+from serverless_learn_tpu.parallel.mesh import make_mesh
+from serverless_learn_tpu.training.checkpoint import _norm_index
+from serverless_learn_tpu.training.train_step import build_trainer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GIB = 1 << 30
+
+
+def _leaf_local_bytes(leaf, sharding) -> int:
+    """Bytes of one device's shard of an abstract leaf."""
+    n = 1
+    for entry in sharding.spec:
+        if entry is None:
+            continue
+        for ax in (entry if isinstance(entry, tuple) else (entry,)):
+            n *= sharding.mesh.shape[ax]
+    return int(math.prod(leaf.shape) * leaf.dtype.itemsize // n)
+
+
+@pytest.fixture(scope="module")
+def trainer8b(devices):
+    """Full 32-layer llama_8b trainer on the fsdp=4,tp=2 mesh the elastic
+    config names — abstract construction only (nothing materialized)."""
+    with open(os.path.join(REPO, "configs", "llama8b_lora_elastic.json")) as f:
+        cfg = ExperimentConfig.from_json(f.read())
+    mesh_cfg = scale_mesh(cfg.mesh, 8)
+    assert mesh_cfg == MeshConfig(dp=1, fsdp=4, tp=2)
+    mesh = make_mesh(mesh_cfg, devices=devices)
+    return build_trainer(cfg.override(mesh=mesh_cfg), mesh=mesh)
+
+
+def test_llama8b_param_census_and_lora_mask(trainer8b):
+    abstract = trainer8b.abstract_state()
+    n_params = sum(math.prod(l.shape) for l in
+                   jax.tree_util.tree_leaves(abstract.params))
+    # Llama-3-8B shape: ~6.98B in 32 blocks + 2 x 0.53B embed/head, plus
+    # ~7M of rank-16 LoRA adapters.
+    assert 7.9e9 < n_params < 8.3e9, n_params
+
+    mask = trainer8b.bundle.trainable_mask(abstract.params)
+    flat_p = jax.tree_util.tree_leaves(abstract.params)
+    flat_m = jax.tree_util.tree_leaves(mask)
+    trainable = sum(math.prod(p.shape) for p, m in zip(flat_p, flat_m) if m)
+    assert 0 < trainable < 2e7, trainable  # adapters only, base frozen
+    # Frozen base params must carry no optimizer moments: the opt state's
+    # total element count is O(trainable), not O(n_params).
+    n_opt = sum(math.prod(l.shape) for l in
+                jax.tree_util.tree_leaves(abstract.opt_state))
+    assert n_opt < 3 * trainable + 1e6, (n_opt, trainable)
+
+
+def test_llama8b_per_device_state_fits_hbm(trainer8b):
+    abstract = trainer8b.abstract_state()
+    sh = trainer8b.state_shardings
+    per_device = 0
+    unsharded_large = []
+    for (path, leaf), s in zip(
+            jax.tree_util.tree_flatten_with_path(abstract)[0],
+            jax.tree_util.tree_leaves(
+                sh, is_leaf=lambda x: hasattr(x, "spec"))):
+        local = _leaf_local_bytes(leaf, s)
+        per_device += local
+        if math.prod(leaf.shape) >= (1 << 24) and local == leaf.dtype.itemsize \
+                * math.prod(leaf.shape):
+            unsharded_large.append(jax.tree_util.keystr(path))
+    # Every >=16M-element tensor must be sharded — a rule-table miss that
+    # replicates one 0.5 GB embed table per chip is a silent HBM leak.
+    assert not unsharded_large, unsharded_large
+    # Resident state (f32 params sharded 8-way + LoRA moments): ~4 GB. The
+    # 16 GB v5e budget then leaves >= 10 GB for bf16 gathers, f32 grads of
+    # the LoRA slice, and remat'd activations at the configured
+    # grad_accum=4 microbatching.
+    assert per_device < 6 * GIB, per_device / GIB
+
+
+def test_llama8b_sharded_checkpoint_chunk_index_math(trainer8b):
+    """save_sharded's chunk-index layout, computed on abstract shapes: the
+    replica-0 chunks partition every leaf exactly, per-device payloads stay
+    balanced, and the JSON indices stay small enough to fetch eagerly at
+    restore (the _ShardedReader contract)."""
+    abstract = trainer8b.abstract_state()
+    shardings = trainer8b.state_shardings
+    per_device_bytes: dict = {}
+    n_chunks = 0
+    index_entries = []
+    for leaf, s in zip(
+            jax.tree_util.tree_leaves(abstract),
+            jax.tree_util.tree_leaves(
+                shardings, is_leaf=lambda x: hasattr(x, "spec"))):
+        shape = tuple(leaf.shape)
+        seen_boxes = set()
+        vol = 0
+        for dev, index in s.devices_indices_map(shape).items():
+            box = _norm_index(index, shape)
+            if box in seen_boxes:
+                continue  # replica (replica_id != 0): not written
+            seen_boxes.add(box)
+            nbytes = (math.prod(b - a for a, b in box) * leaf.dtype.itemsize
+                      if box else leaf.dtype.itemsize)
+            per_device_bytes[dev.id] = per_device_bytes.get(dev.id, 0) + nbytes
+            vol += math.prod(b - a for a, b in box) if box else 1
+            n_chunks += 1
+            index_entries.append({"leaf": n_chunks,
+                                  "start": [a for a, _ in box],
+                                  "stop": [b for _, b in box],
+                                  "offset": 0, "nbytes": nbytes})
+        assert vol == math.prod(shape) if shape else vol == 1, \
+            "replica-0 chunks must partition the leaf exactly"
+    # Balanced save: no device writes more than 2x the mean payload.
+    sizes = list(per_device_bytes.values())
+    assert max(sizes) <= 2 * (sum(sizes) / len(sizes)), sizes
+    # All indices together stay MB-scale (restore fetches them eagerly).
+    assert len(json.dumps(index_entries).encode()) < 8 << 20
+    assert n_chunks < 65536, n_chunks
+
+
+@pytest.mark.slow
+def test_llama8b_width_train_step_compiles(devices):
+    """The full train step at 8B widths (2-layer override, LoRA + remat)
+    AOT-compiles over the fsdp=4,tp=2 mesh, and XLA's compiled memory
+    accounting stays within a v4 chip's HBM for this slice."""
+    from serverless_learn_tpu.data.datasets import SyntheticSource
+
+    cfg = ExperimentConfig(
+        model="llama_8b",
+        model_overrides=dict(n_layers=2, lora_rank=16, remat=True),
+        mesh=MeshConfig(fsdp=4, tp=2),
+        optimizer=OptimizerConfig(name="adamw", learning_rate=2e-4),
+        train=TrainConfig(batch_size=4, num_steps=1),
+        data=DataConfig(seq_len=8),
+    )
+    mesh = make_mesh(cfg.mesh, devices=devices)
+    tr = build_trainer(cfg, mesh=mesh)
+    src = iter(SyntheticSource(tr.bundle.make_batch, cfg.data, 4, seed=0))
+    batch = tr.shard_batch(next(src))
+    compiled = tr.step_fn.lower(
+        jax.eval_shape(lambda: tr.init_fn(0)), batch).compile()
+    ma = compiled.memory_analysis()
+    if ma is not None:
+        total = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+                 + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+        # CPU-backend accounting is looser than TPU's (less fusion), so
+        # this is an upper bound smoke check, not the HBM budget.
+        assert total < 32 * GIB, total / GIB
