@@ -475,6 +475,20 @@ def cmd_publish(args) -> int:
             raise SystemExit(f"--format {args.format} requires --path")
         if args.format == "tokens":
             arrays = raw.load_token_corpus(args.path, seq_len=args.seq_len)
+        elif args.format == "imagefolder":
+            # Streaming: decodes + uploads one shard at a time — an eager
+            # decode of an ImageNet-sized split would need ~250 GB of RAM.
+            from serverless_learn_tpu.data.shard_client import (
+                publish_imagefolder)
+
+            meta = publish_imagefolder(
+                args.shard_server, args.dataset, args.path, split=args.split,
+                records_per_shard=args.records_per_shard)
+            print(json.dumps({"dataset": args.dataset,
+                              "num_records": meta.num_records,
+                              "num_shards": meta.num_shards,
+                              "fields": [f.name for f in meta.fields]}))
+            return 0
         else:
             arrays = raw.LOADERS[args.format](args.path, split=args.split)
         meta = publish_dataset(args.shard_server, args.dataset, arrays,
@@ -592,11 +606,15 @@ def build_parser() -> argparse.ArgumentParser:
     pub.add_argument("--shard-server", required=True, metavar="ADDR")
     pub.add_argument("--dataset", required=True)
     pub.add_argument("--format", default="synthetic",
-                     choices=["synthetic", "mnist", "cifar10", "tokens"],
+                     choices=["synthetic", "mnist", "cifar10", "imagefolder",
+                              "tokens"],
                      help="synthetic: sample a model's batch schema; "
                           "mnist/cifar10: parse the standard raw-file "
-                          "distributions under --path; tokens: chunk a "
-                          "corpus file (.bin token dump or raw text)")
+                          "distributions under --path; imagefolder: decode "
+                          "an ImageNet-layout class-directory tree to "
+                          "256x256 uint8 records (train-time 224 crops "
+                          "happen host-side); tokens: chunk a corpus file "
+                          "(.bin token dump or raw text)")
     pub.add_argument("--path", help="raw dataset directory/file "
                                     "(non-synthetic formats)")
     pub.add_argument("--split", default="train", choices=["train", "test"])

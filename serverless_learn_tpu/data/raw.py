@@ -185,7 +185,77 @@ def load_token_corpus(path: str, seq_len: int,
     return {"input_ids": np.concatenate([bos, rows], axis=1)}
 
 
+# -- ImageNet-class image folders --------------------------------------------
+
+# Storage recipe for large-image datasets: decode ONCE at publish time to
+# fixed 256x256 uint8 records (shorter side resized, center-cropped), so the
+# shard plane carries dense, ranged-readable, schema-typed bytes instead of
+# variable-length JPEGs, and the per-step train path does only the cheap
+# random 224-crop + flip (data/transforms.py). 256 keeps the standard 224
+# random-crop jitter margin. One record = 196,608 B; a 50 MB shard holds 256.
+IMAGEFOLDER_STORE_SIZE = 256
+_IMAGE_EXTS = (".jpeg", ".jpg", ".png", ".bmp")
+
+
+def decode_image(path: str, size: int = IMAGEFOLDER_STORE_SIZE) -> np.ndarray:
+    """One image file -> [size, size, 3] uint8: shorter side resized to
+    ``size`` (bilinear), center crop. The canonical ImageNet storage
+    transform (eval uses the same geometry with a 224 center crop)."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        im = im.convert("RGB")
+        w, h = im.size
+        scale = size / min(w, h)
+        nw, nh = max(size, round(w * scale)), max(size, round(h * scale))
+        im = im.resize((nw, nh), Image.BILINEAR)
+        left, top = (nw - size) // 2, (nh - size) // 2
+        im = im.crop((left, top, left + size, top + size))
+        return np.asarray(im, dtype=np.uint8)
+
+
+def list_imagefolder(root: str, split: str = "train"):
+    """ImageNet-layout directory -> [(path, label)], classes sorted to
+    label ids (the torchvision ImageFolder convention). Layout:
+    ``root[/split]/<class_name>/*.{jpeg,jpg,png,bmp}``."""
+    base = root
+    if split and os.path.isdir(os.path.join(root, split)):
+        base = os.path.join(root, split)
+    classes = sorted(d for d in os.listdir(base)
+                     if os.path.isdir(os.path.join(base, d)))
+    if not classes:
+        raise FileNotFoundError(f"no class directories under {base!r}")
+    files = []
+    for label, cls in enumerate(classes):
+        cdir = os.path.join(base, cls)
+        files.extend((os.path.join(cdir, fn), label)
+                     for fn in sorted(os.listdir(cdir))
+                     if fn.lower().endswith(_IMAGE_EXTS))
+    if not files:
+        raise FileNotFoundError(f"no image files under {base!r}")
+    return files
+
+
+def load_imagefolder(root: str, split: str = "train",
+                     image_size: int = IMAGEFOLDER_STORE_SIZE
+                     ) -> Dict[str, np.ndarray]:
+    """Decode a WHOLE imagefolder split into memory — test/small-set sized.
+
+    Returns {"image": [N, S, S, 3] uint8, "label": [N] int32} ready for
+    ``publish_dataset``. At real ImageNet scale (1.28M x 196 kB = ~250 GB)
+    this cannot fit in RAM: the CLI's ``publish --format imagefolder``
+    therefore uses ``data.shard_client.publish_imagefolder``, which decodes
+    and uploads one shard at a time with bounded memory. This eager variant
+    stays for small sets and tests.
+    """
+    files = list_imagefolder(root, split)
+    images = [decode_image(p, image_size) for p, _ in files]
+    return {"image": np.stack(images),
+            "label": np.asarray([l for _, l in files], np.int32)}
+
+
 LOADERS = {
     "mnist": load_mnist,
     "cifar10": load_cifar10,
+    "imagefolder": load_imagefolder,
 }

@@ -47,36 +47,85 @@ class TransformedSource:
             self.source.close()
 
 
+def _crop_flip(img: np.ndarray, oh: int, ow: int, ys, xs,
+               do_flip) -> np.ndarray:
+    """Per-sample crop + optional horizontal flip, fused into one output
+    write. A per-sample slice loop beats both the strided-fancy-index
+    gather (contiguous row memcpys win) and a whole-batch ``np.where`` flip
+    (which reads the batch twice and writes it once more) — measured at
+    224x224: fused loop 20 ms/64 vs 6.5 + 131 ms split."""
+    b = img.shape[0]
+    out = np.empty((b, oh, ow, img.shape[3]), img.dtype)
+    for i in range(b):
+        v = img[i, ys[i]:ys[i] + oh, xs[i]:xs[i] + ow]
+        out[i] = v[:, ::-1] if do_flip[i] else v
+    return out
+
+
 def image_transform(train: bool, seed: int = 0, crop_pad: int = 4,
-                    flip: bool = True, dtype=np.float32) -> Callable:
-    """uint8 images -> float in [0,1); train mode adds pad+random-crop and
-    horizontal flip. Labels pass through."""
+                    flip: bool = True, dtype=np.float32,
+                    out_hw: Optional[tuple] = None) -> Callable:
+    """Stored uint8 images -> model-input batches. Train mode adds
+    random-crop and horizontal flip; labels pass through.
+
+    Two crop geometries, chosen by ``out_hw``:
+    * ``None`` (CIFAR recipe): pad by ``crop_pad`` then random-crop back to
+      the stored size — output size == stored size.
+    * ``(oh, ow)`` smaller than stored (ImageNet recipe): records are
+      stored oversized (256x256, data/raw.py IMAGEFOLDER_STORE_SIZE) and
+      train randomly crops the (oh, ow) window from them — the standard
+      224-from-256 jitter; eval takes the center crop. No padding.
+
+    ``dtype`` floating: uint8 converts to [0, 1) floats host-side (one
+    fused multiply). ``dtype`` uint8: images stay uint8 — the model bundle
+    normalizes on DEVICE (resnet50's ``input_dtype="uint8"``), which keeps
+    host work and host->HBM DMA at a quarter of the float32 bytes.
+    """
     rng = np.random.default_rng((seed, 0xA46))
+    out_dtype = np.dtype(dtype)
 
     def fn(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         img = batch["image"]
-        if train and crop_pad > 0:
-            b, h, w = img.shape[:3]
-            padded = np.pad(
+        b, h, w = img.shape[:3]
+        oh, ow = out_hw if out_hw is not None else (h, w)
+        if oh > h or ow > w:
+            raise ValueError(
+                f"stored images {h}x{w} smaller than requested "
+                f"crop {oh}x{ow}")
+        do_flip = (rng.random(b) < 0.5 if train and flip
+                   else np.zeros(b, bool))
+        if (oh, ow) != (h, w):
+            # Oversized records: crop the window (random in train, center
+            # in eval — the eval geometry matches decode_image's storage).
+            if train:
+                ys = rng.integers(0, h - oh + 1, b)
+                xs = rng.integers(0, w - ow + 1, b)
+            else:
+                ys = np.full(b, (h - oh) // 2)
+                xs = np.full(b, (w - ow) // 2)
+            img = _crop_flip(img, oh, ow, ys, xs, do_flip)
+        elif train and crop_pad > 0:
+            img = np.pad(
                 img, ((0, 0), (crop_pad, crop_pad), (crop_pad, crop_pad),
                       (0, 0)))
             ys = rng.integers(0, 2 * crop_pad + 1, b)
             xs = rng.integers(0, 2 * crop_pad + 1, b)
-            # Gather per-sample crops via a strided view: windows[i] indexed
-            # at (ys[i], xs[i]) — one fancy-index, no Python loop.
-            s = padded.strides
-            windows = np.lib.stride_tricks.as_strided(
-                padded, shape=(b, 2 * crop_pad + 1, 2 * crop_pad + 1, h, w,
-                               img.shape[3]),
-                strides=(s[0], s[1], s[2], s[1], s[2], s[3]))
-            img = windows[np.arange(b), ys, xs]
-        if train and flip:
-            do = rng.random(len(img)) < 0.5
-            img = np.where(do[:, None, None, None], img[:, :, ::-1], img)
-        if img.dtype == np.uint8:
-            img = img.astype(dtype) / np.array(255.0, dtype)
-        else:
-            img = img.astype(dtype, copy=False)
+            img = _crop_flip(img, oh, ow, ys, xs, do_flip)
+        elif do_flip.any():
+            img = _crop_flip(img, oh, ow, np.zeros(b, int), np.zeros(b, int),
+                             do_flip)
+        if np.issubdtype(out_dtype, np.floating):
+            if img.dtype == np.uint8:
+                # One fused pass (convert + scale): 2x the astype-then-
+                # divide throughput at 224x224.
+                img = np.multiply(img, out_dtype.type(1.0 / 255.0),
+                                  dtype=out_dtype)
+            else:
+                img = img.astype(out_dtype, copy=False)
+        elif img.dtype != out_dtype:
+            raise ValueError(
+                f"stored dtype {img.dtype} cannot bridge to non-float "
+                f"model input {out_dtype} host-side")
         out = dict(batch)
         out["image"] = np.ascontiguousarray(img)
         return out
@@ -132,13 +181,18 @@ def auto_transform(meta_fields, input_spec, task: str, train: bool,
     names = {f.name for f in meta_fields}
     want = set(input_spec)
     if names == want:
-        # Schema matches; images may still need dtype conversion/augment.
+        # Schema matches; images may still need dtype conversion, a size
+        # bridge (oversized stored records -> spec-sized crops, the
+        # 224-from-256 ImageNet geometry), and/or augmentation.
         if "image" in names:
-            stored = next(f.dtype for f in meta_fields if f.name == "image")
+            field = next(f for f in meta_fields if f.name == "image")
             spec_dtype = str(input_spec["image"].dtype)
-            if stored != spec_dtype or (train and augment):
+            spec_hw = tuple(input_spec["image"].shape[1:3])
+            out_hw = spec_hw if tuple(field.shape[:2]) != spec_hw else None
+            if field.dtype != spec_dtype or out_hw or (train and augment):
                 return image_transform(train=train and augment, seed=seed,
-                                       dtype=np.dtype(spec_dtype))
+                                       dtype=np.dtype(spec_dtype),
+                                       out_hw=out_hw)
         return None
     if names == {"input_ids"}:
         if task == "mlm":

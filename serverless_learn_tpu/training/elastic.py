@@ -34,7 +34,7 @@ from typing import Callable, List, Optional
 
 import jax
 
-from serverless_learn_tpu.config import (ExperimentConfig, MeshConfig,
+from serverless_learn_tpu.config import (ExperimentConfig,
                                           UnsatisfiableMeshError, scale_mesh)
 from serverless_learn_tpu.control.client import WorkerAgent
 from serverless_learn_tpu.data.datasets import Prefetcher
@@ -51,11 +51,6 @@ def default_device_policy(peers, local_devices) -> List:
     total = sum(p.n_chips for p in peers) if peers else len(local_devices)
     n = max(1, min(total, len(local_devices)))
     return list(local_devices)[:n]
-
-
-def default_mesh_policy(n_devices: int) -> MeshConfig:
-    """dp-only scaling — the policy used when the config mesh is trivial."""
-    return MeshConfig(dp=n_devices)
 
 
 @dataclass

@@ -177,49 +177,80 @@ class ElasticHostSupervisor:
     def _current_ids(self) -> List[int]:
         return self._tagged_ids(self.agent.snapshot()[1])
 
-    def _tagged_chips(self) -> dict:
-        """worker_id -> registered chip count, for tagged peers."""
-        return {p.worker_id: max(1, p.n_chips)
-                for p in self.agent.snapshot()[1]
-                if p.name.startswith(self._tag)}
+    def _tagged_view(self) -> tuple:
+        """(sorted ids, {id: chips}) for tagged peers — from ONE membership
+        snapshot, so the pair is always internally consistent."""
+        peers = [p for p in self.agent.snapshot()[1]
+                 if p.name.startswith(self._tag)]
+        return (sorted(p.worker_id for p in peers),
+                {p.worker_id: max(1, p.n_chips) for p in peers})
 
-    def _active_ids(self, ids: List[int]) -> Optional[List[int]]:
+    def _active_ids(self, ids: List[int],
+                    chips: dict) -> Optional[List[int]]:
         """The subset of a stable membership that actually forms the world.
 
-        The configured mesh makes some world sizes unusable (model axes need
-        a divisible device total, fsdp has a memory floor — config.
-        scale_mesh). Every supervisor deterministically picks the LARGEST
-        prefix of the id-ordered membership whose total registered chips is
-        satisfiable; members beyond it stand by as hot spares and join at
-        the next membership change. Returns None when no prefix (of at
-        least min_hosts hosts) works.
+        The configured mesh makes some chip totals unusable (model axes
+        need a divisible device total, fsdp has a memory floor — config.
+        scale_mesh). Satisfiability depends only on the chip TOTAL, so this
+        is a small subset-sum: every supervisor deterministically picks the
+        member subset with the LARGEST satisfiable chip total (at least
+        ``min_hosts`` members), which handles heterogeneous chip counts —
+        e.g. hosts with [1, 2, 2] chips under tp=2 form the 4-chip world
+        from the two 2-chip hosts, with the 1-chip host standing by (a
+        plain id-prefix scan would find every prefix total odd and
+        wrongly declare the membership unsatisfiable). Ties prefer
+        lower-id members (join order). Spares re-join at the next
+        membership change. Returns None when no subset works.
+
+        ``chips`` MUST come from the same snapshot as ``ids`` (use
+        ``_tagged_view``): mixing a stale id list with fresh chip counts
+        would let two supervisors derive different active sets from "the
+        same" view.
         """
-        chips = self._tagged_chips()
-        for k in range(len(ids), max(self.min_hosts, 1) - 1, -1):
-            total = sum(chips.get(i, 1) for i in ids[:k])
+        grand = sum(chips[i] for i in ids)
+        # count[t] = max members reaching chip total t; take[t] backtracks
+        # the (id index, previous total) of the member that set it.
+        count = [-1] * (grand + 1)
+        count[0] = 0
+        take: List[Optional[tuple]] = [None] * (grand + 1)
+        for idx, i in enumerate(ids):
+            c = chips[i]
+            for t in range(grand, c - 1, -1):
+                if count[t - c] >= 0 and count[t - c] + 1 > count[t]:
+                    count[t] = count[t - c] + 1
+                    take[t] = (idx, t - c)
+        for t in range(grand, 0, -1):
+            if count[t] < max(self.min_hosts, 1):
+                continue
             try:
-                scale_mesh(self.config.mesh, total)
+                scale_mesh(self.config.mesh, t)
             except UnsatisfiableMeshError:
                 continue
-            return ids[:k]
+            members = []
+            while t > 0:
+                idx, t = take[t]
+                members.append(ids[idx])
+            return sorted(members)
         return None
 
-    def _stable_view(self, deadline: float) -> List[int]:
+    def _stable_view(self, deadline: float) -> tuple:
         """Wait until the set of tagged peers (incl. us) holds still for a
-        stability window. Untagged workers sharing the coordinator churn
-        the epoch but not this view."""
+        stability window; returns (ids, {id: chips}) from the final
+        snapshot. Untagged workers sharing the coordinator churn the epoch
+        but not this view."""
         stability_s = max(2.0 * self.agent.interval, 0.3)
         view: Optional[List[int]] = None
+        chips: dict = {}
         since = 0.0
         while True:
-            ids = self._current_ids()
+            ids, chips = self._tagged_view()
             me = self.agent.worker_id
             now = time.time()
             if me in ids and len(ids) >= self.min_hosts:
                 if ids != view:
                     view, since = ids, now
                 elif now - since >= stability_s:
-                    return ids
+                    return ids, chips
             else:
                 view = None
             if now > deadline:
@@ -334,11 +365,11 @@ class ElasticHostSupervisor:
         self._membership_changed.clear()
         if self._committed_step() >= self.config.train.num_steps:
             return "complete"  # run finished while we were between worlds
-        ids = self._stable_view(deadline)
-        active = self._active_ids(ids)
+        ids, chips = self._stable_view(deadline)
+        active = self._active_ids(ids, chips)
         if active is None:
             return self._standby(
-                deadline, f"membership {ids} (chips {self._tagged_chips()}) "
+                deadline, f"membership {ids} (chips {chips}) "
                           f"cannot host mesh {self.config.mesh}")
         if self.agent.worker_id not in active:
             return self._standby(None, f"hot spare behind active {active}")
@@ -441,10 +472,10 @@ class ElasticHostSupervisor:
                 return "error"
             if self._membership_changed.is_set():
                 self._membership_changed.clear()
-                cur = self._current_ids()
+                cur, cur_chips = self._tagged_view()
                 if cur != ids:
                     lost_active = set(active) - set(cur)
-                    joined = set(cur) - set(ids)
+                    would_be = self._active_ids(cur, cur_chips)
                     if lost_active:
                         # World broken: no collective (not even the drain
                         # agreement) can complete; the inner is wedged or
@@ -455,17 +486,19 @@ class ElasticHostSupervisor:
                             drain_sent = True
                         ka = time.time() + self.kill_grace_s
                         kill_at = ka if kill_at is None else min(kill_at, ka)
-                    elif joined:
-                        # Growth opportunity: drain cleanly and re-form to
-                        # absorb the newcomer.
+                    elif would_be is not None and would_be != active:
+                        # Growth (or reshuffle) opportunity: the new
+                        # membership forms a DIFFERENT active set. Drain
+                        # cleanly and re-form to absorb it.
                         if not drain_sent:
                             inner.send_drain()
                             drain_sent = True
                         if kill_at is None:
                             kill_at = time.time() + self.drain_timeout_s
-                    # A departure that only touched hot spares changes
-                    # nothing for the running world: don't drain a healthy
-                    # inner for it.
+                    # Otherwise (spare-only churn, or a joiner that cannot
+                    # change the active set — e.g. an odd chip that keeps
+                    # the same satisfiable prefix): don't restart a healthy
+                    # world for a membership change that alters nothing.
                     ids = cur
             if kill_at is not None and time.time() > kill_at:
                 inner.kill()
@@ -499,7 +532,14 @@ class _InnerHandle:
                 try:
                     ev = json.loads(line)
                 except ValueError:
-                    continue  # stray non-event output
+                    continue  # stray non-JSON output
+                if not (isinstance(ev, dict) and "event" in ev):
+                    # Native libraries under the inner occasionally write to
+                    # fd 1; a bare JSON scalar ("1") parses fine and then
+                    # crashed _monitor's ev["event"] (observed: supervisor
+                    # death -> partner's formation timeout). Only dicts
+                    # carrying an "event" tag are protocol messages.
+                    continue
                 with self._lock:
                     self._events.append(ev)
                     if ev.get("event") == "inner_done":

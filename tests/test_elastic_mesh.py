@@ -112,3 +112,50 @@ def test_solo_trainer_unsatisfiable_world_raises(tmp_path, devices):
                         LocalStore(str(tmp_path)))
     with pytest.raises(UnsatisfiableMeshError):
         et.run()
+
+
+# -- multi-host active-set selection ------------------------------------------
+
+
+def test_active_ids_subset_sum(tmp_path):
+    """The supervisor's world selection handles heterogeneous chip counts:
+    it maximizes the satisfiable chip TOTAL over member subsets (not just
+    id-ordered prefixes), deterministically, preferring lower ids on ties."""
+    import socket as socket_mod
+
+    from serverless_learn_tpu.control.daemons import start_coordinator
+    from serverless_learn_tpu.training.elastic_multihost import (
+        ElasticHostSupervisor)
+
+    with socket_mod.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = start_coordinator(port=port, lease_ttl_ms=5000, sweep_ms=500)
+    try:
+        def sup(mesh, min_hosts=1):
+            return ElasticHostSupervisor(
+                _config(2, mesh), LocalStore(str(tmp_path)),
+                f"127.0.0.1:{port}", min_hosts=min_hosts)
+
+        tp2 = sup(MeshConfig(tp=2))
+        # heterogeneous: prefixes total 1, 3, 5 (all odd) but {2,3} = 4 works
+        assert tp2._active_ids([1, 2, 3], {1: 1, 2: 2, 3: 2}) == [2, 3]
+        # homogeneous: lowest-id pair wins, third stands by
+        assert tp2._active_ids([1, 2, 3], {1: 1, 2: 1, 3: 1}) == [1, 2]
+        # everything usable -> everyone in
+        assert tp2._active_ids([1, 2], {1: 2, 2: 2}) == [1, 2]
+        # nothing satisfiable
+        assert tp2._active_ids([1], {1: 1}) is None
+
+        # fsdp floor: needs a subset totaling a multiple of 2 with plane >= 4
+        f4 = sup(MeshConfig(fsdp=4, tp=2))
+        assert f4._active_ids([1, 2, 3], {1: 4, 2: 3, 3: 4}) == [1, 3]
+        assert f4._active_ids([1, 2], {1: 4, 2: 3}) is None
+
+        # min_hosts constrains the subset size, not just the view size
+        mh = sup(MeshConfig(tp=2), min_hosts=2)
+        assert mh._active_ids([1, 2], {1: 2, 2: 2}) == [1, 2]
+        assert mh._active_ids([1, 2], {1: 2, 2: 1}) is None  # {1} alone is big enough but lonely
+    finally:
+        coord.terminate()
+        coord.wait(timeout=5)
