@@ -467,7 +467,7 @@ def cmd_publish(args) -> int:
         meta = publish_from_bundle(
             args.shard_server, args.dataset, bundle.make_batch, data_cfg,
             num_records=args.num_records,
-            records_per_shard=args.records_per_shard, seed=args.seed)
+            records_per_shard=args.records_per_shard or 512, seed=args.seed)
     else:
         from serverless_learn_tpu.data import raw
 
@@ -478,21 +478,21 @@ def cmd_publish(args) -> int:
         elif args.format == "imagefolder":
             # Streaming: decodes + uploads one shard at a time — an eager
             # decode of an ImageNet-sized split would need ~250 GB of RAM.
+            # Default shard size follows the imagefolder recipe (256
+            # records ~= 50 MB), not the generic 512.
             from serverless_learn_tpu.data.shard_client import (
                 publish_imagefolder)
 
             meta = publish_imagefolder(
                 args.shard_server, args.dataset, args.path, split=args.split,
-                records_per_shard=args.records_per_shard)
-            print(json.dumps({"dataset": args.dataset,
-                              "num_records": meta.num_records,
-                              "num_shards": meta.num_shards,
-                              "fields": [f.name for f in meta.fields]}))
-            return 0
+                records_per_shard=args.records_per_shard or 256)
+            arrays = None
         else:
             arrays = raw.LOADERS[args.format](args.path, split=args.split)
-        meta = publish_dataset(args.shard_server, args.dataset, arrays,
-                               records_per_shard=args.records_per_shard)
+        if arrays is not None:
+            meta = publish_dataset(args.shard_server, args.dataset, arrays,
+                                   records_per_shard=args.records_per_shard
+                                   or 512)
     print(json.dumps({"dataset": args.dataset,
                       "num_records": meta.num_records,
                       "num_shards": meta.num_shards,
@@ -623,7 +623,9 @@ def build_parser() -> argparse.ArgumentParser:
                           "publish")
     pub.add_argument("--num-records", type=int, default=4096,
                      help="synthetic format: how many records")
-    pub.add_argument("--records-per-shard", type=int, default=512)
+    pub.add_argument("--records-per-shard", type=int, default=None,
+                     help="records per shard (default 512; imagefolder "
+                          "defaults to 256 records ~= 50 MB shards)")
     pub.add_argument("--seq-len", type=int, default=128)
     pub.add_argument("--seed", type=int, default=0)
     pub.set_defaults(fn=cmd_publish)

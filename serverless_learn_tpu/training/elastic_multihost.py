@@ -208,28 +208,44 @@ class ElasticHostSupervisor:
         same" view.
         """
         grand = sum(chips[i] for i in ids)
-        # count[t] = max members reaching chip total t; take[t] backtracks
-        # the (id index, previous total) of the member that set it.
-        count = [-1] * (grand + 1)
-        count[0] = 0
-        take: List[Optional[tuple]] = [None] * (grand + 1)
-        for idx, i in enumerate(ids):
-            c = chips[i]
-            for t in range(grand, c - 1, -1):
-                if count[t - c] >= 0 and count[t - c] + 1 > count[t]:
-                    count[t] = count[t - c] + 1
-                    take[t] = (idx, t - c)
-        for t in range(grand, 0, -1):
-            if count[t] < max(self.min_hosts, 1):
+        need = max(self.min_hosts, 1)
+        n = len(ids)
+        # Layered reachability: reach[i][t] is a bitmask of member COUNTS
+        # achievable with chip total t using only the first i members. The
+        # layers are kept (not a rolling 1-D array with backpointers: a
+        # single take[] table gets overwritten by later members and its
+        # chains then mix DP generations — that produced duplicated
+        # members / wrong totals) so the backtrack below is exact.
+        reach = [[0] * (grand + 1) for _ in range(n + 1)]
+        reach[0][0] = 1  # zero members, zero chips
+        for i in range(n):
+            c = chips[ids[i]]
+            prev, cur = reach[i], reach[i + 1]
+            for t in range(grand + 1):
+                m = prev[t]
+                if t >= c:
+                    m |= prev[t - c] << 1
+                cur[t] = m
+        for total in range(grand, 0, -1):
+            counts = reach[n][total] >> need
+            if not counts:
                 continue
             try:
-                scale_mesh(self.config.mesh, t)
+                scale_mesh(self.config.mesh, total)
             except UnsatisfiableMeshError:
                 continue
-            members = []
-            while t > 0:
-                idx, t = take[t]
-                members.append(ids[idx])
+            # Largest achievable member count (use more of the fleet), then
+            # backtrack preferring to EXCLUDE high-id members when both
+            # choices remain feasible -> lower ids (join order) win ties.
+            k = counts.bit_length() - 1 + need
+            members, t = [], total
+            for i in range(n, 0, -1):
+                if (reach[i - 1][t] >> k) & 1:
+                    continue  # droppable without losing feasibility
+                members.append(ids[i - 1])
+                t -= chips[ids[i - 1]]
+                k -= 1
+            assert t == 0 and k == 0, (ids, chips, total, members)
             return sorted(members)
         return None
 

@@ -156,6 +156,45 @@ def test_active_ids_subset_sum(tmp_path):
         mh = sup(MeshConfig(tp=2), min_hosts=2)
         assert mh._active_ids([1, 2], {1: 2, 2: 2}) == [1, 2]
         assert mh._active_ids([1, 2], {1: 2, 2: 1}) is None  # {1} alone is big enough but lonely
+
+        # Brute-force cross-check vs exhaustive subset enumeration: the DP
+        # must return a VALID subset (distinct members, satisfiable total)
+        # achieving the optimal total. (A 1-D backpointer version of this
+        # DP once returned [3, 5, 19, 19] — a duplicated member whose real
+        # total the mesh could not host.)
+        import itertools
+        import random as random_mod
+
+        from serverless_learn_tpu.config import (
+            UnsatisfiableMeshError as UME, scale_mesh as sm)
+
+        rng = random_mod.Random(0)
+        for mesh, min_hosts in ((MeshConfig(tp=4), 1),
+                                (MeshConfig(fsdp=2, tp=2), 2)):
+            s = sup(mesh, min_hosts=min_hosts)
+            for trial in range(60):
+                n = rng.randint(1, 6)
+                ids = sorted(rng.sample(range(1, 40), n))
+                chips = {i: rng.randint(1, 7) for i in ids}
+                got = s._active_ids(ids, chips)
+                best = -1
+                for r in range(min_hosts, n + 1):
+                    for combo in itertools.combinations(ids, r):
+                        t = sum(chips[i] for i in combo)
+                        try:
+                            sm(mesh, t)
+                        except UME:
+                            continue
+                        best = max(best, t)
+                if best < 0:
+                    assert got is None, (ids, chips, got)
+                    continue
+                assert got is not None, (ids, chips, best)
+                assert len(set(got)) == len(got) >= min_hosts, (ids, chips, got)
+                assert set(got) <= set(ids), (ids, chips, got)
+                total = sum(chips[i] for i in got)
+                sm(mesh, total)  # must not raise
+                assert total == best, (ids, chips, got, total, best)
     finally:
         coord.terminate()
         coord.wait(timeout=5)

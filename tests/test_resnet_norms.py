@@ -1,0 +1,86 @@
+"""ResNet normalization options (docs/MFU_ANALYSIS.md).
+
+``norm="batch"`` is the canonical recipe; ``"group"`` removes cross-replica
+stat syncs and running-stats state; ``"none"`` (scale+bias, zero-init
+residual scales) removes every normalization reduction — the full measured
+BN cost. These tests pin the option surface and a small-scale training
+parity: every variant must actually optimize, and the BN-free variant must
+not lag catastrophically on a memorization task (large-scale accuracy parity
+is a recipe question, documented honestly in the analysis doc, not claimed
+by this test).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.config import (
+    DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig, TrainConfig)
+from serverless_learn_tpu.models.registry import get_model
+from serverless_learn_tpu.training.train_step import build_trainer
+
+
+def _train_losses(norm, steps=30):
+    cfg = ExperimentConfig(
+        model="resnet18_cifar",
+        model_overrides=dict(norm=norm, num_classes=4,
+                             dtype=jnp.float32, param_dtype=jnp.float32),
+        mesh=MeshConfig(dp=8),
+        # adamw: the unnormalized variant diverges under the BN recipe's
+        # SGD momentum at lr 0.05 (measured — the classic NF lr
+        # sensitivity); an adaptive optimizer lets one recipe compare all
+        # three variants.
+        optimizer=OptimizerConfig(name="adamw", learning_rate=1e-3),
+        train=TrainConfig(batch_size=64),
+        data=DataConfig(),
+    )
+    trainer = build_trainer(cfg)
+    state = trainer.init()
+    rng = np.random.default_rng(7)
+    batch = trainer.shard_batch({
+        "image": rng.standard_normal((64, 32, 32, 3), dtype=np.float32),
+        "label": rng.integers(0, 4, 64).astype(np.int32),
+    })
+    losses = []
+    for _ in range(steps):
+        state, m = trainer.step(state, batch)
+        losses.append(float(jax.device_get(m["loss"])))
+    return losses
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("norm", ["batch", "group", "none"])
+def test_all_norms_train(devices, norm):
+    """Each variant memorizes a fixed batch: loss drops well below init
+    (measured at 30 steps: batch 0.001x, group 0.08x, none 0.63x)."""
+    losses = _train_losses(norm)
+    assert np.isfinite(losses).all(), losses[-5:]
+    assert losses[-1] < 0.7 * losses[0], (norm, losses[0], losses[-1])
+
+
+def test_none_norm_has_no_stats_state(devices):
+    bundle = get_model("resnet18_cifar", norm="none")
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = bundle.module.init(jax.random.PRNGKey(0), x, train=True)
+    assert "batch_stats" not in variables
+    # blocks start as identity: residual-branch output scales are zero
+    flat = jax.tree_util.tree_flatten_with_path(variables["params"])[0]
+    zero_scales = [p for p, leaf in flat
+                   if "scale" in jax.tree_util.keystr(p)
+                   and float(jnp.abs(leaf).max()) == 0.0]
+    assert zero_scales, "zero-init residual scales missing"
+
+
+def test_group_norm_has_no_stats_state(devices):
+    bundle = get_model("resnet18_cifar", norm="group")
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = bundle.module.init(jax.random.PRNGKey(0), x, train=True)
+    assert "batch_stats" not in variables
+
+
+def test_unknown_norm_rejected(devices):
+    bundle = get_model("resnet18_cifar", norm="layer")
+    with pytest.raises(ValueError, match="unknown norm"):
+        bundle.module.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3), jnp.float32))
