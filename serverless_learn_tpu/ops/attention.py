@@ -110,7 +110,11 @@ def dot_product_attention(
         mesh = get_active_mesh()
         if (mesh is not None and mesh.shape.get("sp", 1) > 1
                 and not in_manual_region()
-                and mask is None and k.shape[1] % mesh.shape["sp"] == 0):
+                and (mask is None or kv_lengths is not None)
+                and k.shape[1] % mesh.shape["sp"] == 0):
+            # Suffix padding (kv_lengths) rides the ring's per-hop "len"
+            # masking; only a GENERAL mask (no lengths form) forces the
+            # dense fallback on an sp mesh.
             impl = "ring"
         elif kv_lengths is not None:
             impl = ("flash" if q.shape[1] >= AUTO_FLASH_MIN_SEQ_LENGTHS
@@ -129,5 +133,6 @@ def dot_product_attention(
 
         if axis_name is None:
             raise ValueError("ring attention needs axis_name (the sp mesh axis)")
-        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
+                              kv_lengths=kv_lengths)
     raise ValueError(f"unknown attention impl {impl!r}")

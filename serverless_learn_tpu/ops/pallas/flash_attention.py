@@ -107,7 +107,7 @@ def _score_block(q, k, scale, i, j, block_q, block_k, causal, mask_ref,
 
 def _fwd_kernel(*refs, scale: float, causal: bool, mask_mode: str):
     vlen_ref = mask_ref = None
-    if mask_mode == "len":
+    if mask_mode in ("len", "klen"):
         vlen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     elif mask_mode == "rows":
         q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
@@ -132,13 +132,17 @@ def _fwd_kernel(*refs, scale: float, causal: bool, mask_mode: str):
     vlen = vlen_ref[pl.program_id(0)] if vlen_ref is not None else None
     active = j <= last_j
     if vlen is not None:
-        # Fully-padded K blocks contribute nothing, and fully-padded Q
-        # blocks produce loss-masked outputs — skip both entirely (this is
-        # where suffix padding becomes FREE, not just correct). A skipped
-        # Q block's output is zeros via the unconditional init+finalize;
-        # its lse is garbage, which is safe ONLY because the backward
-        # kernels skip the same blocks.
+        # Fully-padded K blocks contribute nothing — skip them (this is
+        # where suffix padding becomes FREE, not just correct).
         active = jnp.logical_and(active, j * block_k < vlen)
+    if vlen is not None and mask_mode == "len":
+        # SELF-attention only ("len"): q and kv share positions, so q rows
+        # >= vlen are padding queries whose outputs are loss-masked — skip
+        # their blocks too. A skipped Q block's output is zeros via the
+        # unconditional init+finalize; its lse is garbage, which is safe
+        # ONLY because the backward kernels skip the same blocks. Ring
+        # hops use "klen": their q is a DIFFERENT sequence shard than the
+        # kv the lengths describe, so every q block computes.
         active = jnp.logical_and(active, i * block_q < vlen)
 
     @pl.when(j == 0)
@@ -180,7 +184,7 @@ def _mask_operand(mask_arg, mask_mode, B, S, block_k):
     """(extra_specs_front, extra_specs_back, args_front, args_back)."""
     from jax.experimental.pallas import tpu as pltpu
 
-    if mask_mode == "len":
+    if mask_mode in ("len", "klen"):
         return ([pl.BlockSpec(memory_space=pltpu.SMEM)], [],
                 [mask_arg.astype(jnp.int32)], [])
     if mask_mode == "rows":
@@ -193,7 +197,10 @@ def _mask_operand(mask_arg, mask_mode, B, S, block_k):
 def _flash_fwd_bhsd(q, k, v, mask_arg, mask_mode, *, causal: bool,
                     block_q: int, block_k: int, interpret: bool):
     """q [B,H,T,D]; k,v [B,K,S,D] with H % K == 0 (GQA via index map).
-    ``mask_arg``: [B] valid lengths ("len" mode) or [B, S] rows ("rows").
+    ``mask_arg``: [B] valid lengths ("len" mode: self-attention suffix
+    padding, q and k blocks both skipped; "klen": lengths describe the
+    KEYS only — ring hops, where q is a different sequence shard) or
+    [B, S] rows ("rows").
     Returns (out [B,H,T,D], lse [B,H,n_q,block_q])."""
     from jax.experimental.pallas import tpu as pltpu
 
@@ -243,7 +250,7 @@ def _flash_fwd_bhsd(q, k, v, mask_arg, mask_mode, *, causal: bool,
 
 def _bwd_dq_kernel(*refs, scale: float, causal: bool, mask_mode: str):
     vlen_ref = mask_ref = None
-    if mask_mode == "len":
+    if mask_mode in ("len", "klen"):
         (vlen_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
          dq_ref, acc_ref) = refs
     elif mask_mode == "rows":
@@ -264,8 +271,10 @@ def _bwd_dq_kernel(*refs, scale: float, causal: bool, mask_mode: str):
     vlen = vlen_ref[pl.program_id(0)] if vlen_ref is not None else None
     active = j <= last_j
     if vlen is not None:
-        # Mirror the forward's skips; padded Q rows get dq = 0.
+        # Mirror the forward's K skips.
         active = jnp.logical_and(active, j * block_k < vlen)
+    if vlen is not None and mask_mode == "len":
+        # Self-attention only: padded Q rows get dq = 0 (see _fwd_kernel).
         active = jnp.logical_and(active, i * block_q < vlen)
 
     @pl.when(j == 0)
@@ -297,7 +306,7 @@ def _bwd_dq_kernel(*refs, scale: float, causal: bool, mask_mode: str):
 
 def _bwd_dkv_kernel(*refs, scale: float, causal: bool, mask_mode: str):
     vlen_ref = mask_ref = None
-    if mask_mode == "len":
+    if mask_mode in ("len", "klen"):
         (vlen_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
     elif mask_mode == "rows":
@@ -318,10 +327,13 @@ def _bwd_dkv_kernel(*refs, scale: float, causal: bool, mask_mode: str):
     vlen = vlen_ref[pl.program_id(0)] if vlen_ref is not None else None
     active = i >= first_i
     if vlen is not None:
-        # A fully-padded K block receives zero gradient; a fully-padded Q
-        # block MUST be skipped — the forward skipped it, so its saved lse
-        # is garbage and exp(s - lse) would be inf (NaN through 0*inf).
+        # A fully-padded K block receives zero gradient.
         active = jnp.logical_and(active, j * block_k < vlen)
+    if vlen is not None and mask_mode == "len":
+        # Self-attention only: a fully-padded Q block MUST be skipped —
+        # the forward skipped it, so its saved lse is garbage and
+        # exp(s - lse) would be inf (NaN through 0*inf). "klen" (ring
+        # hops) computes every q block, and its forward wrote real lse.
         active = jnp.logical_and(active, i * block_q < vlen)
 
     @pl.when(i == 0)
@@ -466,36 +478,45 @@ def _flash_core_bwd(mask_mode, causal, block_q, block_k, interpret, res, g):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_with_lse_bhsd(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def flash_with_lse_bhsd(q, k, v, mask_arg, mask_mode, causal, block_q,
+                        block_k, interpret):
     """Forward flash in [B,H,T,D]/[B,K,S,D] layout returning BOTH the
     output and the logsumexp [B, H, T] — the building block ring attention
     merges across hops. Differentiable in q/k/v including through lse
     (the lse cotangent folds into the backward's delta, see
-    ``_flash_bwd_bhsd``). No mask modes: ring hops mask by hop
-    visibility, outside the kernel."""
-    out_lse, _ = _flash_with_lse_fwd(q, k, v, causal, block_q, block_k,
-                                     interpret)
+    ``_flash_bwd_bhsd``).
+
+    ``mask_arg``/``mask_mode`` follow ``_flash_core``'s contract ("none" |
+    "len" | "klen" | "rows"); ring hops use "klen" to push per-hop local
+    ``kv_lengths`` (suffix padding sliced to the hop's K/V shard) into the
+    kernel instead of falling back to dense attention. Rows whose every
+    key is invalid come back with lse ~= log(0) — callers gate those with
+    their hop-visibility weighting."""
+    out_lse, _ = _flash_with_lse_fwd(q, k, v, mask_arg, mask_mode, causal,
+                                     block_q, block_k, interpret)
     return out_lse
 
 
-def _flash_with_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd_bhsd(q, k, v, None, "none", causal=causal,
+def _flash_with_lse_fwd(q, k, v, mask_arg, mask_mode, causal, block_q,
+                        block_k, interpret):
+    out, lse = _flash_fwd_bhsd(q, k, v, mask_arg, mask_mode, causal=causal,
                                block_q=block_q, block_k=block_k,
                                interpret=interpret)
     B, H, T, _ = q.shape
-    return (out, lse.reshape(B, H, T)), (q, k, v, out, lse)
+    return (out, lse.reshape(B, H, T)), (q, k, v, mask_arg, out, lse)
 
 
-def _flash_with_lse_bwd(causal, block_q, block_k, interpret, res, cts):
-    q, k, v, out, lse = res
+def _flash_with_lse_bwd(mask_mode, causal, block_q, block_k, interpret, res,
+                        cts):
+    q, k, v, mask_arg, out, lse = res
     g_out, g_lse = cts
     B, H, T, _ = q.shape
     dq, dk, dv = _flash_bwd_bhsd(
-        q, k, v, None, "none", lse, g_out, out, causal=causal,
+        q, k, v, mask_arg, mask_mode, lse, g_out, out, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
         g_lse=g_lse.reshape(B, H, T // block_q, block_q))
-    return dq, dk, dv
+    return dq, dk, dv, None
 
 
 flash_with_lse_bhsd.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
@@ -518,6 +539,16 @@ def as_kv_mask(mask: Optional[jax.Array], B: int, S: int
     if mask.ndim == 4 and mask.shape == (B, 1, 1, S):
         return mask[:, 0, 0, :].astype(jnp.int32)
     return None
+
+
+def _fallback_mask(mask, kv_lengths, B: int, S: int):
+    """Mask for the dense fallbacks: a caller may pass ONLY kv_lengths
+    (the kernel path needs nothing else), so the fallback synthesizes the
+    equivalent [B, 1, 1, S] key mask rather than silently ignoring the
+    padding (ADVICE r2)."""
+    if mask is not None or kv_lengths is None:
+        return mask
+    return (jnp.arange(S)[None, :] < kv_lengths[:, None]).reshape(B, 1, 1, S)
 
 
 def flash_attention(
@@ -570,12 +601,14 @@ def flash_attention(
     if ((mask is not None and kv_lengths is None and mask_mode == "none")
             or block_q is None or block_k is None
             or T % block_q or S % block_k):
-        return xla_attention(q, k, v, causal=causal, mask=mask)
+        return xla_attention(q, k, v, causal=causal,
+                             mask=_fallback_mask(mask, kv_lengths, B, S))
     backend = jax.default_backend()
     if backend not in ("cpu", "tpu") and not os.environ.get("SLT_FORCE_PALLAS"):
         # Tunneled/experimental platforms have been observed to hang
         # compiling Pallas kernels; dense attention is always correct.
-        return xla_attention(q, k, v, causal=causal, mask=mask)
+        return xla_attention(q, k, v, causal=causal,
+                             mask=_fallback_mask(mask, kv_lengths, B, S))
     if interpret is None:
         interpret = backend == "cpu"
 
@@ -609,10 +642,11 @@ def flash_attention(
     if sp > 1 or B % n_batch or H % tp or K % tp:
         # Can't keep every shard local (sp wants the seq dim sharded —
         # that's ring attention's job) — let GSPMD partition dense attention.
-        return xla_attention(q, k, v, causal=causal, mask=mask)
+        return xla_attention(q, k, v, causal=causal,
+                             mask=_fallback_mask(mask, kv_lengths, B, S))
     spec = P(batch_axes or None, None, "tp" if tp > 1 else None, None)
     if mask_arg is not None:
-        mspec = (P(batch_axes or None) if mask_mode == "len"
+        mspec = (P(batch_axes or None) if mask_mode in ("len", "klen")
                  else P(batch_axes or None, None))
         fn = shard_map_no_check(local, mesh=mesh,
                                 in_specs=(spec, spec, spec, mspec),

@@ -153,3 +153,155 @@ def test_ring_flash_hops_noncausal_grad(sp2_mesh):
     for name, a, b in zip("qkv", gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-4, err_msg=f"d{name}")
+
+
+def _len_mask(kv_lengths, B, T):
+    return (np.arange(T)[None, :] < np.asarray(kv_lengths)[:, None]
+            ).reshape(B, 1, 1, T)
+
+
+# -- round 3: suffix padding through the ring + zigzag schedule --------------
+
+
+def test_ring_kv_lengths_matches_dense(sp_mesh):
+    """Global suffix lengths slice to per-hop local lengths; parity against
+    dense attention with the equivalent mask — including rows whose valid
+    prefix ends mid-shard and rows with fully-padded shards."""
+    B, T = 4, 64
+    q, k, v = _qkv(jax.random.PRNGKey(6), B, T, 4, 16)
+    lens = jnp.array([64, 37, 8, 50], jnp.int32)  # shard size is 8
+    for causal in (False, True):
+        ref = xla_attention(q, k, v, causal=causal,
+                            mask=jnp.asarray(_len_mask(lens, B, T)))
+        out = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, causal=causal, kv_lengths=lens, mesh=sp_mesh))(q, k, v)
+        # padded q rows attend nothing real; compare valid rows only
+        # (same contract as the flash kernel's kv_lengths path)
+        for b in range(B):
+            n_valid = int(lens[b])
+            np.testing.assert_allclose(
+                np.asarray(out)[b, :n_valid], np.asarray(ref)[b, :n_valid],
+                rtol=2e-5, atol=2e-5, err_msg=f"row {b} causal={causal}")
+
+
+def test_ring_kv_lengths_grad_finite(sp_mesh):
+    q, k, v = _qkv(jax.random.PRNGKey(7), 2, 32, 2, 8)
+    lens = jnp.array([32, 11], jnp.int32)
+    g = jax.jit(jax.grad(lambda q, k, v: (ring_attention(
+        q, k, v, causal=True, kv_lengths=lens, mesh=sp_mesh) ** 2).sum(),
+        (0, 1, 2)))(q, k, v)
+    for a in g:
+        assert np.isfinite(np.asarray(a)).all()
+
+
+def test_zigzag_flash_matches_dense(sp2_mesh):
+    """Long-context shape (T_loc=256 -> half-blocks 128): the zigzag
+    schedule must engage the blocked kernel and match dense causal
+    attention, fwd + grad, incl. GQA."""
+    from serverless_learn_tpu.parallel.ring_attention import _auto_zigzag
+
+    q, k, v = _qkv(jax.random.PRNGKey(8), 4, 512, 4, 32, K=2)
+    assert _auto_zigzag(causal=True, n=2, t_loc=256)
+    fn = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, causal=True, mesh=sp2_mesh))
+    jaxpr = str(jax.make_jaxpr(fn)(q, k, v))
+    assert "pallas_call" in jaxpr
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(fn(q, k, v)), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    gf = jax.jit(jax.grad(lambda q, k, v: ring_attention(
+        q, k, v, causal=True, mesh=sp2_mesh).sum(), (0, 1, 2)))(q, k, v)
+    gr = jax.grad(lambda q, k, v: xla_attention(
+        q, k, v, causal=True).astype(jnp.float32).sum(), (0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4, err_msg=f"d{name}")
+
+
+def test_zigzag_with_kv_lengths(sp2_mesh):
+    B, T = 4, 512
+    q, k, v = _qkv(jax.random.PRNGKey(9), B, T, 4, 32)
+    lens = jnp.array([512, 300, 128, 511], jnp.int32)
+    ref = xla_attention(q, k, v, causal=True,
+                        mask=jnp.asarray(_len_mask(lens, B, T)))
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, causal=True, kv_lengths=lens, layout="zigzag",
+        mesh=sp2_mesh))(q, k, v)
+    for b in range(B):
+        n_valid = int(lens[b])
+        np.testing.assert_allclose(
+            np.asarray(out)[b, :n_valid], np.asarray(ref)[b, :n_valid],
+            rtol=2e-5, atol=2e-5, err_msg=f"row {b}")
+
+
+def test_forced_layouts_agree(sp_mesh):
+    q, k, v = _qkv(jax.random.PRNGKey(10), 2, 64, 4, 16)
+    a = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, causal=True, layout="contiguous", mesh=sp_mesh))(q, k, v)
+    b = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, causal=True, layout="zigzag", mesh=sp_mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError, match="zigzag"):
+        ring_attention(q, k, v, causal=False, layout="zigzag", mesh=sp_mesh)
+
+
+def test_auto_dispatch_padded_sp_uses_ring(sp_mesh, monkeypatch):
+    """sp>1 with SUFFIX padding must take the ring path (r2 it silently
+    fell back to GSPMD-partitioned dense attention)."""
+    from serverless_learn_tpu.ops import attention as attn_mod
+    from serverless_learn_tpu.parallel import ring_attention as ring_mod
+
+    calls = []
+    real = ring_mod.ring_attention
+
+    def spy(*a, **kw):
+        calls.append(kw.get("kv_lengths") is not None)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ring_mod, "ring_attention", spy)
+    B, T = 2, 64
+    q, k, v = _qkv(jax.random.PRNGKey(11), B, T, 4, 16)
+    lens = jnp.array([64, 40], jnp.int32)
+    attn_mod.dot_product_attention(
+        q, k, v, causal=True, mask=jnp.asarray(_len_mask(lens, B, T)),
+        kv_lengths=lens, axis_name="sp")
+    assert calls == [True], "padded sp batch must ride the ring with lengths"
+
+
+def test_zigzag_halves_causal_compute(sp2_mesh):
+    """The measurable balance win on a virtual mesh: XLA's compiled FLOP
+    count per shard. Contiguous causal ring computes hidden hops only to
+    discard them; zigzag computes exactly the visible half-pairs
+    (measured 2.5x fewer FLOPs at sp=2, T=1024)."""
+    B, T, H, D = 4, 1024, 4, 64
+    q = jnp.zeros((B, T, H, D), jnp.float32)
+    k = jnp.zeros((B, T, H, D), jnp.float32)
+    v = jnp.zeros((B, T, H, D), jnp.float32)
+    flops = {}
+    for layout in ("contiguous", "zigzag"):
+        fn = jax.jit(lambda q, k, v, lay=layout: ring_attention(
+            q, k, v, causal=True, layout=lay, mesh=sp2_mesh))
+        flops[layout] = fn.lower(q, k, v).compile().cost_analysis()["flops"]
+    assert flops["zigzag"] < 0.6 * flops["contiguous"], flops
+
+
+def test_ring_kv_lengths_multi_q_block(sp2_mesh):
+    """Regression (r3 review): hop kernels must use keys-only length
+    masking ("klen"). The self-attention "len" mode skips q BLOCKS whose
+    index exceeds the kv shard's local length — with multiple q blocks per
+    hop (T_loc=1024 -> two 512-blocks) that silently dropped the hop's
+    valid keys for valid q rows."""
+    B, T = 4, 2048
+    q, k, v = _qkv(jax.random.PRNGKey(12), B, T, 2, 16)
+    lens = jnp.array([2048, 1200, 512, 2048], jnp.int32)
+    for causal in (False, True):
+        ref = xla_attention(q, k, v, causal=causal,
+                            mask=jnp.asarray(_len_mask(lens, B, T)))
+        out = jax.jit(lambda q, k, v, c=causal: ring_attention(
+            q, k, v, causal=c, kv_lengths=lens, mesh=sp2_mesh))(q, k, v)
+        for b in range(B):
+            n_valid = int(lens[b])
+            np.testing.assert_allclose(
+                np.asarray(out)[b, :n_valid], np.asarray(ref)[b, :n_valid],
+                rtol=2e-5, atol=2e-5, err_msg=f"row {b} causal={causal}")
