@@ -243,14 +243,13 @@ def cmd_train(args) -> int:
 def cmd_eval(args) -> int:
     """Forward-only evaluation of a (possibly checkpointed) model."""
     from serverless_learn_tpu.training.loop import run_eval
-    from serverless_learn_tpu.training.train_step import build_trainer
 
     if args.world_size or args.num_processes:
         raise SystemExit(
             "--world-size/--num-processes form a multi-host group and apply "
             "to `train`; `eval` is single-process")
     cfg = _config_from_args(args)
-    trainer = build_trainer(cfg)
+    trainer = _build_inference_trainer(cfg)
     ckpt = _make_checkpointer(args)
     ckpt_step = None
     if ckpt is not None:
@@ -272,12 +271,46 @@ def cmd_eval(args) -> int:
     return 0
 
 
+def _build_inference_trainer(cfg):
+    """build_trainer for forward-only commands: a config mesh SMALLER than
+    the host's device count uses a device prefix (serving hardware rarely
+    matches the training pod; `--set mesh.dp=1` must just work on an
+    8-device host) instead of erroring on the exact-size check."""
+    import jax
+
+    from serverless_learn_tpu.parallel.mesh import make_mesh
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    devices = jax.devices()
+    if cfg.mesh.size < len(devices):
+        return build_trainer(
+            cfg, mesh=make_mesh(cfg.mesh, devices=devices[:cfg.mesh.size]))
+    return build_trainer(cfg)
+
+
+def _serving_config(cfg):
+    """The sequential-module twin of a (possibly pipeline-trained) config.
+
+    ``generate``/``serve`` need the KV-cached sequential module — the
+    pipeline execution knob is stripped (``pipeline_interleave``/``_stages``
+    stay: the param conversion needs them to undo the interleaved layer
+    order). The mesh is the caller's problem (``--set mesh.dp=1 ...``):
+    serving hardware rarely matches the training pod."""
+    ov = dict(cfg.model_overrides)
+    was_pipeline = bool(ov.pop("pipeline", False))
+    ov.pop("pipeline_microbatches", None)
+    return (cfg.override(model_overrides=ov) if was_pipeline else cfg)
+
+
 def _load_inference_params(args, cfg, trainer):
     """Params for a pure-forward workload: (params, checkpoint_step).
 
-    With a checkpoint store: deserialize the full TrainState on the host
-    but place ONLY params on device — optimizer moments (~2x params for
-    adamw) never touch HBM. Without: a jitted params-only init."""
+    With a checkpoint store: restore ONLY the params subtree on the host
+    (template-free — see ``Checkpointer.restore_params_host``) and place
+    it on device; optimizer moments (~2x params for adamw) never touch
+    HBM. A pipeline-trained checkpoint's stacked ``pipe_blocks`` are
+    unstacked into the serving module's per-layer layout. Without a
+    store: a jitted params-only init."""
     import jax
     import jax.numpy as jnp
 
@@ -286,10 +319,40 @@ def _load_inference_params(args, cfg, trainer):
         step = ckpt.latest_step()
         if step is None:
             raise SystemExit("no checkpoint found in the configured store")
-        abstract = jax.eval_shape(lambda: trainer.init_fn(0))
-        host = ckpt.restore_host(abstract, step=step)
+        host_params = ckpt.restore_params_host(step=step)
+        mcfg = getattr(trainer.bundle.module, "cfg", None)
+        has_stack = (isinstance(host_params, dict)
+                     and ("pipe_blocks" in host_params
+                          or "pipe_blocks" in host_params.get("pipeline", {})))
+        if (has_stack and mcfg is not None
+                and not getattr(mcfg, "pipeline", False)):
+            from serverless_learn_tpu.models.transformer import (
+                unstack_pipeline_params)
+
+            host_params = unstack_pipeline_params(host_params, mcfg)
+        # The template-free restore skipped shape checking; validate
+        # against the serving module's abstract params so a config/
+        # checkpoint mismatch fails HERE with paths and shapes, not as a
+        # dot-shape error deep inside the jitted forward.
+        abstract = jax.eval_shape(lambda: trainer.init_fn(0)).params
+        try:
+            bad = [
+                (jax.tree_util.keystr(p), tuple(got.shape), tuple(want.shape))
+                for (p, got), want in zip(
+                    jax.tree_util.tree_flatten_with_path(host_params)[0],
+                    jax.tree_util.tree_leaves(abstract))
+                if tuple(got.shape) != tuple(want.shape)]
+        except ValueError:
+            bad = None  # structure mismatch: report trees, not leaves
+        if bad is None or bad:
+            detail = (f"first mismatches: {bad[:3]}" if bad
+                      else "param tree STRUCTURE differs")
+            raise SystemExit(
+                f"checkpoint params do not fit the serving config "
+                f"({cfg.model} with overrides {cfg.model_overrides}): "
+                f"{detail}")
         return jax.tree_util.tree_map(
-            jax.device_put, host.params, trainer.state_shardings.params), step
+            jax.device_put, host_params, trainer.state_shardings.params), step
     init_params = jax.jit(
         lambda: trainer.bundle.module.init(
             jax.random.PRNGKey(cfg.train.seed),
@@ -304,14 +367,13 @@ def cmd_generate(args) -> int:
     import jax.numpy as jnp
 
     from serverless_learn_tpu.inference.generate import generate
-    from serverless_learn_tpu.training.train_step import build_trainer
 
     if args.world_size or args.num_processes:
         raise SystemExit(
             "--world-size/--num-processes form a multi-host group and apply "
             "to `train`; `generate` is single-process")
-    cfg = _config_from_args(args)
-    trainer = build_trainer(cfg)
+    cfg = _serving_config(_config_from_args(args))
+    trainer = _build_inference_trainer(cfg)
     params, ckpt_step = _load_inference_params(args, cfg, trainer)
     if args.prompt:
         ids = [int(t) for t in args.prompt.split(",")]
@@ -340,13 +402,12 @@ def np_tolist(x):
 def cmd_serve(args) -> int:
     """Serve generation requests (JSON lines over TCP) from a causal LM."""
     from serverless_learn_tpu.inference.server import GenerationServer
-    from serverless_learn_tpu.training.train_step import build_trainer
     from serverless_learn_tpu.utils.metrics import log_json
 
     if args.world_size or args.num_processes:
         raise SystemExit("`serve` is single-process")
-    cfg = _config_from_args(args)
-    trainer = build_trainer(cfg)
+    cfg = _serving_config(_config_from_args(args))
+    trainer = _build_inference_trainer(cfg)
     params, _ = _load_inference_params(args, cfg, trainer)
     server = GenerationServer(trainer.bundle.module, params,
                               host=args.host, port=args.port)

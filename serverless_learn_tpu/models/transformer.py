@@ -455,6 +455,40 @@ class PipelinedBlocks(nn.Module):
         return out
 
 
+def unstack_pipeline_params(params: dict, cfg: "TransformerConfig") -> dict:
+    """Pipeline-trained params -> the sequential module's layout.
+
+    A pipeline checkpoint stores the blocks as ONE ``pipe_blocks`` subtree
+    (under the Transformer's ``pipeline`` submodule) with a leading
+    ``n_layers`` dim; the sequential (servable, KV-cached) module wants
+    per-layer ``layer_{i}`` subtrees. Interleaved schedules
+    execute the stack in ``layer_execution_order``; sequential ``layer_i``
+    is execution step i, so it takes stack index ``order[i]`` — a V-chunk
+    checkpoint served without this mapping would run its layers in the
+    wrong order. Non-block params (embedder, final norm, lm_head) share
+    names across both layouts and pass through untouched.
+    """
+    stacked = None
+    if "pipe_blocks" in params:  # stack at the root (direct Block stacks)
+        out = {k: v for k, v in params.items() if k != "pipe_blocks"}
+        stacked = params["pipe_blocks"]
+    elif "pipe_blocks" in params.get("pipeline", {}):  # Transformer nesting
+        out = {k: v for k, v in params.items() if k != "pipeline"}
+        stacked = params["pipeline"]["pipe_blocks"]
+    if stacked is None:
+        return params
+    from serverless_learn_tpu.parallel.pipeline import layer_execution_order
+    if cfg.pipeline_interleave > 1:
+        order = layer_execution_order(cfg.n_layers, cfg.pipeline_stages,
+                                      cfg.pipeline_interleave)
+    else:
+        order = list(range(cfg.n_layers))
+    for step, ident in enumerate(order):
+        out[f"layer_{step}"] = jax.tree_util.tree_map(
+            lambda leaf: leaf[ident], stacked)
+    return out
+
+
 class Transformer(nn.Module):
     cfg: TransformerConfig
 
@@ -473,7 +507,11 @@ class Transformer(nn.Module):
         if decode and prefill:
             raise ValueError("decode and prefill are mutually exclusive")
         if (decode or prefill) and cfg.pipeline:
-            raise NotImplementedError("decode with pipeline=True")
+            raise NotImplementedError(
+                "decode with pipeline=True: serve the sequential twin "
+                "instead — unstack_pipeline_params converts a pipeline "
+                "checkpoint to the per-layer layout (the generate/serve "
+                "CLIs do this automatically)")
         if (decode or prefill) and not cfg.causal:
             raise ValueError("decode requires a causal model")
         if (decode or prefill) and not cfg.use_rope:

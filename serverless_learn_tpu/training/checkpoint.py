@@ -318,6 +318,49 @@ class Checkpointer:
             is_leaf=lambda x: hasattr(x, "shape"))
         return serialization.from_bytes(host_template, blob)
 
+    def restore_params_host(self, step: Optional[int] = None) -> Any:
+        """The checkpoint's ``params`` subtree as host numpy arrays —
+        WITHOUT a template.
+
+        Inference against a checkpoint whose training-time module
+        structure differs from the serving module (a pipeline-trained
+        stack served sequentially) cannot build the training TrainState
+        template cheaply (it may need a mesh this host doesn't have, and
+        the optimizer-state structure with it). Blob checkpoints
+        deserialize structure-free via msgpack; sharded checkpoints carry
+        every leaf's keystr path in META, so the params leaves are
+        selected by path and reassembled into their nested dict."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoint under {self.name!r}")
+        if not self._is_sharded(step):
+            from flax.serialization import msgpack_restore
+
+            state = msgpack_restore(self.store.get(self._key(step)))
+            return state["params"]
+        reader = _ShardedReader(self.store, self._key(step))
+        out: dict = {}
+        for i, info in enumerate(reader.meta["leaves"]):
+            path = info["path"]
+            if not path.startswith(".params"):
+                continue
+            keys = re.findall(r"\['([^']+)'\]", path[len(".params"):])
+            if not keys:
+                continue
+            shape = tuple(info["shape"])
+            box = tuple((0, n) for n in shape)
+            leaf = reader.assemble(i, box, shape, _np_dtype(info["dtype"]))
+            reader.drop_cache()
+            node = out
+            for k in keys[:-1]:
+                node = node.setdefault(k, {})
+            node[keys[-1]] = leaf
+        if not out:
+            raise IOError(
+                f"checkpoint {self._key(step)} has no .params leaves")
+        return out
+
     def restore(self, template: TrainState, step: Optional[int] = None,
                 shardings: Any = None) -> TrainState:
         """Restore into the structure of ``template`` (can be the freshly

@@ -1,0 +1,140 @@
+"""Serving pipeline-trained checkpoints (VERDICT r2 item 7).
+
+A pp-trained model's params live as one layer-stacked ``pipe_blocks``
+subtree; the KV-cached decode path needs the sequential per-layer layout.
+``unstack_pipeline_params`` converts at load time (undoing the interleaved
+execution order when present), ``Checkpointer.restore_params_host``
+restores the params subtree template-free from both checkpoint layouts,
+and the generate/serve CLIs wire it together.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.cli import main
+from serverless_learn_tpu.config import (
+    DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig, TrainConfig)
+from serverless_learn_tpu.data.datasets import SyntheticSource
+from serverless_learn_tpu.inference.generate import generate
+from serverless_learn_tpu.models.registry import get_model
+from serverless_learn_tpu.models.transformer import unstack_pipeline_params
+from serverless_learn_tpu.parallel.mesh import make_mesh
+from serverless_learn_tpu.training.checkpoint import Checkpointer, LocalStore
+from serverless_learn_tpu.training.train_step import build_trainer
+
+
+def _train_pp(tmp_path, devices, sharded, overrides=None, steps=2):
+    """Train llama_tiny on a dp2.pp2 mesh briefly; checkpoint; return cfg."""
+    cfg = ExperimentConfig(
+        model="llama_tiny",
+        model_overrides=dict(pipeline=True, pipeline_microbatches=2,
+                             n_layers=4, **(overrides or {})),
+        mesh=MeshConfig(dp=2, pp=2),
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.05),
+        train=TrainConfig(batch_size=8, dtype="float32",
+                          param_dtype="float32"),
+        data=DataConfig(seq_len=32),
+    )
+    mesh = make_mesh(cfg.mesh, devices=devices[:4])
+    trainer = build_trainer(cfg, mesh=mesh)
+    state = trainer.init()
+    src = iter(SyntheticSource(trainer.bundle.make_batch, cfg.data, 8, seed=3))
+    for _ in range(steps):
+        state, _ = trainer.step(state, trainer.shard_batch(next(src)))
+    ckpt = Checkpointer(LocalStore(str(tmp_path)), async_save=False,
+                        sharded=sharded)
+    ckpt.save(state)
+    return cfg, trainer, state
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_generate_from_pp_checkpoint(tmp_path, devices, sharded):
+    """The verdict's done-criterion: generate produces tokens from a
+    pp=2-trained llama checkpoint — via template-free params restore +
+    layout conversion, greedy output deterministic."""
+    cfg, _, _ = _train_pp(tmp_path, devices, sharded=sharded)
+
+    ckpt = Checkpointer(LocalStore(str(tmp_path)), async_save=False)
+    host_params = ckpt.restore_params_host()
+    assert "pipe_blocks" in host_params["pipeline"]
+
+    serve_overrides = {k: v for k, v in cfg.model_overrides.items()
+                       if not k.startswith("pipeline")}
+    bundle = get_model("llama_tiny", dtype=jnp.float32,
+                       param_dtype=jnp.float32, **serve_overrides)
+    params = unstack_pipeline_params(host_params, bundle.module.cfg)
+    assert "pipe_blocks" not in params and "layer_0" in params
+
+    prompt = jnp.asarray([[5, 9, 11]], jnp.int32)
+    out = generate(bundle.module, params, prompt, max_new_tokens=6)
+    assert out.shape == (1, 9)
+    out2 = generate(bundle.module, params, prompt, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_converted_params_match_pipeline_forward(tmp_path, devices):
+    """Logit parity: the sequential module with converted params computes
+    the same function the pipeline-trained model computed."""
+    cfg, trainer, state = _train_pp(tmp_path, devices, sharded=True)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        0, 512, (4, 32)), jnp.int32)
+    # the trained function, on the training (pp=2) mesh
+    logits_pp = trainer.bundle.module.apply(
+        {"params": jax.device_get(state.params)}, tokens)
+
+    ckpt = Checkpointer(LocalStore(str(tmp_path)), async_save=False)
+    host_params = ckpt.restore_params_host()
+    serve_overrides = {k: v for k, v in cfg.model_overrides.items()
+                       if not k.startswith("pipeline")}
+    bundle = get_model("llama_tiny", dtype=jnp.float32,
+                       param_dtype=jnp.float32, **serve_overrides)
+    params = unstack_pipeline_params(host_params, bundle.module.cfg)
+    logits_seq = bundle.module.apply({"params": params}, tokens)
+    np.testing.assert_allclose(np.asarray(logits_seq),
+                               np.asarray(logits_pp), rtol=2e-5, atol=2e-5)
+
+
+def test_interleaved_checkpoint_layer_order(tmp_path, devices):
+    """A V-chunk (interleaved) checkpoint's stack is indexed by layer
+    identity while execution follows layer_execution_order; conversion
+    must map sequential layer_i to stack[order[i]] or the served model
+    runs its layers in the wrong order."""
+    cfg, trainer, state = _train_pp(
+        tmp_path, devices, sharded=False,
+        overrides=dict(pipeline_interleave=2, pipeline_stages=2))
+    tokens = jnp.asarray(np.random.default_rng(1).integers(
+        0, 512, (4, 32)), jnp.int32)
+    logits_pp = trainer.bundle.module.apply(
+        {"params": jax.device_get(state.params)}, tokens)
+
+    ckpt = Checkpointer(LocalStore(str(tmp_path)), async_save=False)
+    host_params = ckpt.restore_params_host()
+    serve_overrides = {k: v for k, v in cfg.model_overrides.items()
+                       if k not in ("pipeline", "pipeline_microbatches")}
+    bundle = get_model("llama_tiny", dtype=jnp.float32,
+                       param_dtype=jnp.float32, **serve_overrides)
+    assert bundle.module.cfg.pipeline_interleave == 2
+    params = unstack_pipeline_params(host_params, bundle.module.cfg)
+    logits_seq = bundle.module.apply({"params": params}, tokens)
+    np.testing.assert_allclose(np.asarray(logits_seq),
+                               np.asarray(logits_pp), rtol=2e-5, atol=2e-5)
+
+
+def test_generate_cli_from_pp_checkpoint(tmp_path, devices, capsys):
+    """End to end through the CLI: a pipeline-trained checkpoint serves
+    tokens with no manual surgery."""
+    cfg, _, _ = _train_pp(tmp_path, devices, sharded=True)
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(cfg.to_json())
+    rc = main(["generate", "--config", str(cfg_path),
+               "--set", "mesh.dp=1", "--set", "mesh.pp=1",
+               "--checkpoint-dir", str(tmp_path),
+               "--prompt", "5,9,11", "--max-new-tokens", "4"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(out["tokens"][0]) == 7
+    assert out["checkpoint_step"] is not None
