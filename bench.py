@@ -4,7 +4,9 @@ Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 plus MFU/step-time fields, and appends to ``bench_history.json`` — the
 regression guard round 1 lacked (its own README number silently dipped 2.6%).
 A run below 97% of the historical best sets ``"regression": true`` and warns
-on stderr; the run still reports honestly rather than failing.
+on stderr; the run still reports honestly rather than failing. The FULL
+bench ladder (r50, BERT, Llama-1B LoRA, flash timing, decode, data plane)
+re-measures through the same guard via ``benchmarks/ladder.py``.
 
 Baseline: the reference (`sheaconlon/serverless_learn`) publishes no numbers
 (README is one line; BASELINE.md). Its workers are CPU processes whose
@@ -31,25 +33,10 @@ HISTORY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "bench_history.json")
 
 
-def _load_history():
-    if not os.path.exists(HISTORY):
-        return []
-    try:
-        with open(HISTORY) as f:
-            return json.load(f)
-    except ValueError:
-        # Never silently overwrite the regression baseline: preserve the
-        # corrupt file and start a fresh history beside it.
-        corrupt = HISTORY + ".corrupt"
-        os.replace(HISTORY, corrupt)
-        print(f"WARNING: {HISTORY} was unreadable; moved to {corrupt}",
-              file=sys.stderr)
-        return []
-    except (IOError, OSError):
-        return []
-
-
-def main():
+def measure() -> dict:
+    """One headline measurement: ResNet-18/CIFAR train throughput on the
+    local chip(s). Pure measurement — no history side effects (the ladder
+    reuses it)."""
     import jax
 
     from serverless_learn_tpu.config import (
@@ -83,43 +70,32 @@ def main():
     float(jax.device_get(metrics["loss"]))
     dt = time.perf_counter() - t0
     step_s = dt / STEPS
-    sps = cfg.train.batch_size / step_s
-    sps_chip = sps / n_dev
+    sps_chip = cfg.train.batch_size / step_s / n_dev
     flops = compiled_step_flops(trainer.step_fn, state, batch,
                                 n_devices=n_dev)
     utilization = mfu(flops, step_s, n_chips=n_dev)
-
-    history = _load_history()
-    kind = jax.devices()[0].device_kind
-    # Only entries from the same configuration are a valid baseline — a
-    # batch-size sweep or different chip would otherwise flag (or mask)
-    # a phantom regression.
-    best = max((h["value"] for h in history
-                if h.get("batch_per_chip") == BATCH
-                and h.get("device_kind", kind) == kind), default=0.0)
     record = {
         "metric": "resnet18_cifar_train_samples_per_sec_per_chip",
         "value": round(sps_chip, 1),
         "unit": "samples/sec/chip",
         "vs_baseline": round(sps_chip / CPU_WORKER_BASELINE_SPS, 2),
         "batch_per_chip": BATCH,
-        "device_kind": kind,
+        "device_kind": jax.devices()[0].device_kind,
         "step_time_ms": round(step_s * 1e3, 2),
     }
     if utilization is not None:
         record["mfu"] = round(utilization, 4)
-    if best and sps_chip < 0.97 * best:
-        record["regression"] = True
-        print(f"WARNING: {sps_chip:.1f} samples/s/chip is below 97% of the "
-              f"historical best {best:.1f} (bench_history.json)",
-              file=sys.stderr)
-    history.append(dict(record, time=time.strftime("%Y-%m-%dT%H:%M:%S")))
-    try:
-        with open(HISTORY, "w") as f:
-            json.dump(history, f, indent=1)
-    except (IOError, OSError):
-        pass  # read-only checkout: still report
-    print(json.dumps(record))
+    return record
+
+
+def main():
+    from serverless_learn_tpu.utils.benchlog import record as record_history
+
+    rec = record_history(
+        measure(), HISTORY, better="max", rel_threshold=0.03,
+        key_fields=("metric", "device_kind", "batch_per_chip"))
+    print(json.dumps(rec))
+    return 0
 
 
 if __name__ == "__main__":

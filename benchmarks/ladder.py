@@ -1,0 +1,318 @@
+"""Re-measure EVERY README ladder row through the shared regression guard.
+
+    python benchmarks/ladder.py [--rows r18,r50,...]
+
+One JSON line per row, each appended to the repo-root ``bench_history.json``
+via ``utils/benchlog.record`` — so every README number is reproducible by
+one command and drift-flagged (>5% vs the best comparable historical entry;
+timing rows widen the threshold by their measured spread). Exit code 1 if
+any row flagged a regression; rows still all run and report.
+
+Rows (chip-side unless noted):
+    r18        ResNet-18/CIFAR headline (the driver's bench.py, 3% guard)
+    r50        ResNet-50/ImageNet-shape b256
+    bert       BERT-base MLM b64 seq512
+    llama1b    Llama-1B LoRA b8 seq1024 bf16+remat
+    lm         llama_tiny-architecture LM seq512 (benchmarks/lm_bench.py)
+    flash      flash-attention fwd+bwd T=8192 causal — median of 5 with
+               spread (resolves the r2 14-vs-16 ms ambiguity: chip-load
+               variance of a few ms is real; the guard widens accordingly)
+    decode     KV-cache decode tokens/sec (llama_tiny b8)
+    data       shard-server raw stream + CIFAR ingest + ImageNet ingest
+               (host-side; no chip needed)
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from serverless_learn_tpu.utils.benchlog import record as record_history  # noqa: E402
+
+HISTORY = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench_history.json")
+
+
+def _device_kind():
+    import jax
+
+    return jax.devices()[0].device_kind
+
+
+def _train_row(metric, model, batch_per_chip, seq=None, overrides=None,
+               opt=None, steps=10, unit_tokens=False, train_kw=None):
+    import jax
+
+    from serverless_learn_tpu.config import (
+        DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig,
+        TrainConfig)
+    from serverless_learn_tpu.data.datasets import SyntheticSource
+    from serverless_learn_tpu.training.train_step import build_trainer
+    from serverless_learn_tpu.utils.flops import compiled_step_flops, mfu
+
+    n_dev = len(jax.devices())
+    batch = batch_per_chip * n_dev
+    cfg = ExperimentConfig(
+        model=model,
+        model_overrides=overrides or {},
+        mesh=MeshConfig(dp=n_dev),
+        optimizer=opt or OptimizerConfig(name="adamw", learning_rate=1e-3),
+        train=TrainConfig(batch_size=batch, **(train_kw or {})),
+        data=DataConfig(seq_len=seq) if seq else DataConfig(),
+    )
+    trainer = build_trainer(cfg)
+    state = trainer.init()
+    src = iter(SyntheticSource(trainer.bundle.make_batch, cfg.data, batch,
+                               seed=0))
+    b = trainer.shard_batch(next(src))
+    for _ in range(3):
+        state, m = trainer.step(state, b)
+    float(jax.device_get(m["loss"]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = trainer.step(state, b)
+    float(jax.device_get(m["loss"]))
+    step_s = (time.perf_counter() - t0) / steps
+    per_chip = batch / step_s / n_dev
+    if unit_tokens:
+        per_chip *= seq
+    rec = {
+        "metric": metric,
+        "value": round(per_chip, 1),
+        "unit": ("tokens/sec/chip" if unit_tokens else "samples/sec/chip"),
+        "batch_per_chip": batch_per_chip,
+        "device_kind": _device_kind(),
+        "step_time_ms": round(step_s * 1e3, 2),
+    }
+    u = mfu(compiled_step_flops(trainer.step_fn, state, b, n_devices=n_dev),
+            step_s, n_chips=n_dev)
+    if u is not None:
+        rec["mfu"] = round(u, 4)
+    return rec
+
+
+def row_r18():
+    sys.path.insert(0, os.path.dirname(HISTORY))
+    import bench
+
+    return record_history(bench.measure(), HISTORY, better="max",
+                          rel_threshold=0.03,
+                          key_fields=("metric", "device_kind",
+                                      "batch_per_chip"))
+
+
+def row_r50():
+    from serverless_learn_tpu.config import OptimizerConfig
+
+    rec = _train_row(
+        "resnet50_imagenet_train_samples_per_sec_per_chip",
+        "resnet50_imagenet", batch_per_chip=256,
+        opt=OptimizerConfig(name="sgd", learning_rate=0.1, momentum=0.9),
+        steps=5)
+    return record_history(rec, HISTORY, better="max",
+                          key_fields=("metric", "device_kind",
+                                      "batch_per_chip"))
+
+
+def row_bert():
+    rec = _train_row(
+        "bert_base_mlm_train_tokens_per_sec_per_chip", "bert_base",
+        batch_per_chip=64, seq=512, unit_tokens=True, steps=10)
+    return record_history(rec, HISTORY, better="max",
+                          key_fields=("metric", "device_kind",
+                                      "batch_per_chip"))
+
+
+def row_llama1b():
+    rec = _train_row(
+        "llama1b_lora_train_tokens_per_sec_per_chip", "llama_1b",
+        batch_per_chip=8, seq=1024,
+        overrides={"lora_rank": 16}, train_kw={"remat": True},
+        steps=5, unit_tokens=True)
+    return record_history(rec, HISTORY, better="max",
+                          key_fields=("metric", "device_kind",
+                                      "batch_per_chip"))
+
+
+def row_lm():
+    from benchmarks.lm_bench import run as lm_run
+
+    rec = lm_run("llama_tiny", batch=32, seq=512, vocab=32000, fused=False,
+                 steps=10)
+    rec["device_kind"] = _device_kind()
+    return record_history(rec, HISTORY, better="max",
+                          key_fields=("metric", "device_kind",
+                                      "batch_per_chip", "seq", "vocab"))
+
+
+def row_flash(repeats=5):
+    """Flash fwd+bwd at T=8192 causal — median of ``repeats`` with spread.
+
+    The r2 README carried two disagreeing one-offs (14 vs 16 ms) for this
+    exact shape; the honest number is the median with its relative spread,
+    and the guard widens by 2x that spread."""
+    import jax
+    import jax.numpy as jnp
+
+    from serverless_learn_tpu.ops.pallas.flash_attention import (
+        flash_attention)
+
+    B, T, H, D = 1, 8192, 8, 64
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (B, T, H, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (B, T, H, D),
+                          jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (B, T, H, D),
+                          jnp.bfloat16)
+
+    f = jax.jit(jax.grad(lambda q, k, v: flash_attention(
+        q, k, v, causal=True).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+
+    inner = 10
+
+    def once():
+        """ms per fwd+bwd, amortized over ``inner`` dispatches: a per-call
+        scalar fetch would time the axon tunnel's round trip (~100 ms), not
+        the kernel."""
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            g = f(q, k, v)
+        float(jax.device_get(jnp.sum(g[0].astype(jnp.float32))))
+        return (time.perf_counter() - t0) * 1e3 / inner
+
+    once()  # compile + warm
+    times = sorted(once() for _ in range(repeats))
+    med = statistics.median(times)
+    spread = (times[-1] - times[0]) / med if med else 0.0
+    rec = {
+        "metric": "flash_attention_fwd_bwd_t8192_causal_ms",
+        "value": round(med, 2),
+        "unit": "ms (median of %d)" % repeats,
+        "spread_rel": round(spread, 4),
+        "times_ms": [round(t, 2) for t in times],
+        "device_kind": _device_kind(),
+    }
+    return record_history(rec, HISTORY, better="min",
+                          key_fields=("metric", "device_kind"))
+
+
+def row_decode():
+    import jax
+    import jax.numpy as jnp
+
+    from serverless_learn_tpu.inference.generate import generate
+    from serverless_learn_tpu.models.registry import get_model
+
+    bundle = get_model("llama_tiny")
+    module = bundle.module
+    params = jax.jit(lambda: module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])()
+    B, P, N = 8, 128, 128
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, P), 0,
+                                module.cfg.vocab_size)
+    out = generate(module, params, prompt, max_new_tokens=N)  # compile
+    float(jax.device_get(out[0, -1]))
+    t0 = time.perf_counter()
+    out = generate(module, params, prompt, max_new_tokens=N)
+    float(jax.device_get(out[0, -1]))
+    dt = time.perf_counter() - t0
+    rec = {
+        "metric": "llama_tiny_decode_tokens_per_sec",
+        "value": round(B * N / dt, 1),
+        "unit": "tokens/sec",
+        "batch": B, "prompt": P, "new": N,
+        "device_kind": _device_kind(),
+    }
+    return record_history(rec, HISTORY, better="max",
+                          key_fields=("metric", "device_kind", "batch",
+                                      "prompt", "new"))
+
+
+def _demand_from_history(metric: str, fallback: float) -> float:
+    """Chip-side demand for the ingest comparisons, from the best measured
+    entry in the shared history — not a hand-recorded constant (the rule
+    this ladder exists to enforce)."""
+    from serverless_learn_tpu.utils.benchlog import load_history
+
+    vals = [h["value"] for h in load_history(HISTORY)
+            if h.get("metric") == metric
+            and isinstance(h.get("value"), (int, float))]
+    return max(vals) if vals else fallback
+
+
+def row_data():
+    """Host-side data plane rows (no chip involved)."""
+    import socket
+    import tempfile
+
+    from benchmarks.data_bench import (
+        bench_imagenet_pipeline, bench_raw, bench_real_pipeline)
+    from serverless_learn_tpu.control.daemons import start_shard_server
+
+    r18_demand = _demand_from_history(
+        "resnet18_cifar_train_samples_per_sec_per_chip", 29793.0)
+    r50_demand = _demand_from_history(
+        "resnet50_imagenet_train_samples_per_sec_per_chip", 2440.0)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    out = []
+    with tempfile.TemporaryDirectory() as root:
+        proc = start_shard_server(port=port, root=root)
+        addr = f"127.0.0.1:{port}"
+        try:
+            for rec, key in (
+                (bench_raw(addr, 64, 4), ("metric", "streams")),
+                (bench_real_pipeline(addr, 4096, r18_demand), ("metric",)),
+                (bench_imagenet_pipeline(addr, 2048, r50_demand),
+                 ("metric",)),
+            ):
+                # 10%, not the default 5%: host-side rows share cores with
+                # the server process and swing ~7% run to run (measured);
+                # the chip-side rows keep the tighter bar.
+                out.append(record_history(rec, HISTORY, better="max",
+                                          rel_threshold=0.10,
+                                          key_fields=key))
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+    return out
+
+
+ROWS = {
+    "r18": row_r18,
+    "r50": row_r50,
+    "bert": row_bert,
+    "llama1b": row_llama1b,
+    "lm": row_lm,
+    "flash": row_flash,
+    "decode": row_decode,
+    "data": row_data,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", default=",".join(ROWS),
+                    help="comma-separated subset of: " + ",".join(ROWS))
+    args = ap.parse_args()
+    regressed = False
+    for name in args.rows.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        if name not in ROWS:
+            raise SystemExit(f"unknown row {name!r}; rows: {','.join(ROWS)}")
+        result = ROWS[name]()
+        for rec in (result if isinstance(result, list) else [result]):
+            print(json.dumps(rec), flush=True)
+            regressed |= bool(rec.get("regression"))
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

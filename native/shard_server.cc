@@ -20,6 +20,7 @@
 // Usage: shard_server [--port 50053] [--root DIR]
 
 #include <atomic>
+#include <unistd.h>
 #include <cstdarg>
 #include <cstdint>
 #include <cstring>
@@ -46,7 +47,22 @@ struct Stats {
   std::atomic<uint64_t> bytes_stored{0};
   std::atomic<uint32_t> active_streams{0};
   std::atomic<uint64_t> crc_failures{0};
+  std::atomic<uint64_t> throttled_chunks{0};
+  std::atomic<uint64_t> starved_streams_served{0};
 };
+
+// Streams whose fetcher declared flow == 0 (consumer actively waiting).
+// While any are in flight, well-fed streams pace themselves between chunks
+// so disk/NIC bandwidth shifts to the starved ones — closing the loop the
+// reference's reserved FlowFeedback only gestured at (proto :73-75).
+std::atomic<int> g_starved_streams{0};
+// Per-chunk pause of a non-starved stream while a starved one is in
+// flight. Scaled by the reported queue depth: a fetcher with N batches
+// buffered can afford ~N ms per chunk before its consumer notices;
+// unreported streams get the minimum (they made no urgency claim either
+// way). Capped so a huge depth can't park a stream indefinitely.
+constexpr int kThrottleUsBase = 2000;
+constexpr int kThrottleUsMax = 16000;
 
 Stats g_stats;
 std::string g_root = "/tmp/slt_shards";
@@ -171,9 +187,23 @@ bool send_error_chunk(int fd, const std::string& err) {
 
 void handle_fetch(int fd, const slt::FetchRequest& req) {
   g_stats.active_streams++;
+  const bool starved = req.flow_present() && req.flow() == 0;
+  const int throttle_us =
+      req.flow_present()
+          ? std::min<int>(kThrottleUsMax,
+                          static_cast<int>(req.flow()) * kThrottleUsBase)
+          : kThrottleUsBase;
+  if (starved) {
+    g_starved_streams++;
+    g_stats.starved_streams_served++;
+  }
   struct Scope {
-    ~Scope() { g_stats.active_streams--; }
-  } scope;
+    bool starved;
+    ~Scope() {
+      g_stats.active_streams--;
+      if (starved) g_starved_streams--;
+    }
+  } scope{starved};
 
   uint64_t syn_size = 0;
   bool synthetic = parse_synthetic(req.key(), &syn_size);
@@ -236,6 +266,13 @@ void handle_fetch(int fd, const slt::FetchRequest& req) {
     }
     g_stats.bytes_served += n;
     buf.clear();
+    if (!starved && g_starved_streams.load(std::memory_order_relaxed) > 0) {
+      // A consumer is waiting somewhere and this fetcher has runway
+      // (flow > 0 or unreported): yield between chunks, longer the more
+      // runway it declared.
+      g_stats.throttled_chunks++;
+      ::usleep(throttle_us);
+    }
   }
   if (!terminated) {
     uint32_t stored_crc = 0;
@@ -480,6 +517,9 @@ void serve_conn(int fd) {
         rep.set_bytes_stored(g_stats.bytes_stored.load());
         rep.set_active_streams(g_stats.active_streams.load());
         rep.set_crc_failures(g_stats.crc_failures.load());
+        rep.set_throttled_chunks(g_stats.throttled_chunks.load());
+        rep.set_starved_streams_served(
+            g_stats.starved_streams_served.load());
         g_rpc_stats.Fill(&rep);
         std::string out;
         rep.SerializeToString(&out);

@@ -25,6 +25,7 @@ struct Conn {
   int fd = -1;
   std::string addr;
   std::mutex mu;
+  int flow = -1;  // sticky per-connection backpressure; -1 = unreported
 
   bool ensure() {
     if (fd >= 0) return true;
@@ -41,6 +42,14 @@ struct Conn {
 }  // namespace
 
 extern "C" {
+
+// Sticky backpressure for this connection's future fetches: the worker's
+// prefetch-queue depth (0 = consumer starving). -1 clears.
+void slt_set_flow(void* h, int flow) {
+  auto* c = static_cast<Conn*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  c->flow = flow;
+}
 
 void* slt_connect(const char* host_port) {
   auto* c = new Conn();
@@ -105,6 +114,10 @@ long long slt_fetch_into(void* h, const char* key, unsigned long long offset,
   req.set_key(key);
   req.set_offset(offset);
   req.set_length(length);
+  if (c->flow >= 0) {
+    req.set_flow(static_cast<uint32_t>(c->flow));
+    req.set_flow_present(true);
+  }
   std::string payload;
   req.SerializeToString(&payload);
   if (!slt::write_frame(c->fd, slt::MSG_FETCH_REQ, payload)) {

@@ -333,24 +333,26 @@ def _load_inference_params(args, cfg, trainer):
         # The template-free restore skipped shape checking; validate
         # against the serving module's abstract params so a config/
         # checkpoint mismatch fails HERE with paths and shapes, not as a
-        # dot-shape error deep inside the jitted forward.
+        # dot-shape error deep inside the jitted forward. Keyed by path
+        # (NOT a leaf zip, which silently truncates and mis-pairs when
+        # the tree structures differ).
         abstract = jax.eval_shape(lambda: trainer.init_fn(0)).params
-        try:
-            bad = [
-                (jax.tree_util.keystr(p), tuple(got.shape), tuple(want.shape))
-                for (p, got), want in zip(
-                    jax.tree_util.tree_flatten_with_path(host_params)[0],
-                    jax.tree_util.tree_leaves(abstract))
-                if tuple(got.shape) != tuple(want.shape)]
-        except ValueError:
-            bad = None  # structure mismatch: report trees, not leaves
-        if bad is None or bad:
-            detail = (f"first mismatches: {bad[:3]}" if bad
-                      else "param tree STRUCTURE differs")
+        got = {jax.tree_util.keystr(p): tuple(l.shape) for p, l in
+               jax.tree_util.tree_flatten_with_path(host_params)[0]}
+        want = {jax.tree_util.keystr(p): tuple(l.shape) for p, l in
+                jax.tree_util.tree_flatten_with_path(abstract)[0]}
+        problems = (
+            [f"missing from checkpoint: {k}" for k in sorted(want - got.keys())]
+            + [f"not in serving model: {k}" for k in sorted(got.keys() - want)]
+            + [f"{k}: checkpoint {got[k]} vs serving {want[k]}"
+               for k in sorted(got.keys() & want) if got[k] != want[k]])
+        if problems:
             raise SystemExit(
                 f"checkpoint params do not fit the serving config "
                 f"({cfg.model} with overrides {cfg.model_overrides}): "
-                f"{detail}")
+                + "; ".join(problems[:5])
+                + (f" (+{len(problems) - 5} more)" if len(problems) > 5
+                   else ""))
         return jax.tree_util.tree_map(
             jax.device_put, host_params, trainer.state_shardings.params), step
     init_params = jax.jit(
@@ -574,6 +576,9 @@ def cmd_stats(args) -> int:
         out["bytes_served"] = rep.bytes_served
         out["bytes_stored"] = rep.bytes_stored
         out["active_streams"] = rep.active_streams
+        out["crc_failures"] = rep.crc_failures
+        out["throttled_chunks"] = rep.throttled_chunks
+        out["starved_streams_served"] = rep.starved_streams_served
     c.close()
     print(json.dumps(out, indent=2))
     return 0

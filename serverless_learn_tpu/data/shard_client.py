@@ -275,6 +275,10 @@ class ShardStreamSource:
                     # the size_of (manifest) RPC a length-less fetch issues.
                     nbytes = sum(f.record_nbytes for f in self.meta.fields
                                  ) * (hi - lo)
+                    # Report backpressure with the fetch: queue depth 0
+                    # means the consumer is starving and the server should
+                    # prioritize this stream over well-fed ones.
+                    client.set_flow(self._q.qsize())
                     raw = client.fetch(_shard_key(self.dataset, idx),
                                        length=nbytes)
                     shard = decode_shard(self.meta, raw, hi - lo)

@@ -1,0 +1,86 @@
+"""Shared benchmark history + regression guard.
+
+Round 2 guarded only the ResNet-18 headline; every other README number was
+a hand-recorded one-off (two in-repo flash timings even disagreed, 14 vs
+16 ms). Every benchmark row now funnels through :func:`record`, which
+appends to one history file and flags any regression beyond a relative
+threshold against the best comparable historical entry.
+
+Comparability: an entry only competes with entries that match it on every
+``key_fields`` value (metric name, device kind, and whatever shape knobs
+the caller lists) — a batch-size sweep or a different chip must neither
+flag nor mask a phantom regression.
+
+Variance-awareness: noisy timings (the flash kernel's chip-load variance is
+a few ms at ~15 ms) report a relative spread (``spread_rel``, e.g.
+IQR/median over repeats); the effective threshold widens to
+``max(rel_threshold, 2 * spread_rel)`` so day-to-day noise doesn't cry
+wolf while real regressions still trip it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Optional, Sequence
+
+
+def load_history(path: str) -> list:
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except ValueError:
+        # Never silently overwrite the regression baseline: preserve the
+        # corrupt file and start a fresh history beside it.
+        corrupt = path + ".corrupt"
+        os.replace(path, corrupt)
+        print(f"WARNING: {path} was unreadable; moved to {corrupt}",
+              file=sys.stderr)
+        return []
+    except (IOError, OSError):
+        return []
+
+
+def record(
+    entry: dict,
+    history_path: str,
+    *,
+    better: str = "max",
+    rel_threshold: float = 0.05,
+    key_fields: Sequence[str] = ("metric", "device_kind"),
+) -> dict:
+    """Append ``entry`` to the history; mark ``entry["regression"]`` and
+    warn on stderr if its ``value`` is worse than the best comparable
+    entry by more than the (variance-widened) threshold. Returns the
+    entry (mutated) either way — benches report honestly, never fail."""
+    assert better in ("max", "min")
+    history = load_history(history_path)
+    same = [h for h in history
+            if all(h.get(k) == entry.get(k) for k in key_fields)]
+    vals = [h["value"] for h in same if isinstance(h.get("value"), (int,
+                                                                    float))]
+    best: Optional[float] = None
+    if vals:
+        best = max(vals) if better == "max" else min(vals)
+    gap = max(rel_threshold, 2.0 * float(entry.get("spread_rel", 0.0)))
+    if best is not None:
+        worse = (entry["value"] < best * (1 - gap) if better == "max"
+                 else entry["value"] > best * (1 + gap))
+        if worse:
+            entry["regression"] = True
+            entry["best"] = round(best, 2)
+            print(
+                f"WARNING: {entry.get('metric')} = {entry['value']} is a "
+                f">{gap:.0%} regression vs best {best} "
+                f"({os.path.basename(history_path)})", file=sys.stderr)
+    history.append(dict(entry, time=time.strftime("%Y-%m-%dT%H:%M:%S")))
+    try:
+        with open(history_path, "w") as f:
+            json.dump(history, f, indent=1)
+    except (IOError, OSError):
+        pass  # read-only checkout: still report
+    return entry

@@ -1,0 +1,72 @@
+"""The shared bench-history regression guard (VERDICT r2 item 8)."""
+
+import json
+
+from serverless_learn_tpu.utils.benchlog import load_history, record
+
+
+def _entry(**kw):
+    base = {"metric": "m", "value": 100.0, "unit": "x/s",
+            "device_kind": "TPU v5 lite"}
+    base.update(kw)
+    return base
+
+
+def test_record_appends_and_flags_regression(tmp_path):
+    path = str(tmp_path / "hist.json")
+    first = record(_entry(value=100.0), path)
+    assert "regression" not in first
+    ok = record(_entry(value=97.0), path)  # within 5%
+    assert "regression" not in ok
+    bad = record(_entry(value=90.0), path)  # 10% below best
+    assert bad["regression"] is True and bad["best"] == 100.0
+    assert len(load_history(path)) == 3
+
+
+def test_only_comparable_entries_compete(tmp_path):
+    path = str(tmp_path / "hist.json")
+    record(_entry(value=100.0, batch_per_chip=4096), path,
+           key_fields=("metric", "device_kind", "batch_per_chip"))
+    # different batch: not a baseline for this entry
+    other = record(_entry(value=50.0, batch_per_chip=256), path,
+                   key_fields=("metric", "device_kind", "batch_per_chip"))
+    assert "regression" not in other
+    # different chip: also no competition
+    chip = record(_entry(value=50.0, batch_per_chip=4096,
+                         device_kind="TPU v4"), path,
+                  key_fields=("metric", "device_kind", "batch_per_chip"))
+    assert "regression" not in chip
+
+
+def test_min_better_direction(tmp_path):
+    path = str(tmp_path / "hist.json")
+    record(_entry(metric="t_ms", value=14.0), path, better="min")
+    worse = record(_entry(metric="t_ms", value=16.0), path, better="min")
+    assert worse["regression"] is True
+    better = record(_entry(metric="t_ms", value=13.0), path, better="min")
+    assert "regression" not in better
+
+
+def test_variance_widens_threshold(tmp_path):
+    """The r2 flash ambiguity (14 vs 16 ms one-offs): with a measured 15%
+    spread the guard must NOT flag a 14 -> 16 ms move, but a clean 2x
+    regression still trips it."""
+    path = str(tmp_path / "hist.json")
+    record(_entry(metric="t_ms", value=14.0, spread_rel=0.15), path,
+           better="min")
+    noisy = record(_entry(metric="t_ms", value=16.0, spread_rel=0.15), path,
+                   better="min")
+    assert "regression" not in noisy  # 14.3% worse < 2*15% widened gap
+    real = record(_entry(metric="t_ms", value=30.0, spread_rel=0.15), path,
+                  better="min")
+    assert real["regression"] is True
+
+
+def test_corrupt_history_preserved(tmp_path):
+    path = str(tmp_path / "hist.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    rec = record(_entry(), path)
+    assert "regression" not in rec
+    assert (tmp_path / "hist.json.corrupt").exists()
+    assert len(json.load(open(path))) == 1
