@@ -5,7 +5,10 @@
 
 Prints one JSON line: decode tokens/sec (total and per sequence) plus
 prefill+decode wall time. Measures the jitted prefill+scan loop in
-``inference/generate.py``.
+``inference/generate.py``. ``run()`` is the single shared measurement the
+ladder's regression-guarded decode row also uses — one methodology, no
+drifting twins (the r2 README's 6.0k one-off came from exactly such a
+divergence).
 """
 
 import argparse
@@ -17,6 +20,44 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def run(model: str = "llama_tiny", batch: int = 8, prompt_len: int = 128,
+        new_tokens: int = 128, iters: int = 5) -> dict:
+    """One decode measurement, tunnel-amortized over ``iters`` calls."""
+    import jax
+    import jax.numpy as jnp
+
+    from serverless_learn_tpu.inference.generate import generate
+    from serverless_learn_tpu.models.registry import get_model
+
+    bundle = get_model(model)
+    module = bundle.module
+    params = jax.jit(lambda: module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])()
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0,
+        module.cfg.vocab_size)
+
+    # Warm up with the SAME signature as the timed loop (rng passed): a
+    # None-rng warmup traces a different pytree and the first timed call
+    # would pay a recompile.
+    out = generate(module, params, prompt, new_tokens,
+                   rng=jax.random.PRNGKey(0))
+    float(jax.device_get(out[0, -1]))  # scalar sync (axon: not block_until_ready)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = generate(module, params, prompt, new_tokens,
+                       rng=jax.random.PRNGKey(i))
+    float(jax.device_get(out[0, -1]))
+    dt = (time.perf_counter() - t0) / iters
+    return {
+        "metric": f"{model}_decode_tokens_per_sec",
+        "batch": batch, "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "value": round(batch * new_tokens / dt, 1), "unit": "tokens/sec",
+        "per_seq_tokens_per_sec": round(new_tokens / dt, 1),
+        "wall_ms": round(dt * 1e3, 1),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="llama_tiny")
@@ -25,42 +66,8 @@ def main():
     ap.add_argument("--new", type=int, default=128)
     ap.add_argument("--iters", type=int, default=5)
     args = ap.parse_args()
-
-    import jax
-    import jax.numpy as jnp
-
-    from serverless_learn_tpu.inference.generate import generate
-    from serverless_learn_tpu.models.registry import get_model
-
-    bundle = get_model(args.model)
-    module = bundle.module
-    params = jax.jit(lambda: module.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])()
-    prompt = jax.random.randint(
-        jax.random.PRNGKey(1), (args.batch, args.prompt), 0,
-        module.cfg.vocab_size)
-
-    # Warm up with the SAME signature as the timed loop (rng passed): a
-    # None-rng warmup traces a different pytree and the first timed call
-    # would pay a recompile.
-    out = generate(module, params, prompt, args.new,
-                   rng=jax.random.PRNGKey(0))
-    _ = jax.device_get(out)
-    t0 = time.perf_counter()
-    for i in range(args.iters):
-        out = generate(module, params, prompt, args.new,
-                       rng=jax.random.PRNGKey(i))
-        _ = jax.device_get(out)
-    dt = (time.perf_counter() - t0) / args.iters
-    total_new = args.batch * args.new
-    print(json.dumps({
-        "metric": f"{args.model}_decode_tokens_per_sec",
-        "batch": args.batch, "prompt_len": args.prompt,
-        "new_tokens": args.new,
-        "value": round(total_new / dt, 1), "unit": "tokens/sec",
-        "per_seq_tokens_per_sec": round(args.new / dt, 1),
-        "wall_ms": round(dt * 1e3, 1),
-    }))
+    print(json.dumps(run(args.model, args.batch, args.prompt, args.new,
+                         args.iters)))
 
 
 if __name__ == "__main__":

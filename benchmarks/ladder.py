@@ -201,45 +201,32 @@ def row_flash(repeats=5):
 
 
 def row_decode():
-    import jax
-    import jax.numpy as jnp
+    from benchmarks.gen_bench import run as gen_run
 
-    from serverless_learn_tpu.inference.generate import generate
-    from serverless_learn_tpu.models.registry import get_model
-
-    bundle = get_model("llama_tiny")
-    module = bundle.module
-    params = jax.jit(lambda: module.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])()
-    B, P, N = 8, 128, 128
-    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, P), 0,
-                                module.cfg.vocab_size)
-    out = generate(module, params, prompt, max_new_tokens=N)  # compile
-    float(jax.device_get(out[0, -1]))
-    t0 = time.perf_counter()
-    out = generate(module, params, prompt, max_new_tokens=N)
-    float(jax.device_get(out[0, -1]))
-    dt = time.perf_counter() - t0
-    rec = {
-        "metric": "llama_tiny_decode_tokens_per_sec",
-        "value": round(B * N / dt, 1),
-        "unit": "tokens/sec",
-        "batch": B, "prompt": P, "new": N,
-        "device_kind": _device_kind(),
-    }
+    rec = gen_run("llama_tiny", batch=8, prompt_len=128, new_tokens=128)
+    rec["device_kind"] = _device_kind()
     return record_history(rec, HISTORY, better="max",
                           key_fields=("metric", "device_kind", "batch",
-                                      "prompt", "new"))
+                                      "prompt_len", "new_tokens"))
 
 
 def _demand_from_history(metric: str, fallback: float) -> float:
     """Chip-side demand for the ingest comparisons, from the best measured
     entry in the shared history — not a hand-recorded constant (the rule
-    this ladder exists to enforce)."""
+    this ladder exists to enforce). Filtered to the CURRENT chip kind:
+    values differ across chips, which is exactly why the guard keys on
+    device_kind."""
+    import jax
+
     from serverless_learn_tpu.utils.benchlog import load_history
 
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        kind = None
     vals = [h["value"] for h in load_history(HISTORY)
             if h.get("metric") == metric
+            and (kind is None or h.get("device_kind") == kind)
             and isinstance(h.get("value"), (int, float))]
     return max(vals) if vals else fallback
 
@@ -265,17 +252,28 @@ def row_data():
         proc = start_shard_server(port=port, root=root)
         addr = f"127.0.0.1:{port}"
         try:
+            # Raw streaming swings hardest of all (149-286 MB/s observed
+            # over one day on this shared-core box): median of 3 with the
+            # spread recorded so benchlog widens its own threshold.
+            raws = sorted((bench_raw(addr, 64, 4) for _ in range(3)),
+                          key=lambda r: r["value"])
+            raw = raws[1]
+            raw["spread_rel"] = round(
+                (raws[2]["value"] - raws[0]["value"]) / raw["value"], 4)
             for rec, key in (
-                (bench_raw(addr, 64, 4), ("metric", "streams")),
+                (raw, ("metric", "streams")),
                 (bench_real_pipeline(addr, 4096, r18_demand), ("metric",)),
                 (bench_imagenet_pipeline(addr, 2048, r50_demand),
                  ("metric",)),
             ):
-                # 10%, not the default 5%: host-side rows share cores with
-                # the server process and swing ~7% run to run (measured);
-                # the chip-side rows keep the tighter bar.
+                # 20%, not the default 5%: host-side rows share one core
+                # with the server process and swing +-15% run to run
+                # (measured across a day: raw 149-355 MB/s, ingest
+                # 47-59k/s). The regressions this guard exists to catch
+                # here (losing the fused transform, a chunking bug) are
+                # 2x-class; chip-side rows keep the tighter bar.
                 out.append(record_history(rec, HISTORY, better="max",
-                                          rel_threshold=0.10,
+                                          rel_threshold=0.20,
                                           key_fields=key))
         finally:
             proc.terminate()

@@ -188,11 +188,15 @@ bool send_error_chunk(int fd, const std::string& err) {
 void handle_fetch(int fd, const slt::FetchRequest& req) {
   g_stats.active_streams++;
   const bool starved = req.flow_present() && req.flow() == 0;
-  const int throttle_us =
+  // min BEFORE any narrowing: flow is a client-supplied uint32, and
+  // flow * base in int overflows at flow >= ~1.07M (UB; a negative value
+  // reaching usleep() would wrap to a ~71-minute sleep per chunk).
+  const useconds_t throttle_us = static_cast<useconds_t>(
       req.flow_present()
-          ? std::min<int>(kThrottleUsMax,
-                          static_cast<int>(req.flow()) * kThrottleUsBase)
-          : kThrottleUsBase;
+          ? std::min<uint64_t>(
+                kThrottleUsMax,
+                static_cast<uint64_t>(req.flow()) * kThrottleUsBase)
+          : kThrottleUsBase);
   if (starved) {
     g_starved_streams++;
     g_stats.starved_streams_served++;

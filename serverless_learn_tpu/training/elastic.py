@@ -82,8 +82,13 @@ class ElasticTrainer:
         self.name = name
         # The worker's name is its checkpoint namespace: two live workers
         # sharing a name would silently clobber each other's state (guarded
-        # at startup in run()).
-        self.ckpt = Checkpointer(store, name=name, async_save=False)
+        # at startup in run()). Sharded layout, same as the multi-host
+        # path: saves write only replica-0 shards and restores ranged-fetch
+        # exactly the target sharding's bytes — a single-host world change
+        # (e.g. fsdp 2 -> 4) no longer round-trips the full state through
+        # one blob (r2 weak item).
+        self.ckpt = Checkpointer(store, name=name, async_save=False,
+                                 sharded=True)
         self.device_policy = device_policy
         # Default policy honors the CONFIGURED mesh: tp/pp/sp/ep stay fixed,
         # fsdp is a memory floor, dp stretches with the world (config.

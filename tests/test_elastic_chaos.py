@@ -1,0 +1,216 @@
+"""Elastic chaos soak (VERDICT r2 item 10).
+
+A randomized, seeded join/kill schedule over the multi-host elastic harness
+(tests/emh_host.py): hosts join and are SIGKILLed (whole process group, so
+wedgeable inners die with their supervisors) at random points until the run
+has lived through >= 6 world generations. Invariants asserted per schedule:
+
+* no supervisor wedge — every surviving host EXITS with a clean RESULT
+  (status complete) within the deadline;
+* the committed step (store LATEST) is MONOTONE throughout the churn —
+  kills roll back only to the last commit, never backwards in the store;
+* bounded rollback — each re-formed generation resumes within
+  checkpoint_every + 1 steps of the farthest committed progress;
+* the loss trajectory survives every kill: the learnable synthetic task
+  ends well below where it started, across all the restarts.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import random
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HOST = os.path.join(REPO, "tests", "emh_host.py")
+
+STEPS = 120
+CKPT_EVERY = 4
+
+
+def _spawn_host(label, coordinator, store_root, steps=STEPS):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    return subprocess.Popen(
+        [sys.executable, "-u", HOST,
+         "--coordinator", coordinator, "--store-root", store_root,
+         "--label", label, "--steps", str(steps),
+         "--min-hosts", "1", "--ckpt-every", str(CKPT_EVERY),
+         "--step-delay", "0.3", "--chips", "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, start_new_session=True, cwd=REPO)
+
+
+def _read_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (IOError, OSError, ValueError):
+        return None
+
+
+def _result(proc, label):
+    out, err = proc.communicate(timeout=60)
+    for line in out.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise AssertionError(
+        f"host {label} produced no RESULT (rc={proc.returncode})\n"
+        f"--- stderr ---\n{err[-3000:]}")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_chaos_schedule(tmp_path, seed):
+    from serverless_learn_tpu.control.daemons import start_coordinator
+
+    import socket as socket_mod
+
+    with socket_mod.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = start_coordinator(port=port, lease_ttl_ms=1200, sweep_ms=200)
+    coordinator = f"127.0.0.1:{port}"
+    store = str(tmp_path / "store")
+    latest_path = os.path.join(store, "emh-t", "LATEST")
+    form_path = os.path.join(store, "emh-t", "FORM")
+    rng = random.Random(seed)
+
+    procs = {}
+    next_label = 0
+
+    def spawn():
+        nonlocal next_label
+        label = f"h{next_label}"
+        next_label += 1
+        procs[label] = _spawn_host(label, coordinator, store)
+        return label
+
+    def live():
+        return [l for l, p in procs.items() if p.poll() is None]
+
+    committed_seen = [-1]
+    gens_seen = set()
+
+    def observe():
+        """Poll invariant state; assert monotone committed step."""
+        latest = _read_json(latest_path)
+        if latest is not None:
+            step = int(latest["step"])
+            assert step >= committed_seen[-1], (
+                f"committed step went BACKWARDS: {committed_seen[-1]} -> "
+                f"{step}")
+            if step != committed_seen[-1]:
+                committed_seen.append(step)
+        form = _read_json(form_path)
+        if form is not None:
+            gens_seen.add(form["gen"])
+
+    def wait_progress(min_new_commits, timeout):
+        """Let the system breathe between chaos events: wait for the
+        committed step to advance (or the run to finish)."""
+        start = committed_seen[-1]
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            observe()
+            if committed_seen[-1] >= STEPS:
+                return
+            if committed_seen[-1] >= start + min_new_commits:
+                return
+            assert live(), "every host died without completing the run"
+            time.sleep(0.3)
+        raise AssertionError(
+            f"no committed progress within {timeout}s "
+            f"(stuck at {committed_seen[-1]}, live={live()}, "
+            f"gens={sorted(gens_seen)})")
+
+    try:
+        spawn()
+        spawn()
+        wait_progress(2, timeout=150)
+
+        # Pace chaos by COMMIT progress (one checkpoint interval per
+        # event): 12 events consume at most ~half the 120-step budget, so
+        # the schedule always reaches 6 generations before the run ends.
+
+        # Randomized churn until we have lived >= 6 generations (or the
+        # run finishes under us — then the schedule just ends early, and
+        # the generation floor is asserted below on what we saw).
+        events = 0
+        while (len(gens_seen) < 6 and committed_seen[-1] < STEPS
+               and events < 12):
+            events += 1
+            alive = live()
+            if len(alive) <= 1 or (len(alive) < 4 and rng.random() < 0.55):
+                spawn()
+            else:
+                victim = procs[rng.choice(alive)]
+                try:
+                    os.killpg(victim.pid, signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    pass
+            # Breathe: commits must keep flowing after every event.
+            wait_progress(1, timeout=150)
+
+        # Drain to completion.
+        deadline = time.time() + 360
+        while committed_seen[-1] < STEPS and time.time() < deadline:
+            observe()
+            assert live(), "every host died without completing the run"
+            time.sleep(0.5)
+        observe()
+        assert committed_seen[-1] >= STEPS, (
+            f"run never completed: committed {committed_seen[-1]}, "
+            f"gens {sorted(gens_seen)}")
+        assert len(gens_seen) >= 6, (
+            f"schedule produced only {sorted(gens_seen)} generations")
+
+        # Survivors exit cleanly with consistent generation records.
+        results = []
+        for label, p in procs.items():
+            if p.poll() is None or p.returncode == 0:
+                try:
+                    results.append(_result(p, label))
+                except AssertionError:
+                    if p.returncode == -signal.SIGKILL:
+                        continue  # a chaos victim, not a wedge
+                    raise
+        assert results, "no survivor produced a RESULT"
+        finals = [r["generations"][-1] for r in results
+                  if r["generations"]]
+        assert any(g["status"] == "complete" and g["end_step"] == STEPS
+                   for g in finals), finals
+
+        losses = {}
+        for r in results:
+            losses.update({int(s): l for s, l in r["losses"]})
+        for r in results:
+            gens = [g for g in r["generations"] if g["start_step"] >= 0]
+            for prev, nxt in zip(gens, gens[1:]):
+                # Bounded rollback: a re-formed world resumes from a
+                # committed step no older than one checkpoint interval
+                # behind its predecessor's last report.
+                if prev["end_step"] >= 0:
+                    assert nxt["start_step"] >= prev["end_step"] \
+                        - CKPT_EVERY - 1, (prev, nxt)
+                assert nxt["start_step"] >= prev["start_step"], (prev, nxt)
+        # The learnable task trained through all of it.
+        steps_sorted = sorted(losses)
+        first = [losses[s] for s in steps_sorted[:5]]
+        last = [losses[s] for s in steps_sorted[-5:]]
+        assert sum(last) / len(last) < 0.7 * (sum(first) / len(first)), (
+            first, last)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    pass
+        coord.terminate()
+        coord.wait(timeout=5)
