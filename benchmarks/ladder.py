@@ -216,12 +216,10 @@ def _demand_from_history(metric: str, fallback: float) -> float:
     this ladder exists to enforce). Filtered to the CURRENT chip kind:
     values differ across chips, which is exactly why the guard keys on
     device_kind."""
-    import jax
-
     from serverless_learn_tpu.utils.benchlog import load_history
 
     try:
-        kind = jax.devices()[0].device_kind
+        kind = _device_kind()
     except Exception:
         kind = None
     vals = [h["value"] for h in load_history(HISTORY)

@@ -45,6 +45,22 @@ def load_history(path: str) -> list:
         return []
 
 
+def best_comparable(
+    history: list,
+    entry: dict,
+    key_fields: Sequence[str] = ("metric", "device_kind"),
+    better: str = "max",
+) -> Optional[float]:
+    """The single definition of "comparable baseline": best numeric value
+    among history entries matching ``entry`` on every key field."""
+    vals = [h["value"] for h in history
+            if all(h.get(k) == entry.get(k) for k in key_fields)
+            and isinstance(h.get("value"), (int, float))]
+    if not vals:
+        return None
+    return max(vals) if better == "max" else min(vals)
+
+
 def record(
     entry: dict,
     history_path: str,
@@ -59,13 +75,7 @@ def record(
     entry (mutated) either way — benches report honestly, never fail."""
     assert better in ("max", "min")
     history = load_history(history_path)
-    same = [h for h in history
-            if all(h.get(k) == entry.get(k) for k in key_fields)]
-    vals = [h["value"] for h in same if isinstance(h.get("value"), (int,
-                                                                    float))]
-    best: Optional[float] = None
-    if vals:
-        best = max(vals) if better == "max" else min(vals)
+    best = best_comparable(history, entry, key_fields, better)
     gap = max(rel_threshold, 2.0 * float(entry.get("spread_rel", 0.0)))
     if best is not None:
         worse = (entry["value"] < best * (1 - gap) if better == "max"
