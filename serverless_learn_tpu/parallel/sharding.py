@@ -51,6 +51,15 @@ class ShardingRules:
         return _prune_spec(self.default, ndim, mesh)
 
 
+def _collapse_entry(names) -> Optional[object]:
+    """A filtered axis-name list back to a spec entry (None/name/tuple)."""
+    if not names:
+        return None
+    if len(names) == 1:
+        return names[0]
+    return tuple(names)
+
+
 def _prune_spec(spec: P, ndim: int, mesh: Mesh) -> P:
     """Drop axes absent from the mesh or of size 1; trim/pad to ndim."""
     out = []
@@ -59,13 +68,8 @@ def _prune_spec(spec: P, ndim: int, mesh: Mesh) -> P:
             out.append(None)
             continue
         names = entry if isinstance(entry, tuple) else (entry,)
-        kept = tuple(n for n in names if mesh.shape.get(n, 1) > 1)
-        if not kept:
-            out.append(None)
-        elif len(kept) == 1:
-            out.append(kept[0])
-        else:
-            out.append(kept)
+        out.append(_collapse_entry(
+            [n for n in names if mesh.shape.get(n, 1) > 1]))
     out = out[:ndim]
     while len(out) < ndim:
         out.append(None)
@@ -125,27 +129,71 @@ DEFAULT_RULES = ShardingRules(
 )
 
 
+def _drop_indivisible(spec: P, shape, mesh: Mesh) -> P:
+    """Drop sharding entries whose axis product doesn't divide the dim.
+
+    Optimizer states are the motivating case: their leaves are looked up
+    by PARAM path (an adafactor ``v['embedder']['embedding']`` matches the
+    embedding rule) but are not param-shaped — factored row/col stats and
+    ``(1,)`` placeholders would be invalidly sharded, crashing jit. For
+    such leaves a dropped axis means "replicated", which is always
+    correct."""
+    out = []
+    for d, entry in enumerate(spec):
+        if entry is None or d >= len(shape):
+            out.append(None)
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        kept = []
+        prod = 1
+        for n in names:
+            size = mesh.shape.get(n, 1)
+            if shape[d] % (prod * size) == 0:
+                kept.append(n)
+                prod *= size
+        out.append(_collapse_entry(kept))
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
 def shardings_for_tree(
     tree: Any,
     mesh: Mesh,
     rules: Optional[ShardingRules] = None,
+    divisible_only: bool = False,
 ) -> Any:
-    """Map a pytree of arrays (or ShapeDtypeStructs) to NamedShardings."""
+    """Map a pytree of arrays (or ShapeDtypeStructs) to NamedShardings.
+
+    ``divisible_only=True`` additionally drops rule axes that don't divide
+    the leaf's actual dims (see ``_drop_indivisible``) — used for
+    optimizer state, whose leaves share the params' PATHS but not
+    necessarily their shapes. Params themselves stay strict: a
+    non-dividing model dim should fail loudly, not silently replicate."""
     rules = rules or DEFAULT_RULES
 
     def one(path, leaf):
         ndim = getattr(leaf, "ndim", len(getattr(leaf, "shape", ())))
         spec = rules.spec_for(_path_str(path), ndim, mesh)
+        if divisible_only:
+            spec = _drop_indivisible(spec, tuple(getattr(leaf, "shape", ())),
+                                     mesh)
         return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(one, tree)
 
 
-def specs_for_tree(tree: Any, mesh: Mesh, rules: Optional[ShardingRules] = None) -> Any:
+def specs_for_tree(tree: Any, mesh: Mesh,
+                   rules: Optional[ShardingRules] = None,
+                   divisible_only: bool = False) -> Any:
     rules = rules or DEFAULT_RULES
 
     def one(path, leaf):
         ndim = getattr(leaf, "ndim", len(getattr(leaf, "shape", ())))
-        return rules.spec_for(_path_str(path), ndim, mesh)
+        spec = rules.spec_for(_path_str(path), ndim, mesh)
+        if divisible_only:
+            spec = _drop_indivisible(spec, tuple(getattr(leaf, "shape", ())),
+                                     mesh)
+        return spec
 
     return jax.tree_util.tree_map_with_path(one, tree)

@@ -183,14 +183,19 @@ class LocalSGDTrainer:
             return jax.tree_util.tree_map(
                 lambda l: jax.ShapeDtypeStruct(l.shape[1:], l.dtype), tree)
 
-        def stacked_shardings(tree):
-            inner = specs_for_tree(un_abstract(tree), mesh)
+        # divisible_only (opt trees only): optimizer leaves match param
+        # PATHS but not necessarily param shapes (adafactor's factored
+        # stats) — see parallel/sharding._drop_indivisible. Params stay
+        # strict, matching train_step.
+        def stacked_shardings(tree, lenient=False):
+            inner = specs_for_tree(un_abstract(tree), mesh,
+                                   divisible_only=lenient)
             return jax.tree_util.tree_map(
                 lambda sp: NamedSharding(mesh, P("dp", *tuple(sp))), inner,
                 is_leaf=lambda x: isinstance(x, P))
 
-        def inner_shardings(tree):
-            inner = specs_for_tree(tree, mesh)
+        def inner_shardings(tree, lenient=False):
+            inner = specs_for_tree(tree, mesh, divisible_only=lenient)
             return jax.tree_util.tree_map(
                 lambda sp: NamedSharding(mesh, sp), inner,
                 is_leaf=lambda x: isinstance(x, P))
@@ -198,9 +203,10 @@ class LocalSGDTrainer:
         self.state_shardings = LocalSGDState(
             step=NamedSharding(mesh, P()),
             params=stacked_shardings(abstract.params),
-            opt_state=stacked_shardings(abstract.opt_state),
+            opt_state=stacked_shardings(abstract.opt_state, lenient=True),
             anchor=inner_shardings(abstract.anchor),
-            outer_opt_state=inner_shardings(abstract.outer_opt_state),
+            outer_opt_state=inner_shardings(abstract.outer_opt_state,
+                                            lenient=True),
         )
         self.init_fn = jax.jit(init_raw, static_argnums=(0,),
                                out_shardings=self.state_shardings)

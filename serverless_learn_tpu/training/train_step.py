@@ -148,7 +148,11 @@ def build_trainer(
     state_shardings = TrainState(
         step=replicated(mesh),
         params=shardings_for_tree(abstract.params, mesh, rules),
-        opt_state=shardings_for_tree(abstract.opt_state, mesh, rules),
+        # divisible_only: optimizer leaves match param PATHS but not
+        # necessarily param shapes (adafactor's factored stats, counts) —
+        # non-dividing rule axes drop to replicated instead of crashing.
+        opt_state=shardings_for_tree(abstract.opt_state, mesh, rules,
+                                     divisible_only=True),
         model_state=shardings_for_tree(abstract.model_state, mesh, rules),
     )
     init_jit = jax.jit(init_raw, static_argnums=(0,),

@@ -132,7 +132,7 @@ def test_chaos_schedule(tmp_path, seed):
     try:
         spawn()
         spawn()
-        wait_progress(2, timeout=150)
+        wait_progress(2, timeout=240)
 
         # Pace chaos by COMMIT progress (one checkpoint interval per
         # event): 12 events consume at most ~half the 120-step budget, so
@@ -155,7 +155,7 @@ def test_chaos_schedule(tmp_path, seed):
                 except (OSError, ProcessLookupError):
                     pass
             # Breathe: commits must keep flowing after every event.
-            wait_progress(1, timeout=150)
+            wait_progress(1, timeout=240)
 
         # Drain to completion.
         deadline = time.time() + 360

@@ -97,3 +97,32 @@ def test_schedule_warmup_and_decay(devices):
     assert float(sched(0)) == 0.0
     assert float(sched(10)) == pytest.approx(1e-2, rel=1e-3)
     assert float(sched(100)) < 1e-3
+
+
+def test_adafactor_trains_on_tp_sharded_mesh(devices):
+    """Regression (r3): adafactor's factored second-moment leaves share the
+    params' tree PATHS but not their shapes — a (1,) placeholder matched
+    the embedding rule and got an invalid tp sharding, crashing jit for
+    any adafactor + tp/fsdp config. Non-dividing rule axes now drop to
+    replicated for optimizer state."""
+    import jax
+
+    from serverless_learn_tpu.config import (
+        DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig,
+        TrainConfig)
+    from serverless_learn_tpu.data.datasets import SyntheticSource
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    cfg = ExperimentConfig(
+        model="llama_tiny",
+        model_overrides=dict(dtype=jnp.float32),
+        mesh=MeshConfig(dp=2, fsdp=2, tp=2),
+        optimizer=OptimizerConfig(name="adafactor", learning_rate=1e-3),
+        train=TrainConfig(batch_size=8),
+        data=DataConfig(seq_len=16))
+    trainer = build_trainer(cfg)
+    state = trainer.init()
+    src = iter(SyntheticSource(trainer.bundle.make_batch, cfg.data, 8,
+                               seed=0))
+    state, m = trainer.step(state, trainer.shard_batch(next(src)))
+    assert np.isfinite(float(jax.device_get(m["loss"])))
