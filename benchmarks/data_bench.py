@@ -20,6 +20,8 @@ import tempfile
 import threading
 import time
 
+import numpy as np
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -124,23 +126,19 @@ def bench_real_pipeline(addr: str, records: int, r18_samples_per_sec: float
             "ingest_over_demand": round(sps / r18_samples_per_sec, 2)}
 
 
-def bench_imagenet_pipeline(addr: str, records: int,
-                            r50_samples_per_sec: float) -> dict:
-    """ImageNet-class ingest (VERDICT r2 item 4): 256x256x3 uint8 records
-    (the imagefolder storage format, 196 kB each — 6000x a CIFAR record's
-    density per image) -> stream -> per-sample random 224-crop + flip ->
-    float32 batches, exactly what feeds the ResNet-50 rung. The bar: ingest
-    >= the v4-32 step demand (~2,440 samples/s/32 chips => per-HOST demand
-    is that divided by the host count; a v4-32 has 4 hosts, so ~610
-    samples/s/host ~= 92 MB/s uint8 — but we report against the FULL chip
-    demand so single-host headroom is explicit)."""
+# A v4 pod host owns 4 chips: its input pipeline must feed FOUR chips'
+# demand, so the per-host bar is per-chip demand x 4 (round-3 verdict #1:
+# the previous /4 modeled 4 hosts jointly feeding one chip — 16x too
+# generous).
+CHIPS_PER_HOST = 4
+
+
+def _publish_imagenet(addr: str, records: int, dataset: str) -> int:
+    """Publish synthetic imagefolder-format shards; returns stored size."""
     import numpy as np
 
     from serverless_learn_tpu.data.raw import IMAGEFOLDER_STORE_SIZE
-    from serverless_learn_tpu.data.shard_client import (
-        ShardStreamSource, publish_dataset)
-    from serverless_learn_tpu.data.transforms import (
-        TransformedSource, image_transform)
+    from serverless_learn_tpu.data.shard_client import publish_dataset
 
     s = IMAGEFOLDER_STORE_SIZE
     rng = np.random.default_rng(0)
@@ -148,15 +146,12 @@ def bench_imagenet_pipeline(addr: str, records: int,
         "image": rng.integers(0, 256, (records, s, s, 3), dtype=np.uint8),
         "label": rng.integers(0, 1000, records).astype(np.int32),
     }
-    publish_dataset(addr, "bench_imagenet_u8", arrays, records_per_shard=256)
-    batch = 64
-    # dtype=uint8: resnet50_imagenet takes uint8 input and normalizes on
-    # device, so the host pipeline (and this bench) stays uint8 end to end.
-    src = TransformedSource(
-        ShardStreamSource(addr, "bench_imagenet_u8", batch_size=batch,
-                          prefetch_shards=3),
-        image_transform(train=True, seed=0, out_hw=(224, 224),
-                        dtype=np.uint8))
+    publish_dataset(addr, dataset, arrays, records_per_shard=256)
+    return s
+
+
+def _drain(src, records: int, batch: int) -> float:
+    """Samples/s through an already-constructed batch source."""
     it = iter(src)
     next(it)  # warm the prefetch pipeline
     n_batches = records // batch - 2
@@ -165,20 +160,90 @@ def bench_imagenet_pipeline(addr: str, records: int,
         next(it)
     dt = time.perf_counter() - t0
     src.close()
-    sps = n_batches * batch / dt
-    wire_mb = sps * s * s * 3 / 1e6  # uint8 bytes/s off the shard plane
-    # A v4-32 is 4 hosts; each host's input pipeline feeds its own quarter
-    # of the global batch, so the per-HOST bar is demand/4 — and this
-    # number is per CORE (single fetch+transform thread pair): real hosts
-    # run one source per dp rank and have dozens of cores.
-    per_host = r50_samples_per_sec / 4
-    return {"metric": "imagenet_ingest_samples_per_sec",
-            "value": round(sps, 1), "unit": "samples/s",
-            "wire_mb_per_sec": round(wire_mb, 1),
-            "r50_demand_samples_per_sec": r50_samples_per_sec,
-            "ingest_over_demand": round(sps / r50_samples_per_sec, 2),
-            "r50_demand_per_host_samples_per_sec": per_host,
-            "ingest_over_host_demand": round(sps / per_host, 2)}
+    return n_batches * batch / dt
+
+
+def _imagenet_rec(metric: str, sps: float, stored: int,
+                  r50_samples_per_sec: float, **extra) -> dict:
+    per_host = r50_samples_per_sec * CHIPS_PER_HOST
+    return {"metric": metric, "value": round(sps, 1), "unit": "samples/s",
+            "wire_mb_per_sec": round(sps * stored * stored * 3 / 1e6, 1),
+            "r50_demand_per_chip_samples_per_sec": r50_samples_per_sec,
+            "ingest_over_chip_demand": round(sps / r50_samples_per_sec, 2),
+            "r50_demand_per_host_samples_per_sec": round(per_host, 1),
+            "chips_per_host": CHIPS_PER_HOST,
+            "ingest_over_host_demand": round(sps / per_host, 3), **extra}
+
+
+def bench_imagenet_pipeline(addr: str, records: int,
+                            r50_samples_per_sec: float) -> dict:
+    """ImageNet-class HOST-transform ingest (VERDICT r2 item 4): 256x256x3
+    uint8 records (the imagefolder storage format, 196 kB each) -> stream ->
+    per-sample random 224-crop + flip on the HOST -> uint8 batches. This is
+    the legacy geometry (host does the per-pixel work); one core covers only
+    ~13% of a 4-chip host's demand — which is exactly why the device-augment
+    path below and the parallel multi-source path exist."""
+    from serverless_learn_tpu.data.shard_client import ShardStreamSource
+    from serverless_learn_tpu.data.transforms import (
+        TransformedSource, image_transform)
+
+    stored = _publish_imagenet(addr, records, "bench_imagenet_u8")
+    src = TransformedSource(
+        ShardStreamSource(addr, "bench_imagenet_u8", batch_size=64,
+                          prefetch_shards=3),
+        image_transform(train=True, seed=0, out_hw=(224, 224),
+                        dtype=np.uint8))
+    sps = _drain(src, records, 64)
+    return _imagenet_rec("imagenet_ingest_samples_per_sec", sps, stored,
+                         r50_samples_per_sec)
+
+
+def bench_imagenet_device_augment(addr: str, records: int,
+                                  r50_samples_per_sec: float) -> dict:
+    """The TPU-first ImageNet ingest geometry: the host streams STORED-size
+    (256x256) uint8 records untouched — zero per-pixel host work — and the
+    crop+flip+/255 happen on device inside the train step
+    (``models/resnet.py::device_crop_flip``, resnet50 ``device_augment=True``).
+    Host cost collapses to fetch + decode (zero-copy frombuffer) + shuffle
+    memcpy, at 1.31x the wire bytes of shipping 224-crops."""
+    from serverless_learn_tpu.data.shard_client import ShardStreamSource
+
+    stored = _publish_imagenet(addr, records, "bench_imagenet_da")
+    src = ShardStreamSource(addr, "bench_imagenet_da", batch_size=64,
+                            prefetch_shards=3)
+    sps = _drain(src, records, 64)
+    return _imagenet_rec("imagenet_device_aug_ingest_samples_per_sec", sps,
+                         stored, r50_samples_per_sec)
+
+
+def bench_parallel_scaling(addr: str, records: int,
+                           r50_samples_per_sec: float,
+                           workers_list=(1, 2)) -> dict:
+    """Per-core scaling curve of ``ParallelIngestSource`` on the
+    device-augment geometry (verdict #1's missing capability). Aggregate
+    samples/s per worker count, with ``host_cores`` recorded: on an
+    N-core pod host the curve scales to ~min(workers, cores) x the
+    single-worker rate; on this 1-core bench box it is flat by construction
+    and the honest projection is value x cores_needed (reported as
+    ``cores_to_meet_host_demand``)."""
+    from serverless_learn_tpu.data.parallel_ingest import ParallelIngestSource
+
+    stored = _publish_imagenet(addr, records, "bench_imagenet_par")
+    curve = {}
+    for w in workers_list:
+        src = ParallelIngestSource(addr, "bench_imagenet_par", batch_size=64,
+                                   workers=w, prefetch_shards=2)
+        curve[str(w)] = round(_drain(src, records, 64), 1)
+    best = max(curve.values())
+    per_host = r50_samples_per_sec * CHIPS_PER_HOST
+    single = curve.get("1", best)
+    rec = _imagenet_rec(
+        "imagenet_parallel_ingest_samples_per_sec", best, stored,
+        r50_samples_per_sec, scaling_curve=curve,
+        host_cores=os.cpu_count(),
+        cores_to_meet_host_demand=(round(per_host / single, 1)
+                                   if single else None))
+    return rec
 
 
 def main():
@@ -190,9 +255,12 @@ def main():
     ap.add_argument("--r18-samples-per-sec", type=float, default=29793.0,
                     help="the chip-side demand to compare ingest against "
                          "(BENCH_r01 ResNet-18 throughput)")
-    ap.add_argument("--r50-samples-per-sec", type=float, default=2440.0,
-                    help="ResNet-50/v4-32 step demand for the ImageNet "
-                         "ingest comparison (BASELINE.md rung 3)")
+    ap.add_argument("--r50-samples-per-sec", type=float, default=2315.0,
+                    help="ResNet-50 PER-CHIP step demand for the ImageNet "
+                         "ingest comparison (measured, bench_history)")
+    ap.add_argument("--parallel-workers", default="1,2",
+                    help="comma-separated worker counts for the parallel "
+                         "ingest scaling curve")
     args = ap.parse_args()
     from serverless_learn_tpu.control.daemons import start_shard_server
 
@@ -207,6 +275,12 @@ def main():
                 addr, args.records, args.r18_samples_per_sec)))
             print(json.dumps(bench_imagenet_pipeline(
                 addr, args.imagenet_records, args.r50_samples_per_sec)))
+            print(json.dumps(bench_imagenet_device_augment(
+                addr, args.imagenet_records, args.r50_samples_per_sec)))
+            print(json.dumps(bench_parallel_scaling(
+                addr, args.imagenet_records, args.r50_samples_per_sec,
+                workers_list=tuple(int(w) for w in
+                                   args.parallel_workers.split(",")))))
         finally:
             proc.terminate()
             proc.wait(timeout=5)

@@ -118,6 +118,40 @@ def row_r50():
                                       "batch_per_chip"))
 
 
+def row_r18nf():
+    """ResNet-18 with norm="none" (NF-style scale+bias, zero-init residual
+    scales) as a FIRST-CLASS guarded row — round-3 verdict #6 promoted it
+    out of its footnote. Captures the full measured 8.6% BN cost; the
+    training recipe itself is pinned by tests/test_resnet_norms.py."""
+    from serverless_learn_tpu.config import OptimizerConfig
+
+    rec = _train_row(
+        "resnet18_cifar_nfnorm_train_samples_per_sec_per_chip",
+        "resnet18_cifar", batch_per_chip=4096,
+        overrides={"norm": "none"},
+        opt=OptimizerConfig(name="sgd", learning_rate=0.1, momentum=0.9),
+        steps=10)
+    return record_history(rec, HISTORY, better="max", rel_threshold=0.03,
+                          key_fields=("metric", "device_kind",
+                                      "batch_per_chip"))
+
+
+def row_r50nf():
+    """ResNet-50 norm="none" (measured +10% over BN in round 3: 2,518
+    samples/s, 30.4% MFU) as a guarded row."""
+    from serverless_learn_tpu.config import OptimizerConfig
+
+    rec = _train_row(
+        "resnet50_imagenet_nfnorm_train_samples_per_sec_per_chip",
+        "resnet50_imagenet", batch_per_chip=256,
+        overrides={"norm": "none"},
+        opt=OptimizerConfig(name="sgd", learning_rate=0.1, momentum=0.9),
+        steps=5)
+    return record_history(rec, HISTORY, better="max",
+                          key_fields=("metric", "device_kind",
+                                      "batch_per_chip"))
+
+
 def row_bert():
     rec = _train_row(
         "bert_base_mlm_train_tokens_per_sec_per_chip", "bert_base",
@@ -149,12 +183,17 @@ def row_lm():
                                       "batch_per_chip", "seq", "vocab"))
 
 
-def row_flash(repeats=5):
-    """Flash fwd+bwd at T=8192 causal — median of ``repeats`` with spread.
+def row_flash(repeats=11):
+    """Flash fwd+bwd at T=8192 causal — median of ``repeats`` with an
+    IQR-based spread.
 
     The r2 README carried two disagreeing one-offs (14 vs 16 ms) for this
     exact shape; the honest number is the median with its relative spread,
-    and the guard widens by 2x that spread."""
+    and the guard widens by 2x that spread. Round 3 recorded min-max
+    spread over 5 reps (0.41-0.45 — so wide a 30-40% real regression
+    would pass); round 4 runs 11 reps and reports IQR/median, which
+    rejects the shared-chip outlier tails and keeps the effective guard
+    threshold <= ~15% (verdict #9)."""
     import jax
     import jax.numpy as jnp
 
@@ -187,12 +226,15 @@ def row_flash(repeats=5):
     once()  # compile + warm
     times = sorted(once() for _ in range(repeats))
     med = statistics.median(times)
-    spread = (times[-1] - times[0]) / med if med else 0.0
+    q = repeats // 4
+    iqr = (times[-1 - q] - times[q]) if repeats >= 4 else \
+        (times[-1] - times[0])
+    spread = iqr / med if med else 0.0
     rec = {
         "metric": "flash_attention_fwd_bwd_t8192_causal_ms",
         "value": round(med, 2),
         "unit": "ms (median of %d)" % repeats,
-        "spread_rel": round(spread, 4),
+        "spread_rel": round(spread, 4),  # IQR/median (guard widens by 2x)
         "times_ms": [round(t, 2) for t in times],
         "device_kind": _device_kind(),
     }
@@ -207,6 +249,97 @@ def row_decode():
     rec["device_kind"] = _device_kind()
     return record_history(rec, HISTORY, better="max",
                           key_fields=("metric", "device_kind", "batch",
+                                      "prompt_len", "new_tokens"))
+
+
+def row_llama8b_width():
+    """8B-width on REAL silicon (round-3 verdict #7): every 8B artifact so
+    far was abstract or compile-only. A 2-layer and a 4-layer slice of
+    llama_8b (TRUE widths: d_model 4096, d_ff 14336, 32 heads/8 KV, vocab
+    128256; LoRA + remat, bf16) both fit one v5e chip; their step-time
+    difference isolates the marginal per-layer cost, and
+    t(32) = t(2) + 30 x layer_ms extrapolates the full model. The
+    extrapolated tokens/s is clearly labeled ESTIMATE: it assumes layer
+    cost stays constant with depth (true under remat — each layer's
+    weights and activation working set are depth-independent) and that
+    32 layers' weights fit the target chip, which they do NOT on one v5e
+    — the estimate prices the compute, pricing a sharded deployment's
+    per-chip step where weights are fsdp-resident."""
+    import jax
+
+    from serverless_learn_tpu.config import (
+        DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig,
+        TrainConfig)
+    from serverless_learn_tpu.data.datasets import SyntheticSource
+    from serverless_learn_tpu.training.train_step import build_trainer
+    from serverless_learn_tpu.utils.flops import compiled_step_flops, mfu
+
+    batch, seq = 4, 1024
+
+    def step_time(n_layers, steps=6):
+        cfg = ExperimentConfig(
+            model="llama_8b",
+            model_overrides=dict(n_layers=n_layers, lora_rank=16,
+                                 max_seq_len=seq),
+            mesh=MeshConfig(dp=len(jax.devices())),
+            optimizer=OptimizerConfig(name="adamw", learning_rate=2e-4),
+            train=TrainConfig(batch_size=batch * len(jax.devices()),
+                              remat=True),
+            data=DataConfig(seq_len=seq))
+        trainer = build_trainer(cfg)
+        state = trainer.init()
+        src = iter(SyntheticSource(trainer.bundle.make_batch, cfg.data,
+                                   cfg.train.batch_size, seed=0))
+        b = trainer.shard_batch(next(src))
+        for _ in range(3):
+            state, m = trainer.step(state, b)
+        float(jax.device_get(m["loss"]))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = trainer.step(state, b)
+        float(jax.device_get(m["loss"]))
+        dt = (time.perf_counter() - t0) / steps
+        fl = compiled_step_flops(trainer.step_fn, state, b,
+                                 n_devices=len(jax.devices()))
+        return dt, fl
+
+    t2, f2 = step_time(2)
+    t4, f4 = step_time(4)
+    layer_s = (t4 - t2) / 2
+    flops_layer = None if (f2 is None or f4 is None) else (f4 - f2) / 2
+    t32 = t2 + 30 * layer_s
+    tokens = batch * seq
+    rec = {
+        "metric": "llama8b_width_layer_ms",
+        "value": round(layer_s * 1e3, 2),
+        "unit": "ms/layer (b%d seq%d bf16 LoRA remat)" % (batch, seq),
+        "step_ms_2layer": round(t2 * 1e3, 1),
+        "step_ms_4layer": round(t4 * 1e3, 1),
+        "extrapolated_full_8b_step_ms": round(t32 * 1e3, 1),
+        "extrapolated_full_8b_tokens_per_sec_per_chip":
+            round(tokens / t32, 1),
+        "extrapolation_note": "t(32)=t(2)+30*layer; compute-price of a "
+                              "weight-sharded deployment, NOT a one-chip "
+                              "fit",
+        "device_kind": _device_kind(),
+    }
+    if flops_layer is not None and f2 is not None:
+        u = mfu(f2 + 30 * flops_layer, t32, n_chips=1)
+        if u is not None:
+            rec["extrapolated_full_8b_mfu"] = round(u, 4)
+    return record_history(rec, HISTORY, better="min",
+                          key_fields=("metric", "device_kind"))
+
+
+def row_serve():
+    """Multi-client batched serving aggregate (round-3 verdict #2)."""
+    from benchmarks.gen_bench import run_concurrent
+
+    rec = run_concurrent("llama_tiny", clients=4, prompt_len=128,
+                         new_tokens=64)
+    rec["device_kind"] = _device_kind()
+    return record_history(rec, HISTORY, better="max",
+                          key_fields=("metric", "device_kind", "clients",
                                       "prompt_len", "new_tokens"))
 
 
@@ -229,13 +362,68 @@ def _demand_from_history(metric: str, fallback: float) -> float:
     return max(vals) if vals else fallback
 
 
+def row_localsgd():
+    """Local SGD communication-interval sweep on the REAL chip (round-3
+    verdict #4): resnet18_cifar (BatchNorm — the stateful case round 3
+    refused) under DiLoCo at inner_steps 1/8/32. On one chip the dp axis
+    is 1 so the sweep prices the OUTER SYNC OVERHEAD itself (vmapped inner
+    step + averaging cadence); on a pod the same knob trades ICI traffic
+    for divergence. Value = samples/s at inner_steps=8 (the default)."""
+    import jax
+
+    from serverless_learn_tpu.config import (
+        DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig,
+        TrainConfig)
+    from serverless_learn_tpu.training.local_sgd import LocalSGDTrainer
+
+    import numpy as np
+
+    n_dev = len(jax.devices())
+    cfg = ExperimentConfig(
+        model="resnet18_cifar",
+        mesh=MeshConfig(dp=n_dev),
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.05),
+        train=TrainConfig(batch_size=1024 * n_dev),
+        data=DataConfig())
+    sweep = {}
+    for inner in (1, 8, 32):
+        tr = LocalSGDTrainer(cfg, inner_steps=inner, outer="average")
+        state = tr.init()
+        batch = tr.shard_batch(tr.bundle.make_batch(
+            np.random.default_rng(0), cfg.data, cfg.train.batch_size))
+        for _ in range(3):
+            state, losses = tr.inner_step(state, batch)
+        state = tr.outer_sync(state)
+        float(jax.device_get(losses.mean()))
+        steps = 3 * inner if inner < 32 else 32
+        t0 = time.perf_counter()
+        for t in range(steps):
+            state, losses = tr.inner_step(state, batch)
+            if (t + 1) % inner == 0:
+                state = tr.outer_sync(state)
+        float(jax.device_get(losses.mean()))
+        dt = time.perf_counter() - t0
+        sweep[str(inner)] = round(cfg.train.batch_size * steps / dt, 1)
+    rec = {
+        "metric": "resnet18_local_sgd_samples_per_sec",
+        "value": sweep["8"], "unit": "samples/sec (inner_steps=8)",
+        "interval_sweep": sweep,
+        "batch_per_replica": 1024,
+        "device_kind": _device_kind(),
+    }
+    return record_history(rec, HISTORY, better="max", rel_threshold=0.10,
+                          key_fields=("metric", "device_kind",
+                                      "batch_per_replica"))
+
+
 def row_data():
     """Host-side data plane rows (no chip involved)."""
     import socket
     import tempfile
 
     from benchmarks.data_bench import (
-        bench_imagenet_pipeline, bench_raw, bench_real_pipeline)
+        bench_imagenet_device_augment, bench_imagenet_pipeline,
+        bench_parallel_scaling, bench_raw, bench_real_pipeline)
     from serverless_learn_tpu.control.daemons import start_shard_server
 
     r18_demand = _demand_from_history(
@@ -263,6 +451,10 @@ def row_data():
                 (bench_real_pipeline(addr, 4096, r18_demand), ("metric",)),
                 (bench_imagenet_pipeline(addr, 2048, r50_demand),
                  ("metric",)),
+                (bench_imagenet_device_augment(addr, 2048, r50_demand),
+                 ("metric",)),
+                (bench_parallel_scaling(addr, 2048, r50_demand),
+                 ("metric",)),
             ):
                 # 20%, not the default 5%: host-side rows share one core
                 # with the server process and swing +-15% run to run
@@ -281,12 +473,17 @@ def row_data():
 
 ROWS = {
     "r18": row_r18,
+    "r18nf": row_r18nf,
     "r50": row_r50,
+    "r50nf": row_r50nf,
     "bert": row_bert,
     "llama1b": row_llama1b,
     "lm": row_lm,
     "flash": row_flash,
     "decode": row_decode,
+    "serve": row_serve,
+    "llama8b": row_llama8b_width,
+    "localsgd": row_localsgd,
     "data": row_data,
 }
 

@@ -412,7 +412,9 @@ def cmd_serve(args) -> int:
     trainer = _build_inference_trainer(cfg)
     params, _ = _load_inference_params(args, cfg, trainer)
     server = GenerationServer(trainer.bundle.module, params,
-                              host=args.host, port=args.port)
+                              host=args.host, port=args.port,
+                              max_batch=args.max_batch,
+                              batch_wait_ms=args.batch_wait_ms)
     log_json({"event": "serving", "addr": server.addr,
               "model": cfg.model}, stream=sys.stdout)
     try:
@@ -538,6 +540,15 @@ def cmd_publish(args) -> int:
             raise SystemExit(f"--format {args.format} requires --path")
         if args.format == "tokens":
             arrays = raw.load_token_corpus(args.path, seq_len=args.seq_len)
+        elif args.format == "text":
+            # Real text ingestion: optional GPT-2-format BPE vocab (else
+            # byte-level fallback), documents packed densely into rows
+            # (data/tokenizer.py — round-4 verdict #8).
+            from serverless_learn_tpu.data.tokenizer import load_text_corpus
+
+            arrays = load_text_corpus(
+                args.path, seq_len=args.seq_len, vocab_file=args.vocab,
+                merges_file=args.merges)
         elif args.format == "imagefolder":
             # Streaming: decodes + uploads one shard at a time — an eager
             # decode of an ImageNet-sized split would need ~250 GB of RAM.
@@ -625,6 +636,12 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--host", default="127.0.0.1",
                     help="bind address (0.0.0.0 to accept remote clients)")
     sv.add_argument("--port", type=int, default=50060)
+    sv.add_argument("--max-batch", type=int, default=8,
+                    help="admission queue coalesces up to this many "
+                         "compatible concurrent requests per device batch")
+    sv.add_argument("--batch-wait-ms", type=float, default=3.0,
+                    help="how long the dispatcher waits to co-batch "
+                         "requests (latency floor under load)")
     sv.set_defaults(fn=cmd_serve)
 
     w = sub.add_parser("worker", help="elastic worker: join a cluster & train")
@@ -673,14 +690,16 @@ def build_parser() -> argparse.ArgumentParser:
     pub.add_argument("--dataset", required=True)
     pub.add_argument("--format", default="synthetic",
                      choices=["synthetic", "mnist", "cifar10", "imagefolder",
-                              "tokens"],
+                              "tokens", "text"],
                      help="synthetic: sample a model's batch schema; "
                           "mnist/cifar10: parse the standard raw-file "
                           "distributions under --path; imagefolder: decode "
                           "an ImageNet-layout class-directory tree to "
                           "256x256 uint8 records (train-time 224 crops "
                           "happen host-side); tokens: chunk a corpus file "
-                          "(.bin token dump or raw text)")
+                          "(.bin token dump or raw text); text: tokenize a "
+                          "text corpus (--vocab/--merges for GPT-2-format "
+                          "BPE, else byte-level) and pack documents densely")
     pub.add_argument("--path", help="raw dataset directory/file "
                                     "(non-synthetic formats)")
     pub.add_argument("--split", default="train", choices=["train", "test"])
@@ -694,6 +713,10 @@ def build_parser() -> argparse.ArgumentParser:
                           "defaults to 256 records ~= 50 MB shards)")
     pub.add_argument("--seq-len", type=int, default=128)
     pub.add_argument("--seed", type=int, default=0)
+    pub.add_argument("--vocab", default=None,
+                     help="text format: GPT-2-style vocab.json")
+    pub.add_argument("--merges", default=None,
+                     help="text format: GPT-2-style merges.txt")
     pub.set_defaults(fn=cmd_publish)
 
     st = sub.add_parser("stats", help="scrape a daemon's load/RPC stats")

@@ -36,8 +36,13 @@ def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
 @partial(jax.jit, static_argnums=(0, 4, 5, 6, 7))
 def _generate_jit(module, params, cache, prompt, max_new_tokens: int,
                   temperature: float, top_k: int, eos_id: Optional[int],
-                  rng=None):
-    """(tokens [B, P+N], cache) — prefill scan + sample scan, fully jitted."""
+                  rng=None, prompt_lengths=None):
+    """(tokens [B, P+N], cache) — prefill scan + sample scan, fully jitted.
+
+    ``prompt_lengths`` [B]: true lengths of right-padded prompts (batched
+    serving coalesces unequal requests into one shape). Each sequence
+    samples its first token from the logits at its OWN last real position
+    and its cache index starts at its own length."""
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
@@ -52,9 +57,14 @@ def _generate_jit(module, params, cache, prompt, max_new_tokens: int,
     # bulk-writes the cache — not P sequential decode steps.
     prefill_logits, updated = module.apply(
         {"params": params, "cache": cache}, prompt,
-        prefill=True, mutable=["cache"])
+        prefill=True, mutable=["cache"], seq_lengths=prompt_lengths)
     cache = updated["cache"]
-    last_logits = prefill_logits[:, -1]
+    if prompt_lengths is None:
+        last_logits = prefill_logits[:, -1]
+    else:
+        last_logits = jnp.take_along_axis(
+            prefill_logits, (prompt_lengths - 1)[:, None, None], axis=1
+        )[:, 0]
 
     def pick(logits, step_rng, done):
         tok = _sample(logits, step_rng, temperature, top_k)
@@ -106,6 +116,7 @@ def generate(
     top_k: int = 0,
     eos_id: Optional[int] = None,
     rng: Optional[jax.Array] = None,
+    prompt_lengths: Optional[jax.Array] = None,  # [B] int32
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
@@ -113,6 +124,11 @@ def generate(
     is greedy decoding; otherwise softmax sampling, optionally truncated to
     the ``top_k`` most likely tokens. With ``eos_id``, sequences that emit it
     keep emitting it (no early exit — shapes stay static for jit).
+
+    ``prompt_lengths``: when set, prompts are right-padded to a shared
+    shape and each sequence decodes from its own true length (the batched
+    serving path); its new tokens are the [B, max_new_tokens] suffix of
+    the return value regardless of padding.
     """
     cfg = module.cfg
     if max_new_tokens <= 0:
@@ -125,5 +141,6 @@ def generate(
     cache = init_cache(module, prompt.shape[0])
     tokens, _ = _generate_jit(module, params, cache,
                               prompt.astype(jnp.int32), max_new_tokens,
-                              float(temperature), int(top_k), eos_id, rng)
+                              float(temperature), int(top_k), eos_id, rng,
+                              prompt_lengths=prompt_lengths)
     return tokens

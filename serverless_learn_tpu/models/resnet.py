@@ -173,46 +173,91 @@ class ResNet(nn.Module):
         return x
 
 
-def _bundle(module, num_classes, image_shape, input_dtype="float32"):
+def device_crop_flip(x: jax.Array, ys: jax.Array, xs: jax.Array,
+                     flip: jax.Array, oh: int, ow: int) -> jax.Array:
+    """Per-sample crop + horizontal flip ON DEVICE (vmapped dynamic_slice →
+    one gather; the conditional flip is a select fused into it by XLA).
+
+    The host-side twin is ``data/transforms.py::_crop_flip`` — measured
+    ~1.2k samples/s on one host core at 256→224, which is the entire
+    ImageNet-ingest bottleneck (round-3 verdict #1). On the chip the same
+    op rides HBM at effectively zero marginal step time, so the host ships
+    raw stored-size uint8 records and does no per-pixel work at all."""
+    C = x.shape[-1]
+
+    def one(im, y, xpos, f):
+        s = jax.lax.dynamic_slice(im, (y, xpos, jnp.zeros((), y.dtype)),
+                                  (oh, ow, C))
+        return jnp.where(f, s[:, ::-1, :], s)
+
+    return jax.vmap(one)(x, ys, xs, flip)
+
+
+def _bundle(module, num_classes, image_shape, input_dtype="float32",
+            stored_shape=None):
     """``input_dtype="uint8"`` moves image normalization onto the device:
     the host pipeline ships raw uint8 crops (4x less host work and
     host->HBM DMA than float32 — measured 224 vs 825 samples/s/core for the
     f32 convert alone at 224x224) and XLA fuses the /255 cast into the
-    first conv. The default stays float32 for synthetic-batch callers."""
+    first conv. The default stays float32 for synthetic-batch callers.
+
+    ``stored_shape`` (e.g. (256, 256, 3) vs image_shape (224, 224, 3))
+    additionally moves the random-crop + flip augmentation onto the device:
+    batches carry STORED-size records, the train step samples crop offsets
+    and flips from its per-step PRNG and applies them via
+    ``device_crop_flip``; eval center-crops deterministically. The host
+    pipeline then does zero per-pixel work (no crop, no flip, no convert)."""
     in_dtype = jnp.dtype(input_dtype)
+    batch_shape = stored_shape if stored_shape is not None else image_shape
+    oh, ow = image_shape[:2]
 
     def _norm(x):
         if jnp.issubdtype(x.dtype, jnp.integer):
             return x.astype(jnp.float32) * jnp.float32(1.0 / 255.0)
         return x
 
+    def _augment(x, rng):
+        if stored_shape is None:
+            return x
+        B, H, W = x.shape[:3]
+        if rng is None:  # no PRNG (eval-style call): center crop
+            return x[:, (H - oh) // 2:(H - oh) // 2 + oh,
+                     (W - ow) // 2:(W - ow) // 2 + ow]
+        ky, kx, kf = jax.random.split(rng, 3)
+        ys = jax.random.randint(ky, (B,), 0, H - oh + 1)
+        xs = jax.random.randint(kx, (B,), 0, W - ow + 1)
+        fl = jax.random.bernoulli(kf, 0.5, (B,))
+        return device_crop_flip(x, ys, xs, fl, oh, ow)
+
     def loss_fn(params, batch, rngs=None, model_state=None):
         variables = {"params": params, **(model_state or {})}
         logits, updates = module.apply(
-            variables, _norm(batch["image"]), train=True,
+            variables, _norm(_augment(batch["image"], rngs)), train=True,
             mutable=["batch_stats"])
         loss, metrics = softmax_cross_entropy(logits, batch["label"])
         return loss, {"metrics": metrics, "model_state": dict(updates)}
 
     def eval_loss_fn(params, batch, rngs=None, model_state=None):
         variables = {"params": params, **(model_state or {})}
-        logits = module.apply(variables, _norm(batch["image"]), train=False)
+        logits = module.apply(variables,
+                              _norm(_augment(batch["image"], None)),
+                              train=False)
         loss, metrics = softmax_cross_entropy(logits, batch["label"])
         return loss, {"metrics": metrics, "model_state": {}}
 
     def input_spec(data_config, batch_size):
         return {
-            "image": jax.ShapeDtypeStruct((batch_size, *image_shape), in_dtype),
+            "image": jax.ShapeDtypeStruct((batch_size, *batch_shape), in_dtype),
             "label": jax.ShapeDtypeStruct((batch_size,), jnp.int32),
         }
 
     def make_batch(rng: np.random.Generator, data_config, batch_size):
         if np.issubdtype(np.dtype(input_dtype), np.integer):
-            image = rng.integers(0, 256, (batch_size, *image_shape)).astype(
+            image = rng.integers(0, 256, (batch_size, *batch_shape)).astype(
                 np.dtype(input_dtype))
         else:
             image = rng.standard_normal(
-                (batch_size, *image_shape), dtype=np.float32)
+                (batch_size, *batch_shape), dtype=np.float32)
         return {
             "image": image,
             "label": rng.integers(0, num_classes, (batch_size,)).astype(np.int32),
@@ -226,10 +271,11 @@ def _bundle(module, num_classes, image_shape, input_dtype="float32"):
 @register_model("resnet18_cifar")
 def make_resnet18_cifar(num_classes=10, dtype=jnp.bfloat16,
                         param_dtype=jnp.float32, image_shape=(32, 32, 3),
-                        input_dtype="float32", norm="batch"):
+                        input_dtype="float32", norm="batch", num_filters=64):
     module = ResNet(stage_sizes=(2, 2, 2, 2), block_cls=ResNetBlock,
                     num_classes=num_classes, dtype=dtype,
-                    param_dtype=param_dtype, small_images=True, norm=norm)
+                    param_dtype=param_dtype, small_images=True, norm=norm,
+                    num_filters=num_filters)
     return _bundle(module, num_classes, image_shape, input_dtype=input_dtype)
 
 
@@ -237,12 +283,18 @@ def make_resnet18_cifar(num_classes=10, dtype=jnp.bfloat16,
 def make_resnet50_imagenet(num_classes=1000, dtype=jnp.bfloat16,
                            param_dtype=jnp.float32, image_shape=(224, 224, 3),
                            space_to_depth=True, input_dtype="uint8",
-                           norm="batch"):
+                           norm="batch", device_augment=False,
+                           stored_hw=(256, 256)):
     # uint8 input by default: the ImageNet rung streams uint8 shards, and
     # device-side /255 (fused into the first conv by XLA) keeps the host
     # pipeline and the host->HBM DMA at a quarter of the float32 bytes.
+    # device_augment=True additionally takes STORED-size (256x256) records
+    # and does the random 224-crop + flip on device from the step PRNG —
+    # the host then does zero per-pixel work (see _bundle docstring).
     module = ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock,
                     num_classes=num_classes, dtype=dtype,
                     param_dtype=param_dtype, small_images=False,
                     space_to_depth=space_to_depth, norm=norm)
-    return _bundle(module, num_classes, image_shape, input_dtype=input_dtype)
+    stored = (*stored_hw, image_shape[2]) if device_augment else None
+    return _bundle(module, num_classes, image_shape, input_dtype=input_dtype,
+                   stored_shape=stored)

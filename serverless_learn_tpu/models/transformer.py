@@ -80,6 +80,13 @@ class TransformerConfig:
     # d_ff/tp), and Attention / MlpBlock psum their row-parallel outputs
     # over this axis. Set by PipelinedBlocks, never by users.
     manual_tp_axis: Optional[str] = None
+    # GShard-style manual expert parallelism INSIDE a pipeline stage's
+    # shard_map (round-4: pp x ep composition): this config's n_experts is
+    # the LOCAL expert count (global / ep), routing runs over
+    # moe_global_experts, and MoELayer all-to-alls token slots to their
+    # owning ep member and back. Set by PipelinedBlocks, never by users.
+    manual_ep_axis: Optional[str] = None
+    moe_global_experts: int = 0  # routing-global E when manual_ep_axis set
     head_dim_override: Optional[int] = None  # local-slice cfgs must pin it
 
     @property
@@ -186,7 +193,7 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, mask=None, positions=None, decode=False,
-                 prefill=False):
+                 prefill=False, seq_lengths=None):
         cfg = self.cfg
         H, K, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
         dense = lambda feats, name: nn.DenseGeneral(
@@ -203,12 +210,16 @@ class Attention(nn.Module):
         causal = cfg.causal
         if decode or prefill:
             # Autoregressive KV cache. decode: x is the single newest token
-            # ([B, 1, d_model]); K/V land at slot `cache_index` and
-            # attention reads the whole cache under a <= index mask. RoPE
-            # must use the absolute position, which *is* the cache index —
-            # so rotation happens inside this branch. prefill: one batched
-            # causal forward over the whole prompt that bulk-writes the
-            # cache (slots [0, T)) instead of T sequential decode steps.
+            # per sequence ([B, 1, d_model]); K/V land at slot
+            # `cache_index[b]` and attention reads the whole cache under a
+            # per-sequence <= index mask. RoPE must use the absolute
+            # position, which *is* the cache index — so rotation happens
+            # inside this branch. prefill: one batched causal forward over
+            # the (right-padded) prompt that bulk-writes the cache. The
+            # index is a [B] VECTOR: batched serving right-pads unequal
+            # prompts to one shape and passes ``seq_lengths`` — pad slots
+            # hold garbage K/V that the per-seq mask never reads and the
+            # next decode writes straight over (inference/batching.py).
             B = x.shape[0]
             is_init = not self.has_variable("cache", "cached_k")
             ck = self.variable("cache", "cached_k", jnp.zeros,
@@ -216,7 +227,7 @@ class Attention(nn.Module):
             cv = self.variable("cache", "cached_v", jnp.zeros,
                                (B, cfg.max_seq_len, K, D), v.dtype)
             ci = self.variable("cache", "cache_index",
-                               lambda: jnp.zeros((), jnp.int32))
+                               lambda: jnp.zeros((B,), jnp.int32))
             if not is_init and prefill:
                 T = x.shape[1]
                 if cfg.use_rope:
@@ -229,25 +240,33 @@ class Attention(nn.Module):
                     ck.value, k, (0, 0, 0, 0))
                 cv.value = jax.lax.dynamic_update_slice(
                     cv.value, v, (0, 0, 0, 0))
-                ci.value = jnp.asarray(T, jnp.int32)
-                # Attention runs causally over just the prompt-length K/V.
+                if seq_lengths is None:
+                    ci.value = jnp.full((B,), T, jnp.int32)
+                else:
+                    ci.value = seq_lengths.astype(jnp.int32)
+                # Attention runs causally over the padded prompt: real
+                # token i attends only [0, i] — all real under right-
+                # padding; pad rows produce garbage nobody reads.
             elif not is_init:
                 if x.shape[1] != 1:
                     raise ValueError(
                         f"decode feeds one token at a time, got T={x.shape[1]}")
-                pos = ci.value
+                pos = ci.value  # [B]
                 if cfg.use_rope:
-                    p = jnp.full((B, 1), pos, jnp.int32)
-                    sin, cos = rope_angles(p, D, cfg.rope_theta)
+                    sin, cos = rope_angles(pos[:, None], D, cfg.rope_theta)
                     q = apply_rope(q, sin, cos)
                     k = apply_rope(k, sin, cos)
-                ck.value = jax.lax.dynamic_update_slice(
-                    ck.value, k, (0, pos, 0, 0))
-                cv.value = jax.lax.dynamic_update_slice(
-                    cv.value, v, (0, pos, 0, 0))
+
+                def write_at(c, new, p):  # [S, K, D], [1, K, D], []
+                    z = jnp.zeros((), p.dtype)
+                    return jax.lax.dynamic_update_slice(c, new, (p, z, z))
+
+                ck.value = jax.vmap(write_at)(ck.value, k, pos)
+                cv.value = jax.vmap(write_at)(cv.value, v, pos)
                 ci.value = pos + 1
                 k, v = ck.value, cv.value
-                mask = (jnp.arange(cfg.max_seq_len) <= pos)[None, None, None, :]
+                mask = (jnp.arange(cfg.max_seq_len)[None, :]
+                        <= pos[:, None])[:, None, None, :]
                 causal = False  # the index mask already encodes causality
         elif cfg.use_rope:
             if positions is None:
@@ -307,14 +326,14 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, mask=None, positions=None, decode=False,
-                 prefill=False):
+                 prefill=False, seq_lengths=None):
         cfg = self.cfg
         norm = (nn.RMSNorm if cfg.norm == "rms" else nn.LayerNorm)
         mk_norm = lambda name: norm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                                     name=name)
         x = x + Attention(cfg, name="attn")(
             mk_norm("norm_attn")(x), mask=mask, positions=positions,
-            decode=decode, prefill=prefill)
+            decode=decode, prefill=prefill, seq_lengths=seq_lengths)
         if cfg.n_experts > 0:
             x = x + MoELayer(cfg, name="moe")(mk_norm("norm_mlp")(x))
         else:
@@ -360,27 +379,42 @@ class PipelinedBlocks(nn.Module):
 
         mesh = get_active_mesh()
         tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+        ep = mesh.shape.get("ep", 1) if mesh is not None else 1
         pp_live = mesh is not None and mesh.shape.get("pp", 1) > 1
         block_cfg = cfg
         param_specs = None
-        if cfg.n_experts > 0 and tp > 1:
-            raise NotImplementedError(
-                "pipeline + tp + MoE is unsupported: expert weights are "
-                "not tp-sliced by the pipeline's local-shape scheme")
         if pp_live and tp > 1:
             # Megatron-style manual tp inside the pipeline's shard_map:
             # each tp member applies a LOCAL slice of every layer (heads
             # and d_ff divided by tp; the rule table shards the stacked
-            # leaves to match) and psums its row-parallel outputs.
+            # leaves to match) and psums its row-parallel outputs. Experts
+            # tp-slice their d_ff exactly like the dense MLP (MoELayer
+            # psums after its down projection).
             H, K = cfg.n_heads, cfg.kv_heads
             if H % tp or K % tp or cfg.d_ff % tp:
                 raise ValueError(
                     f"pp x tp needs n_heads ({H}), kv_heads ({K}) and "
                     f"d_ff ({cfg.d_ff}) divisible by tp={tp}")
             block_cfg = dataclasses.replace(
-                cfg, n_heads=H // tp, n_kv_heads=K // tp,
+                block_cfg, n_heads=H // tp, n_kv_heads=K // tp,
                 d_ff=cfg.d_ff // tp, manual_tp_axis="tp",
                 head_dim_override=cfg.head_dim)
+        if pp_live and ep > 1 and cfg.n_experts > 0:
+            # GShard-style manual ep inside the pipeline's shard_map
+            # (round-4: the Mixtral-shaped flagship must pipeline): each ep
+            # member owns n_experts/ep experts of every layer; MoELayer
+            # routes over the global count and all-to-alls slots to their
+            # owners. Batch rows are ep-sharded (gpipe_apply batch axes),
+            # so attention is data-parallel over ep.
+            if cfg.n_experts % ep:
+                raise ValueError(
+                    f"pp x ep needs n_experts ({cfg.n_experts}) divisible "
+                    f"by ep={ep}")
+            block_cfg = dataclasses.replace(
+                block_cfg, n_experts=cfg.n_experts // ep,
+                moe_global_experts=cfg.n_experts, manual_ep_axis="ep",
+                head_dim_override=cfg.head_dim)
+        if pp_live and (tp > 1 or (ep > 1 and cfg.n_experts > 0)):
             from serverless_learn_tpu.parallel.sharding import (
                 DEFAULT_RULES, _path_str)
 
@@ -494,7 +528,7 @@ class Transformer(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, mask=None, positions=None, decode=False,
-                 prefill=False):
+                 prefill=False, seq_lengths=None):
         """tokens [B, T] int32 -> logits [B, T, vocab].
 
         ``decode=True``: autoregressive inference mode — ``tokens`` is the
@@ -502,6 +536,10 @@ class Transformer(nn.Module):
         maintains a KV cache in the ``cache`` variable collection.
         ``prefill=True``: one batched causal forward over the prompt that
         bulk-writes the cache (see ``inference/generate.py`` for the driver).
+        ``seq_lengths`` [B] (prefill only): true prompt lengths of
+        right-padded prompts — each sequence's cache index starts at its
+        own length, so one batched prefill serves unequal prompts
+        (``inference/batching.py``).
         """
         cfg = self.cfg
         if decode and prefill:
@@ -545,7 +583,8 @@ class Transformer(nn.Module):
                     y = blk(x, mask=mask, positions=positions)
                 else:
                     y = blk(x, mask=mask, positions=positions,
-                            decode=decode, prefill=prefill)
+                            decode=decode, prefill=prefill,
+                            seq_lengths=seq_lengths)
                 x = constrain_residual(y)
         norm = (nn.RMSNorm if cfg.norm == "rms" else nn.LayerNorm)
         x = norm(dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="norm_f")(x)

@@ -122,6 +122,12 @@ def dot_product_attention(
         else:
             impl = "flash" if q.shape[1] >= AUTO_FLASH_MIN_SEQ else "xla"
     if impl == "xla":
+        if kv_lengths is not None and mask is None:
+            # Honor the lengths contract on this path too: a caller that
+            # passes only kv_lengths must not silently attend to padding.
+            S = k.shape[1]
+            mask = (jnp.arange(S)[None, :] < kv_lengths[:, None])
+            mask = mask[:, None, None, :]  # [B, 1, 1, S]
         return xla_attention(q, k, v, causal=causal, mask=mask)
     if impl == "flash":
         from serverless_learn_tpu.ops.pallas.flash_attention import flash_attention

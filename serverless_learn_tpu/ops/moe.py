@@ -115,6 +115,17 @@ class MoELayer(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:  # [B, T, D] -> [B, T, D]
         cfg = self.cfg
         E, K, F = cfg.n_experts, cfg.moe_top_k, cfg.d_ff
+        # Manual expert parallelism (inside a pipeline's shard_map, where
+        # GSPMD can't partition for us — round-4 pp x ep): cfg.n_experts is
+        # this member's LOCAL expert count, routing runs over the GLOBAL
+        # count, and two explicit lax.all_to_all calls replace the
+        # partitioner-induced ones: slots split by owning expert and
+        # exchanged for the other members' token slots (the literal GShard
+        # schedule). Tokens here are ep-sharded batch rows (gpipe_apply
+        # includes "ep" in its batch axes), so attention runs data-parallel
+        # over ep and only expert compute reshuffles.
+        ep = cfg.manual_ep_axis
+        E_route = cfg.moe_global_experts if ep else E
         B, T, D = x.shape
         # Split each row into routing subgroups of <= moe_group_size tokens
         # (largest divisor of T that fits) so the one-hot dispatch
@@ -122,13 +133,14 @@ class MoELayer(nn.Module):
         limit = min(cfg.moe_group_size or T, T)
         gs = max(d for d in range(1, limit + 1) if T % d == 0)
         x = x.reshape(B * (T // gs), gs, D)  # [G, S, D]
-        capacity = max(1, int(cfg.moe_capacity_factor * K * gs / E))
+        capacity = max(1, int(cfg.moe_capacity_factor * K * gs / E_route))
 
         router = self.param(
-            "router", nn.initializers.normal(0.02), (D, E), cfg.param_dtype)
+            "router", nn.initializers.normal(0.02), (D, E_route),
+            cfg.param_dtype)
         logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32),
                             router.astype(jnp.float32))
-        dispatch, combine, aux = top_k_routing(logits, E, K, capacity)
+        dispatch, combine, aux = top_k_routing(logits, E_route, K, capacity)
         self.sow("losses", "moe_aux", cfg.moe_aux_weight * aux)
 
         init = nn.initializers.lecun_normal(in_axis=1, out_axis=2)
@@ -137,13 +149,26 @@ class MoELayer(nn.Module):
         w_down = self.param("expert_down", init, (E, F, D), cfg.param_dtype)
 
         # Dispatch tokens to expert slots; with batch over dp and experts
-        # over ep, GSPMD lowers the e-contraction to an ICI all-to-all.
+        # over ep, GSPMD lowers the e-contraction to an ICI all-to-all (or
+        # the manual path below issues it explicitly).
         xe = jnp.einsum("btec,btd->becd", dispatch.astype(cfg.dtype),
-                        x.astype(cfg.dtype))  # [B, E, C, D]
+                        x.astype(cfg.dtype))  # [B, E_route, C, D]
+        if ep:
+            # -> [B * ep, E_local, C, D]: every member's slots for MY experts.
+            xe = jax.lax.all_to_all(xe, ep, split_axis=1, concat_axis=0,
+                                    tiled=True)
         h = nn.silu(jnp.einsum("becd,edf->becf", xe, w_gate.astype(cfg.dtype)))
         h = h * jnp.einsum("becd,edf->becf", xe, w_up.astype(cfg.dtype))
         ye = jnp.einsum("becf,efd->becd", h, w_down.astype(cfg.dtype))
+        if ep:
+            # Return each member's slots: -> [B, E_route, C, D].
+            ye = jax.lax.all_to_all(ye, ep, split_axis=0, concat_axis=1,
+                                    tiled=True)
         # Combine back to token order, gate-weighted (second all-to-all).
         y = jnp.einsum("btec,becd->btd", combine.astype(jnp.float32),
                        ye.astype(jnp.float32))
+        if cfg.manual_tp_axis:
+            # Row-parallel expert down-projection: each tp member holds
+            # d_ff/tp of every (local) expert; partial sums combine here.
+            y = jax.lax.psum(y, cfg.manual_tp_axis)
         return y.reshape(B, T, D).astype(cfg.dtype)

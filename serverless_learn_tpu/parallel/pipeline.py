@@ -135,7 +135,7 @@ def gpipe_apply(
     n_microbatches: int,
     n_virtual: int = 1,
     axis_name: str = "pp",
-    batch_axes: Sequence[str] = ("dp", "fsdp"),
+    batch_axes: Sequence[str] = ("dp", "fsdp", "ep"),
     param_specs=None,
     with_aux: bool = False,
 ):
@@ -181,11 +181,12 @@ def gpipe_apply(
         return sequential_apply(
             block_apply, stacked_params, x, positions, mask,
             layer_order=None, with_aux=with_aux)
-    for ax in ("ep", "sp"):
-        if mesh.shape.get(ax, 1) > 1:
-            raise NotImplementedError(
-                f"pipeline parallelism composes with dp/fsdp/tp; mesh axis "
-                f"'{ax}' must be 1 (got {mesh.shape[ax]})")
+    if mesh.shape.get("sp", 1) > 1:
+        raise NotImplementedError(
+            "pipeline parallelism composes with dp/fsdp/tp/ep; mesh axis "
+            f"'sp' must be 1 (got {mesh.shape['sp']}) — ring attention "
+            "rotates K/V around the sp ring with its own ppermute schedule, "
+            "which would interleave with the pipeline's stage ring")
     n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
     V = int(n_virtual)
     if V < 1:

@@ -102,6 +102,12 @@ DEFAULT_RULES = ShardingRules(
         (r"pipe_blocks/.*(wi|wi_0|wi_1|up_proj|gate_proj)/kernel$",
          P("pp", None, "tp")),
         (r"pipe_blocks/.*(wo|down_proj)/kernel$", P("pp", "tp")),
+        # Pipelined MoE (round-4 pp x ep): stacked expert leaves
+        # [L, E, D, F] shard experts over ep and d_ff over tp (the manual
+        # GShard + Megatron scheme in ops/moe.py). Router replicated within
+        # a stage — every ep member routes over the GLOBAL expert count.
+        (r"pipe_blocks/.*moe/expert_(gate|up)$", P("pp", "ep", None, "tp")),
+        (r"pipe_blocks/.*moe/expert_down$", P("pp", "ep", "tp")),
         (r"pipe_blocks/", P("pp")),
         # MoE (ops/moe.py): experts stacked on dim 0 shard over ep; inner
         # dims follow the dense-MLP tp/fsdp convention. Router replicated.

@@ -61,10 +61,32 @@ class LocalSGDState:
     opt_state: Any  # leaves [R, ...] — per-replica inner optimizer state
     anchor: Any  # leaves [...] — outer anchor params ("average" mode)
     outer_opt_state: Any  # outer optimizer state ("average" mode)
+    model_state: Any = flax.struct.field(default_factory=dict)
+    # ^ leaves [R, ...] — per-replica mutable collections (BatchNorm
+    # running stats etc.); round 4 — r3 refused stateful models outright.
 
 
+def _mean_float_leaves(tree):
+    """Replica-mean of float leaves (BatchNorm stats at a sync), tiled back
+    to the stacked [R, ...] shape; non-float leaves (counters) pass through
+    untouched — averaging an int step counter would be meaningless."""
+    def mix(l):
+        if not jnp.issubdtype(l.dtype, jnp.floating):
+            return l
+        return jnp.broadcast_to(l.mean(0, keepdims=True), l.shape
+                                ).astype(l.dtype)
+    return jax.tree_util.tree_map(mix, tree)
+
+
+@jax.jit
 def replica_divergence(params) -> jax.Array:
-    """Max over leaves of max |p_r - mean_r p| — 0 iff replicas agree."""
+    """Max over leaves of max |p_r - mean_r p| — 0 iff replicas agree.
+
+    Jitted into ONE program: leaves are dp-sharded [R, ...], so each mean
+    is a cross-device reduction — dispatched eagerly op-by-op, a large
+    stateful model (ResNet batch_stats) serializes dozens of collectives
+    on the CPU test backend and trips XLA:CPU's hardcoded 40 s
+    collective-rendezvous abort."""
     leaves = jax.tree_util.tree_leaves(params)
     divs = [jnp.max(jnp.abs(l - l.mean(0, keepdims=True))) for l in leaves]
     return jnp.max(jnp.stack([jnp.asarray(d, jnp.float32) for d in divs]))
@@ -129,16 +151,13 @@ class LocalSGDTrainer:
         per_replica = cfg.train.batch_size // R
         spec = bundle.input_spec(cfg.data, per_replica)
 
-        # v1 supports stateless models only (no batch_stats etc.): the inner
-        # step would otherwise need per-replica model_state threading.
+        # Stateful models (BatchNorm running stats etc.): every non-param
+        # collection is stacked per replica and vmapped through the inner
+        # step alongside the params — each replica owns its own statistics
+        # between syncs, exactly as each reference worker owned its own
+        # model vector between gossip exchanges (src/worker.cc:221-231).
         first_spec = (next(iter(spec.values()))
                       if isinstance(spec, dict) else spec)
-        collections = jax.eval_shape(
-            lambda x: bundle.module.init(jax.random.PRNGKey(0), x), first_spec)
-        extra = [k for k in collections if k not in ("params", "losses")]
-        if extra:
-            raise ValueError(f"local SGD supports stateless models; "
-                             f"{cfg.model} has collections {extra}")
 
         # Per-replica batch rows additionally split over fsdp (standard
         # ZeRO data parallelism WITHIN the replica); tp replicates data.
@@ -156,7 +175,10 @@ class LocalSGDTrainer:
         def init_raw(seed):
             rng = jax.random.PRNGKey(seed)
             first = jnp.zeros(first_spec.shape, first_spec.dtype)
-            params = bundle.module.init(rng, first)["params"]
+            variables = bundle.module.init(rng, first)
+            params = variables["params"]
+            mstate = {k: v for k, v in variables.items()
+                      if k not in ("params", "losses")}
             tile = lambda p: jnp.broadcast_to(p[None], (R,) + p.shape)
             params_r = jax.tree_util.tree_map(tile, params)
             opt_r = jax.vmap(tx.init)(params_r)
@@ -169,6 +191,7 @@ class LocalSGDTrainer:
                 anchor=params if average_mode else {},
                 outer_opt_state=(self.outer_tx.init(params)
                                  if average_mode else {}),
+                model_state=jax.tree_util.tree_map(tile, mstate),
             )
 
         abstract = jax.eval_shape(init_raw, 0)
@@ -207,20 +230,23 @@ class LocalSGDTrainer:
             anchor=inner_shardings(abstract.anchor),
             outer_opt_state=inner_shardings(abstract.outer_opt_state,
                                             lenient=True),
+            model_state=stacked_shardings(abstract.model_state,
+                                          lenient=True),
         )
         self.init_fn = jax.jit(init_raw, static_argnums=(0,),
                                out_shardings=self.state_shardings)
 
-        def one_replica(params, opt_state, batch, rng):
+        def one_replica(params, mstate, opt_state, batch, rng):
             def loss_fn(p):
-                loss, aux = bundle.loss_fn(p, batch, rngs=rng)
+                loss, aux = bundle.loss_fn(p, batch, rngs=rng,
+                                           model_state=mstate)
                 return loss, aux
             (loss, aux), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = jax.tree_util.tree_map(
                 lambda p, u: p + u.astype(p.dtype), params, updates)
-            return new_params, new_opt, loss
+            return new_params, (aux["model_state"] or mstate), new_opt, loss
 
         st_sh = self.state_shardings
 
@@ -232,10 +258,11 @@ class LocalSGDTrainer:
                 lambda i: jax.random.fold_in(
                     jax.random.fold_in(jax.random.PRNGKey(cfg.train.seed), i),
                     state.step))(jnp.arange(R))
-            new_params, new_opt, losses = jax.vmap(one_replica)(
-                state.params, state.opt_state, batch, rngs)
+            new_params, new_mstate, new_opt, losses = jax.vmap(one_replica)(
+                state.params, state.model_state, state.opt_state, batch, rngs)
             return state.replace(step=state.step + 1, params=new_params,
-                                 opt_state=new_opt), losses
+                                 opt_state=new_opt,
+                                 model_state=new_mstate), losses
 
         self.inner_step = inner_step
 
@@ -261,7 +288,8 @@ class LocalSGDTrainer:
             return state.replace(
                 params=jax.tree_util.tree_map(tile, new_anchor),
                 anchor=new_anchor,
-                outer_opt_state=new_outer)
+                outer_opt_state=new_outer,
+                model_state=_mean_float_leaves(state.model_state))
 
         self.average_sync = average_sync
 
@@ -273,6 +301,8 @@ class LocalSGDTrainer:
         perm = [(j, j ^ (1 << bit)) for j in range(R)]
 
         def mix_leaf(p):  # inside shard_map: leading dim 1 (this replica)
+            if not jnp.issubdtype(p.dtype, jnp.floating):
+                return p  # int state (counters) doesn't gossip
             partner = jax.lax.ppermute(p, "dp", perm)
             # The reference's delta-apply (src/worker.cc:91-94): mix toward
             # the partner's model at the gossip learn rate.
@@ -282,20 +312,28 @@ class LocalSGDTrainer:
         # carry fsdp/tp on their inner dims, and shard_map must keep those
         # dims device-local — the ppermute then exchanges each replica
         # SHARD with the same-positioned shard of the partner replica.
-        param_specs = jax.tree_util.tree_map(
-            lambda s: s.spec, self.state_shardings.params,
+        as_specs = lambda tree: jax.tree_util.tree_map(
+            lambda s: s.spec, tree,
             is_leaf=lambda x: isinstance(x, NamedSharding))
+        param_specs = as_specs(self.state_shardings.params)
+        mstate_specs = as_specs(self.state_shardings.model_state)
 
         @partial(jax.jit, donate_argnums=(0,),
                  in_shardings=(self.state_shardings,),
                  out_shardings=self.state_shardings)
         def gossip_sync(state: LocalSGDState):
-            mixed = _shard_map(
-                lambda params: jax.tree_util.tree_map(mix_leaf, params),
+            # model_state gossips with the params: BatchNorm statistics ARE
+            # part of the model the reference's workers exchanged (its
+            # whole vector went over the wire, src/worker.cc:205-208).
+            mixed, mixed_state = _shard_map(
+                lambda params, ms: (
+                    jax.tree_util.tree_map(mix_leaf, params),
+                    jax.tree_util.tree_map(mix_leaf, ms)),
                 mesh=mesh,
-                in_specs=(param_specs,), out_specs=param_specs,
-            )(state.params)
-            return state.replace(params=mixed)
+                in_specs=(param_specs, mstate_specs),
+                out_specs=(param_specs, mstate_specs),
+            )(state.params, state.model_state)
+            return state.replace(params=mixed, model_state=mixed_state)
 
         self._gossip_jits[bit] = gossip_sync
         return gossip_sync

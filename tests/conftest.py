@@ -137,6 +137,21 @@ SLOW_TESTS = {
     "tests/test_train_step.py::test_remat_matches_no_remat",
     "tests/test_train_step.py::test_resnet18_step_runs_and_updates_batchstats",
     "tests/test_train_step.py::test_train_dtype_policy_reaches_model",
+    # round 4
+    "tests/test_pipeline.py::test_pp_ep_train_step_matches_dp",
+    "tests/test_pipeline.py::test_pp_tp_moe_train_step_matches_dp",
+    "tests/test_pipeline.py::test_moe_pipeline_matches_dp",
+    "tests/test_local_sgd.py::test_stateful_resnet_gossip_trains_and_stats_gossip",
+    "tests/test_local_sgd.py::test_stateful_diloco_exact_parity_groupnorm",
+    "tests/test_local_sgd.py::test_stateful_diloco_batchnorm_tolerance_documented",
+    "tests/test_serve_batching.py::test_engine_coalesces_and_is_exact",
+    "tests/test_serve_batching.py::test_engine_groups_by_sampling_params",
+    "tests/test_serve_batching.py::test_engine_mixed_max_new_truncates_exactly",
+    "tests/test_serve_batching.py::test_server_concurrent_clients_share_batches",
+    "tests/test_serve_batching.py::test_padded_batch_generate_matches_solo",
+    "tests/test_parallel_ingest.py::test_resnet50_device_augment_trains",
+    "tests/test_tokenizer.py::test_packed_batches_train_llama_and_bert",
+    "tests/test_flash_masks.py::test_dispatcher_honors_kv_lengths_alone",
 }
 
 
@@ -148,5 +163,7 @@ def pytest_configure(config):
 def pytest_collection_modifyitems(config, items):
     for item in items:
         nodeid = item.nodeid.replace("\\", "/")
-        if nodeid in SLOW_TESTS:
+        # A bare (un-parametrized) entry in SLOW_TESTS marks every
+        # parametrization of that test.
+        if nodeid in SLOW_TESTS or nodeid.split("[")[0] in SLOW_TESTS:
             item.add_marker(pytest.mark.slow)

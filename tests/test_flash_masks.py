@@ -159,6 +159,27 @@ def test_bert_step_executes_flash_path(devices):
                    for x in jax.tree_util.tree_leaves(g))
 
 
+@pytest.mark.parametrize("T", [128, 256, 512])
+@pytest.mark.parametrize("impl", ["auto", "xla", "flash"])
+def test_dispatcher_honors_kv_lengths_alone(impl, T):
+    """Round-3 verdict #5: every dispatch branch must honor kv_lengths even
+    when the caller passes NO mask — in particular impl="xla" with T < 512,
+    which previously ignored padding silently."""
+    from serverless_learn_tpu.ops.attention import dot_product_attention
+
+    rng = np.random.default_rng(7)
+    B, H, D = 2, 4, 64
+    q, k, v = (_rand(rng, B, T, H, D) for _ in range(3))
+    lens = jnp.asarray([T, T // 3], jnp.int32)
+    m4 = jnp.asarray(_suffix_mask([T, T // 3], T))[:, None, None, :]
+    w = jnp.asarray(_suffix_mask([T, T // 3], T))[:, :, None, None]
+
+    out = dot_product_attention(q, k, v, kv_lengths=lens, impl=impl)
+    ref = xla_attention(q, k, v, mask=m4)
+    assert float(jnp.abs((out - ref) * w).max()) < 1e-5, \
+        f"impl={impl} T={T}: padding ignored on the dispatch path"
+
+
 def test_fully_padded_row_is_nan_free(qkv):
     """A row with zero valid keys must produce output 0 and, with zero
     upstream gradient (the loss masks it), NaN-free input gradients."""

@@ -129,12 +129,104 @@ def test_unknown_outer_mode_rejected(devices):
         LocalSGDTrainer(cfg, outer="avg")
 
 
-def test_stateful_model_rejected(devices):
+def _r18_trainer(outer="gossip", inner_steps=2, batch=16, norm="batch",
+                 **kw):
+    """ResNet-18 (BatchNorm: a `batch_stats` collection) — the stateful
+    case round 3 refused outright (verdict #4)."""
     cfg = ExperimentConfig(
-        model="resnet18_cifar", mesh=MeshConfig(dp=8),
-        train=TrainConfig(batch_size=16))
-    with pytest.raises(ValueError, match="stateless"):
-        LocalSGDTrainer(cfg)
+        model="resnet18_cifar",
+        model_overrides=dict(num_classes=4, dtype=jnp.float32,
+                             image_shape=(8, 8, 3), num_filters=32,
+                             norm=norm),
+        mesh=MeshConfig(dp=8),
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.05,
+                                  momentum=0.0),
+        train=TrainConfig(batch_size=batch, num_steps=8, dtype="float32",
+                          param_dtype="float32"),
+        data=DataConfig())
+    return LocalSGDTrainer(cfg, inner_steps=inner_steps, outer=outer, **kw)
+
+
+def test_stateful_resnet_gossip_trains_and_stats_gossip(devices):
+    """BatchNorm models train under Local SGD: per-replica batch_stats are
+    stacked [R, ...], diverge during inner steps (different shards), and
+    gossip back toward agreement with the params."""
+    import itertools
+
+    tr = _r18_trainer(outer="gossip", mix_rate=0.5)
+    state = tr.init()
+    stats = state.model_state["batch_stats"]
+    assert all(l.shape[0] == tr.R
+               for l in jax.tree_util.tree_leaves(stats))
+
+    batch = tr.bundle.make_batch(np.random.default_rng(0), tr.config.data, 16)
+    state, losses0 = tr.inner_step(state, tr.shard_batch(batch))
+    div = float(replica_divergence(state.model_state["batch_stats"]))
+    assert div > 1e-6, "replica stats should diverge on different shards"
+    mean_before = jax.tree_util.tree_map(
+        lambda p: np.asarray(jax.device_get(p)).mean(0),
+        state.model_state["batch_stats"])
+    for _ in range(3):  # log2(8) hypercube rounds at rate 0.5 => exact mean
+        state = tr.outer_sync(state)
+    assert float(replica_divergence(state.model_state["batch_stats"])) < 1e-6
+    for a, b in zip(jax.tree_util.tree_leaves(mean_before),
+                    jax.tree_util.tree_leaves(state.model_state["batch_stats"])):
+        np.testing.assert_allclose(a, np.asarray(jax.device_get(b))[0],
+                                   rtol=1e-5, atol=1e-6)
+
+    state, losses = tr.run(itertools.repeat(batch), num_steps=6)
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def _parity_losses(norm, batch, steps=4):
+    """(local DiLoCo-degenerate losses, sync losses) on a fixed batch."""
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    tr = _r18_trainer(outer="average", inner_steps=1, outer_lr=1.0,
+                      outer_momentum=0.0, batch=batch, norm=norm)
+    sync = build_trainer(tr.config)
+    b = tr.bundle.make_batch(np.random.default_rng(1), tr.config.data,
+                             batch)
+    l_state, s_state = tr.init(), sync.init()
+    l_losses, s_losses = [], []
+    for _ in range(steps):
+        l_state, ll = tr.inner_step(l_state, tr.shard_batch(b))
+        l_state = tr.outer_sync(l_state)
+        l_losses.append(float(jax.device_get(ll.mean())))
+        s_state, m = sync.step(s_state, sync.shard_batch(b))
+        s_losses.append(float(jax.device_get(m["loss"])))
+    return l_losses, s_losses
+
+
+def test_stateful_diloco_exact_parity_groupnorm(devices):
+    """DiLoCo degenerate case (inner_steps=1, outer lr=1, no momentum) is
+    param-averaging every step — for plain SGD that EQUALS the synchronous
+    trainer's step when normalization statistics are per-sample
+    (GroupNorm): the only nonlinearity Local SGD changes is batch-stat
+    scope, so with GroupNorm the loss trajectories must agree to float
+    tolerance. This isolates the DiLoCo machinery from the BatchNorm
+    semantics tested below."""
+    l_losses, s_losses = _parity_losses("group", batch=16)
+    np.testing.assert_allclose(l_losses, s_losses, rtol=2e-3)
+
+
+def test_stateful_diloco_batchnorm_tolerance_documented(devices):
+    """With BatchNorm the divergence is SEMANTIC, not a bug: each replica
+    normalizes its own sub-batch where sync training psums statistics
+    globally, so gradients genuinely differ. Measured on this fixture
+    (8 replicas x 16 samples each, 4 steps, fixed batch): local losses
+    track sync within ~35% per step and both decrease monotonically —
+    THAT is the documented tolerance users opt into when running
+    BatchNorm models under Local SGD (per-replica batch must be a sane
+    BN batch; at 2 samples/replica the stats are noise and the gap is
+    ~4x). Reference analogue: each gossiping worker trained on its own
+    stream with no shared statistics at all (src/worker.cc:221-231)."""
+    l_losses, s_losses = _parity_losses("batch", batch=128)
+    assert l_losses[-1] < l_losses[0] and s_losses[-1] < s_losses[0]
+    for l, s in zip(l_losses, s_losses):
+        assert abs(l - s) <= 0.35 * max(abs(s), 1e-3) + 0.05, (
+            l_losses, s_losses)
 
 
 def test_run_local_sgd_integrated_with_checkpoint(tmp_path, devices):

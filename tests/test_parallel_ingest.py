@@ -1,0 +1,186 @@
+"""Parallel multi-source ingest + device-side augmentation (round-3
+verdict #1).
+
+The data plane's per-host bar is per-chip demand x chips-per-host (4 on a
+v4 host). Two capabilities close the gap: ``ParallelIngestSource`` (N
+fetch+transform processes striping one host's shard share) and the
+device-augment geometry (host ships stored-size uint8 records; the train
+step crops/flips on device from its PRNG). These tests pin both: exact
+per-epoch record coverage across workers, error propagation, crop/flip
+parity device-vs-host, and the resnet50 ``device_augment=True`` bundle
+training end to end from 256x256 records.
+"""
+
+import socket
+
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.control.daemons import start_shard_server
+from serverless_learn_tpu.data.parallel_ingest import ParallelIngestSource
+from serverless_learn_tpu.data.shard_client import publish_dataset
+
+
+@pytest.fixture
+def shard_server(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = start_shard_server(port=port, root=str(tmp_path / "store"))
+    try:
+        yield f"127.0.0.1:{port}"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_workers_cover_every_record_exactly_once(shard_server):
+    n = 1024
+    publish_dataset(shard_server, "cover",
+                    {"idx": np.arange(n, dtype=np.int64)},
+                    records_per_shard=128)  # 8 shards over 2 workers
+    src = ParallelIngestSource(shard_server, "cover", batch_size=64,
+                               workers=2, loop=False)
+    seen = []
+    for batch in src:
+        seen.extend(batch["idx"].tolist())
+    src.close()
+    # Full batches of 64 from 128-record shards: no partial-batch drops,
+    # so the union across workers is exactly one epoch.
+    assert sorted(seen) == list(range(n))
+
+
+def test_worker_striping_is_disjoint(shard_server):
+    """Workers subdivide THIS host's dp share: dp_rank 0 of 2 with 2
+    workers must see exactly the records of shard stripes {0, 1} mod 4."""
+    n = 1024
+    publish_dataset(shard_server, "stripe",
+                    {"idx": np.arange(n, dtype=np.int64)},
+                    records_per_shard=128)
+    src = ParallelIngestSource(shard_server, "stripe", batch_size=64,
+                               workers=2, dp_rank=0, dp_size=2, loop=False)
+    seen = set()
+    for batch in src:
+        seen.update(batch["idx"].tolist())
+    src.close()
+    want = set()
+    for shard in range(8):
+        if shard % 4 in (0, 1):  # rank 0's workers own stripes 0 and 1
+            want.update(range(shard * 128, (shard + 1) * 128))
+    assert seen == want
+
+
+def _double_and_tag_factory(worker_idx):
+    # Module-level: spawn-based workers pickle the factory by reference.
+    def fn(batch):
+        out = dict(batch)
+        out["idx"] = batch["idx"] * 2
+        out["worker"] = np.full(len(batch["idx"]), worker_idx, np.int32)
+        return out
+    return fn
+
+
+def test_transform_factory_runs_in_child(shard_server):
+    n = 256
+    publish_dataset(shard_server, "xform",
+                    {"idx": np.arange(n, dtype=np.int64)},
+                    records_per_shard=64)
+
+    src = ParallelIngestSource(shard_server, "xform", batch_size=32,
+                               workers=2, loop=False,
+                               transform_factory=_double_and_tag_factory)
+    seen, workers = [], set()
+    for batch in src:
+        seen.extend(batch["idx"].tolist())
+        workers.update(batch["worker"].tolist())
+    src.close()
+    assert sorted(seen) == [2 * i for i in range(n)]
+    assert workers == {0, 1}
+
+
+def test_worker_error_propagates(shard_server):
+    src = ParallelIngestSource(shard_server, "does_not_exist", batch_size=8,
+                               workers=2, loop=False)
+    with pytest.raises(Exception):
+        next(iter(src))
+    src.close()
+
+
+def test_device_crop_flip_matches_host():
+    import jax.numpy as jnp
+
+    from serverless_learn_tpu.data.transforms import _crop_flip
+    from serverless_learn_tpu.models.resnet import device_crop_flip
+
+    rng = np.random.default_rng(11)
+    img = rng.integers(0, 256, (8, 40, 40, 3), dtype=np.uint8)
+    ys = rng.integers(0, 9, 8)
+    xs = rng.integers(0, 9, 8)
+    fl = rng.random(8) < 0.5
+    host = _crop_flip(img, 32, 32, ys, xs, fl)
+    dev = device_crop_flip(jnp.asarray(img), jnp.asarray(ys, jnp.int32),
+                           jnp.asarray(xs, jnp.int32), jnp.asarray(fl),
+                           32, 32)
+    np.testing.assert_array_equal(np.asarray(dev), host)
+
+
+def test_resnet50_device_augment_trains(devices):
+    """device_augment=True: batches carry STORED-size (here 48x48) uint8
+    records; the jitted step crops to image_shape on device, per-step
+    random (different steps -> different crops -> different losses on
+    frozen params), and eval center-crops deterministically."""
+    import jax
+    import jax.numpy as jnp
+
+    from serverless_learn_tpu.config import (
+        DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig,
+        TrainConfig)
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    cfg = ExperimentConfig(
+        model="resnet50_imagenet",
+        model_overrides=dict(num_classes=4, device_augment=True,
+                             stored_hw=(48, 48),
+                             image_shape=(32, 32, 3), dtype="float32"),
+        mesh=MeshConfig(dp=8),
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.01,
+                                  momentum=0.9),
+        train=TrainConfig(batch_size=16, dtype="float32"),
+        data=DataConfig())
+    trainer = build_trainer(cfg)
+    spec = trainer.bundle.input_spec(cfg.data, 16)
+    assert tuple(spec["image"].shape) == (16, 48, 48, 3)  # stored size
+
+    rng = np.random.default_rng(0)
+    batch = trainer.bundle.make_batch(rng, cfg.data, 16)
+    state = trainer.init()
+    losses = []
+    for _ in range(2):
+        state, m = trainer.step(state, trainer.shard_batch(batch))
+        losses.append(float(jax.device_get(m["loss"])))
+    assert all(np.isfinite(l) for l in losses)
+
+    # Frozen params, same batch, two different step counters -> the crop
+    # randomness must come from the step PRNG (losses differ).
+    l0, _ = trainer.bundle.loss_fn(
+        state.params, batch, rngs=jax.random.PRNGKey(0),
+        model_state=state.model_state)
+    l1, _ = trainer.bundle.loss_fn(
+        state.params, batch, rngs=jax.random.PRNGKey(1),
+        model_state=state.model_state)
+    assert float(l0) != float(l1)
+
+    # Eval: deterministic center crop (no rng), matches a manual slice.
+    from serverless_learn_tpu.models.registry import get_model
+    bundle = get_model("resnet50_imagenet", num_classes=4,
+                       device_augment=True, stored_hw=(48, 48),
+                       image_shape=(32, 32, 3), dtype=jnp.float32)
+    cropped = {"image": batch["image"][:, 8:40, 8:40],
+               "label": batch["label"]}
+    plain = get_model("resnet50_imagenet", num_classes=4,
+                      image_shape=(32, 32, 3), dtype=jnp.float32)
+    le, _ = bundle.eval_loss_fn(state.params, batch,
+                                model_state=state.model_state)
+    lp, _ = plain.eval_loss_fn(state.params, cropped,
+                               model_state=state.model_state)
+    np.testing.assert_allclose(float(le), float(lp), rtol=1e-6)

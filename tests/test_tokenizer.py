@@ -1,0 +1,155 @@
+"""Vocab-file BPE + sequence packing (round-3 verdict #8): encode against
+a GPT-2-format artifact pair, lossless round-trip, packing density, and
+packed batches actually training BERT and llama."""
+
+import json
+
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.data.raw import BYTE_VOCAB, EOS_ID
+from serverless_learn_tpu.data.tokenizer import (
+    BPETokenizer, load_text_corpus, pack_token_docs, packing_efficiency)
+
+
+def _toy_vocab(tmp_path):
+    """A tiny but REAL GPT-2-format artifact pair: byte-level alphabet +
+    a few ranked merges, written as vocab.json + merges.txt."""
+    from serverless_learn_tpu.data.tokenizer import _bytes_to_unicode
+
+    b2u = _bytes_to_unicode()
+    vocab = {c: i for i, c in enumerate(sorted(b2u.values()))}
+    merges = [("h", "e"), ("l", "l"), ("he", "ll"), ("o", "w"),
+              ("Ġ", "w"), ("Ġw", "orld"), ("o", "r"),
+              ("or", "l"), ("orl", "d")]
+    for a, b in merges:
+        if a + b not in vocab:
+            vocab[a + b] = len(vocab)
+    vocab["<|endoftext|>"] = len(vocab)
+    vp = tmp_path / "vocab.json"
+    mp = tmp_path / "merges.txt"
+    vp.write_text(json.dumps(vocab))
+    mp.write_text("#version: 0.2\n" +
+                  "\n".join(f"{a} {b}" for a, b in merges))
+    return str(vp), str(mp), vocab
+
+
+def test_bpe_merges_apply_by_rank(tmp_path):
+    vp, mp, vocab = _toy_vocab(tmp_path)
+    tok = BPETokenizer.from_files(vp, mp)
+    ids = tok.encode("hello world")
+    # "hello" -> [hell, o]; " world" -> [Ġworld]  (Ġ = Ġ = space byte)
+    toks = [tok.inv_vocab[int(i)] for i in ids]
+    assert toks == ["hell", "o", "Ġworld"], toks
+
+
+def test_bpe_round_trips_arbitrary_text(tmp_path):
+    vp, mp, _ = _toy_vocab(tmp_path)
+    tok = BPETokenizer.from_files(vp, mp)
+    for text in ("hello world", "héllo wörld 123 \n tabs\t!",
+                 "emoji \U0001f600 and 中文"):
+        assert tok.decode(tok.encode(text)) == text, text
+
+
+def test_bpe_eos_discovered(tmp_path):
+    vp, mp, vocab = _toy_vocab(tmp_path)
+    tok = BPETokenizer.from_files(vp, mp)
+    assert tok.eos_id == vocab["<|endoftext|>"]
+    assert tok.vocab_size == len(vocab)
+
+
+def test_packing_dense_and_ordered():
+    docs = [np.arange(10, 40), np.arange(100, 105), np.arange(200, 230)]
+    out = pack_token_docs(docs, seq_len=16)["input_ids"]
+    assert out.shape[1] == 16
+    flat = []
+    for d in docs:
+        flat.extend(int(x) for x in d)
+        flat.append(EOS_ID)
+    want = np.asarray(flat[:(len(flat) // 15) * 15]).reshape(-1, 15)
+    np.testing.assert_array_equal(out[:, 1:], want)  # BOS heads each row
+    assert (out[:, 0] == 2).all()
+
+
+def test_packing_wire_efficiency():
+    """The verdict's wire-efficiency bar: short docs (40 tokens) in
+    512-token rows — packing must cut shipped rows by >80% vs
+    one-doc-per-row."""
+    rng = np.random.default_rng(0)
+    docs = [rng.integers(4, 260, rng.integers(20, 60))
+            for _ in range(200)]
+    eff = packing_efficiency(docs, seq_len=512)
+    assert eff["packed_pad_fraction"] == 0.0
+    assert eff["naive_pad_fraction"] > 0.85
+    assert eff["wire_bytes_saved_fraction"] > 0.8, eff
+
+
+def test_load_text_corpus_byte_fallback(tmp_path):
+    p = tmp_path / "corpus.txt"
+    p.write_text("doc one text here\n\ndoc two text\n\n" * 50)
+    out = load_text_corpus(str(p), seq_len=32)
+    assert out["input_ids"].shape[1] == 32
+    assert out["input_ids"].max() < BYTE_VOCAB
+
+
+def test_load_text_corpus_with_vocab(tmp_path):
+    vp, mp, vocab = _toy_vocab(tmp_path)
+    p = tmp_path / "corpus.txt"
+    p.write_text("hello world\n\nhello hello world\n\n" * 80)
+    out = load_text_corpus(str(p), seq_len=16, vocab_file=vp,
+                           merges_file=mp)
+    ids = out["input_ids"]
+    assert ids.max() < len(vocab)
+    # BPE compresses: far fewer tokens than bytes
+    n_bytes = len("hello world") * 80 + len("hello hello world") * 80
+    assert ids.size < 0.6 * n_bytes
+
+
+def test_packed_batches_train_llama_and_bert(tmp_path, devices):
+    """End to end: text -> packed shards -> stream -> lm/mlm transform ->
+    finite train steps on both LM families."""
+    import socket
+
+    import jax
+
+    from serverless_learn_tpu.config import (
+        DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig,
+        TrainConfig)
+    from serverless_learn_tpu.control.daemons import start_shard_server
+    from serverless_learn_tpu.data.shard_client import publish_dataset
+    from serverless_learn_tpu.training.loop import make_source
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    p = tmp_path / "corpus.txt"
+    p.write_text("the quick brown fox jumps over the lazy dog\n\n" * 300)
+    arrays = load_text_corpus(str(p), seq_len=32)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = start_shard_server(port=port, root=str(tmp_path / "store"))
+    addr = f"127.0.0.1:{port}"
+    try:
+        publish_dataset(addr, "packed_text", arrays, records_per_shard=64)
+        for model, overrides in (
+                ("llama_tiny", dict(vocab_size=512)),
+                ("bert_tiny", dict(vocab_size=512, max_seq_len=32))):
+            cfg = ExperimentConfig(
+                model=model, model_overrides=overrides,
+                mesh=MeshConfig(dp=8),
+                optimizer=OptimizerConfig(name="adamw", learning_rate=1e-3),
+                train=TrainConfig(batch_size=16, num_steps=2,
+                                  dtype="float32", param_dtype="float32"),
+                data=DataConfig(dataset="packed_text",
+                                shard_server_addr=addr, seq_len=32))
+            trainer = build_trainer(cfg)
+            source = make_source(cfg, trainer, dp_rank=0, dp_size=1)
+            it = iter(source)
+            state = trainer.init()
+            for _ in range(2):
+                state, m = trainer.step(state, trainer.shard_batch(next(it)))
+            assert np.isfinite(float(jax.device_get(m["loss"]))), model
+            if hasattr(source, "close"):
+                source.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
