@@ -118,7 +118,18 @@ def run_concurrent(model: str = "llama_tiny", clients: int = 4,
                     raise RuntimeError(f"serving errors: {errors[:3]}")
                 return dt
 
-            round_trip()  # compile + warm every bucket this load hits
+            # Deterministically compile EVERY batch bucket the timed round
+            # could form (grouping is timing-dependent: a straggler thread
+            # can split 4 clients into groups of 3+1, and an uncompiled
+            # bucket inside the timed window would bill a multi-second XLA
+            # compile as serving time).
+            sizes = {1}
+            b = 1
+            while b < min(clients, max_batch):
+                b *= 2
+                sizes.add(min(b, max_batch))
+            eng.warm(prompt_len, new_tokens, batch_sizes=sorted(sizes))
+            round_trip()  # warm the queue path itself
             dt = round_trip()
             return clients * reqs * new_tokens / dt
         finally:

@@ -152,6 +152,25 @@ def row_r50nf():
                                       "batch_per_chip"))
 
 
+def row_r50da():
+    """ResNet-50 with DEVICE-side augmentation (round-4 data-plane
+    geometry): batches carry stored-size 256x256 uint8 records and the
+    step crops+flips on device from its PRNG. The row prices what that
+    costs the chip (expected ~free: one gather + select against 100+ ms
+    of convs) — the host-side win is measured in data_bench."""
+    from serverless_learn_tpu.config import OptimizerConfig
+
+    rec = _train_row(
+        "resnet50_imagenet_device_aug_train_samples_per_sec_per_chip",
+        "resnet50_imagenet", batch_per_chip=256,
+        overrides={"device_augment": True},
+        opt=OptimizerConfig(name="sgd", learning_rate=0.1, momentum=0.9),
+        steps=5)
+    return record_history(rec, HISTORY, better="max",
+                          key_fields=("metric", "device_kind",
+                                      "batch_per_chip"))
+
+
 def row_bert():
     rec = _train_row(
         "bert_base_mlm_train_tokens_per_sec_per_chip", "bert_base",
@@ -184,16 +203,19 @@ def row_lm():
 
 
 def row_flash(repeats=11):
-    """Flash fwd+bwd at T=8192 causal — median of ``repeats`` with an
-    IQR-based spread.
+    """Flash fwd+bwd at T=8192 causal — MIN of ``repeats``, with the
+    low-cluster spread.
 
-    The r2 README carried two disagreeing one-offs (14 vs 16 ms) for this
-    exact shape; the honest number is the median with its relative spread,
-    and the guard widens by 2x that spread. Round 3 recorded min-max
-    spread over 5 reps (0.41-0.45 — so wide a 30-40% real regression
-    would pass); round 4 runs 11 reps and reports IQR/median, which
-    rejects the shared-chip outlier tails and keeps the effective guard
-    threshold <= ~15% (verdict #9)."""
+    Round 3 recorded median-of-5 with min-max spread 0.41-0.45 — so wide
+    a 30-40% real regression would pass the guard (verdict #9). Measured
+    11-rep distributions on this shared tunneled chip are BIMODAL
+    (13-14 ms uncontended vs 17-23 ms under contention; e.g.
+    [13.2, 13.3, 13.5, 14.0, 16.5, 17.2, ... 23.0]), so median and IQR
+    both straddle the modes and stay noisy. Contention only ever ADDS
+    time, so the minimum estimates the true kernel cost; the recorded
+    spread is (p25 - min)/min — the width of the uncontended cluster —
+    which keeps the guard threshold tight (~5-10%). The median and full
+    times ride along for honesty about the distribution."""
     import jax
     import jax.numpy as jnp
 
@@ -225,16 +247,15 @@ def row_flash(repeats=11):
 
     once()  # compile + warm
     times = sorted(once() for _ in range(repeats))
-    med = statistics.median(times)
-    q = repeats // 4
-    iqr = (times[-1 - q] - times[q]) if repeats >= 4 else \
-        (times[-1] - times[0])
-    spread = iqr / med if med else 0.0
+    lo = times[0]
+    p25 = times[max(1, repeats // 4)]
+    spread = (p25 - lo) / lo if lo else 0.0
     rec = {
         "metric": "flash_attention_fwd_bwd_t8192_causal_ms",
-        "value": round(med, 2),
-        "unit": "ms (median of %d)" % repeats,
-        "spread_rel": round(spread, 4),  # IQR/median (guard widens by 2x)
+        "value": round(lo, 2),
+        "unit": "ms (min of %d)" % repeats,
+        "spread_rel": round(spread, 4),  # uncontended-cluster width
+        "median_ms": round(statistics.median(times), 2),
         "times_ms": [round(t, 2) for t in times],
         "device_kind": _device_kind(),
     }
@@ -476,6 +497,7 @@ ROWS = {
     "r18nf": row_r18nf,
     "r50": row_r50,
     "r50nf": row_r50nf,
+    "r50da": row_r50da,
     "bert": row_bert,
     "llama1b": row_llama1b,
     "lm": row_lm,

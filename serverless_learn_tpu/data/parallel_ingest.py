@@ -43,7 +43,8 @@ _SENTINEL = "__end_of_worker__"
 
 def _worker_main(out_q, stop, addr: str, dataset: str, batch_size: int,
                  seed: int, rank: int, size: int, loop: bool,
-                 prefetch_shards: int, transform_factory, worker_idx: int):
+                 prefetch_shards: int, transform_factory, worker_idx: int,
+                 sub_count: int):
     """Child process: build source (+ transform) and pump batches."""
     from serverless_learn_tpu.data.shard_client import ShardStreamSource
 
@@ -51,7 +52,8 @@ def _worker_main(out_q, stop, addr: str, dataset: str, batch_size: int,
     try:
         src = ShardStreamSource(addr, dataset, batch_size, seed=seed,
                                 dp_rank=rank, dp_size=size, loop=loop,
-                                prefetch_shards=prefetch_shards)
+                                prefetch_shards=prefetch_shards,
+                                sub_rank=worker_idx, sub_count=sub_count)
         it = iter(src)
         fn = transform_factory(worker_idx) if transform_factory else None
         for batch in it:
@@ -81,11 +83,13 @@ def _worker_main(out_q, stop, addr: str, dataset: str, batch_size: int,
 class ParallelIngestSource:
     """Aggregate batch stream from ``workers`` ingest processes.
 
-    Each worker owns shard stripe ``dp_rank * workers + w`` of
-    ``dp_size * workers`` — collectively exactly this host's dp-rank share
-    of the dataset, each record seen once per epoch across the union
-    (asserted by ``tests/test_parallel_ingest.py``). Batch order interleaves
-    across workers nondeterministically; per-worker order stays the seeded
+    Each worker takes every ``workers``-th shard OF THIS HOST'S dp stripe
+    (``ShardStreamSource(sub_rank=w, sub_count=workers)``) — collectively
+    exactly the same shard set a plain single-source rank would own, each
+    record seen once per epoch across the union, and safely mixable with
+    plain-source ranks on other hosts (asserted by
+    ``tests/test_parallel_ingest.py``). Batch order interleaves across
+    workers nondeterministically; per-worker order stays the seeded
     shuffle. ``transform_factory(worker_idx) -> fn`` builds the per-batch
     transform INSIDE each child (factories close over rngs that must not be
     shared across processes).
@@ -124,8 +128,8 @@ class ParallelIngestSource:
             p = ctx.Process(
                 target=_worker_main,
                 args=(self._q, self._stop, addr, dataset, batch_size,
-                      seed, dp_rank * workers + w, dp_size * workers, loop,
-                      prefetch_shards, transform_factory, w),
+                      seed, dp_rank, dp_size, loop,
+                      prefetch_shards, transform_factory, w, workers),
                 daemon=True)
             p.start()
             self._procs.append(p)

@@ -225,9 +225,12 @@ class ShardStreamSource:
 
     def __init__(self, addr: str, dataset: str, batch_size: int,
                  seed: int = 0, dp_rank: int = 0, dp_size: int = 1,
-                 loop: bool = True, prefetch_shards: int = 2):
+                 loop: bool = True, prefetch_shards: int = 2,
+                 sub_rank: int = 0, sub_count: int = 1):
         if not (0 <= dp_rank < dp_size):
             raise ValueError(f"dp_rank {dp_rank} not in [0, {dp_size})")
+        if not (0 <= sub_rank < sub_count):
+            raise ValueError(f"sub_rank {sub_rank} not in [0, {sub_count})")
         self.addr = addr
         self.dataset = dataset
         self.batch_size = batch_size
@@ -236,12 +239,21 @@ class ShardStreamSource:
         self.dp_size = dp_size
         self.loop = loop
         self.meta = load_meta(addr, dataset)
-        self._my_shards = [i for i in range(self.meta.num_shards)
-                           if i % dp_size == dp_rank]
+        mine = [i for i in range(self.meta.num_shards)
+                if i % dp_size == dp_rank]
+        # sub_rank/sub_count subdivide THIS RANK'S OWN stripe (parallel
+        # ingest workers within one host): the union over sub-ranks is
+        # exactly the dp-rank share whatever sub_count is — subdividing by
+        # re-striping the global index instead would change which shards
+        # the host owns and silently double/zero-cover records when mixed
+        # with plain single-source ranks.
+        self._my_shards = [s for j, s in enumerate(mine)
+                          if j % sub_count == sub_rank]
         if not self._my_shards:
             # More ranks than shards: wrap (ranks may then share records —
             # publish with more shards to avoid).
-            self._my_shards = [dp_rank % self.meta.num_shards]
+            wrap = mine or [dp_rank % self.meta.num_shards]
+            self._my_shards = [wrap[sub_rank % len(wrap)]]
         per_epoch = sum(self.meta.shard_range(i)[1] - self.meta.shard_range(i)[0]
                         for i in self._my_shards)
         if per_epoch < batch_size:

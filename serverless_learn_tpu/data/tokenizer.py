@@ -77,6 +77,9 @@ class BPETokenizer:
         self.eos_id = (self.vocab[eos_token] if eos_token else
                        self.vocab.get("<|endoftext|>",
                                       self.vocab.get("</s>")))
+        unk = next((self.vocab[t] for t in ("<unk>", "<UNK>", "[UNK]")
+                    if t in self.vocab), None)
+        self.unk_id = unk
 
     @classmethod
     def from_files(cls, vocab_path: str, merges_path: Optional[str] = None,
@@ -129,13 +132,18 @@ class BPETokenizer:
             mapped = "".join(self._b2u[b] for b in pre.encode("utf-8"))
             for piece in self._bpe(mapped):
                 i = self.vocab.get(piece)
-                if i is None:
-                    # Vocab without this merge product (truncated files):
-                    # fall back to the piece's byte symbols, which a
-                    # byte-level vocab always contains.
-                    ids.extend(self.vocab[c] for c in piece)
-                else:
+                if i is not None:
                     ids.append(i)
+                    continue
+                # Vocab without this merge product (truncated files):
+                # fall back to the piece's byte symbols. A vocab that is
+                # ALSO missing a byte symbol (non-byte-level artifacts)
+                # degrades to <unk> — or drops the byte if no unk exists
+                # — instead of crashing mid-encode with a bare KeyError.
+                for c in piece:
+                    j = self.vocab.get(c, self.unk_id)
+                    if j is not None:
+                        ids.append(j)
         return np.asarray(ids, np.int32)
 
     def decode(self, ids: Iterable[int]) -> str:

@@ -109,12 +109,18 @@ class BatchingEngine:
                timeout_s: float = 600.0) -> dict:
         """Blocks until the dispatcher serves this request; returns either
         {"new_tokens": [...]} or {"error": ...}."""
+        max_seq = self.module.cfg.max_seq_len
+        if len(prompt) + max_new > max_seq:
+            # Validate HERE, not only in the server: _shape_buckets would
+            # otherwise clamp new_bucket and silently return fewer tokens
+            # than asked to direct engine callers.
+            return {"error": f"prompt ({len(prompt)}) + max_new_tokens "
+                             f"({max_new}) exceeds max_seq_len {max_seq}"}
         p = _Pending(prompt=prompt, max_new=max_new, temperature=temperature,
                      top_k=top_k, eos_id=eos_id, seed=seed)
         # Compatible requests share sampling params and padded shapes.
         p.group_key = (temperature, top_k, eos_id,
-                       _shape_buckets(len(prompt), max_new,
-                                      self.module.cfg.max_seq_len))
+                       _shape_buckets(len(prompt), max_new, max_seq))
         self._q.put(p)
         if not p.done.wait(timeout_s):
             return {"error": "generation timed out in the admission queue"}
@@ -188,6 +194,26 @@ class BatchingEngine:
             p.result = {"new_tokens": [int(t) for t in new[i, :p.max_new]],
                         "batch_size": n}
             p.done.set()
+
+    def warm(self, prompt_len: int, max_new: int, temperature: float = 0.0,
+             top_k: int = 0, eos_id: Optional[int] = None,
+             batch_sizes=(1,)):
+        """Pre-compile the decode buckets a known workload will hit, by
+        running synthetic groups straight through ``_run_group`` (bypassing
+        the queue — call only while no live submissions are in flight).
+        Benchmarks use this so a timed window never pays an XLA compile
+        for a batch bucket the warm traffic happened not to form."""
+        for n in batch_sizes:
+            group = []
+            for _ in range(n):
+                p = _Pending(prompt=[1] * prompt_len, max_new=max_new,
+                             temperature=temperature, top_k=top_k,
+                             eos_id=eos_id, seed=0)
+                p.group_key = (temperature, top_k, eos_id,
+                               _shape_buckets(prompt_len, max_new,
+                                              self.module.cfg.max_seq_len))
+                group.append(p)
+            self._run_group(group)
 
     def stop(self):
         self._stop.set()

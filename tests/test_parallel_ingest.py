@@ -50,24 +50,29 @@ def test_workers_cover_every_record_exactly_once(shard_server):
     assert sorted(seen) == list(range(n))
 
 
-def test_worker_striping_is_disjoint(shard_server):
-    """Workers subdivide THIS host's dp share: dp_rank 0 of 2 with 2
-    workers must see exactly the records of shard stripes {0, 1} mod 4."""
+def test_worker_striping_matches_plain_source_share(shard_server):
+    """Workers subdivide THIS host's dp share: whatever the worker count,
+    the union must be EXACTLY the shard set a plain single-source rank
+    owns ({i : i % dp_size == dp_rank}) — otherwise a parallel-ingest
+    host mixed with plain-source hosts double-trains some shards and
+    never sees others (round-4 code-review finding)."""
     n = 1024
     publish_dataset(shard_server, "stripe",
                     {"idx": np.arange(n, dtype=np.int64)},
                     records_per_shard=128)
-    src = ParallelIngestSource(shard_server, "stripe", batch_size=64,
-                               workers=2, dp_rank=0, dp_size=2, loop=False)
-    seen = set()
-    for batch in src:
-        seen.update(batch["idx"].tolist())
-    src.close()
     want = set()
     for shard in range(8):
-        if shard % 4 in (0, 1):  # rank 0's workers own stripes 0 and 1
+        if shard % 2 == 0:  # plain ShardStreamSource(dp_rank=0, dp_size=2)
             want.update(range(shard * 128, (shard + 1) * 128))
-    assert seen == want
+    for workers in (1, 2, 3):
+        src = ParallelIngestSource(shard_server, "stripe", batch_size=64,
+                                   workers=workers, dp_rank=0, dp_size=2,
+                                   loop=False)
+        seen = set()
+        for batch in src:
+            seen.update(batch["idx"].tolist())
+        src.close()
+        assert seen == want, f"workers={workers}"
 
 
 def _double_and_tag_factory(worker_idx):
