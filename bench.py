@@ -24,8 +24,10 @@ import time
 CPU_WORKER_BASELINE_SPS = 12.09  # ResNet-18 CIFAR b128, JAX CPU, this image
 
 # Batch sweep on the v5e chip (samples/sec/chip, MFU):
-#   256 -> ~26.9k | 512 -> ~29.8k | 2048 -> 31.3k, 46% | 4096 -> 32.7k, 48%
-BATCH = 4096
+#   256 -> ~26.9k | 512 -> ~29.8k | 2048 -> 31.3k, 46% | 4096 -> 32.7-33.7k,
+#   48-49.8% | 8192 -> 34.0k, 50.2% (round 4: first crossing of the 50% MFU
+#   bar; beyond 8192 the activation footprint stops paying for itself)
+BATCH = 8192
 WARMUP = 3
 STEPS = 20
 

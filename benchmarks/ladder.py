@@ -248,7 +248,7 @@ def row_flash(repeats=11):
     once()  # compile + warm
     times = sorted(once() for _ in range(repeats))
     lo = times[0]
-    p25 = times[max(1, repeats // 4)]
+    p25 = times[min(len(times) - 1, max(1, repeats // 4))]
     spread = (p25 - lo) / lo if lo else 0.0
     rec = {
         "metric": "flash_attention_fwd_bwd_t8192_causal_ms",

@@ -82,6 +82,15 @@ def scale_mesh(base: "MeshConfig", n_devices: int) -> "MeshConfig":
 
     * ``tp``/``pp``/``sp``/``ep`` — fixed at the configured size. A world
       whose device count isn't a multiple of their product is rejected.
+      pp being FIXED is also what keeps interleaved-pipeline checkpoints
+      valid across re-formations: an interleaved checkpoint's layer
+      EXECUTION order is a function of the stage count
+      (``TransformerConfig.pipeline_stages``), so a world change that
+      resized pp would strand it. Elasticity therefore never resizes pp;
+      serving/sequential replay of such checkpoints goes through
+      ``unstack_pipeline_params``, which undoes the pinned order, and a
+      mesh whose pp disagrees with ``pipeline_stages`` is rejected at
+      build time (``models/transformer.py``).
     * ``fsdp`` — the configured value is a MEMORY FLOOR (the state provably
       fits at that sharding, e.g. an 8B state needs fsdp>=4); the actual
       axis is the smallest divisor of the remaining plane that is >= the

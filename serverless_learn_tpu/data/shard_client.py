@@ -249,11 +249,19 @@ class ShardStreamSource:
         # with plain single-source ranks.
         self._my_shards = [s for j, s in enumerate(mine)
                           if j % sub_count == sub_rank]
+        if mine and not self._my_shards:
+            # More ingest workers than this rank's shards: surplus workers
+            # would only wrap onto shards their siblings already own,
+            # silently training records 2x per epoch. Fail loudly — the
+            # caller should lower `workers` or publish more shards.
+            raise ValueError(
+                f"sub_rank {sub_rank}/{sub_count} of dp rank {dp_rank} has "
+                f"no shards ({len(mine)} in the rank's stripe); use at most "
+                f"{len(mine)} ingest workers for {dataset!r}")
         if not self._my_shards:
-            # More ranks than shards: wrap (ranks may then share records —
-            # publish with more shards to avoid).
-            wrap = mine or [dp_rank % self.meta.num_shards]
-            self._my_shards = [wrap[sub_rank % len(wrap)]]
+            # More dp ranks than shards: wrap (ranks may then share
+            # records — publish with more shards to avoid).
+            self._my_shards = [dp_rank % self.meta.num_shards]
         per_epoch = sum(self.meta.shard_range(i)[1] - self.meta.shard_range(i)[0]
                         for i in self._my_shards)
         if per_epoch < batch_size:

@@ -74,6 +74,16 @@ def test_worker_striping_matches_plain_source_share(shard_server):
         src.close()
         assert seen == want, f"workers={workers}"
 
+    # More workers than the rank's shards (4 shards in the stripe, 5
+    # workers): surplus workers must FAIL LOUDLY, not wrap onto siblings'
+    # shards and silently train records twice per epoch.
+    src = ParallelIngestSource(shard_server, "stripe", batch_size=64,
+                               workers=5, dp_rank=0, dp_size=2, loop=False)
+    with pytest.raises(Exception, match="ingest workers"):
+        for batch in src:
+            pass
+    src.close()
+
 
 def _double_and_tag_factory(worker_idx):
     # Module-level: spawn-based workers pickle the factory by reference.
