@@ -259,6 +259,15 @@ class ShardStreamSource:
                 f"no shards ({len(mine)} in the rank's stripe); use at most "
                 f"{len(mine)} ingest workers for {dataset!r}")
         if not self._my_shards:
+            if sub_count > 1:
+                # Empty dp stripe with multiple ingest workers: every
+                # sub-worker would wrap onto the SAME shard and duplicate
+                # its records sub_count x per epoch.
+                raise ValueError(
+                    f"dp rank {dp_rank}/{dp_size} owns no shards of "
+                    f"{dataset!r} ({self.meta.num_shards} total); parallel "
+                    "ingest workers would all wrap onto one shard — use a "
+                    "single source or publish more shards")
             # More dp ranks than shards: wrap (ranks may then share
             # records — publish with more shards to avoid).
             self._my_shards = [dp_rank % self.meta.num_shards]

@@ -87,6 +87,12 @@ class TransformerConfig:
     # owning ep member and back. Set by PipelinedBlocks, never by users.
     manual_ep_axis: Optional[str] = None
     moe_global_experts: int = 0  # routing-global E when manual_ep_axis set
+    # Ring attention INSIDE a pipeline stage's shard_map (round-4 pp x sp
+    # composition): the sequence dim of every pipeline operand is sharded
+    # over this axis and Attention calls ring_attention_manual directly
+    # (the dispatcher's shard_map wrapper can't nest in a manual region).
+    # Set by PipelinedBlocks, never by users.
+    manual_sp_axis: Optional[str] = None
     head_dim_override: Optional[int] = None  # local-slice cfgs must pin it
 
     @property
@@ -158,13 +164,21 @@ def _shard_head_over_pp(x: jax.Array) -> jax.Array:
     from serverless_learn_tpu.parallel.ring_attention import get_active_mesh
 
     mesh = get_active_mesh()
-    if (mesh is None or mesh.shape.get("pp", 1) == 1
-            or x.shape[1] % mesh.shape["pp"]):
+    if mesh is None or mesh.shape.get("pp", 1) == 1:
+        return x
+    # Under pp x sp the sequence dim is ALREADY sp-sharded; the head runs
+    # over ("sp", "pp") jointly — constraining to "pp" alone would force
+    # an sp->pp reshard of the whole activation.
+    seq = tuple(a for a in ("sp", "pp") if mesh.shape.get(a, 1) > 1)
+    n_seq = 1
+    for a in seq:
+        n_seq *= mesh.shape[a]
+    if x.shape[1] % n_seq:
         return x
     batch, n_batch = live_batch_axes(mesh)
     if batch and x.shape[0] % n_batch:
         batch = ()
-    spec = P(batch if batch else None, "pp", None)
+    spec = P(batch if batch else None, seq if len(seq) > 1 else seq[0], None)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
@@ -285,10 +299,32 @@ class Attention(nn.Module):
             # Float masks are excluded — they could be additive (0 = KEEP),
             # whose row sum would be garbage lengths.
             kv_lengths = mask[:, 0, 0, :].astype(jnp.int32).sum(-1)
-        out = dot_product_attention(
-            q, k, v, causal=causal, mask=mask, kv_lengths=kv_lengths,
-            impl="xla" if (decode or prefill) else cfg.attention_impl,
-            axis_name=cfg.sp_axis or "sp")
+        if cfg.manual_sp_axis and not (decode or prefill):
+            # Inside the pipeline's manual region with the seq dim sharded
+            # over sp: hop the K/V shards around the ring directly.
+            if mask is not None and kv_lengths is None:
+                raise NotImplementedError(
+                    "pp x sp with a general attention mask: a local mask "
+                    "shard cannot express cross-shard visibility; use "
+                    "causal and/or suffix kv_lengths")
+            from serverless_learn_tpu.parallel.ring_attention import (
+                ring_attention_manual)
+
+            if kv_lengths is not None:
+                # Derived from the LOCAL mask shard (the pipeline shards
+                # the mask's key dim over sp), but the ring wants GLOBAL
+                # suffix lengths; a suffix-padded mask's per-shard valid
+                # counts sum to exactly the global valid length.
+                kv_lengths = jax.lax.psum(kv_lengths, cfg.manual_sp_axis)
+            out = ring_attention_manual(q, k, v,
+                                        axis_name=cfg.manual_sp_axis,
+                                        causal=causal,
+                                        kv_lengths=kv_lengths)
+        else:
+            out = dot_product_attention(
+                q, k, v, causal=causal, mask=mask, kv_lengths=kv_lengths,
+                impl="xla" if (decode or prefill) else cfg.attention_impl,
+                axis_name=cfg.sp_axis or "sp")
         y = nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=False,
                             name="o_proj", dtype=cfg.dtype,
                             param_dtype=cfg.param_dtype)(out)
@@ -365,10 +401,14 @@ class PipelinedBlocks(nn.Module):
         def init_stack(rng):
             dummy = jnp.zeros((1, 4, cfg.d_model), cfg.dtype)
             dpos = jnp.zeros((1, 4), jnp.int32)
+            # Params don't depend on the attention impl; pinning "xla"
+            # keeps init's trace free of the auto dispatcher (which on an
+            # sp mesh would wrap a shard_map around this [1, 4, D] dummy).
+            init_cfg = dataclasses.replace(cfg, attention_impl="xla")
 
             def one(r):
-                return Block(cfg).init(r, dummy, mask=None,
-                                       positions=dpos)["params"]
+                return Block(init_cfg).init(r, dummy, mask=None,
+                                            positions=dpos)["params"]
 
             return jax.vmap(one)(jax.random.split(rng, cfg.n_layers))
 
@@ -380,9 +420,31 @@ class PipelinedBlocks(nn.Module):
         mesh = get_active_mesh()
         tp = mesh.shape.get("tp", 1) if mesh is not None else 1
         ep = mesh.shape.get("ep", 1) if mesh is not None else 1
+        sp = mesh.shape.get("sp", 1) if mesh is not None else 1
         pp_live = mesh is not None and mesh.shape.get("pp", 1) > 1
         block_cfg = cfg
         param_specs = None
+        if pp_live and sp > 1:
+            # pp x sp (round 4): the pipeline's operands shard their seq
+            # dim over sp and each stage's attention hops K/V around the
+            # sp ring from inside the stage (manual ring attention).
+            if not cfg.causal:
+                raise NotImplementedError(
+                    "pp x sp requires a causal model: a bidirectional "
+                    "model's padding mask cannot be expressed per seq "
+                    "shard (use sp without pp, where GSPMD reshards)")
+            if cfg.n_experts > 0:
+                # Routing groups would subdivide per-SHARD token runs, a
+                # silently different grouping (capacity, drops, aux) from
+                # the dp/ep golden semantics — refuse until per-shard
+                # routing is a deliberate, tested mode.
+                raise NotImplementedError(
+                    "pp x sp x MoE is unsupported: sequence-sharded "
+                    "routing changes group/capacity semantics; use "
+                    "pp x ep (dp absorbs the sequence) instead")
+            block_cfg = dataclasses.replace(
+                block_cfg, manual_sp_axis="sp",
+                head_dim_override=cfg.head_dim)
         if pp_live and tp > 1:
             # Megatron-style manual tp inside the pipeline's shard_map:
             # each tp member applies a LOCAL slice of every layer (heads
@@ -479,7 +541,8 @@ class PipelinedBlocks(nn.Module):
                           mesh=mesh,
                           n_microbatches=cfg.pipeline_microbatches,
                           n_virtual=V, param_specs=param_specs,
-                          with_aux=moe_aux)
+                          with_aux=moe_aux,
+                          seq_axis="sp" if sp > 1 else None)
         if moe_aux:
             out, aux = out
             # aux carries one entry per batch shard; the mean over shards

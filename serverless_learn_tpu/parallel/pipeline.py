@@ -138,6 +138,7 @@ def gpipe_apply(
     batch_axes: Sequence[str] = ("dp", "fsdp", "ep"),
     param_specs=None,
     with_aux: bool = False,
+    seq_axis: Optional[str] = None,
 ):
     """Run the stacked layers as a pipeline over ``mesh.shape[pp]`` stages.
 
@@ -181,12 +182,12 @@ def gpipe_apply(
         return sequential_apply(
             block_apply, stacked_params, x, positions, mask,
             layer_order=None, with_aux=with_aux)
-    if mesh.shape.get("sp", 1) > 1:
+    if mesh.shape.get("sp", 1) > 1 and seq_axis is None:
         raise NotImplementedError(
-            "pipeline parallelism composes with dp/fsdp/tp/ep; mesh axis "
-            f"'sp' must be 1 (got {mesh.shape['sp']}) — ring attention "
-            "rotates K/V around the sp ring with its own ppermute schedule, "
-            "which would interleave with the pipeline's stage ring")
+            "this pipeline call does not thread a sequence axis; on an "
+            f"sp={mesh.shape['sp']} mesh pass seq_axis='sp' so operands "
+            "shard their seq dim and the block body runs manual ring "
+            "attention (PipelinedBlocks does this automatically)")
     n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
     V = int(n_virtual)
     if V < 1:
@@ -202,14 +203,24 @@ def gpipe_apply(
             f"microbatch buffer slot after S ticks")
 
     live_batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
-    bspec = P(live_batch if live_batch else None)
+    b_entry = live_batch if live_batch else None
+    bspec = P(b_entry)
+    seq_live = seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1
+    if seq_live:
+        # pp x sp: every operand's sequence dim shards over seq_axis; the
+        # block body (manual ring attention) owns the cross-shard hops.
+        xspec = P(b_entry, seq_axis)
+        mspec = P(b_entry, None, None, seq_axis)
+    else:
+        xspec = bspec
+        mspec = bspec
     have_mask = mask is not None
     operands = (stacked_params, x, positions) + ((mask,) if have_mask else ())
-    in_specs = (P(axis_name), bspec, bspec) + ((bspec,) if have_mask else ())
+    in_specs = (P(axis_name), xspec, xspec) + ((mspec,) if have_mask else ())
     if param_specs is not None:
         in_specs = (param_specs,) + in_specs[1:]
     smap = partial(shard_map_no_check, mesh=mesh, in_specs=in_specs,
-                   out_specs=(bspec, bspec) if with_aux else bspec)
+                   out_specs=(xspec, bspec) if with_aux else xspec)
 
     @smap
     def run(params_local, x_local, pos_local, *rest):

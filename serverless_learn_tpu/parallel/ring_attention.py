@@ -343,36 +343,16 @@ def _auto_zigzag(causal: bool, n: int, t_loc: int, flash_ok: bool = True
             or _pick_block(t_loc) is None)
 
 
-def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
-                   kv_lengths=None, layout: str = "auto",
-                   mesh: Optional[Mesh] = None):
-    """Sequence-parallel attention. q [B,T,H,D], k/v [B,T,K,D] (global
-    logical shapes; T sharded over ``axis_name``).
-
-    ``kv_lengths`` [B] — global SUFFIX padding lengths; each hop slices
-    them to its resident K/V shard and pushes them into the flash kernel's
-    "len" mode (padded batches no longer force the dense fallback).
-
-    ``layout``: "auto" uses the zigzag half-block schedule for causal
-    attention (balanced hop work, ~2x less causal hop compute — see
-    ``_ring_attention_zigzag``) when the half-blocks are kernel-tileable,
-    and the contiguous schedule otherwise; "contiguous"/"zigzag" force.
-    """
+def _local_ring_fn(T_loc: int, n: int, causal: bool, layout: str,
+                   scale: float):
+    """The per-shard ring body — ``f(q, k, v, lens) -> out`` on LOCAL
+    sequence shards, hop kernel chosen for these shapes. Shared by the
+    GSPMD entry below (which wraps it in shard_map) and
+    ``ring_attention_manual`` (callers already inside a manual region,
+    e.g. pipeline stages)."""
     from serverless_learn_tpu.ops.pallas.flash_attention import _pick_block
 
-    mesh = mesh or _ACTIVE_MESH
-    if mesh is None:
-        raise RuntimeError(
-            "ring_attention needs an active mesh; call set_active_mesh() "
-            "(build_trainer does this automatically)")
-    H, K = q.shape[2], k.shape[2]
-    if H % K:
-        raise ValueError(f"n_heads {H} not divisible by kv_heads {K}")
-    scale = q.shape[-1] ** -0.5
-    n = mesh.shape[axis_name]
-    T_loc = q.shape[1] // n
     backend = jax.default_backend()
-
     flash_ok = (backend in ("cpu", "tpu")
                 or bool(os.environ.get("SLT_FORCE_PALLAS")))
 
@@ -395,6 +375,56 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
         zigzag = _auto_zigzag(causal, n, T_loc, flash_ok)
     else:
         zigzag = False
+    if zigzag:
+        return partial(_ring_attention_zigzag, hop_fn=make_hop(T_loc // 2))
+    return partial(_ring_attention_local, causal=causal,
+                   hop_fn=make_hop(T_loc))
+
+
+def ring_attention_manual(q, k, v, *, axis_name: str = "sp",
+                          causal: bool = False, kv_lengths=None,
+                          layout: str = "auto"):
+    """Ring attention for callers ALREADY inside a manual region over
+    ``axis_name`` — the pipeline's shard_map (round-4 pp x sp composition).
+
+    q [B, T_loc, H, D]; k/v [B, T_loc, K, D] are this device's LOCAL
+    sequence shards (global T = T_loc * axis size); ``kv_lengths`` [B] are
+    GLOBAL suffix lengths (each hop slices its resident block's span).
+    Same math and hop kernels as the public ``ring_attention``; only the
+    shard_map wrapper is omitted."""
+    n = jax.lax.axis_size(axis_name)
+    local = _local_ring_fn(q.shape[1], n, causal, layout,
+                           q.shape[-1] ** -0.5)
+    lens = None if kv_lengths is None else kv_lengths.astype(jnp.int32)
+    return local(q, k, v, lens, axis_name=axis_name)
+
+
+def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
+                   kv_lengths=None, layout: str = "auto",
+                   mesh: Optional[Mesh] = None):
+    """Sequence-parallel attention. q [B,T,H,D], k/v [B,T,K,D] (global
+    logical shapes; T sharded over ``axis_name``).
+
+    ``kv_lengths`` [B] — global SUFFIX padding lengths; each hop slices
+    them to its resident K/V shard and pushes them into the flash kernel's
+    "len" mode (padded batches no longer force the dense fallback).
+
+    ``layout``: "auto" uses the zigzag half-block schedule for causal
+    attention (balanced hop work, ~2x less causal hop compute — see
+    ``_ring_attention_zigzag``) when the half-blocks are kernel-tileable,
+    and the contiguous schedule otherwise; "contiguous"/"zigzag" force.
+    """
+    mesh = mesh or _ACTIVE_MESH
+    if mesh is None:
+        raise RuntimeError(
+            "ring_attention needs an active mesh; call set_active_mesh() "
+            "(build_trainer does this automatically)")
+    H, K = q.shape[2], k.shape[2]
+    if H % K:
+        raise ValueError(f"n_heads {H} not divisible by kv_heads {K}")
+    n = mesh.shape[axis_name]
+    local = _local_ring_fn(q.shape[1] // n, n, causal, layout,
+                           q.shape[-1] ** -0.5)
     tp = mesh.shape.get("tp", 1)
     if tp > 1 and K > 1 and K % tp:
         # Replicating kv over tp here would silently mis-group: each tp
@@ -407,12 +437,7 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
     qspec = P(("dp", "fsdp"), axis_name, "tp", None)
     kvspec = P(("dp", "fsdp"), axis_name, "tp" if K > 1 else None, None)
     lspec = P(("dp", "fsdp"))
-    if zigzag:
-        local = partial(_ring_attention_zigzag, axis_name=axis_name,
-                        hop_fn=make_hop(T_loc // 2))
-    else:
-        local = partial(_ring_attention_local, axis_name=axis_name,
-                        causal=causal, hop_fn=make_hop(T_loc))
+    local = partial(local, axis_name=axis_name)
     if kv_lengths is not None:
         fn = _shard_map(local, mesh=mesh,
                         in_specs=(qspec, kvspec, kvspec, lspec),

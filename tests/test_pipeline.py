@@ -214,6 +214,75 @@ def _moe_losses(mesh_cfg, extra=None, steps=3):
     return float(m["loss"]), float(m.get("moe_aux_loss", 0.0))
 
 
+def test_pp_sp_train_step_matches_dp(devices):
+    """Round-4: the LAST composition refusal removed — pp=2 x sp=2 runs
+    manual ring attention inside pipeline stages (seq dim sharded across
+    the sp ring, K/V hopping via ppermute from within each stage) and
+    tracks the dp golden model."""
+    l_dp = _train_losses(MeshConfig(dp=8))
+    l_sp = _train_losses(MeshConfig(dp=2, pp=2, sp=2))
+    assert abs(l_dp - l_sp) < 5e-3, (l_dp, l_sp)
+
+
+def test_pp_sp_suffix_lengths_match_dp(devices):
+    """The pp x sp padding escape hatch (causal + suffix kv_lengths): the
+    stage derives lengths from its LOCAL mask shard and psums them over sp
+    to recover the GLOBAL suffix length — logits must match the dp golden
+    at every valid position (code-review finding: local sums passed as
+    global lengths silently mis-masked)."""
+    import numpy as np
+
+    from serverless_learn_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from serverless_learn_tpu.parallel.mesh import make_mesh
+    from serverless_learn_tpu.parallel.ring_attention import set_active_mesh
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=4, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq_len=32, causal=True, use_rope=True,
+        suffix_padding_mask=True, pipeline=True, pipeline_microbatches=2,
+        dtype=jnp.float32, param_dtype=jnp.float32)
+    module = Transformer(cfg)
+    rng = np.random.default_rng(0)
+    B, T = 8, 32
+    tokens = jnp.asarray(rng.integers(0, 64, (B, T)), jnp.int32)
+    lens = np.full(B, T)
+    lens[1], lens[3], lens[5] = 20, 8, 26
+    mask = jnp.asarray((np.arange(T)[None, :] < lens[:, None])
+                       )[:, None, None, :]
+
+    set_active_mesh(make_mesh(MeshConfig(dp=8)))
+    params = module.init(jax.random.PRNGKey(0), tokens)["params"]
+    golden = jax.device_get(jax.jit(
+        lambda p: module.apply({"params": p}, tokens, mask=mask))(params))
+
+    set_active_mesh(make_mesh(MeshConfig(dp=2, pp=2, sp=2)))
+    got = jax.device_get(jax.jit(
+        lambda p: module.apply({"params": p}, tokens, mask=mask))(params))
+    valid = (np.arange(T)[None, :] < lens[:, None])[:, :, None]
+    err = np.abs((got - golden) * valid).max()
+    assert err < 2e-3, err
+
+
+def test_pp_sp_rejects_noncausal(devices):
+    from serverless_learn_tpu.parallel.mesh import make_mesh
+    from serverless_learn_tpu.parallel.ring_attention import set_active_mesh
+
+    mesh = make_mesh(MeshConfig(dp=2, pp=2, sp=2))
+    set_active_mesh(mesh)
+    from serverless_learn_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=4,
+                            n_heads=2, d_ff=64, max_seq_len=64,
+                            causal=False, use_rope=True, pipeline=True,
+                            pipeline_microbatches=2)
+    with pytest.raises(NotImplementedError, match="causal"):
+        jax.eval_shape(
+            lambda: Transformer(cfg).init(
+                jax.random.PRNGKey(0), jnp.zeros((8, 32), jnp.int32)))
+
+
 def test_pp_ep_train_step_matches_dp(devices):
     """Round-3 verdict #3: a Mixtral-shaped model must PIPELINE — pp=2 x
     ep=2 (manual GShard all-to-alls inside pipeline stages) tracks the dp
