@@ -21,18 +21,31 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run(model: str = "llama_tiny", batch: int = 8, prompt_len: int = 128,
-        new_tokens: int = 128, iters: int = 5) -> dict:
-    """One decode measurement, tunnel-amortized over ``iters`` calls."""
+        new_tokens: int = 128, iters: int = 5, quant=None,
+        model_kw=None) -> dict:
+    """One decode measurement, tunnel-amortized over ``iters`` calls.
+
+    ``quant="int8"``: params quantize post-init and the module switches to
+    the weight-only-int8 config — the decode is weight-HBM-bound, so the
+    expected win is ~the byte ratio."""
     import jax
     import jax.numpy as jnp
 
     from serverless_learn_tpu.inference.generate import generate
     from serverless_learn_tpu.models.registry import get_model
 
-    bundle = get_model(model)
+    bundle = get_model(model, **(model_kw or {}))
     module = bundle.module
     params = jax.jit(lambda: module.init(
         jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])()
+    if quant:
+        import dataclasses
+
+        from serverless_learn_tpu.inference.quantize import (
+            quantize_params_int8)
+
+        params = jax.jit(quantize_params_int8)(params)
+        module = type(module)(dataclasses.replace(module.cfg, quant=quant))
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (batch, prompt_len), 0,
         module.cfg.vocab_size)
@@ -49,8 +62,9 @@ def run(model: str = "llama_tiny", batch: int = 8, prompt_len: int = 128,
                        rng=jax.random.PRNGKey(i))
     float(jax.device_get(out[0, -1]))
     dt = (time.perf_counter() - t0) / iters
+    suffix = f"_{quant}" if quant else ""
     return {
-        "metric": f"{model}_decode_tokens_per_sec",
+        "metric": f"{model}_decode{suffix}_tokens_per_sec",
         "batch": batch, "prompt_len": prompt_len, "new_tokens": new_tokens,
         "value": round(batch * new_tokens / dt, 1), "unit": "tokens/sec",
         "per_seq_tokens_per_sec": round(new_tokens / dt, 1),

@@ -352,6 +352,35 @@ def row_llama8b_width():
                           key_fields=("metric", "device_kind"))
 
 
+def row_decode8():
+    """Weight-only int8 decode (round 4): llama_1b, int8 vs the same-shape
+    bf16 baseline. The HONEST reading of this row: int8 halves resident
+    weight memory (the capacity win) and runs ~0.85x of bf16 decode on
+    this chip (0.67-0.85x across runs; shared-chip variance) — decode at 1B scale is dispatch-bound (~30% of HBM BW), so
+    the byte saving buys no speed here; the row guards that the throughput
+    COST of the memory win stays bounded."""
+    import jax.numpy as jnp
+
+    from benchmarks.gen_bench import run as gen_run
+
+    kw = dict(max_seq_len=512, dtype=jnp.bfloat16,
+              param_dtype=jnp.bfloat16)
+    base = gen_run("llama_1b", batch=8, prompt_len=128, new_tokens=64,
+                   iters=3, model_kw=kw)
+    q = gen_run("llama_1b", batch=8, prompt_len=128, new_tokens=64,
+                iters=3, quant="int8", model_kw=kw)
+    rec = dict(q)
+    rec["bf16_tokens_per_sec"] = base["value"]
+    rec["int8_speedup_vs_bf16"] = round(q["value"] / base["value"], 2)
+    rec["device_kind"] = _device_kind()
+    # 25%, not the default 5%: this metric swings 0.67-0.85x of bf16 run
+    # to run on the shared chip (recorded in-row via the speedup field);
+    # a 5% guard would flag every run and train operators to ignore it.
+    return record_history(rec, HISTORY, better="max", rel_threshold=0.25,
+                          key_fields=("metric", "device_kind", "batch",
+                                      "prompt_len", "new_tokens"))
+
+
 def row_serve():
     """Multi-client batched serving aggregate (round-3 verdict #2)."""
     from benchmarks.gen_bench import run_concurrent
@@ -503,6 +532,7 @@ ROWS = {
     "lm": row_lm,
     "flash": row_flash,
     "decode": row_decode,
+    "decode8": row_decode8,
     "serve": row_serve,
     "llama8b": row_llama8b_width,
     "localsgd": row_localsgd,

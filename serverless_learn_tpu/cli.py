@@ -363,6 +363,24 @@ def _load_inference_params(args, cfg, trainer):
     return init_params(), None
 
 
+def _maybe_quantize(args, trainer, params):
+    """(module, params) honoring ``--quant``: the checkpoint restores in
+    its trained dtype, THEN projections quantize to int8 + scale and the
+    serving module switches to the quant config — the restore-time shape
+    validation stays against the float tree."""
+    module = trainer.bundle.module
+    if not getattr(args, "quant", None):
+        return module, params
+    import dataclasses
+
+    import jax
+
+    from serverless_learn_tpu.inference.quantize import quantize_params_int8
+
+    qmodule = type(module)(dataclasses.replace(module.cfg, quant=args.quant))
+    return qmodule, jax.jit(quantize_params_int8)(params)
+
+
 def cmd_generate(args) -> int:
     """Autoregressive sampling from a (possibly checkpointed) causal LM."""
     import jax
@@ -384,7 +402,8 @@ def cmd_generate(args) -> int:
         prompt = jax.random.randint(
             jax.random.PRNGKey(args.seed), (1, args.prompt_len), 0,
             trainer.bundle.module.cfg.vocab_size)
-    out = generate(trainer.bundle.module, params, prompt,
+    module, params = _maybe_quantize(args, trainer, params)
+    out = generate(module, params, prompt,
                    max_new_tokens=args.max_new_tokens,
                    temperature=args.temperature, top_k=args.top_k,
                    eos_id=args.eos_id,
@@ -411,7 +430,8 @@ def cmd_serve(args) -> int:
     cfg = _serving_config(_config_from_args(args))
     trainer = _build_inference_trainer(cfg)
     params, _ = _load_inference_params(args, cfg, trainer)
-    server = GenerationServer(trainer.bundle.module, params,
+    module, params = _maybe_quantize(args, trainer, params)
+    server = GenerationServer(module, params,
                               host=args.host, port=args.port,
                               max_batch=args.max_batch,
                               batch_wait_ms=args.batch_wait_ms)
@@ -629,6 +649,10 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--top-k", type=int, default=0)
     g.add_argument("--eos-id", type=int, default=None)
     g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--quant", choices=["int8"], default=None,
+                   help="weight-only quantization: restore the trained "
+                        "checkpoint, then store projections int8 + scale "
+                        "(half the decode HBM traffic)")
     g.set_defaults(fn=cmd_generate)
 
     sv = sub.add_parser("serve", help="serve LM generation over TCP (JSON lines)")
@@ -642,6 +666,8 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--batch-wait-ms", type=float, default=3.0,
                     help="how long the dispatcher waits to co-batch "
                          "requests (latency floor under load)")
+    sv.add_argument("--quant", choices=["int8"], default=None,
+                    help="weight-only int8 serving (see generate --quant)")
     sv.set_defaults(fn=cmd_serve)
 
     w = sub.add_parser("worker", help="elastic worker: join a cluster & train")

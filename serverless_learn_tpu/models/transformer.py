@@ -93,6 +93,16 @@ class TransformerConfig:
     # (the dispatcher's shard_map wrapper can't nest in a manual region).
     # Set by PipelinedBlocks, never by users.
     manual_sp_axis: Optional[str] = None
+    # Weight-only quantization for INFERENCE (round 4): "int8" stores every
+    # projection kernel as int8 + per-output-channel scale, HALVING the
+    # resident weight memory (a 2x larger model fits one chip). Measured
+    # on v5e, it does NOT speed up 1B-scale decode (0.85x: decode there is
+    # dispatch-bound, not weight-bandwidth-bound — see
+    # ops/pallas/quant_matmul.py for the measured negative result of the
+    # in-kernel dequant attempt). Params come from a trained checkpoint
+    # via inference/quantize.quantize_params_int8; training with quant set
+    # is unsupported (STE is out of scope).
+    quant: Optional[str] = None
     head_dim_override: Optional[int] = None  # local-slice cfgs must pin it
 
     @property
@@ -202,6 +212,55 @@ class LoRAAdapter(nn.Module):
         return b * (self.alpha / self.rank)
 
 
+class QuantDenseGeneral(nn.Module):
+    """Weight-only int8 projection (inference): the kernel is stored int8
+    with a per-output-channel float scale — HALF the resident weight
+    memory of bf16, which is the feature's win (fit a ~2x larger model
+    per chip). It is NOT a decode speedup on this chip: measured 1B-scale
+    decode is dispatch-bound (see ops/pallas/quant_matmul.py for the
+    preserved negative result). Params come from
+    ``inference/quantize.quantize_params_int8`` over a trained
+    checkpoint; the random init here exists only to give the pytree its
+    shapes."""
+
+    features: tuple  # output feature dims
+    n_contract: int = 1  # trailing input dims contracted
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from serverless_learn_tpu.ops.pallas.quant_matmul import quant_matmul
+
+        in_dims = tuple(x.shape[-self.n_contract:])
+        kq = self.param("kernel_q", nn.initializers.zeros,
+                        (*in_dims, *self.features), jnp.int8)
+        scale = self.param("scale", nn.initializers.ones,
+                           self.features, jnp.float32)
+        I = O = 1
+        for d in in_dims:
+            I *= d
+        for d in self.features:
+            O *= d
+        lead = x.shape[:-self.n_contract]
+        y = quant_matmul(x.reshape(*lead, I), kq.reshape(I, O),
+                         scale.reshape(O), out_dtype=self.dtype)
+        return y.reshape(*lead, *self.features)
+
+
+def _proj(cfg: TransformerConfig, feats, name: str, n_contract: int = 1):
+    """A projection layer honoring ``cfg.quant`` (same param paths the
+    sharding rules key on; quantized variants add _q/scale leaves)."""
+    if cfg.quant == "int8":
+        return QuantDenseGeneral(
+            features=feats if isinstance(feats, tuple) else (feats,),
+            n_contract=n_contract, dtype=cfg.dtype, name=name)
+    if cfg.quant is not None:
+        raise ValueError(f"unknown quant mode {cfg.quant!r} (int8)")
+    axis = -1 if n_contract == 1 else tuple(range(-n_contract, 0))
+    return nn.DenseGeneral(feats, use_bias=False, name=name, axis=axis,
+                           dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -210,9 +269,7 @@ class Attention(nn.Module):
                  prefill=False, seq_lengths=None):
         cfg = self.cfg
         H, K, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
-        dense = lambda feats, name: nn.DenseGeneral(
-            feats, use_bias=False, name=name, dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype)
+        dense = lambda feats, name: _proj(cfg, feats, name)
         q = dense((H, D), "q_proj")(x)
         k = dense((K, D), "k_proj")(x)
         v = dense((K, D), "v_proj")(x)
@@ -325,9 +382,7 @@ class Attention(nn.Module):
                 q, k, v, causal=causal, mask=mask, kv_lengths=kv_lengths,
                 impl="xla" if (decode or prefill) else cfg.attention_impl,
                 axis_name=cfg.sp_axis or "sp")
-        y = nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=False,
-                            name="o_proj", dtype=cfg.dtype,
-                            param_dtype=cfg.param_dtype)(out)
+        y = _proj(cfg, cfg.d_model, "o_proj", n_contract=2)(out)
         if cfg.manual_tp_axis:
             # Row-parallel output projection: each tp member contracted its
             # local heads; the partial sums combine here.
@@ -341,9 +396,7 @@ class MlpBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        dense = lambda feats, name: nn.DenseGeneral(
-            feats, use_bias=False, name=name, dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype)
+        dense = lambda feats, name: _proj(cfg, feats, name)
         if cfg.activation == "swiglu":
             gate = nn.silu(dense(cfg.d_ff, "gate_proj")(x))
             up = dense(cfg.d_ff, "up_proj")(x)
@@ -652,8 +705,8 @@ class Transformer(nn.Module):
         norm = (nn.RMSNorm if cfg.norm == "rms" else nn.LayerNorm)
         x = norm(dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="norm_f")(x)
         if cfg.tie_embeddings:
+            # Tied head reads the (unquantized) embedding table.
             logits = embed.attend(x.astype(cfg.param_dtype))
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
-                              dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
+            logits = _proj(cfg, cfg.vocab_size, "lm_head")(x)
         return logits

@@ -114,16 +114,20 @@ DEFAULT_RULES = ShardingRules(
         (r"moe/expert_(gate|up)$", P("ep", "fsdp", "tp")),
         (r"moe/expert_down$", P("ep", "tp", "fsdp")),
         (r"moe/router$", P()),
-        (r"(q_proj|k_proj|v_proj)/kernel$", P("fsdp", "tp")),
-        (r"o_proj/kernel$", P("tp", None, "fsdp")),
-        (r"(wi|wi_0|wi_1|up_proj|gate_proj)/kernel$", P("fsdp", "tp")),
-        (r"(wo|down_proj)/kernel$", P("tp", "fsdp")),
+        # kernel(_q)?: weight-only int8 serving stores projections as
+        # kernel_q with the SAME dim layout as kernel, so both share one
+        # rule; the tiny per-channel `scale` leaves fall through to the
+        # replicated default.
+        (r"(q_proj|k_proj|v_proj)/kernel(_q)?$", P("fsdp", "tp")),
+        (r"o_proj/kernel(_q)?$", P("tp", None, "fsdp")),
+        (r"(wi|wi_0|wi_1|up_proj|gate_proj)/kernel(_q)?$", P("fsdp", "tp")),
+        (r"(wo|down_proj)/kernel(_q)?$", P("tp", "fsdp")),
         # Vocab over tp+fsdp, d_model unsharded: a d_model-sharded table
         # propagates its sharding into the lookup's output and the SPMD
         # partitioner pays an involuntary full-remat reshard moving it back
         # to the batch-sharded residual stream.
         (r"embed(der|ding)?/embedding$", P(("tp", "fsdp"), None)),
-        (r"lm_head/kernel$", P("fsdp", "tp")),
+        (r"lm_head/kernel(_q)?$", P("fsdp", "tp")),
         (r"lora_a/kernel$", P("fsdp", None)),
         (r"lora_b/kernel$", P(None, "tp")),
         # conv kernels [h, w, cin, cout]: shard cout over tp, cin over fsdp

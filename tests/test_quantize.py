@@ -1,0 +1,148 @@
+"""Weight-only int8 inference (round 4): quantized projections, logit
+error bounds, and end-to-end decode through the quant module."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.inference.quantize import (
+    QUANT_DIRS, quantize_params_int8)
+from serverless_learn_tpu.models.registry import get_model
+
+
+@pytest.fixture(scope="module")
+def fp_model(devices):
+    bundle = get_model("llama_tiny", dtype=jnp.float32,
+                       param_dtype=jnp.float32, max_seq_len=64)
+    params = bundle.module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return bundle.module, params
+
+
+def _quant_module(module):
+    return type(module)(dataclasses.replace(module.cfg, quant="int8"))
+
+
+def test_quantized_tree_structure(fp_model):
+    module, params = fp_model
+    qp = quantize_params_int8(params)
+    flat = {jax.tree_util.keystr(p): l for p, l in
+            jax.tree_util.tree_flatten_with_path(qp)[0]}
+    n_q = sum(1 for k in flat if k.endswith("['kernel_q']"))
+    # llama_tiny: 2 layers x (q,k,v,o,gate,up,down) + lm_head
+    assert n_q == 2 * 7 + 1, sorted(flat)[:10]
+    for k, l in flat.items():
+        if k.endswith("['kernel_q']"):
+            assert l.dtype == jnp.int8, k
+            assert int(jnp.abs(l).max()) <= 127
+        if k.endswith("['scale']"):
+            assert l.dtype == jnp.float32, k
+    # Norms/embeddings untouched.
+    assert flat["['embedder']['embedding']"].dtype == jnp.float32
+    # And the quant module's own init matches the transformed tree's
+    # structure exactly (same paths, same shapes).
+    qm = _quant_module(module)
+    abstract = jax.eval_shape(lambda: qm.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)))["params"]
+    want = {jax.tree_util.keystr(p): (tuple(l.shape), l.dtype) for p, l in
+            jax.tree_util.tree_flatten_with_path(abstract)[0]}
+    got = {jax.tree_util.keystr(p): (tuple(l.shape), l.dtype) for p, l in
+           jax.tree_util.tree_flatten_with_path(qp)[0]}
+    assert got == want
+
+
+def test_dequantized_kernel_error_bounded(fp_model):
+    """Per-output-channel symmetric int8: |w - q*s| <= s/2 elementwise —
+    the textbook bound, including the 2-contract o_proj layout."""
+    _, params = fp_model
+    qp = quantize_params_int8(params)
+    layer = params["layer_0"]["attn"]
+    qlayer = qp["layer_0"]["attn"]
+    for name, nc in (("q_proj", 1), ("o_proj", 2)):
+        w = np.asarray(layer[name]["kernel"], np.float32)
+        q = np.asarray(qlayer[name]["kernel_q"], np.float32)
+        s = np.asarray(qlayer[name]["scale"], np.float32)
+        deq = q * s  # broadcast over leading contraction dims
+        assert np.abs(w - deq).max() <= s.max() / 2 + 1e-7, name
+
+
+def test_quant_logits_close_and_decode_runs(fp_model):
+    """End to end: the quant module's logits track fp32 within the quant
+    error budget, and KV-cache generation runs through the int8 path."""
+    from serverless_learn_tpu.inference.generate import generate
+
+    module, params = fp_model
+    qm = _quant_module(module)
+    qp = quantize_params_int8(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                module.cfg.vocab_size)
+    ref = jax.device_get(module.apply({"params": params}, tokens))
+    got = jax.device_get(qm.apply({"params": qp}, tokens))
+    scale = np.abs(ref).max()
+    rel = np.abs(got - ref).max() / scale
+    assert rel < 0.05, f"relative logit error {rel}"
+
+    out = generate(qm, qp, jnp.asarray([[5, 9, 11]], jnp.int32), 8)
+    out = jax.device_get(out)
+    assert out.shape == (1, 11)
+    assert (out >= 0).all() and (out < module.cfg.vocab_size).all()
+
+
+def test_quant_rejects_moe(devices):
+    """Expert tensors (the bulk of MoE params) are not quantized; a
+    partial quantization must refuse loudly, not silently under-deliver
+    the memory claim."""
+    bundle = get_model("moe_tiny", dtype=jnp.float32,
+                       param_dtype=jnp.float32)
+    params = jax.eval_shape(lambda: bundle.module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)))["params"]
+    with pytest.raises(NotImplementedError, match="MoE"):
+        quantize_params_int8(params)
+
+
+def test_quant_leaves_carry_sharding_rules(fp_model):
+    """A quantized tree must shard like its float twin on a serving mesh
+    (the capacity story depends on it): kernel_q leaves pick up the same
+    fsdp/tp specs as kernel; scales replicate."""
+    import jax.numpy as _  # noqa: F401
+
+    from serverless_learn_tpu.config import MeshConfig
+    from serverless_learn_tpu.parallel.mesh import make_mesh
+    from serverless_learn_tpu.parallel.sharding import specs_for_tree
+
+    module, params = fp_model
+    qp = quantize_params_int8(params)
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    fspecs = {jax.tree_util.keystr(p): s for p, s in
+              jax.tree_util.tree_flatten_with_path(
+                  specs_for_tree(params, mesh))[0]}
+    qspecs = {jax.tree_util.keystr(p): s for p, s in
+              jax.tree_util.tree_flatten_with_path(
+                  specs_for_tree(qp, mesh))[0]}
+    checked = 0
+    for k, spec in qspecs.items():
+        if k.endswith("['kernel_q']"):
+            twin = k.replace("['kernel_q']", "['kernel']")
+            assert qspecs[k] == fspecs[twin], (k, spec, fspecs[twin])
+            assert tuple(spec), f"{k} fell to replicated default"
+            checked += 1
+        if k.endswith("['scale']"):
+            assert tuple(spec) == (), (k, spec)
+    assert checked >= 15
+
+
+def test_quant_dirs_cover_proj_sites(fp_model):
+    """Every float projection kernel in the tree is covered by QUANT_DIRS
+    (a new projection name must be added deliberately, not silently left
+    unquantized)."""
+    _, params = fp_model
+    flat = {jax.tree_util.keystr(p) for p, _ in
+            jax.tree_util.tree_flatten_with_path(params)[0]}
+    for k in flat:
+        if "['kernel']" not in k:
+            continue
+        mod_dir = k.split("[")[-2].strip("]'")
+        assert mod_dir in QUANT_DIRS | {"lora_a", "lora_b"}, k
