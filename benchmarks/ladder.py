@@ -10,16 +10,26 @@ any row flagged a regression; rows still all run and report.
 
 Rows (chip-side unless noted):
     r18        ResNet-18/CIFAR headline (the driver's bench.py, 3% guard)
+    r18nf      ResNet-18 norm="none" (NF recipe, guarded since r4)
     r50        ResNet-50/ImageNet-shape b256
+    r50nf      ResNet-50 norm="none"
+    r50da      ResNet-50 with device-side crop+flip augmentation
     bert       BERT-base MLM b64 seq512
     llama1b    Llama-1B LoRA b8 seq1024 bf16+remat
     lm         llama_tiny-architecture LM seq512 (benchmarks/lm_bench.py)
-    flash      flash-attention fwd+bwd T=8192 causal — median of 5 with
-               spread (resolves the r2 14-vs-16 ms ambiguity: chip-load
-               variance of a few ms is real; the guard widens accordingly)
+    flash      flash-attention fwd+bwd T=8192 causal — min of 11 with the
+               uncontended-cluster spread (the distribution is bimodal
+               under chip sharing; median + full times ride along)
     decode     KV-cache decode tokens/sec (llama_tiny b8)
+    decode8    weight-only int8 decode vs bf16 (llama_1b; capacity win,
+               honest throughput cost)
+    serve      4-client batched-serving aggregate vs serialized
+    llama8b    8B-width per-layer step time on real silicon (labeled
+               extrapolation to the full model)
+    localsgd   Local SGD communication-interval sweep (r18, BatchNorm)
     data       shard-server raw stream + CIFAR ingest + ImageNet ingest
-               (host-side; no chip needed)
+               (host-crop, device-augment, parallel-source scaling;
+               host-side, no chip needed)
 """
 
 import argparse
