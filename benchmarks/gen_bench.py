@@ -22,12 +22,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def run(model: str = "llama_tiny", batch: int = 8, prompt_len: int = 128,
         new_tokens: int = 128, iters: int = 5, quant=None,
-        model_kw=None) -> dict:
+        model_kw=None, quant_direct: bool = False) -> dict:
     """One decode measurement, tunnel-amortized over ``iters`` calls.
 
     ``quant="int8"``: params quantize post-init and the module switches to
     the weight-only-int8 config — the decode is weight-HBM-bound, so the
-    expected win is ~the byte ratio."""
+    expected win is ~the byte ratio. ``quant_direct``: init random params
+    straight in the int8 layout — the 8B path, where materializing the
+    bf16 tree first (16 GB) cannot share a 16 GB chip with its copy."""
     import jax
     import jax.numpy as jnp
 
@@ -36,16 +38,27 @@ def run(model: str = "llama_tiny", batch: int = 8, prompt_len: int = 128,
 
     bundle = get_model(model, **(model_kw or {}))
     module = bundle.module
-    params = jax.jit(lambda: module.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])()
-    if quant:
+    if quant and quant_direct:
+        import dataclasses
+
+        from serverless_learn_tpu.inference.quantize import (
+            random_quantized_params)
+
+        module = type(module)(dataclasses.replace(module.cfg, quant=quant))
+        params = random_quantized_params(module)
+    elif quant:
         import dataclasses
 
         from serverless_learn_tpu.inference.quantize import (
             quantize_params_int8)
 
-        params = jax.jit(quantize_params_int8)(params)
+        params = jax.jit(lambda: quantize_params_int8(module.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 8), jnp.int32))["params"]))()
         module = type(module)(dataclasses.replace(module.cfg, quant=quant))
+    else:
+        params = jax.jit(lambda: module.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])()
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (batch, prompt_len), 0,
         module.cfg.vocab_size)

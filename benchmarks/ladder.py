@@ -26,6 +26,9 @@ Rows (chip-side unless noted):
     serve      4-client batched-serving aggregate vs serialized
     llama8b    8B-width per-layer step time on real silicon (labeled
                extrapolation to the full model)
+    llama8b_real  REAL full-depth Llama-8B on ONE chip: QLoRA train step
+               (int8 frozen base + bf16 LoRA + remat) and int8 decode —
+               the measured rung 5 (round 5)
     localsgd   Local SGD communication-interval sweep (r18, BatchNorm)
     data       shard-server raw stream + CIFAR ingest + ImageNet ingest
                (host-crop, device-augment, parallel-source scaling;
@@ -362,41 +365,168 @@ def row_llama8b_width():
                           key_fields=("metric", "device_kind"))
 
 
+def row_llama8b_real():
+    """A REAL full-depth Llama-8B on ONE v5e chip (round-5 verdict #1 —
+    replaces the rung-5 extrapolation with silicon).
+
+    The round-4 int8 capacity win is the tool: the 8B base stored
+    weight-only int8 is ~7.5 GB resident (vs 16 GB bf16, which cannot
+    even load), leaving room for bf16 LoRA adapters + their adam moments,
+    remat'd activations, and the KV cache. Two measurements:
+
+    * QLoRA train step: int8 FROZEN base + bf16 LoRA (rank 16, q/v),
+      remat, b4 seq1024. The partitioned trainer
+      (``training/partition.py``) differentiates ONLY the LoRA subtree —
+      an int8 base has no gradients, by construction not just by masking.
+    * greedy decode at b8: prefill 128, 64 new tokens.
+
+    Honest notes recorded in-row: params are RANDOM in the int8 layout
+    (``random_quantized_params``) — identical compute graph and memory
+    footprint to a quantized trained checkpoint, but nobody has measured
+    fine-tune QUALITY here; the gradient-quality claim (LoRA grads through
+    an int8 base track the bf16-base grads) is pinned by
+    ``tests/test_qlora.py`` at small scale, not at 8B."""
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.gen_bench import run as gen_run
+    from serverless_learn_tpu.config import (
+        DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig,
+        TrainConfig)
+    from serverless_learn_tpu.data.datasets import SyntheticSource
+    from serverless_learn_tpu.inference.quantize import (
+        random_quantized_params)
+    from serverless_learn_tpu.training.train_step import build_trainer
+    from serverless_learn_tpu.utils.flops import compiled_step_flops, mfu
+
+    batch, seq = 4, 1024
+    cfg = ExperimentConfig(
+        model="llama_8b",
+        model_overrides=dict(lora_rank=16, quant="int8", max_seq_len=seq,
+                             param_dtype=jnp.bfloat16),
+        mesh=MeshConfig(dp=len(jax.devices())),
+        optimizer=OptimizerConfig(name="adamw", learning_rate=2e-4),
+        train=TrainConfig(batch_size=batch * len(jax.devices()), remat=True),
+        data=DataConfig(seq_len=seq))
+    trainer = build_trainer(cfg)
+    # Build the state MANUALLY from one random int8-layout tree:
+    # trainer.init() would allocate a zero-init 7.5 GB base that then
+    # coexists with its random replacement — ~15 GB of base weights on a
+    # 16 GB chip. The optimizer state only covers the LoRA subtree
+    # (training/partition.py), so it is cheap to init directly.
+    from serverless_learn_tpu.training.optimizer import make_optimizer
+    from serverless_learn_tpu.training.partition import prune
+    from serverless_learn_tpu.training.train_state import TrainState
+
+    params = random_quantized_params(trainer.bundle.module)
+    tx = make_optimizer(cfg.optimizer)
+    opt_state = tx.init(prune(params,
+                              trainer.bundle.trainable_mask(params)))
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       opt_state=opt_state, model_state={})
+    src = iter(SyntheticSource(trainer.bundle.make_batch, cfg.data,
+                               cfg.train.batch_size, seed=0))
+    b = trainer.shard_batch(next(src))
+    for _ in range(2):
+        state, m = trainer.step(state, b)
+    float(jax.device_get(m["loss"]))
+    steps = 5
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = trainer.step(state, b)
+    float(jax.device_get(m["loss"]))
+    step_s = (time.perf_counter() - t0) / steps
+    tokens_s = batch * seq / step_s
+    rec = {
+        "metric": "llama8b_real_qlora_train_tokens_per_sec_per_chip",
+        "value": round(tokens_s, 1),
+        "unit": "tokens/sec/chip (b%d seq%d int8 base + bf16 LoRA, remat)"
+                % (batch, seq),
+        "step_time_ms": round(step_s * 1e3, 1),
+        "batch_per_chip": batch,
+        "params_note": "random int8-layout params; compute graph and "
+                       "memory identical to a quantized checkpoint",
+        "device_kind": _device_kind(),
+    }
+    u = mfu(compiled_step_flops(trainer.step_fn, state, b, n_devices=1),
+            step_s, n_chips=1)
+    if u is not None:
+        rec["mfu"] = round(u, 4)
+    out = [record_history(rec, HISTORY, better="max", rel_threshold=0.10,
+                          key_fields=("metric", "device_kind",
+                                      "batch_per_chip"))]
+    # Free the training state before decode loads its own 7.5 GB copy.
+    del state, trainer, b, src
+
+    dec = gen_run("llama_8b", batch=8, prompt_len=128, new_tokens=64,
+                  iters=3, quant="int8", quant_direct=True,
+                  model_kw=dict(max_seq_len=512,
+                                param_dtype=jnp.bfloat16))
+    dec["metric"] = "llama8b_real_int8_decode_tokens_per_sec"
+    dec["device_kind"] = _device_kind()
+    out.append(record_history(dec, HISTORY, better="max", rel_threshold=0.15,
+                              key_fields=("metric", "device_kind", "batch",
+                                          "prompt_len", "new_tokens")))
+    return out
+
+
+def _best_of(fn, repeats=3):
+    """Best-of-N for throughput rows on the shared chip (the flash-row
+    treatment, round-5 verdict #6): contention only ever SUBTRACTS
+    throughput, so the max estimates the uncontended rate; the recorded
+    ``spread_rel`` (max-min)/max widens the guard via benchlog and keeps
+    the distribution honest in-row."""
+    recs = sorted((fn() for _ in range(repeats)), key=lambda r: r["value"])
+    best = dict(recs[-1])
+    best["spread_rel"] = round(
+        (best["value"] - recs[0]["value"]) / max(best["value"], 1e-9), 4)
+    best["values_all"] = [r["value"] for r in recs]
+    return best
+
+
 def row_decode8():
     """Weight-only int8 decode (round 4): llama_1b, int8 vs the same-shape
     bf16 baseline. The HONEST reading of this row: int8 halves resident
     weight memory (the capacity win) and runs ~0.85x of bf16 decode on
-    this chip (0.67-0.85x across runs; shared-chip variance) — decode at 1B scale is dispatch-bound (~30% of HBM BW), so
+    this chip — decode at 1B scale is dispatch-bound (~30% of HBM BW), so
     the byte saving buys no speed here; the row guards that the throughput
-    COST of the memory win stays bounded."""
+    COST of the memory win stays bounded. Round 5: best-of-3 per arm with
+    recorded spread — the r4 row measured each arm ONCE and its 0.61-0.85x
+    swing was two independent single samples' noise compounding in a
+    ratio, tripping the guard three runs straight."""
     import jax.numpy as jnp
 
     from benchmarks.gen_bench import run as gen_run
 
     kw = dict(max_seq_len=512, dtype=jnp.bfloat16,
               param_dtype=jnp.bfloat16)
-    base = gen_run("llama_1b", batch=8, prompt_len=128, new_tokens=64,
-                   iters=3, model_kw=kw)
-    q = gen_run("llama_1b", batch=8, prompt_len=128, new_tokens=64,
-                iters=3, quant="int8", model_kw=kw)
+    base = _best_of(lambda: gen_run(
+        "llama_1b", batch=8, prompt_len=128, new_tokens=64, iters=3,
+        model_kw=kw))
+    q = _best_of(lambda: gen_run(
+        "llama_1b", batch=8, prompt_len=128, new_tokens=64, iters=3,
+        quant="int8", model_kw=kw))
     rec = dict(q)
     rec["bf16_tokens_per_sec"] = base["value"]
+    rec["bf16_values_all"] = base["values_all"]
     rec["int8_speedup_vs_bf16"] = round(q["value"] / base["value"], 2)
+    rec["spread_rel"] = max(q["spread_rel"], base["spread_rel"])
     rec["device_kind"] = _device_kind()
-    # 25%, not the default 5%: this metric swings 0.67-0.85x of bf16 run
-    # to run on the shared chip (recorded in-row via the speedup field);
-    # a 5% guard would flag every run and train operators to ignore it.
-    return record_history(rec, HISTORY, better="max", rel_threshold=0.25,
+    # Best-of-3 tightened the single-sample noise; keep a 15% floor for
+    # residual day-scale swings (shared chip).
+    return record_history(rec, HISTORY, better="max", rel_threshold=0.15,
                           key_fields=("metric", "device_kind", "batch",
                                       "prompt_len", "new_tokens"))
 
 
 def row_serve():
-    """Multi-client batched serving aggregate (round-3 verdict #2)."""
+    """Multi-client batched serving aggregate (round-3 verdict #2).
+    Round 5: best-of-3 with recorded spread (verdict #6) — single-sample
+    serve runs swung 756-805 tokens/s and tripped the guard."""
     from benchmarks.gen_bench import run_concurrent
 
-    rec = run_concurrent("llama_tiny", clients=4, prompt_len=128,
-                         new_tokens=64)
+    rec = _best_of(lambda: run_concurrent(
+        "llama_tiny", clients=4, prompt_len=128, new_tokens=64))
     rec["device_kind"] = _device_kind()
     return record_history(rec, HISTORY, better="max",
                           key_fields=("metric", "device_kind", "clients",
@@ -545,14 +675,23 @@ ROWS = {
     "decode8": row_decode8,
     "serve": row_serve,
     "llama8b": row_llama8b_width,
+    "llama8b_real": row_llama8b_real,
     "localsgd": row_localsgd,
     "data": row_data,
 }
 
 
+# llama8b_real is opt-in, not in the default sweep: it resides ~8.5 GB of
+# base weights plus activations on the chip — fine alone, but the shared
+# dev chip may be holding other tenants' HBM, and a routine guard run
+# should not OOM on their behalf. Run it explicitly:
+#   python benchmarks/ladder.py --rows llama8b_real
+DEFAULT_ROWS = [k for k in ROWS if k != "llama8b_real"]
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", default=",".join(ROWS),
+    ap.add_argument("--rows", default=",".join(DEFAULT_ROWS),
                     help="comma-separated subset of: " + ",".join(ROWS))
     args = ap.parse_args()
     regressed = False

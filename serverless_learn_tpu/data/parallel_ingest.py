@@ -69,7 +69,7 @@ def _worker_main(out_q, stop, addr: str, dataset: str, batch_size: int,
                     break
                 except queue_mod.Full:
                     continue
-        out_q.put(_SENTINEL)
+        out_q.put((_SENTINEL, worker_idx))
     except Exception as e:  # surface to the consumer, don't die silently
         try:
             out_q.put(RuntimeError(f"ingest worker {worker_idx}: {e!r}"))
@@ -135,20 +135,30 @@ class ParallelIngestSource:
             self._procs.append(p)
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
-        live = self.workers
-        while live:
+        done: set = set()
+        while len(done) < self.workers:
             try:
                 item = self._q.get(timeout=1.0)
             except queue_mod.Empty:
-                if not any(p.is_alive() for p in self._procs) \
-                        and self._q.empty():
+                # A worker killed hard (OOM-kill/SIGKILL) never enqueues
+                # its sentinel or an error. Once its buffered batches are
+                # drained, nothing more can arrive from it — detect that
+                # per worker instead of waiting for ALL workers to die,
+                # which with loop=True would iterate forever with one
+                # shard stripe silently missing.
+                dead = [w for w, p in enumerate(self._procs)
+                        if not p.is_alive() and w not in done]
+                if dead and self._q.empty():
                     raise RuntimeError(
-                        "all ingest workers exited without end-of-data")
+                        f"ingest worker(s) {dead} exited without "
+                        "end-of-data or an error (killed?); their shard "
+                        "stripe would be silently missing")
                 continue
             if isinstance(item, Exception):
                 raise item
-            if isinstance(item, str) and item == _SENTINEL:
-                live -= 1
+            if isinstance(item, tuple) and len(item) == 2 \
+                    and item[0] == _SENTINEL:
+                done.add(item[1])
                 continue
             yield item
 

@@ -22,11 +22,13 @@ of two (extra tokens are generated then truncated — bounded at <2x decode
 work, amortized by the batching win). Each (batch_bucket, prompt_bucket,
 new_bucket, sampling params) tuple compiles once and is reused forever.
 
-Sampling reproducibility: a coalesced batch draws from one PRNG stream
-(seeded by the group's first request), so a sampled (temperature > 0)
-request's tokens depend on its batch-mates. Greedy requests
-(temperature=0, the default) are exact and batch-invariant. Callers that
-need reproducible sampling should serialize themselves.
+Sampling reproducibility: sampled (temperature > 0) requests key their
+group on ``seed`` too, so a client's requested seed is never silently
+replaced by a batch-mate's. The draws still flow from ONE stream shaped
+by the padded batch, so a sampled request's tokens can vary with batch
+composition. Greedy requests (temperature=0, the default) ignore the
+PRNG entirely and are exact and batch-invariant. Callers that need
+bit-reproducible sampling should serialize themselves.
 
 The reference has no inference at all (its "model" is a gossiped double
 vector, ``/root/reference/src/protos/serverless_learn.proto:81-83``); this
@@ -110,6 +112,11 @@ class BatchingEngine:
         """Blocks until the dispatcher serves this request; returns either
         {"new_tokens": [...]} or {"error": ...}."""
         max_seq = self.module.cfg.max_seq_len
+        if len(prompt) == 0:
+            # An empty prompt would make prompt_lengths-1 == -1, which
+            # take_along_axis clamps to index 0 — garbage tokens from an
+            # all-pad row rather than an error.
+            return {"error": "prompt must contain at least one token"}
         if len(prompt) + max_new > max_seq:
             # Validate HERE, not only in the server: _shape_buckets would
             # otherwise clamp new_bucket and silently return fewer tokens
@@ -119,7 +126,12 @@ class BatchingEngine:
         p = _Pending(prompt=prompt, max_new=max_new, temperature=temperature,
                      top_k=top_k, eos_id=eos_id, seed=seed)
         # Compatible requests share sampling params and padded shapes.
+        # Sampled requests additionally key on seed: a coalesced batch
+        # draws one PRNG stream seeded by the group's FIRST request, so
+        # grouping different seeds would silently discard the others'.
+        # Greedy (temperature=0) ignores the PRNG and groups freely.
         p.group_key = (temperature, top_k, eos_id,
+                       seed if temperature > 0 else None,
                        _shape_buckets(len(prompt), max_new, max_seq))
         self._q.put(p)
         if not p.done.wait(timeout_s):
@@ -165,7 +177,7 @@ class BatchingEngine:
         first = group[0]
         # The shared key guarantees every member's prompt fits the prompt
         # bucket and its max_new fits the new bucket (see _shape_buckets).
-        prompt_bucket, new_bucket = first.group_key[3]
+        prompt_bucket, new_bucket = first.group_key[-1]
         n = len(group)
         batch_bucket = 1
         while batch_bucket < n:
@@ -210,6 +222,7 @@ class BatchingEngine:
                              temperature=temperature, top_k=top_k,
                              eos_id=eos_id, seed=0)
                 p.group_key = (temperature, top_k, eos_id,
+                               0 if temperature > 0 else None,
                                _shape_buckets(prompt_len, max_new,
                                               self.module.cfg.max_seq_len))
                 group.append(p)

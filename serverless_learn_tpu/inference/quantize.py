@@ -49,6 +49,49 @@ QUANT_DIRS: Set[str] = {
 }
 
 
+def random_quantized_params(module, seed: int = 0) -> dict:
+    """Random params DIRECTLY in the ``quant="int8"`` module's layout.
+
+    Benchmarking an 8B int8 model cannot take the quantize_params_int8
+    route — that would first materialize the bf16 tree (16 GB) next to
+    its int8 copy on a 16 GB chip. Instead init the quant module's pytree
+    abstractly and fill it leaf-by-leaf: ``kernel_q`` uniform int8 in
+    [-127, 127], ``scale`` at the 0.02-stddev init's per-channel max-abs
+    (~``2.5 * 0.02 / 127``), float leaves (norms, embedder, LoRA) keep
+    their abstract shapes with standard inits. Statistically matches a
+    quantized trained checkpoint closely enough for timing (identical
+    compute graph, realistic value ranges); it is NOT a trained model.
+    """
+    abstract = jax.eval_shape(
+        lambda: module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"])
+
+    def fill(path, leaf):
+        import zlib
+
+        name = jax.tree_util.keystr(path)
+        keys = [str(getattr(p, "key", "")) for p in path]
+        # crc32, not hash(): Python's str hash is PYTHONHASHSEED-random
+        # per process, which would break the seed's reproducibility.
+        key = jax.random.fold_in(jax.random.PRNGKey(seed),
+                                 zlib.crc32(name.encode()))
+        if leaf.dtype == jnp.int8:
+            return jax.random.randint(key, leaf.shape, -127, 128, jnp.int32
+                                      ).astype(jnp.int8)
+        # A QUANT projection's dequant scale — NOT a norm's: flax norms
+        # also name their parameter "scale", and handing them ~4e-4 would
+        # collapse every residual stream to zero.
+        if (keys[-1] == "scale" and len(keys) >= 2
+                and keys[-2] in QUANT_DIRS):
+            return jnp.full(leaf.shape, 2.5 * 0.02 / 127.0, leaf.dtype)
+        if leaf.ndim >= 2:  # embedder / unquantized kernels
+            return (jax.random.normal(key, leaf.shape, jnp.float32) * 0.02
+                    ).astype(leaf.dtype)
+        return jnp.ones(leaf.shape, leaf.dtype)  # norm scales / biases
+
+    return jax.tree_util.tree_map_with_path(fill, abstract)
+
+
 def quantize_params_int8(params: dict, n_contract: dict | None = None
                          ) -> dict:
     """Trained transformer params -> the ``quant="int8"`` module's pytree.

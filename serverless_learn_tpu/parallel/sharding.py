@@ -118,10 +118,14 @@ DEFAULT_RULES = ShardingRules(
         # kernel_q with the SAME dim layout as kernel, so both share one
         # rule; the tiny per-channel `scale` leaves fall through to the
         # replicated default.
-        (r"(q_proj|k_proj|v_proj)/kernel(_q)?$", P("fsdp", "tp")),
-        (r"o_proj/kernel(_q)?$", P("tp", None, "fsdp")),
-        (r"(wi|wi_0|wi_1|up_proj|gate_proj)/kernel(_q)?$", P("fsdp", "tp")),
-        (r"(wo|down_proj)/kernel(_q)?$", P("tp", "fsdp")),
+        # (^|/) anchors: these are MODULE names, and re.search without the
+        # boundary lets "conv_proj" match the v_proj rule (round-5 dryrun
+        # sharded a [1,1,64,128] projection conv's 1-wide dim over fsdp).
+        (r"(^|/)(q_proj|k_proj|v_proj)/kernel(_q)?$", P("fsdp", "tp")),
+        (r"(^|/)o_proj/kernel(_q)?$", P("tp", None, "fsdp")),
+        (r"(^|/)(wi|wi_0|wi_1|up_proj|gate_proj)/kernel(_q)?$",
+         P("fsdp", "tp")),
+        (r"(^|/)(wo|down_proj)/kernel(_q)?$", P("tp", "fsdp")),
         # Vocab over tp+fsdp, d_model unsharded: a d_model-sharded table
         # propagates its sharding into the lookup's output and the SPMD
         # partitioner pays an involuntary full-remat reshard moving it back
@@ -130,8 +134,13 @@ DEFAULT_RULES = ShardingRules(
         (r"lm_head/kernel(_q)?$", P("fsdp", "tp")),
         (r"lora_a/kernel$", P("fsdp", None)),
         (r"lora_b/kernel$", P(None, "tp")),
-        # conv kernels [h, w, cin, cout]: shard cout over tp, cin over fsdp
-        (r"conv[^/]*/kernel$", P(None, None, "fsdp", "tp")),
+        # conv kernels [h, w, cin, cout]: shard cout over fsdp+tp. Case-
+        # insensitive: flax auto-names in-block convs "Conv_0" (the round-5
+        # dryrun caught them falling through to the generic kernel rule,
+        # which shards dim 0 — the 3-tap spatial dim). cout, not cin: the
+        # stem conv's cin is 3 (RGB) and can never divide an fsdp axis,
+        # while cout is a filter count (64+), divisible by construction.
+        (r"(?i)conv[^/]*/kernel$", P(None, None, None, ("fsdp", "tp"))),
         (r"kernel$", P("fsdp", "tp")),
         (r"(bias|scale)$", P()),
     ],

@@ -136,6 +136,11 @@ class LocalSGDTrainer:
         self.outer = outer
         self.mix_rate = mix_rate
         self.bundle = get_model(config.model, **config.model_overrides)
+        # NOTE: freezes via the optimizer-mask path (multi_transform +
+        # set_to_zero), NOT train_step.py's gradient partitioning — fine at
+        # the scales Local SGD runs at today, but it pays the full-model
+        # backward for frozen bases and cannot take an int8 base; migrate
+        # to training/partition.py when a frozen-base model needs DiLoCo.
         self.tx = make_optimizer(config.optimizer, self.bundle.trainable_mask)
         self.outer_tx = optax.sgd(outer_lr, momentum=outer_momentum,
                                   nesterov=True)

@@ -1,0 +1,78 @@
+"""Trainable/frozen parameter partitioning for frozen-base fine-tuning.
+
+LoRA-style training differentiates a FEW leaves of a LARGE pytree. The
+round-1..4 trainer froze base params at the optimizer (``optax
+.multi_transform`` + ``set_to_zero``), which still pays the full-model
+weight-gradient backward and materializes a full-size gradient pytree —
+fatal at 8B scale (an 8B f32 gradient tree is 32 GB; the chip has 16),
+and impossible at all for an int8-quantized frozen base (JAX refuses to
+differentiate with respect to integer leaves). This module partitions
+instead: ``prune`` extracts the trainable SUBTREE (keeping its nested
+names, so the sharding rule table still applies), the loss closes over
+the frozen remainder, and ``jax.grad`` runs only over the subtree — the
+backward never computes frozen weight gradients, and the optimizer state
+covers only what trains.
+
+The reference has no counterpart (its "training" bumps a double vector,
+``/root/reference/src/worker.cc:221-231``); this is the TPU-native
+machinery behind the ladder's QLoRA rung (int8 frozen 8B base + bf16
+LoRA, ``benchmarks/ladder.py --rows llama8b_real``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class _Empty:
+    """Sentinel for a fully-frozen branch (``None`` is itself a pytree)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<all-frozen>"
+
+
+EMPTY = _Empty()
+
+
+def prune(tree: Any, mask: Any) -> Any:
+    """Subtree of ``tree`` where ``mask``'s (same-structure) leaves are True.
+
+    Nested-dict trees only (flax params). Fully-frozen branches disappear
+    entirely — the result's leaf paths are a subset of the input's, so
+    path-keyed sharding rules resolve identically. Raises if nothing is
+    trainable (a silent no-op optimizer is never what the caller meant).
+    """
+    out = _prune(tree, mask)
+    if out is EMPTY:
+        raise ValueError("trainable mask selects no parameters")
+    return out
+
+
+def _prune(tree, mask):
+    if isinstance(tree, dict):
+        out = {}
+        for k, v in tree.items():
+            sub = _prune(v, mask[k])
+            if sub is not EMPTY:
+                out[k] = sub
+        return out if out else EMPTY
+    return tree if mask else EMPTY
+
+
+def overlay(full: Any, sub: Any) -> Any:
+    """``full`` with every leaf present in ``sub`` replaced by ``sub``'s.
+
+    The merge direction matters for autodiff: gradients flow through the
+    returned tree's ``sub`` leaves only — ``full`` contributes constants.
+    """
+    if isinstance(sub, dict):
+        return {k: (overlay(v, sub[k]) if k in sub else v)
+                for k, v in full.items()}
+    return sub

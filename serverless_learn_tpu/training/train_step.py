@@ -108,7 +108,13 @@ def build_trainer(
     bundle = get_model(config.model, **overrides)
     if mesh is None:
         mesh = make_mesh(config.mesh)
-    tx = make_optimizer(config.optimizer, bundle.trainable_mask)
+    # With a trainable_mask the trainer PARTITIONS (training/partition.py):
+    # grads and optimizer state cover only the trainable subtree, so the
+    # optimizer needs no multi_transform freeze — and the backward never
+    # computes frozen weight gradients at all. (An 8B frozen base would
+    # otherwise materialize a 32 GB gradient pytree; an int8 frozen base
+    # cannot be differentiated against, period.)
+    tx = make_optimizer(config.optimizer)
 
     # Ring attention (sequence parallelism) shard_maps over this mesh.
     from serverless_learn_tpu.parallel.ring_attention import set_active_mesh
@@ -124,6 +130,14 @@ def build_trainer(
         lambda s: batch_sharding(mesh, sp_seq=sp_seq and len(s.shape) >= 2),
         spec)
 
+    from serverless_learn_tpu.training.partition import overlay, prune
+
+    def trainable_of(params):
+        """Trainable subtree (the whole tree when no mask is set)."""
+        if bundle.trainable_mask is None:
+            return params
+        return prune(params, bundle.trainable_mask(params))
+
     def init_raw(seed):
         rng = jax.random.PRNGKey(seed)
         dummy = jax.tree_util.tree_map(
@@ -138,7 +152,7 @@ def build_trainer(
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
-            opt_state=tx.init(params),
+            opt_state=tx.init(trainable_of(params)),
             model_state=model_state,
         )
 
@@ -158,7 +172,11 @@ def build_trainer(
     init_jit = jax.jit(init_raw, static_argnums=(0,),
                        out_shardings=state_shardings)
 
-    def loss_for_grad(params, model_state, batch, rng):
+    def loss_for_grad(t_params, full_params, model_state, batch, rng):
+        # Gradients flow only through ``t_params``; with a trainable_mask
+        # the frozen remainder of ``full_params`` enters as constants.
+        params = (overlay(full_params, t_params)
+                  if bundle.trainable_mask is not None else t_params)
         loss, aux = bundle.loss_fn(params, batch, rngs=rng,
                                    model_state=model_state)
         return loss, aux
@@ -177,8 +195,9 @@ def build_trainer(
     micro_shardings = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, P(None, *tuple(s.spec))), b_shardings)
 
-    def grads_and_aux(params, model_state, batch, rng):
-        """(mean grads, last model_state, mean loss, mean metrics).
+    def grads_and_aux(t_params, full_params, model_state, batch, rng):
+        """(mean grads over the TRAINABLE tree, last model_state, mean loss,
+        mean metrics).
 
         accum == 1: single whole-batch backward. accum > 1: ``lax.scan`` over
         microbatches — activations live only for one microbatch at a time,
@@ -187,7 +206,8 @@ def build_trainer(
         """
         grad_fn = jax.value_and_grad(loss_for_grad, has_aux=True)
         if accum == 1:
-            (loss, aux), grads = grad_fn(params, model_state, batch, rng)
+            (loss, aux), grads = grad_fn(t_params, full_params, model_state,
+                                         batch, rng)
             return grads, (aux["model_state"] or model_state), loss, aux["metrics"]
 
         def to_micro(x, s):
@@ -214,7 +234,7 @@ def build_trainer(
         def body(carry, xs):
             g_acc, w_acc, mstate = carry
             mb, idx = xs
-            (loss, aux), g = grad_fn(params, mstate,
+            (loss, aux), g = grad_fn(t_params, full_params, mstate,
                                      mb, jax.random.fold_in(rng, idx))
             # Losses with data-dependent normalization (MLM divides by the
             # microbatch's masked-token count) report that denominator as
@@ -229,12 +249,12 @@ def build_trainer(
                                                       aux["metrics"])))
 
         zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            lambda p: jnp.zeros(p.shape, jnp.float32), t_params)
         (g_sum, w_sum, mstate), (losses, metrics) = jax.lax.scan(
             body, (zeros, jnp.float32(0.0), model_state),
             (micro, jnp.arange(accum)))
         grads = jax.tree_util.tree_map(
-            lambda g, p: (g / w_sum).astype(p.dtype), g_sum, params)
+            lambda g, p: (g / w_sum).astype(p.dtype), g_sum, t_params)
         metrics = jax.tree_util.tree_map(lambda m: m.sum() / w_sum, metrics)
         return grads, mstate, losses.sum() / w_sum, metrics
 
@@ -246,11 +266,14 @@ def build_trainer(
     def step_fn(state: TrainState, batch):
         rng = jax.random.fold_in(jax.random.PRNGKey(config.train.seed),
                                  state.step)
+        t_params = trainable_of(state.params)
         grads, new_model_state, loss, metrics = grads_and_aux(
-            state.params, state.model_state, batch, rng)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = jax.tree_util.tree_map(
-            lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
+            t_params, state.params, state.model_state, batch, rng)
+        updates, new_opt = tx.update(grads, state.opt_state, t_params)
+        new_t = jax.tree_util.tree_map(
+            lambda p, u: (p + u.astype(p.dtype)), t_params, updates)
+        new_params = (overlay(state.params, new_t)
+                      if bundle.trainable_mask is not None else new_t)
         metrics = dict(metrics)
         schedule = make_schedule(config.optimizer)
         metrics["lr"] = (schedule(state.step) if callable(schedule)

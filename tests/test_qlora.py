@@ -1,0 +1,233 @@
+"""Partitioned frozen-base training (training/partition.py) and QLoRA.
+
+Round-5 verdict #1 machinery, pinned at small scale:
+
+* The trainer with a ``trainable_mask`` differentiates ONLY the trainable
+  subtree: frozen base params are bit-identical after a step, optimizer
+  state covers adapters only, and the LoRA gradients match a hand-rolled
+  ``jax.grad`` over the same leaves.
+* grad_accum composes with partitioning (microbatched == whole-batch).
+* An int8-quantized FROZEN base trains its LoRA adapters: the step runs
+  (int leaves are never differentiated — impossible, not just masked) and
+  the LoRA grads through the int8 base track the bf16-base grads within
+  the quantization error bound — the "gradient quality" evidence behind
+  the 8B QLoRA ladder row (``benchmarks/ladder.py --rows llama8b_real``).
+
+The reference trains nothing (``/root/reference/src/worker.cc:221-231``).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.config import (
+    DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig, TrainConfig)
+from serverless_learn_tpu.data.datasets import SyntheticSource
+from serverless_learn_tpu.parallel.mesh import make_mesh
+from serverless_learn_tpu.training.partition import overlay, prune
+from serverless_learn_tpu.training.train_step import build_trainer as _build
+
+
+def build_trainer(cfg):
+    return _build(cfg, mesh=make_mesh(cfg.mesh, devices=jax.devices()[:1]))
+
+
+def _cfg(**model_overrides):
+    return ExperimentConfig(
+        model="llama_tiny",
+        model_overrides=dict(lora_rank=4, **model_overrides),
+        mesh=MeshConfig(dp=1),
+        optimizer=OptimizerConfig(name="adamw", learning_rate=1e-2),
+        # donate_state=False: these tests read pre-step params after the
+        # step; donation would delete their buffers.
+        train=TrainConfig(batch_size=4, seed=0, donate_state=False),
+        data=DataConfig(seq_len=32),
+    )
+
+
+def _batch(trainer, cfg):
+    src = iter(SyntheticSource(trainer.bundle.make_batch, cfg.data,
+                               cfg.train.batch_size, seed=0))
+    return trainer.shard_batch(next(src))
+
+
+def test_prune_overlay_roundtrip():
+    tree = {"a": {"x": 1, "y": 2}, "b": {"z": 3}}
+    mask = {"a": {"x": True, "y": False}, "b": {"z": False}}
+    sub = prune(tree, mask)
+    assert sub == {"a": {"x": 1}}
+    merged = overlay(tree, {"a": {"x": 10}})
+    assert merged == {"a": {"x": 10, "y": 2}, "b": {"z": 3}}
+    with pytest.raises(ValueError):
+        prune(tree, jax.tree_util.tree_map(lambda _: False, tree))
+
+
+def test_frozen_base_is_bit_identical_and_opt_state_is_adapter_sized():
+    cfg = _cfg()
+    tr = build_trainer(cfg)
+    state = tr.init()
+    mask = tr.bundle.trainable_mask(state.params)
+    base_before = jax.device_get(
+        prune(state.params, jax.tree_util.tree_map(lambda m: not m, mask)))
+    batch = _batch(tr, cfg)
+    state2, metrics = tr.step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    base_after = jax.device_get(
+        prune(state2.params, jax.tree_util.tree_map(lambda m: not m, mask)))
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(base_before)[0],
+            jax.tree_util.tree_flatten_with_path(base_after)[0]):
+        assert pa == pb
+        np.testing.assert_array_equal(a, b, err_msg=str(pa))
+    # Adapters actually moved.
+    lora_before = prune(state.params, mask)
+    lora_after = prune(state2.params, mask)
+    moved = any(
+        not np.array_equal(x, y) for x, y in zip(
+            jax.tree_util.tree_leaves(jax.device_get(lora_before)),
+            jax.tree_util.tree_leaves(jax.device_get(lora_after))))
+    assert moved
+    # Optimizer state elements ~ O(trainable), not O(model).
+    import math
+
+    n_opt = sum(math.prod(np.shape(l))
+                for l in jax.tree_util.tree_leaves(state.opt_state))
+    n_train = sum(math.prod(np.shape(l))
+                  for l in jax.tree_util.tree_leaves(lora_before))
+    n_model = sum(math.prod(np.shape(l))
+                  for l in jax.tree_util.tree_leaves(state.params))
+    assert n_opt <= 3 * n_train + 64
+    assert n_opt < n_model / 10
+
+
+def test_partitioned_grads_match_manual_grad():
+    cfg = _cfg()
+    tr = build_trainer(cfg)
+    state = tr.init()
+    batch = _batch(tr, cfg)
+    mask = tr.bundle.trainable_mask(state.params)
+    sub = prune(state.params, mask)
+
+    def loss_of(sub_params):
+        params = overlay(state.params, sub_params)
+        rng = jax.random.fold_in(jax.random.PRNGKey(cfg.train.seed),
+                                 state.step)
+        loss, _ = tr.bundle.loss_fn(params, batch, rngs=rng, model_state={})
+        return loss
+
+    manual = jax.grad(loss_of)(sub)
+    # Reproduce the trainer's gradient through one sgd step of lr=1:
+    # delta = -grad for plain sgd. Use a dedicated sgd trainer to read the
+    # gradient straight off the parameter delta.
+    sgd_cfg = dataclasses.replace(
+        cfg, optimizer=OptimizerConfig(name="sgd", learning_rate=1.0))
+    tr2 = build_trainer(sgd_cfg)
+    state2 = tr2.init()
+    state2 = state2.replace(params=state.params)
+    after, _ = tr2.step(state2, batch)
+    got = jax.tree_util.tree_map(
+        lambda a, b: np.asarray(b - a),
+        jax.device_get(prune(after.params, mask)),
+        jax.device_get(sub))
+    for (pa, g), (pb, d) in zip(
+            jax.tree_util.tree_flatten_with_path(jax.device_get(manual))[0],
+            jax.tree_util.tree_flatten_with_path(got)[0]):
+        assert pa == pb
+        # bf16 compute: two differently-fused XLA graphs of the same math
+        # agree to ~1e-3 absolute on grads of this scale, not bitwise.
+        np.testing.assert_allclose(np.asarray(g), d, rtol=5e-2, atol=1e-3,
+                                   err_msg=str(pa))
+
+
+def test_grad_accum_composes_with_partitioning():
+    # sgd, not adam: adam's first step is ~sign(grad) * lr, so a
+    # near-zero gradient whose bf16 sign flips between the fused
+    # whole-batch graph and the microbatch scan flips a whole +-lr —
+    # testing the optimizer's noise amplification, not the accumulation.
+    cfg1 = dataclasses.replace(
+        _cfg(), optimizer=OptimizerConfig(name="sgd", learning_rate=1.0))
+    cfg2 = dataclasses.replace(
+        cfg1, train=TrainConfig(batch_size=4, seed=0, grad_accum=2,
+                                donate_state=False))
+    tr1, tr2 = build_trainer(cfg1), build_trainer(cfg2)
+    s1, s2 = tr1.init(), tr2.init()
+    batch = _batch(tr1, cfg1)
+    a1, m1 = tr1.step(s1, batch)
+    a2, m2 = tr2.step(s2, batch)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=1e-5)
+    mask = tr1.bundle.trainable_mask(s1.params)
+    for x, y in zip(
+            jax.tree_util.tree_leaves(
+                jax.device_get(prune(a1.params, mask))),
+            jax.tree_util.tree_leaves(
+                jax.device_get(prune(a2.params, mask)))):
+        np.testing.assert_allclose(np.asarray(x, np.float32),
+                                   np.asarray(y, np.float32),
+                                   rtol=5e-2, atol=2e-3)
+
+
+def test_int8_frozen_base_trains_lora():
+    """The QLoRA configuration end-to-end at tiny scale: int8 base params
+    (integer leaves in the pytree!), bf16 compute, LoRA-only training."""
+    cfg = _cfg(quant="int8")
+    tr = build_trainer(cfg)
+    state = tr.init()
+    # Give the zero-init int8 base real values: quantize a bf16-base init.
+    from serverless_learn_tpu.inference.quantize import quantize_params_int8
+
+    base_tr = build_trainer(_cfg())
+    bf16_params = base_tr.init().params
+    state = state.replace(params=quantize_params_int8(bf16_params))
+    batch = _batch(tr, cfg)
+    has_int8 = [l for l in jax.tree_util.tree_leaves(state.params)
+                if l.dtype == jnp.int8]
+    assert has_int8, "int8 config must store int8 kernels"
+    s2, metrics = tr.step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    s3, metrics = tr.step(s2, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_qlora_lora_grads_track_bf16_base_grads():
+    """Gradient quality: LoRA grads through the int8 base stay within a
+    few percent (relative, per-leaf norm) of the same grads through the
+    bf16 base — per-channel symmetric weight-only int8's standard
+    behavior, asserted rather than assumed (8B ladder row's evidence)."""
+    cfg_fp = _cfg()
+    tr_fp = build_trainer(cfg_fp)
+    state = tr_fp.init()
+    batch = _batch(tr_fp, cfg_fp)
+    mask = tr_fp.bundle.trainable_mask(state.params)
+    sub = prune(state.params, mask)
+
+    def grads_with(params_full, bundle):
+        def loss_of(sub_params):
+            p = overlay(params_full, sub_params)
+            loss, _ = bundle.loss_fn(p, batch, rngs=jax.random.PRNGKey(0),
+                                     model_state={})
+            return loss
+        return jax.device_get(jax.grad(loss_of)(sub))
+
+    g_fp = grads_with(state.params, tr_fp.bundle)
+
+    from serverless_learn_tpu.inference.quantize import quantize_params_int8
+
+    cfg_q = _cfg(quant="int8")
+    tr_q = build_trainer(cfg_q)
+    g_q = grads_with(quantize_params_int8(state.params), tr_q.bundle)
+
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(g_fp)[0],
+            jax.tree_util.tree_flatten_with_path(g_q)[0]):
+        assert pa == pb
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        denom = np.linalg.norm(a)
+        if denom < 1e-12:
+            continue
+        rel = np.linalg.norm(a - b) / denom
+        assert rel < 0.10, (str(pa), rel)
