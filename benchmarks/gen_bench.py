@@ -38,6 +38,9 @@ def run(model: str = "llama_tiny", batch: int = 8, prompt_len: int = 128,
 
     bundle = get_model(model, **(model_kw or {}))
     module = bundle.module
+    if quant_direct and not quant:
+        raise ValueError("quant_direct=True requires quant: the flag picks "
+                         "the int8-layout init path, not a measurement mode")
     if quant and quant_direct:
         import dataclasses
 

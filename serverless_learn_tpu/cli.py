@@ -434,7 +434,9 @@ def cmd_serve(args) -> int:
     server = GenerationServer(module, params,
                               host=args.host, port=args.port,
                               max_batch=args.max_batch,
-                              batch_wait_ms=args.batch_wait_ms)
+                              batch_wait_ms=args.batch_wait_ms,
+                              engine=args.serve_engine,
+                              chunk_size=args.chunk_size)
     log_json({"event": "serving", "addr": server.addr,
               "model": cfg.model}, stream=sys.stdout)
     try:
@@ -668,6 +670,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "requests (latency floor under load)")
     sv.add_argument("--quant", choices=["int8"], default=None,
                     help="weight-only int8 serving (see generate --quant)")
+    sv.add_argument("--serve-engine", choices=["continuous", "static"],
+                    default="continuous",
+                    help="continuous: slot-level scheduler (admit at chunk "
+                         "boundaries, retire at EOS, FIFO); static: "
+                         "round-4 group coalescer")
+    sv.add_argument("--chunk-size", type=int, default=16,
+                    help="decode tokens per jitted chunk between admission "
+                         "boundaries (continuous engine)")
     sv.set_defaults(fn=cmd_serve)
 
     w = sub.add_parser("worker", help="elastic worker: join a cluster & train")

@@ -40,14 +40,30 @@ class GenerationServer:
 
     def __init__(self, module, params, host: str = "127.0.0.1",
                  port: int = 0, conn_timeout_s: float = 60.0,
-                 max_batch: int = 8, batch_wait_ms: float = 3.0):
-        from serverless_learn_tpu.inference.batching import BatchingEngine
-
+                 max_batch: int = 8, batch_wait_ms: float = 3.0,
+                 engine: str = "continuous", chunk_size: int = 16):
         self.module = module
         self.params = params
         self.conn_timeout_s = conn_timeout_s
-        self.engine = BatchingEngine(module, params, max_batch=max_batch,
-                                     batch_wait_ms=batch_wait_ms)
+        if engine == "continuous":
+            # Slot-level scheduler (round-5): admits at chunk boundaries,
+            # retires at EOS, FIFO — no group keys, nothing starves.
+            from serverless_learn_tpu.inference.continuous import (
+                ContinuousBatchingEngine)
+
+            self.engine = ContinuousBatchingEngine(
+                module, params, max_slots=max_batch, chunk_size=chunk_size)
+        elif engine == "static":
+            # Round-4 group coalescer, kept for comparison benches.
+            from serverless_learn_tpu.inference.batching import (
+                BatchingEngine)
+
+            self.engine = BatchingEngine(module, params,
+                                         max_batch=max_batch,
+                                         batch_wait_ms=batch_wait_ms)
+        else:
+            raise ValueError(f"unknown engine {engine!r}: "
+                             "expected 'continuous' or 'static'")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))

@@ -162,7 +162,8 @@ def test_server_concurrent_clients_share_batches(model):
         GenerationServer, request)
 
     module, params = model
-    srv = GenerationServer(module, params, batch_wait_ms=200.0).start()
+    srv = GenerationServer(module, params, batch_wait_ms=200.0,
+                           engine="static").start()
     try:
         prompts = [[5, 9, 11], [7, 3, 2, 8], [4, 4], [1, 2, 3, 4, 5]]
         reps = [None] * 4
