@@ -90,21 +90,27 @@ def run(model: str = "llama_tiny", batch: int = 8, prompt_len: int = 128,
 
 def run_concurrent(model: str = "llama_tiny", clients: int = 4,
                    prompt_len: int = 128, new_tokens: int = 64,
-                   reqs: int = 3) -> dict:
+                   reqs: int = 3, engine: str = "static",
+                   stagger_ms: float = 0.0) -> dict:
     """Aggregate multi-client serving throughput: ``clients`` threads each
-    fire ``reqs`` sequential requests at a ``BatchingEngine``, once with
-    coalescing (max_batch=clients*2) and once serialized (max_batch=1 —
-    what the round-3 server did to every workload). The ratio is the
-    batching win; the round-3 verdict's bar is >= 2.5x with 4 clients.
-    Decode is HBM-bound on TPU, so batch-4 decode steps cost ~ the same
-    wall time as batch-1 — near-linear aggregate scaling is the expected
-    physics, and this row guards it."""
+    fire ``reqs`` sequential requests at the chosen engine, once batched
+    and once serialized (max_batch/max_slots=1 — what the round-3 server
+    did to every workload). The ratio is the batching win; the round-3
+    verdict's bar is >= 2.5x with 4 clients. Decode is HBM-bound on TPU,
+    so batch-4 decode steps cost ~ the same wall time as batch-1 —
+    near-linear aggregate scaling is the expected physics.
+
+    ``engine="continuous"`` measures the round-5 slot scheduler on the
+    same workload. ``stagger_ms``: per-client start offset — the arrival
+    pattern where run-to-completion groups lose (a request landing one
+    tick after dispatch waits out the whole group) and slot-level
+    admission wins. Per-request latencies are recorded; p50/p95 ride in
+    the row."""
     import threading
 
     import jax
     import jax.numpy as jnp
 
-    from serverless_learn_tpu.inference.batching import BatchingEngine
     from serverless_learn_tpu.models.registry import get_model
 
     bundle = get_model(model)
@@ -116,23 +122,42 @@ def run_concurrent(model: str = "llama_tiny", clients: int = 4,
         jax.random.randint(rng, (clients, prompt_len), 0,
                            module.cfg.vocab_size))]
 
-    def measure(max_batch: int) -> float:
-        eng = BatchingEngine(module, params, max_batch=max_batch,
-                             batch_wait_ms=5.0)
+    def make_engine(width: int):
+        if engine == "continuous":
+            from serverless_learn_tpu.inference.continuous import (
+                ContinuousBatchingEngine)
+
+            return ContinuousBatchingEngine(module, params,
+                                            max_slots=width,
+                                            chunk_size=32)
+        from serverless_learn_tpu.inference.batching import BatchingEngine
+
+        return BatchingEngine(module, params, max_batch=width,
+                              batch_wait_ms=5.0)
+
+    def measure(width: int):
+        eng = make_engine(width)
         try:
             def round_trip():
                 barrier = threading.Barrier(clients)
                 errors = []
+                lat: list = []
+                lat_lock = threading.Lock()
 
                 def client(i):
                     barrier.wait()
+                    if stagger_ms:
+                        time.sleep(stagger_ms * i / 1e3)
                     for _ in range(reqs):
+                        t0 = time.perf_counter()
                         r = eng.submit(prompts[i], new_tokens,
                                        temperature=0.0, top_k=0,
                                        eos_id=None, seed=0)
                         if "error" in r:
                             errors.append(r)
                             return
+                        with lat_lock:
+                            lat.append(time.perf_counter() - t0)
 
                 threads = [threading.Thread(target=client, args=(i,))
                            for i in range(clients)]
@@ -146,35 +171,45 @@ def run_concurrent(model: str = "llama_tiny", clients: int = 4,
                     # Fail loudly AFTER joining: a dead client thread must
                     # not let the bench report tokens never generated.
                     raise RuntimeError(f"serving errors: {errors[:3]}")
-                return dt
+                return dt, sorted(lat)
 
             # Deterministically compile EVERY batch bucket the timed round
             # could form (grouping is timing-dependent: a straggler thread
             # can split 4 clients into groups of 3+1, and an uncompiled
             # bucket inside the timed window would bill a multi-second XLA
-            # compile as serving time).
+            # compile as serving time). The continuous engine's chunk shape
+            # is bucket-independent; its warm compiles admit buckets.
             sizes = {1}
             b = 1
-            while b < min(clients, max_batch):
+            while b < min(clients, width):
                 b *= 2
-                sizes.add(min(b, max_batch))
+                sizes.add(min(b, width))
             eng.warm(prompt_len, new_tokens, batch_sizes=sorted(sizes))
             round_trip()  # warm the queue path itself
-            dt = round_trip()
-            return clients * reqs * new_tokens / dt
+            dt, lat = round_trip()
+            return clients * reqs * new_tokens / dt, lat
         finally:
             eng.stop()
 
-    serialized = measure(1)
-    batched = measure(clients * 2)
-    return {
+    serialized, _ = measure(1)
+    batched, lat = measure(clients * 2)
+    rec = {
         "metric": f"{model}_serve_concurrent_tokens_per_sec",
         "clients": clients, "prompt_len": prompt_len,
         "new_tokens": new_tokens,
         "value": round(batched, 1), "unit": "tokens/sec aggregate",
         "serialized_tokens_per_sec": round(serialized, 1),
         "batching_speedup": round(batched / serialized, 2),
+        "p50_latency_ms": round(lat[len(lat) // 2] * 1e3, 1),
+        "p95_latency_ms": round(lat[min(len(lat) - 1,
+                                        int(len(lat) * 0.95))] * 1e3, 1),
     }
+    if engine != "static":
+        rec["metric"] = f"{model}_serve_{engine}_tokens_per_sec"
+        rec["engine"] = engine
+    if stagger_ms:
+        rec["stagger_ms"] = stagger_ms
+    return rec
 
 
 def main():

@@ -448,6 +448,66 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_diloco(args) -> int:
+    """Run one DiLoCo island: local inner steps, anchor-delta outer syncs
+    through the coordinator + shard-server plane (training/diloco_dcn.py).
+    Launch one per host/world; islands tolerate each other joining,
+    crashing, and leading interchangeably."""
+    from serverless_learn_tpu.data.datasets import SyntheticSource
+    from serverless_learn_tpu.models.registry import get_model
+    from serverless_learn_tpu.training.checkpoint import (
+        LocalStore, ShardServerStore)
+    from serverless_learn_tpu.training.diloco_dcn import DilocoIsland
+    from serverless_learn_tpu.utils.metrics import log_json
+
+    if not args.coordinator:
+        raise SystemExit("diloco requires --coordinator")
+    cfg = _config_from_args(args)
+    if args.store_dir:
+        store = LocalStore(args.store_dir)
+    elif args.shard_server:
+        # The EXPLICIT flag, not cfg.control.shard_server_addr — that
+        # config field has a non-empty default, which would silently
+        # point the exchange at a server nobody asked for.
+        store = ShardServerStore(args.shard_server)
+    else:
+        raise SystemExit("diloco requires --shard-server or --store-dir "
+                         "for the anchor/delta exchange")
+    bundle = get_model(cfg.model, **cfg.model_overrides)
+    if not args.dataset:
+        # --shard-server names the anchor/delta EXCHANGE plane here; only
+        # stream training data from it when the user explicitly passes
+        # --dataset (otherwise make_source would try to stream the
+        # config's default dataset name from a server that's just a
+        # blob store for this run).
+        cfg = cfg.override(data=dataclasses.replace(
+            cfg.data, shard_server_addr=""))
+
+    def source_factory(wid):
+        from serverless_learn_tpu.training.loop import make_source
+
+        if cfg.data.shard_server_addr:
+            return iter(make_source(cfg, island.trainer))
+        # Synthetic default: distinct stream per island.
+        return iter(SyntheticSource(bundle.make_batch, cfg.data,
+                                    cfg.train.batch_size, seed=1000 + wid))
+
+    island = DilocoIsland(
+        cfg, store, args.coordinator, args.run_name,
+        source_factory=source_factory,
+        round_timeout_s=args.round_timeout_s)
+    log_json({"event": "diloco_island_up", "run": args.run_name,
+              "worker_id": island.agent.worker_id,
+              "inner_steps": island.inner_steps}, stream=sys.stdout)
+    rep = island.run_rounds(args.rounds)
+    log_json({"event": "diloco_island_done", "rounds": rep.rounds_done,
+              "steps": rep.steps_done, "led_rounds": rep.led_rounds,
+              "joined_at_round": rep.joined_at_round,
+              "final_loss": rep.losses[-1] if rep.losses else None},
+             stream=sys.stdout)
+    return 0
+
+
 def cmd_worker(args) -> int:
     """Elastic worker: register with the coordinator, train, re-mesh on
     membership changes — the successor of ``./worker ADDR``.
@@ -675,7 +735,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="continuous: slot-level scheduler (admit at chunk "
                          "boundaries, retire at EOS, FIFO); static: "
                          "round-4 group coalescer")
-    sv.add_argument("--chunk-size", type=int, default=16,
+    sv.add_argument("--chunk-size", type=int, default=32,
                     help="decode tokens per jitted chunk between admission "
                          "boundaries (continuous engine)")
     sv.set_defaults(fn=cmd_serve)
@@ -754,6 +814,22 @@ def build_parser() -> argparse.ArgumentParser:
     pub.add_argument("--merges", default=None,
                      help="text format: GPT-2-style merges.txt")
     pub.set_defaults(fn=cmd_publish)
+
+    dl = sub.add_parser("diloco",
+                        help="DiLoCo island: local training + anchor-delta "
+                             "outer syncs over the control/data plane")
+    _add_train_flags(dl)
+    dl.add_argument("--run-name", required=True,
+                    help="islands sharing this name form one DiLoCo run")
+    dl.add_argument("--rounds", type=int, default=10,
+                    help="outer rounds to participate in")
+    dl.add_argument("--store-dir", default=None,
+                    help="local directory store (testing); production uses "
+                         "--shard-server")
+    dl.add_argument("--round-timeout-s", type=float, default=60.0,
+                    help="leader waits at most this long for straggler "
+                         "deltas before averaging what's posted")
+    dl.set_defaults(fn=cmd_diloco)
 
     st = sub.add_parser("stats", help="scrape a daemon's load/RPC stats")
     st.add_argument("--addr", required=True)

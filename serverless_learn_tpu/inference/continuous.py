@@ -117,7 +117,7 @@ class ContinuousBatchingEngine:
     """Owns the device; persistent chunked decode over a slot pool."""
 
     def __init__(self, module, params, max_slots: int = 8,
-                 chunk_size: int = 16, pipeline_depth: int = 2,
+                 chunk_size: int = 32, pipeline_depth: int = 2,
                  max_top_k: int = 64):
         self.module = module
         self.params = params
@@ -301,6 +301,10 @@ class ContinuousBatchingEngine:
                                jnp.asarray(slot_ids), jnp.asarray(temp),
                                jnp.asarray(topk), jnp.asarray(eos),
                                jnp.asarray(seed))
+        try:
+            tok0.copy_to_host_async()  # overlap the tunnel RTT (see chunk)
+        except (AttributeError, RuntimeError):
+            pass
         # The admit's first tokens harvest like a 1-token chunk, in order.
         return ("admit", tok0, [(ids[i], batch[i]) for i in range(n)])
 
@@ -358,6 +362,18 @@ class ContinuousBatchingEngine:
                     self._state, toks = self._chunk_jit(self.params,
                                                         self._state)
                     self.chunks_run += 1
+                    # Start the D2H transfer NOW, behind the enqueued
+                    # compute: on a tunneled dev chip a device_get costs
+                    # ~100 ms of round trip, and serial per-chunk fetches
+                    # would dominate decode (measured 0.38x of the static
+                    # engine before this). With the copy launched at
+                    # dispatch, harvest's np.asarray finds the bytes
+                    # already en route / landed and the RTTs overlap the
+                    # in-flight chunks' compute.
+                    try:
+                        toks.copy_to_host_async()
+                    except (AttributeError, RuntimeError):
+                        pass  # platform without async D2H: harvest blocks
                     futures.append(
                         ("chunk", toks,
                          [(i, r) for i, r in enumerate(self._slots)

@@ -41,7 +41,7 @@ class GenerationServer:
     def __init__(self, module, params, host: str = "127.0.0.1",
                  port: int = 0, conn_timeout_s: float = 60.0,
                  max_batch: int = 8, batch_wait_ms: float = 3.0,
-                 engine: str = "continuous", chunk_size: int = 16):
+                 engine: str = "continuous", chunk_size: int = 32):
         self.module = module
         self.params = params
         self.conn_timeout_s = conn_timeout_s

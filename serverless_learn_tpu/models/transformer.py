@@ -424,7 +424,18 @@ class Block(nn.Module):
             mk_norm("norm_attn")(x), mask=mask, positions=positions,
             decode=decode, prefill=prefill, seq_lengths=seq_lengths)
         if cfg.n_experts > 0:
-            x = x + MoELayer(cfg, name="moe")(mk_norm("norm_mlp")(x))
+            moe_cfg = cfg
+            if decode or prefill:
+                # Inference routes PER TOKEN (group size 1): capacity is
+                # a training-efficiency construct, and grouped drops make
+                # routing depend on the other tokens in the group — under
+                # prefill that includes FUTURE positions, which would
+                # break the cached-decode == full-forward equivalence
+                # (tests/test_moe_generate.py pins it). Per-token groups
+                # give every token its full top-k experts, no drops, and
+                # identical routing between prefill and decode.
+                moe_cfg = dataclasses.replace(cfg, moe_group_size=1)
+            x = x + MoELayer(moe_cfg, name="moe")(mk_norm("norm_mlp")(x))
         else:
             x = x + MlpBlock(cfg, name="mlp")(mk_norm("norm_mlp")(x))
         return x

@@ -1,0 +1,301 @@
+"""DiLoCo over DCN: Local SGD composed with the elastic control/data plane.
+
+Round-5 verdict #4. ``training/local_sgd.py`` realizes the reference's
+gossip idea inside ONE SPMD world — replicas on the ``dp`` axis, outer
+syncs as ICI collectives inside a jit. The reference's sync, though, was
+*cross-process over the network* with tolerance of stale peers
+(``/root/reference/src/worker.cc:194-219``) — its one genuinely
+distinctive idea. This module is that idea at pod scale: each **island**
+is an independent SPMD world (a host, or an elastic multihost world) that
+trains ``inner_steps`` batches purely locally, then meets the other
+islands at an **outer boundary** through the framework's existing
+coordinator + shard-server plane:
+
+    island                      coordinator            shard server (store)
+    ─────────────────────────   ────────────────────   ─────────────────────
+    inner_steps × trainer.step  lease heartbeats       —  (ZERO model bytes)
+    ── outer boundary r ──
+    delta = anchor - params   →                        PUT round-r/delta-<id>
+    leader? (lowest LIVE id)  ←  membership snapshot
+      leader: wait for live
+      members' deltas (or
+      round timeout), average,
+      Nesterov outer step      →                       PUT round-(r+1)/anchor
+    adopt anchor r+1          ←                        GET round-(r+1)/anchor
+
+Model bytes cross DCN **only at outer boundaries** — one delta PUT and one
+anchor GET per island per round, regardless of ``inner_steps``
+(``tests/test_diloco_dcn.py`` pins wire bytes ∝ rounds, not steps).
+
+Elasticity is membership-safe by construction, the same property the
+reference's gossip bought with stale-peer tolerance:
+
+* A **crashed** island stops heartbeating; its coordinator lease expires;
+  the leader's next live-member snapshot no longer expects its delta (a
+  round timeout covers the lease window itself). No collective wedges —
+  islands never participate in each other's jits.
+* A **joining** island registers, reads ``LATEST``, adopts the current
+  anchor, and posts deltas from the next boundary on.
+* A **crashed leader** is replaced: every island re-checks the live
+  membership while polling for the next anchor, and whoever is now the
+  lowest live id assumes leadership for the round. Two transient leaders
+  can double-publish (atomic PUT, last wins) — both anchors are valid
+  averages of posted deltas, and the algorithm family tolerates that
+  inexactness by design (far tighter than the reference's pairwise-random
+  mixing ever was).
+
+The outer math mirrors ``LocalSGDTrainer``'s "average" mode exactly:
+outer_grad = anchor − mean(island params) = mean(deltas), stepped with
+Nesterov SGD (optax's trace formulation) on the anchor; the momentum tree
+is published WITH the anchor so leadership can migrate without hidden
+state. Inner optimizer state persists across rounds on each island (the
+DiLoCo recipe).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import jax
+import numpy as np
+from flax import serialization
+
+from serverless_learn_tpu.config import ExperimentConfig
+from serverless_learn_tpu.control.client import WorkerAgent
+from serverless_learn_tpu.training.train_step import build_trainer
+
+
+def _to_f32_host(tree):
+    return jax.tree_util.tree_map(
+        lambda l: np.asarray(jax.device_get(l), np.float32), tree)
+
+
+def _pack(tree) -> bytes:
+    return serialization.msgpack_serialize(
+        serialization.to_state_dict(tree))
+
+
+def _unpack(blob: bytes, template):
+    return serialization.from_state_dict(
+        template, serialization.msgpack_restore(blob))
+
+
+def _nesterov_step(anchor, grad, trace, lr: float, mu: float):
+    """optax.sgd(lr, momentum=mu, nesterov=True) on host trees:
+    trace' = g + mu * trace; update = -lr * (g + mu * trace');
+    matches LocalSGDTrainer's outer_tx bit-for-bit in f32."""
+    new_trace = jax.tree_util.tree_map(
+        lambda g, t: g + mu * t, grad, trace)
+    new_anchor = jax.tree_util.tree_map(
+        lambda a, g, t: a - lr * (g + mu * t), anchor, grad, new_trace)
+    return new_anchor, new_trace
+
+
+@dataclass
+class IslandReport:
+    rounds_done: int = 0
+    steps_done: int = 0
+    led_rounds: int = 0
+    losses: List[float] = field(default_factory=list)
+    joined_at_round: int = 0
+
+
+class DilocoIsland:
+    """One DiLoCo island: a local trainer + the outer-sync DCN client.
+
+    ``store``: LocalStore / ShardServerStore (``training/checkpoint.py``)
+    — anchors and deltas ride the same data plane as shards/checkpoints.
+    ``mesh``: this island's own device mesh (a subset of local devices in
+    tests; a whole multihost world in production). ``source_factory(wid)``
+    lets each island stream distinct data keyed by its worker id.
+    """
+
+    def __init__(self, config: ExperimentConfig, store, coordinator_addr:
+                 str, run_name: str, mesh=None,
+                 inner_steps: Optional[int] = None,
+                 outer_lr: Optional[float] = None,
+                 outer_momentum: Optional[float] = None,
+                 round_timeout_s: float = 20.0, poll_s: float = 0.05,
+                 source_factory: Optional[Callable] = None,
+                 init_timeout_s: float = 30.0):
+        lcfg = config.local_sgd
+        self.config = config
+        self.store = store
+        self.run = run_name
+        self.inner_steps = inner_steps or lcfg.inner_steps
+        self.outer_lr = outer_lr if outer_lr is not None else lcfg.outer_lr
+        self.outer_momentum = (outer_momentum if outer_momentum is not None
+                               else lcfg.outer_momentum)
+        self.round_timeout_s = round_timeout_s
+        self.poll_s = poll_s
+        self.init_timeout_s = init_timeout_s
+        if self.inner_steps < 1:
+            raise ValueError(f"inner_steps must be >= 1, "
+                             f"got {self.inner_steps}")
+        if source_factory is None:
+            raise ValueError("source_factory is required: each island "
+                             "streams its own data (see the CLI's "
+                             "synthetic default for an example)")
+        self.trainer = build_trainer(config, mesh=mesh)
+        self.source_factory = source_factory
+        self.report = IslandReport()
+        self.final_params = None  # f32 host tree after run_rounds
+        self.abort = None  # test hook: set to an Event to simulate a crash
+        self.agent = WorkerAgent(
+            coordinator_addr, advertise_addr=f"island:{run_name}",
+            name=f"diloco:{run_name}",
+            n_chips=self.trainer.mesh.size).start()
+
+    # -- store keys --------------------------------------------------------
+
+    def _k(self, *parts) -> str:
+        return "/".join((f"diloco-{self.run}",) + parts)
+
+    def _latest_round(self) -> Optional[int]:
+        if not self.store.exists(self._k("LATEST")):
+            return None
+        return int(json.loads(self.store.get(self._k("LATEST")))["round"])
+
+    # -- membership --------------------------------------------------------
+
+    def _live_ids(self) -> List[int]:
+        """Live same-run island ids straight from the coordinator — lease
+        expiry IS the failure detector (native/coordinator.cc sweeps)."""
+        peers = self.agent.client.membership().peers
+        return sorted(p.worker_id for p in peers
+                      if p.name == f"diloco:{self.run}")
+
+    # -- protocol ----------------------------------------------------------
+
+    def _publish(self, rnd: int, anchor, trace, step: int):
+        self.store.put(self._k(f"round-{rnd}", "anchor"),
+                       _pack({"params": anchor, "trace": trace}))
+        self.store.put(self._k("LATEST"),
+                       json.dumps({"round": rnd, "step": step}).encode())
+
+    def _fetch_anchor(self, rnd: int, template):
+        blob = self.store.get(self._k(f"round-{rnd}", "anchor"))
+        return _unpack(blob, {"params": template, "trace": template})
+
+    def _deltas_for(self, rnd: int) -> List[int]:
+        # Directory-style prefix: LocalStore.list walks a directory;
+        # ShardServerStore.list string-prefix-matches. Both cover this.
+        keys = self.store.list(self._k(f"round-{rnd}"))
+        return sorted(int(k.rsplit("-", 1)[1]) for k in keys
+                      if "/delta-" in k)
+
+    def _aborted(self) -> bool:
+        return self.abort is not None and self.abort.is_set()
+
+    def run_rounds(self, num_rounds: int) -> IslandReport:
+        tr = self.trainer
+        state = tr.init()
+        params_t = _to_f32_host(state.params)  # template (f32 host tree)
+
+        # Bootstrap: the lowest live id publishes round 0 from its init;
+        # everyone else adopts. A late joiner lands here too — it simply
+        # finds LATEST already present.
+        deadline = time.monotonic() + self.init_timeout_s
+        while self._latest_round() is None:
+            if self._aborted():
+                return self.report
+            # worker_id is re-read everywhere it's used: the agent
+            # re-registers under a NEW id after a lease lapse, and a
+            # stale id here would let every later round stall on a
+            # delta the membership no longer expects.
+            wid = self.agent.worker_id
+            if wid == min(self._live_ids(), default=wid):
+                zeros = jax.tree_util.tree_map(np.zeros_like, params_t)
+                self._publish(0, _to_f32_host(state.params), zeros, 0)
+                break
+            if time.monotonic() > deadline:
+                # Leave cleanly: an agent still heartbeating would keep
+                # this dead island "live" in every leader's membership
+                # snapshot, stalling each round to its timeout.
+                self.agent.stop()
+                raise TimeoutError("no DiLoCo anchor appeared; is the "
+                                   "bootstrap island alive?")
+            time.sleep(self.poll_s)
+        rnd = self._latest_round()
+        self.report.joined_at_round = rnd
+        pub = self._fetch_anchor(rnd, params_t)
+        anchor = pub["params"]
+        state = self._adopt(state, anchor)
+
+        src = self.source_factory(self.agent.worker_id)
+        while self.report.rounds_done < num_rounds:
+            if self._aborted():
+                return self.report
+            # ---- inner phase: ZERO bytes on the store -------------------
+            for _ in range(self.inner_steps):
+                batch = tr.shard_batch(next(src))
+                state, metrics = tr.step(state, batch)
+                self.report.steps_done += 1
+            loss = float(jax.device_get(metrics["loss"]))
+            self.report.losses.append(loss)
+            self.agent.report(step=self.report.steps_done, metric=loss)
+            if self._aborted():  # crash BEFORE posting: verdict churn case
+                return self.report
+            # ---- outer boundary -----------------------------------------
+            delta = jax.tree_util.tree_map(
+                lambda a, p: a - p, anchor, _to_f32_host(state.params))
+            self.store.put(
+                self._k(f"round-{rnd}",
+                        f"delta-{self.agent.worker_id}"),
+                _pack(delta))
+            self._await_next_anchor(rnd, anchor, pub["trace"], params_t)
+            if self._aborted():  # crashed while waiting: no next anchor
+                return self.report
+            pub = self._fetch_anchor(rnd + 1, params_t)
+            anchor = pub["params"]
+            state = self._adopt(state, anchor)
+            rnd += 1
+            self.report.rounds_done += 1
+        self.final_params = anchor
+        self.agent.stop()
+        return self.report
+
+    def _await_next_anchor(self, rnd: int, anchor, trace, template):
+        """Poll for round ``rnd+1``'s anchor; assume leadership if this
+        island is (or becomes, via lease expiry) the lowest live id."""
+        next_key = self._k(f"round-{rnd + 1}", "anchor")
+        deadline = time.monotonic() + self.round_timeout_s
+        wid = self.agent.worker_id
+        while not self.store.exists(next_key):
+            if self._aborted():
+                return anchor
+            live = self._live_ids()
+            if wid == min(live, default=wid):
+                posted = set(self._deltas_for(rnd))
+                waiting_on = [i for i in live if i not in posted]
+                if not waiting_on or time.monotonic() > deadline:
+                    self.report.led_rounds += 1
+                    self._lead(rnd, sorted(posted), anchor, trace, template)
+                    return anchor
+            time.sleep(self.poll_s)
+        return anchor
+
+    def _lead(self, rnd: int, posted: List[int], anchor, trace, template):
+        deltas = [_unpack(self.store.get(
+            self._k(f"round-{rnd}", f"delta-{i}")), template)
+            for i in posted]
+        n = float(len(deltas))
+        grad = jax.tree_util.tree_map(
+            lambda *ls: np.add.reduce(ls) / n, *deltas)
+        new_anchor, new_trace = _nesterov_step(
+            anchor, grad, trace, self.outer_lr, self.outer_momentum)
+        self._publish(rnd + 1, new_anchor, new_trace,
+                      self.report.steps_done)
+
+    def _adopt(self, state, anchor_f32):
+        new_params = jax.tree_util.tree_map(
+            lambda p, a: jax.device_put(a.astype(p.dtype),
+                                        p.sharding),
+            state.params, anchor_f32)
+        return state.replace(params=new_params)
+
+    def stop(self):
+        self.agent.stop()

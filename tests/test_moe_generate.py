@@ -1,0 +1,180 @@
+"""MoE generation end-to-end (round-5 verdict #3): a framework whose
+flagship family includes Mixtral-scale MoE must demonstrably SERVE one.
+
+Decode-time routing semantics (the decision the verdict asked for):
+inference routes PER TOKEN (``Block`` forces ``moe_group_size=1`` under
+``decode``/``prefill``, ``models/transformer.py``). Grouped capacity is a
+training-efficiency construct; at inference it would make a token's
+routing depend on the other tokens in its group — under prefill that
+includes FUTURE positions, so the cached incremental decode could never
+match a full forward. Per-token groups give every token its full top-k
+experts (capacity clamps to >= 1 slot, choices are distinct experts —
+no drops by construction), which is also how Mixtral-class MoEs are
+served in practice.
+
+Goldens therefore compare against a full forward of a ``moe_group_size=1``
+twin (same params — group size shapes no parameters).
+"""
+
+import dataclasses
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.inference.generate import generate, init_cache
+from serverless_learn_tpu.models.registry import get_model
+
+MOE_KW = dict(n_experts=4, moe_top_k=2, moe_capacity_factor=1.0,
+              dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def moe(devices):
+    bundle = get_model("llama_tiny", **MOE_KW)
+    params = bundle.module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return bundle.module, params
+
+
+def _per_token_twin(module):
+    """Same params, routing groups of 1 — the full-forward golden that
+    matches inference routing semantics."""
+    return type(module)(dataclasses.replace(module.cfg, moe_group_size=1))
+
+
+def test_moe_decode_matches_full_forward(moe):
+    """Incremental cached decode == full forward, position for position —
+    the golden equivalence, through expert routing."""
+    module, params = moe
+    twin = _per_token_twin(module)
+    B, T = 2, 10
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, 512)
+    full = twin.apply({"params": params}, tokens)  # [B, T, V]
+
+    cache = init_cache(module, B)
+    step_logits = []
+    for t in range(T):
+        logits, updated = module.apply(
+            {"params": params, "cache": cache}, tokens[:, t:t + 1],
+            decode=True, mutable=["cache"])
+        cache = updated["cache"]
+        step_logits.append(logits[:, 0])
+    inc = jnp.stack(step_logits, axis=1)
+    np.testing.assert_allclose(np.asarray(inc), np.asarray(full),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_moe_training_groups_would_diverge(moe):
+    """Documents WHY inference forces per-token groups: the same params
+    under training-grouped routing (tight capacity, whole-row groups)
+    produce different logits than the per-token twin — tokens drop."""
+    module, params = moe
+    twin = _per_token_twin(module)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 512)
+    grouped = module.apply({"params": params}, tokens)
+    per_token = twin.apply({"params": params}, tokens)
+    assert not np.allclose(np.asarray(grouped), np.asarray(per_token),
+                           rtol=2e-4, atol=2e-4), \
+        "tight-capacity grouped routing unexpectedly matched per-token " \
+        "routing; the inference override would be untestable"
+
+
+def test_moe_greedy_generation_matches_full_forward_argmax(moe):
+    module, params = moe
+    twin = _per_token_twin(module)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 5), 0, 512)
+    out = generate(module, params, prompt, max_new_tokens=6)
+    assert out.shape == (1, 11)
+    seq = prompt
+    for _ in range(6):
+        logits = twin.apply({"params": params}, seq)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_moe_batched_padded_prompts_match_solo(moe):
+    """The serving primitive: right-padded unequal prompts, per-sequence
+    cache indices, through expert routing."""
+    module, params = moe
+
+    def solo(prompt, n):
+        toks = generate(module, params, jnp.asarray([prompt], jnp.int32), n)
+        return [int(t) for t in jax.device_get(toks)[0][len(prompt):]]
+
+    prompts = [[5, 9, 11], [7, 3, 2, 8, 1, 30, 12], [4]]
+    P = max(len(p) for p in prompts)
+    padded = np.zeros((3, P), np.int32)
+    lens = np.zeros(3, np.int32)
+    for i, p in enumerate(prompts):
+        padded[i, :len(p)] = p
+        lens[i] = len(p)
+    toks = generate(module, params, jnp.asarray(padded), 6,
+                    prompt_lengths=jnp.asarray(lens))
+    new = np.asarray(jax.device_get(toks))[:, P:]
+    for i, p in enumerate(prompts):
+        assert new[i].tolist() == solo(p, 6), f"row {i}"
+
+
+def test_moe_sampled_generation_runs(moe):
+    module, params = moe
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 4), 0, 512)
+    out = generate(module, params, prompt, max_new_tokens=5,
+                   temperature=0.8, top_k=16, rng=jax.random.PRNGKey(0))
+    assert out.shape == (2, 9)
+    V = module.cfg.vocab_size
+    assert (np.asarray(out) >= 0).all() and (np.asarray(out) < V).all()
+
+
+def test_moe_through_continuous_engine(moe):
+    """Mixtral-tiny through the round-5 slot scheduler: concurrent
+    greedy requests, byte-identical to solo."""
+    from serverless_learn_tpu.inference.continuous import (
+        ContinuousBatchingEngine)
+
+    module, params = moe
+    eng = ContinuousBatchingEngine(module, params, max_slots=4,
+                                   chunk_size=4)
+    try:
+        prompts = [[5, 9, 11], [7, 3, 2, 8], [4, 4]]
+        results = [None] * 3
+
+        def client(i):
+            results[i] = eng.submit(prompts[i], 5, temperature=0.0,
+                                    top_k=0, eos_id=None, seed=0)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        for i, p in enumerate(prompts):
+            assert "error" not in results[i], results[i]
+            want = generate(module, params, jnp.asarray([p], jnp.int32), 5)
+            assert results[i]["new_tokens"] == [
+                int(t) for t in jax.device_get(want)[0][len(p):]]
+    finally:
+        eng.stop()
+
+
+def test_moe_serves_over_the_wire(moe):
+    """End to end: a MoE model behind the TCP server."""
+    from serverless_learn_tpu.inference.server import (
+        GenerationServer, request)
+
+    module, params = moe
+    srv = GenerationServer(module, params, engine="continuous",
+                           chunk_size=4).start()
+    try:
+        rep = request(srv.addr, {"prompt": [5, 9, 11],
+                                 "max_new_tokens": 4})
+        want = generate(module, params,
+                        jnp.asarray([[5, 9, 11]], jnp.int32), 4)
+        assert rep.get("new_tokens") == [
+            int(t) for t in jax.device_get(want)[0][3:]]
+    finally:
+        srv.stop()
