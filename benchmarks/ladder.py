@@ -507,33 +507,40 @@ def _best_of(fn, repeats=3):
 def row_decode8():
     """Weight-only int8 decode (round 4): llama_1b, int8 vs the same-shape
     bf16 baseline. The HONEST reading of this row: int8 halves resident
-    weight memory (the capacity win) and runs ~0.85x of bf16 decode on
-    this chip — decode at 1B scale is dispatch-bound (~30% of HBM BW), so
-    the byte saving buys no speed here; the row guards that the throughput
-    COST of the memory win stays bounded. Round 5: best-of-3 per arm with
-    recorded spread — the r4 row measured each arm ONCE and its 0.61-0.85x
-    swing was two independent single samples' noise compounding in a
-    ratio, tripping the guard three runs straight."""
+    weight memory (the capacity win); the RATIO guards that the
+    throughput cost of the memory win stays bounded. Round 5 round 2 of
+    methodology: the arms are INTERLEAVED pairwise — measuring all of one
+    arm then all of the other let shared-chip contention land on one arm
+    only (observed: bf16 785 tokens/s in a quiet window vs 476 under
+    contention an hour later, flipping the 'ratio' from 0.61x to 1.66x
+    with spread 0.4 inside each arm). Per-pair ratios ride in-row; the
+    reported ratio is best-int8 / best-bf16 across interleaved pairs."""
     import jax.numpy as jnp
 
     from benchmarks.gen_bench import run as gen_run
 
     kw = dict(max_seq_len=512, dtype=jnp.bfloat16,
               param_dtype=jnp.bfloat16)
-    base = _best_of(lambda: gen_run(
-        "llama_1b", batch=8, prompt_len=128, new_tokens=64, iters=3,
-        model_kw=kw))
-    q = _best_of(lambda: gen_run(
-        "llama_1b", batch=8, prompt_len=128, new_tokens=64, iters=3,
-        quant="int8", model_kw=kw))
-    rec = dict(q)
-    rec["bf16_tokens_per_sec"] = base["value"]
-    rec["bf16_values_all"] = base["values_all"]
-    rec["int8_speedup_vs_bf16"] = round(q["value"] / base["value"], 2)
-    rec["spread_rel"] = max(q["spread_rel"], base["spread_rel"])
+    pairs = []
+    for _ in range(3):
+        b = gen_run("llama_1b", batch=8, prompt_len=128, new_tokens=64,
+                    iters=3, model_kw=kw)
+        q = gen_run("llama_1b", batch=8, prompt_len=128, new_tokens=64,
+                    iters=3, quant="int8", model_kw=kw)
+        pairs.append((b, q))
+    best_b = max(p[0]["value"] for p in pairs)
+    best_q = max(p[1]["value"] for p in pairs)
+    rec = dict(max((p[1] for p in pairs), key=lambda r: r["value"]))
+    rec["bf16_tokens_per_sec"] = best_b
+    rec["bf16_values_all"] = [p[0]["value"] for p in pairs]
+    rec["values_all"] = [p[1]["value"] for p in pairs]
+    rec["pair_ratios"] = [round(p[1]["value"] / p[0]["value"], 2)
+                          for p in pairs]
+    rec["int8_speedup_vs_bf16"] = round(best_q / best_b, 2)
+    lo_q, lo_b = min(rec["values_all"]), min(rec["bf16_values_all"])
+    rec["spread_rel"] = round(max((best_q - lo_q) / best_q,
+                                  (best_b - lo_b) / best_b), 4)
     rec["device_kind"] = _device_kind()
-    # Best-of-3 tightened the single-sample noise; keep a 15% floor for
-    # residual day-scale swings (shared chip).
     return record_history(rec, HISTORY, better="max", rel_threshold=0.15,
                           key_fields=("metric", "device_kind", "batch",
                                       "prompt_len", "new_tokens"))

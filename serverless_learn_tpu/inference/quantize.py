@@ -84,6 +84,9 @@ def random_quantized_params(module, seed: int = 0) -> dict:
         if (keys[-1] == "scale" and len(keys) >= 2
                 and keys[-2] in QUANT_DIRS):
             return jnp.full(leaf.shape, 2.5 * 0.02 / 127.0, leaf.dtype)
+        if keys[-1].endswith("_scale") and keys[-1].startswith("expert_"):
+            # int8 MoE expert dequant scales (same magnitude logic).
+            return jnp.full(leaf.shape, 2.5 * 0.02 / 127.0, leaf.dtype)
         if leaf.ndim >= 2:  # embedder / unquantized kernels
             return (jax.random.normal(key, leaf.shape, jnp.float32) * 0.02
                     ).astype(leaf.dtype)
@@ -103,23 +106,45 @@ def quantize_params_int8(params: dict, n_contract: dict | None = None
     """
     n_contract = {"o_proj": 2, **(n_contract or {})}
 
-    flat_keys = {jax.tree_util.keystr(p) for p, _ in
-                 jax.tree_util.tree_flatten_with_path(params)[0]}
-    if any("expert_" in k or "moe" in k for k in flat_keys):
-        # MoE expert tensors are the BULK of an MoE model's params and are
-        # not _proj sites — quantizing only attention + lm_head would hand
-        # the user a fraction of the advertised memory halving with no
-        # warning. Refuse until expert quantization is a tested mode.
-        raise NotImplementedError(
-            "int8 quantization of MoE models is unsupported: expert "
-            "tensors (the dominant parameters) would stay unquantized")
+    def quant_expert(w, red_axis):
+        """[E, ..in.., ..out..] -> (int8, scale over non-contraction dims).
+        Per-(expert, out-channel) symmetric scaling — the same recipe as
+        the _proj sites, with the expert dim treated as a batch dim."""
+        w = jnp.asarray(w, jnp.float32)
+        s = jnp.max(jnp.abs(w), axis=red_axis) / 127.0
+        s = jnp.maximum(s, 1e-12)
+        q = jnp.clip(jnp.round(w / jnp.expand_dims(s, red_axis)),
+                     -127, 127).astype(jnp.int8)
+        return q, s.astype(jnp.float32)
 
     def walk(tree):
         if not isinstance(tree, dict):
             return tree
         out = {}
         for k, v in tree.items():
-            if (k in QUANT_DIRS and isinstance(v, dict)
+            if (k == "moe" and isinstance(v, dict)
+                    and "expert_gate" in v):
+                # MoE experts (round 5): [E, D, F] / [E, F, D] contract
+                # their middle dim; the router (tiny) stays float.
+                # STACKED pipelined trees carry [L, E, D, F] leaves —
+                # red_axis=1 there would contract the EXPERT dim (wrong
+                # math, unloadable shapes); refuse loudly as round 4 did.
+                if getattr(v["expert_gate"], "ndim", 0) != 3:
+                    raise NotImplementedError(
+                        "int8 quantization of stacked/pipelined MoE "
+                        "expert leaves (ndim "
+                        f"{getattr(v['expert_gate'], 'ndim', '?')}) is "
+                        "unsupported; serve the sequential twin "
+                        "(unstack_pipeline_params) and quantize that")
+                out[k] = {}
+                for name, val in v.items():
+                    if name in ("expert_gate", "expert_up", "expert_down"):
+                        q, s = quant_expert(val, red_axis=1)
+                        out[k][name + "_q"] = q
+                        out[k][name + "_scale"] = s
+                    else:
+                        out[k][name] = walk(val)
+            elif (k in QUANT_DIRS and isinstance(v, dict)
                     and "kernel" in v and getattr(v["kernel"], "ndim", 0) >= 2):
                 w = jnp.asarray(v["kernel"], jnp.float32)
                 nc = n_contract.get(k, 1)

@@ -143,10 +143,32 @@ class MoELayer(nn.Module):
         dispatch, combine, aux = top_k_routing(logits, E_route, K, capacity)
         self.sow("losses", "moe_aux", cfg.moe_aux_weight * aux)
 
-        init = nn.initializers.lecun_normal(in_axis=1, out_axis=2)
-        w_gate = self.param("expert_gate", init, (E, D, F), cfg.param_dtype)
-        w_up = self.param("expert_up", init, (E, D, F), cfg.param_dtype)
-        w_down = self.param("expert_down", init, (E, F, D), cfg.param_dtype)
+        if cfg.quant == "int8":
+            # Weight-only int8 experts (round 5): expert tensors are the
+            # BULK of a MoE model's params, so the capacity win demands
+            # them. Stored int8 + per-(expert, out-channel) scale —
+            # dequant is one fused multiply on the einsum's weight load;
+            # params come from inference/quantize.quantize_params_int8.
+            def qparam(name, shape, red_axis):
+                q = self.param(name + "_q", nn.initializers.zeros, shape,
+                               jnp.int8)
+                s_shape = tuple(d for i, d in enumerate(shape)
+                                if i != red_axis)
+                s = self.param(name + "_scale", nn.initializers.ones,
+                               s_shape, jnp.float32)
+                return (q.astype(cfg.dtype)
+                        * jnp.expand_dims(s, red_axis).astype(cfg.dtype))
+
+            w_gate = qparam("expert_gate", (E, D, F), 1)
+            w_up = qparam("expert_up", (E, D, F), 1)
+            w_down = qparam("expert_down", (E, F, D), 1)
+        else:
+            init = nn.initializers.lecun_normal(in_axis=1, out_axis=2)
+            w_gate = self.param("expert_gate", init, (E, D, F),
+                                cfg.param_dtype)
+            w_up = self.param("expert_up", init, (E, D, F), cfg.param_dtype)
+            w_down = self.param("expert_down", init, (E, F, D),
+                                cfg.param_dtype)
 
         # Dispatch tokens to expert slots; with batch over dp and experts
         # over ep, GSPMD lowers the e-contraction to an ICI all-to-all (or

@@ -111,8 +111,12 @@ DEFAULT_RULES = ShardingRules(
         (r"pipe_blocks/", P("pp")),
         # MoE (ops/moe.py): experts stacked on dim 0 shard over ep; inner
         # dims follow the dense-MLP tp/fsdp convention. Router replicated.
-        (r"moe/expert_(gate|up)$", P("ep", "fsdp", "tp")),
-        (r"moe/expert_down$", P("ep", "tp", "fsdp")),
+        (r"moe/expert_(gate|up)(_q)?$", P("ep", "fsdp", "tp")),
+        (r"moe/expert_down(_q)?$", P("ep", "tp", "fsdp")),
+        # int8 expert scales: [E, out-channels] — experts over ep, the
+        # channel dim matching its weight's out-dim sharding.
+        (r"moe/expert_(gate|up)_scale$", P("ep", "tp")),
+        (r"moe/expert_down_scale$", P("ep", "fsdp")),
         (r"moe/router$", P()),
         # kernel(_q)?: weight-only int8 serving stores projections as
         # kernel_q with the SAME dim layout as kernel, so both share one

@@ -263,11 +263,14 @@ class DilocoIsland:
         island is (or becomes, via lease expiry) the lowest live id."""
         next_key = self._k(f"round-{rnd + 1}", "anchor")
         deadline = time.monotonic() + self.round_timeout_s
-        wid = self.agent.worker_id
         while not self.store.exists(next_key):
             if self._aborted():
                 return anchor
             live = self._live_ids()
+            # Re-read the id every iteration: a lease lapse mid-wait
+            # re-registers the agent under a NEW id, and a hoisted read
+            # would compare a dead id against live membership forever.
+            wid = self.agent.worker_id
             if wid == min(live, default=wid):
                 posted = set(self._deltas_for(rnd))
                 waiting_on = [i for i in live if i not in posted]
@@ -282,6 +285,14 @@ class DilocoIsland:
         deltas = [_unpack(self.store.get(
             self._k(f"round-{rnd}", f"delta-{i}")), template)
             for i in posted]
+        if not deltas:
+            # Reachable: the round deadline can fire while a transient
+            # manifest RPC failure makes _deltas_for return [] (the
+            # ShardServerStore swallows IOError into an empty list).
+            # Publish the anchor UNCHANGED — liveness over progress; the
+            # posted deltas, if any exist, are simply skipped this round.
+            self._publish(rnd + 1, anchor, trace, self.report.steps_done)
+            return
         n = float(len(deltas))
         grad = jax.tree_util.tree_map(
             lambda *ls: np.add.reduce(ls) / n, *deltas)

@@ -91,16 +91,50 @@ def test_quant_logits_close_and_decode_runs(fp_model):
     assert (out >= 0).all() and (out < module.cfg.vocab_size).all()
 
 
-def test_quant_rejects_moe(devices):
-    """Expert tensors (the bulk of MoE params) are not quantized; a
-    partial quantization must refuse loudly, not silently under-deliver
-    the memory claim."""
+def test_quant_moe_experts(devices):
+    """Round 5 (round 4 refused this): MoE expert tensors — the BULK of
+    an MoE model's params — quantize to int8 + per-(expert, out-channel)
+    scales, the quant module's logits track fp32 within the error
+    budget, decode runs, and resident bytes actually halve."""
+    from serverless_learn_tpu.inference.generate import generate
+
     bundle = get_model("moe_tiny", dtype=jnp.float32,
-                       param_dtype=jnp.float32)
-    params = jax.eval_shape(lambda: bundle.module.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)))["params"]
-    with pytest.raises(NotImplementedError, match="MoE"):
-        quantize_params_int8(params)
+                       param_dtype=jnp.float32, max_seq_len=64)
+    module = bundle.module
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    qp = quantize_params_int8(params)
+    moe_q = qp["layer_0"]["moe"]
+    assert moe_q["expert_gate_q"].dtype == jnp.int8
+    assert moe_q["expert_down_q"].dtype == jnp.int8
+    assert "router" in moe_q  # tiny, stays float
+    # Dequant error bound per (expert, channel).
+    w = np.asarray(params["layer_0"]["moe"]["expert_gate"], np.float32)
+    q = np.asarray(moe_q["expert_gate_q"], np.float32)
+    s = np.asarray(moe_q["expert_gate_scale"], np.float32)
+    deq = q * s[:, None, :]
+    assert np.abs(w - deq).max() <= s.max() / 2 + 1e-7
+
+    qm = _quant_module(module)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                module.cfg.vocab_size)
+    ref = jax.device_get(module.apply({"params": params}, tokens))
+    got = jax.device_get(qm.apply({"params": qp}, tokens))
+    rel = np.abs(got - ref).max() / np.abs(ref).max()
+    assert rel < 0.05, f"relative logit error {rel}"
+
+    out = jax.device_get(generate(qm, qp,
+                                  jnp.asarray([[5, 9, 11]], jnp.int32), 6))
+    assert out.shape == (1, 9)
+
+    def nbytes(tree):
+        return sum(l.size * l.dtype.itemsize
+                   for l in jax.tree_util.tree_leaves(tree))
+
+    # f32 baseline -> int8 should cut well below 40% of the original
+    # (experts + projections are ~all the params at this shape).
+    assert nbytes(qp) < 0.4 * nbytes(params), \
+        (nbytes(qp), nbytes(params))
 
 
 def test_quant_leaves_carry_sharding_rules(fp_model):
