@@ -24,6 +24,8 @@ Rows (chip-side unless noted):
     decode8    weight-only int8 decode vs bf16 (llama_1b; capacity win,
                honest throughput cost)
     decodemoe  MoE decode (moe_tiny, per-token top-2 routing)
+    spec       speculative decode (llama_1b, 4-layer prefix draft, K=4;
+               acceptance recorded — weight-dependent)
     serve      4-client batched-serving aggregate vs serialized
     servec     continuous vs static engines under staggered arrivals
                (aggregate + p50/p95; round-5 slot scheduler)
@@ -546,6 +548,26 @@ def row_decode8():
                                       "prompt_len", "new_tokens"))
 
 
+def row_spec():
+    """Speculative decoding (round 5): prefix-draft + one-pass verify on
+    llama_1b. Exactness is free (greedy verify); throughput hinges on
+    acceptance, a WEIGHTS property — recorded in-row next to tokens/s,
+    with a self-draft arm pricing the mechanism ceiling."""
+    import jax.numpy as jnp
+
+    from benchmarks.gen_bench import run_speculative
+
+    rec = run_speculative("llama_1b", draft_layers=4, K=4, batch=8,
+                          prompt_len=128, new_tokens=64, iters=3,
+                          model_kw=dict(max_seq_len=512,
+                                        dtype=jnp.bfloat16,
+                                        param_dtype=jnp.bfloat16))
+    rec["device_kind"] = _device_kind()
+    return record_history(rec, HISTORY, better="max", rel_threshold=0.15,
+                          key_fields=("metric", "device_kind", "batch",
+                                      "prompt_len", "new_tokens", "K"))
+
+
 def row_serve():
     """Multi-client batched serving aggregate (round-3 verdict #2).
     Round 5: best-of-3 with recorded spread (verdict #6) — single-sample
@@ -728,6 +750,7 @@ ROWS = {
     "decode": row_decode,
     "decode8": row_decode8,
     "decodemoe": row_decodemoe,
+    "spec": row_spec,
     "serve": row_serve,
     "servec": row_servec,
     "llama8b": row_llama8b_width,

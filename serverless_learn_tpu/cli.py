@@ -403,14 +403,37 @@ def cmd_generate(args) -> int:
             jax.random.PRNGKey(args.seed), (1, args.prompt_len), 0,
             trainer.bundle.module.cfg.vocab_size)
     module, params = _maybe_quantize(args, trainer, params)
-    out = generate(module, params, prompt,
-                   max_new_tokens=args.max_new_tokens,
-                   temperature=args.temperature, top_k=args.top_k,
-                   eos_id=args.eos_id,
-                   rng=jax.random.PRNGKey(args.seed))
-    print(json.dumps({"checkpoint_step": ckpt_step,
-                      "prompt": np_tolist(prompt),
-                      "tokens": np_tolist(out)}))
+    stats = None
+    if args.draft_layers:
+        # Speculative decoding: prefix-draft (the target's own first N
+        # layers) + one-pass verify. Greedy-exact by construction.
+        from serverless_learn_tpu.inference.speculative import (
+            prefix_draft, speculative_generate)
+
+        if args.temperature != 0.0:
+            raise SystemExit("--draft-layers is greedy-only "
+                             "(temperature must be 0)")
+        try:
+            draft, dparams = prefix_draft(module, params,
+                                          args.draft_layers)
+        except ValueError as e:
+            raise SystemExit(str(e))
+        out, stats = speculative_generate(
+            module, params, draft, dparams, prompt,
+            max_new_tokens=args.max_new_tokens, K=args.spec_k,
+            eos_id=args.eos_id)
+    else:
+        out = generate(module, params, prompt,
+                       max_new_tokens=args.max_new_tokens,
+                       temperature=args.temperature, top_k=args.top_k,
+                       eos_id=args.eos_id,
+                       rng=jax.random.PRNGKey(args.seed))
+    rep = {"checkpoint_step": ckpt_step,
+           "prompt": np_tolist(prompt),
+           "tokens": np_tolist(out)}
+    if stats is not None:
+        rep["speculative"] = stats
+    print(json.dumps(rep))
     return 0
 
 
@@ -715,6 +738,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="weight-only quantization: restore the trained "
                         "checkpoint, then store projections int8 + scale "
                         "(half the decode HBM traffic)")
+    g.add_argument("--draft-layers", type=int, default=0,
+                   help="speculative decoding: draft with the target's "
+                        "own first N layers, verify K drafts in one "
+                        "target pass (greedy-exact; speedup tracks "
+                        "draft/target agreement)")
+    g.add_argument("--spec-k", type=int, default=4,
+                   help="drafted tokens per verify pass (--draft-layers)")
     g.set_defaults(fn=cmd_generate)
 
     sv = sub.add_parser("serve", help="serve LM generation over TCP (JSON lines)")

@@ -266,7 +266,7 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, mask=None, positions=None, decode=False,
-                 prefill=False, seq_lengths=None):
+                 prefill=False, extend=False, seq_lengths=None):
         cfg = self.cfg
         H, K, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
         dense = lambda feats, name: _proj(cfg, feats, name)
@@ -279,7 +279,7 @@ class Attention(nn.Module):
             v = v + LoRAAdapter(cfg.lora_rank, cfg.lora_alpha, (K, D),
                                 cfg.dtype, cfg.param_dtype, name="v_lora")(x)
         causal = cfg.causal
-        if decode or prefill:
+        if decode or prefill or extend:
             # Autoregressive KV cache. decode: x is the single newest token
             # per sequence ([B, 1, d_model]); K/V land at slot
             # `cache_index[b]` and attention reads the whole cache under a
@@ -318,6 +318,33 @@ class Attention(nn.Module):
                 # Attention runs causally over the padded prompt: real
                 # token i attends only [0, i] — all real under right-
                 # padding; pad rows produce garbage nobody reads.
+            elif not is_init and extend:
+                # Append T tokens at each row's current index (the
+                # speculative-verify primitive): RoPE at absolute
+                # positions ci+t, K/V written at per-row offsets, and a
+                # shifted-causal mask — query t of row b sees cached keys
+                # [0, ci_b + t]. Entries past the index that a later
+                # rollback strands are dead by the <= index mask.
+                T = x.shape[1]
+                pos0 = ci.value  # [B]
+                positions_bt = pos0[:, None] + jnp.arange(T,
+                                                          dtype=jnp.int32)
+                if cfg.use_rope:
+                    sin, cos = rope_angles(positions_bt, D, cfg.rope_theta)
+                    q = apply_rope(q, sin, cos)
+                    k = apply_rope(k, sin, cos)
+
+                def write_span(c, new, p):  # [S,K,D], [T,K,D], []
+                    z = jnp.zeros((), p.dtype)
+                    return jax.lax.dynamic_update_slice(c, new, (p, z, z))
+
+                ck.value = jax.vmap(write_span)(ck.value, k, pos0)
+                cv.value = jax.vmap(write_span)(cv.value, v, pos0)
+                ci.value = pos0 + T
+                k, v = ck.value, cv.value
+                mask = (jnp.arange(cfg.max_seq_len)[None, None, :]
+                        <= positions_bt[:, :, None])[:, None]  # [B,1,T,S]
+                causal = False
             elif not is_init:
                 if x.shape[1] != 1:
                     raise ValueError(
@@ -347,7 +374,7 @@ class Attention(nn.Module):
             k = apply_rope(k, sin, cos)
         kv_lengths = None
         if (cfg.suffix_padding_mask and mask is not None
-                and not (decode or prefill) and mask.ndim == 4
+                and not (decode or prefill or extend) and mask.ndim == 4
                 and mask.shape[1] == 1 and mask.shape[2] == 1
                 and (jnp.issubdtype(mask.dtype, jnp.integer)
                      or jnp.issubdtype(mask.dtype, jnp.bool_))):
@@ -356,7 +383,7 @@ class Attention(nn.Module):
             # Float masks are excluded — they could be additive (0 = KEEP),
             # whose row sum would be garbage lengths.
             kv_lengths = mask[:, 0, 0, :].astype(jnp.int32).sum(-1)
-        if cfg.manual_sp_axis and not (decode or prefill):
+        if cfg.manual_sp_axis and not (decode or prefill or extend):
             # Inside the pipeline's manual region with the seq dim sharded
             # over sp: hop the K/V shards around the ring directly.
             if mask is not None and kv_lengths is None:
@@ -380,7 +407,8 @@ class Attention(nn.Module):
         else:
             out = dot_product_attention(
                 q, k, v, causal=causal, mask=mask, kv_lengths=kv_lengths,
-                impl="xla" if (decode or prefill) else cfg.attention_impl,
+                impl="xla" if (decode or prefill or extend)
+                else cfg.attention_impl,
                 axis_name=cfg.sp_axis or "sp")
         y = _proj(cfg, cfg.d_model, "o_proj", n_contract=2)(out)
         if cfg.manual_tp_axis:
@@ -415,17 +443,18 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, mask=None, positions=None, decode=False,
-                 prefill=False, seq_lengths=None):
+                 prefill=False, extend=False, seq_lengths=None):
         cfg = self.cfg
         norm = (nn.RMSNorm if cfg.norm == "rms" else nn.LayerNorm)
         mk_norm = lambda name: norm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                                     name=name)
         x = x + Attention(cfg, name="attn")(
             mk_norm("norm_attn")(x), mask=mask, positions=positions,
-            decode=decode, prefill=prefill, seq_lengths=seq_lengths)
+            decode=decode, prefill=prefill, extend=extend,
+            seq_lengths=seq_lengths)
         if cfg.n_experts > 0:
             moe_cfg = cfg
-            if decode or prefill:
+            if decode or prefill or extend:
                 # Inference routes PER TOKEN (group size 1): capacity is
                 # a training-efficiency construct, and grouped drops make
                 # routing depend on the other tokens in the group — under
@@ -655,7 +684,7 @@ class Transformer(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, mask=None, positions=None, decode=False,
-                 prefill=False, seq_lengths=None):
+                 prefill=False, extend=False, seq_lengths=None):
         """tokens [B, T] int32 -> logits [B, T, vocab].
 
         ``decode=True``: autoregressive inference mode — ``tokens`` is the
@@ -667,19 +696,25 @@ class Transformer(nn.Module):
         right-padded prompts — each sequence's cache index starts at its
         own length, so one batched prefill serves unequal prompts
         (``inference/batching.py``).
+        ``extend=True``: feed T>1 tokens APPENDING at each row's current
+        cache index (causal within the new span, full visibility of the
+        cached prefix) — the speculative-verify primitive: one forward
+        scores K drafted tokens (``inference/speculative.py``).
         """
         cfg = self.cfg
-        if decode and prefill:
-            raise ValueError("decode and prefill are mutually exclusive")
-        if (decode or prefill) and cfg.pipeline:
+        if decode + prefill + extend > 1:
+            raise ValueError(
+                "decode, prefill and extend are mutually exclusive")
+        infer = decode or prefill or extend
+        if infer and cfg.pipeline:
             raise NotImplementedError(
                 "decode with pipeline=True: serve the sequential twin "
                 "instead — unstack_pipeline_params converts a pipeline "
                 "checkpoint to the per-layer layout (the generate/serve "
                 "CLIs do this automatically)")
-        if (decode or prefill) and not cfg.causal:
+        if infer and not cfg.causal:
             raise ValueError("decode requires a causal model")
-        if (decode or prefill) and not cfg.use_rope:
+        if infer and not cfg.use_rope:
             # Learned positions would need the cache index at this level.
             raise NotImplementedError("decode requires use_rope=True")
         embed = nn.Embed(cfg.vocab_size, cfg.d_model, name="embedder",
@@ -700,7 +735,7 @@ class Transformer(nn.Module):
             # pp makes GSPMD split that tail across stages instead.
             x = _shard_head_over_pp(x)
         else:
-            use_remat = cfg.remat and not (decode or prefill)
+            use_remat = cfg.remat and not infer
             block = nn.remat(Block, static_argnums=()) if use_remat else Block
             for i in range(cfg.n_layers):
                 blk = block(cfg, name=f"layer_{i}")
@@ -710,7 +745,7 @@ class Transformer(nn.Module):
                     y = blk(x, mask=mask, positions=positions)
                 else:
                     y = blk(x, mask=mask, positions=positions,
-                            decode=decode, prefill=prefill,
+                            decode=decode, prefill=prefill, extend=extend,
                             seq_lengths=seq_lengths)
                 x = constrain_residual(y)
         norm = (nn.RMSNorm if cfg.norm == "rms" else nn.LayerNorm)

@@ -154,6 +154,28 @@ SLOW_TESTS = {
     "tests/test_parallel_ingest.py::test_resnet50_device_augment_trains",
     "tests/test_tokenizer.py::test_packed_batches_train_llama_and_bert",
     "tests/test_flash_masks.py::test_dispatcher_honors_kv_lengths_alone",
+    # round 5
+    "tests/test_continuous.py::test_concurrent_greedy_exact",
+    "tests/test_continuous.py::test_mid_stream_admission_exact",
+    "tests/test_continuous.py::test_eos_retires_slot_early",
+    "tests/test_continuous.py::test_more_requests_than_slots",
+    "tests/test_continuous.py::test_mixed_sampling_in_one_batch_no_starvation",
+    "tests/test_continuous.py::test_sampled_is_reproducible_and_batch_invariant",
+    "tests/test_continuous.py::test_server_with_continuous_engine",
+    "tests/test_moe_generate.py::test_moe_through_continuous_engine",
+    "tests/test_moe_generate.py::test_moe_serves_over_the_wire",
+    "tests/test_moe_generate.py::test_moe_batched_padded_prompts_match_solo",
+    "tests/test_diloco_dcn.py::test_two_islands_converge_and_track_single_world",
+    "tests/test_diloco_dcn.py::test_island_crash_does_not_wedge_survivors",
+    "tests/test_diloco_dcn.py::test_leader_crash_hands_over",
+    "tests/test_diloco_dcn.py::test_late_joiner_adopts_current_anchor",
+    "tests/test_diloco_dcn.py::test_islands_are_sharded_worlds",
+    "tests/test_speculative.py::test_cross_draft_is_exact",
+    "tests/test_speculative.py::test_self_draft_is_exact_and_fully_accepted",
+    "tests/test_speculative.py::test_unequal_prompts_exact",
+    "tests/test_qlora.py::test_int8_frozen_base_trains_lora",
+    "tests/test_qlora.py::test_qlora_lora_grads_track_bf16_base_grads",
+    "tests/test_quantize.py::test_quant_moe_experts",
 }
 
 

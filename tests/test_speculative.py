@@ -1,0 +1,103 @@
+"""Speculative decoding: EXACT greedy equivalence is the whole contract.
+
+The draft model only proposes; every emitted token is the argmax of the
+TARGET's logits given the same prefix, so the output must be
+byte-identical to plain ``generate`` greedy for ANY draft — an adversarial
+draft can only make it slow. Pinned here with a same-model draft
+(acceptance 100%, the fast path), a differently-initialized draft
+(near-chance acceptance, the worst case), unequal padded prompts, and
+the sticky-EOS contract.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.inference.generate import generate
+from serverless_learn_tpu.inference.speculative import speculative_generate
+from serverless_learn_tpu.models.registry import get_model
+
+
+@pytest.fixture(scope="module")
+def models(devices):
+    bundle = get_model("llama_tiny", dtype=jnp.float32,
+                       param_dtype=jnp.float32, max_seq_len=64)
+    module = bundle.module
+    tparams = module.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    dparams = module.init(jax.random.PRNGKey(7),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    return module, tparams, dparams
+
+
+def _golden(module, params, prompt, n, eos_id=None):
+    return np.asarray(jax.device_get(generate(
+        module, params, jnp.asarray(prompt, jnp.int32), n, eos_id=eos_id)))
+
+
+def test_self_draft_is_exact_and_fully_accepted(models):
+    """draft == target: every draft accepted, K+1 tokens per round."""
+    module, tparams, _ = models
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, 512)
+    want = _golden(module, tparams, prompt, 12)
+    got, stats = speculative_generate(module, tparams, module, tparams,
+                                      prompt, 12, K=4)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["acceptance"] > 0.99, stats
+    # ceil(12 / (K+1)) rounds when everything accepts.
+    assert stats["rounds"] <= 3, stats
+
+
+def test_cross_draft_is_exact(models):
+    """A draft with DIFFERENT weights (chance-level agreement) changes
+    speed only — outputs still match plain target greedy exactly."""
+    module, tparams, dparams = models
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (3, 6), 0, 512)
+    want = _golden(module, tparams, prompt, 10)
+    for k in (1, 3, 5):
+        got, stats = speculative_generate(module, tparams, module, dparams,
+                                          prompt, 10, K=k)
+        np.testing.assert_array_equal(np.asarray(got), want,
+                                      err_msg=f"K={k}")
+        assert stats["rounds"] >= 2  # chance acceptance => many rounds
+
+
+def test_unequal_prompts_exact(models):
+    module, tparams, dparams = models
+    prompts = [[5, 9, 11], [7, 3, 2, 8, 1, 30, 12], [4]]
+    P = max(len(p) for p in prompts)
+    padded = np.zeros((3, P), np.int32)
+    lens = np.zeros(3, np.int32)
+    for i, p in enumerate(prompts):
+        padded[i, :len(p)] = p
+        lens[i] = len(p)
+    got, _ = speculative_generate(module, tparams, module, dparams,
+                                  jnp.asarray(padded), 8, K=3,
+                                  prompt_lengths=jnp.asarray(lens))
+    new = np.asarray(got)[:, P:]
+    for i, p in enumerate(prompts):
+        want = _golden(module, tparams, [p], 8)[0][len(p):]
+        np.testing.assert_array_equal(new[i], want, err_msg=f"row {i}")
+
+
+def test_eos_sticky_matches_generate(models):
+    module, tparams, dparams = models
+    prompt = [[5, 9, 11]]
+    first = _golden(module, tparams, prompt, 1)[0][-1]
+    want = _golden(module, tparams, prompt, 8, eos_id=int(first))
+    got, _ = speculative_generate(module, tparams, module, dparams,
+                                  jnp.asarray(prompt, jnp.int32), 8, K=3,
+                                  eos_id=int(first))
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_validation(models):
+    module, tparams, dparams = models
+    prompt = jnp.ones((1, 50), jnp.int32)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        speculative_generate(module, tparams, module, dparams, prompt,
+                             12, K=4)
+    with pytest.raises(ValueError, match="K must be"):
+        speculative_generate(module, tparams, module, dparams,
+                             jnp.ones((1, 4), jnp.int32), 4, K=0)
