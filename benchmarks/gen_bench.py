@@ -248,12 +248,11 @@ def run_speculative(model: str = "llama_1b", draft_layers: int = 4,
         float(jax.device_get(out[0, -1]))
 
     def spec_once(dm, dp):
-        out, stats = speculative_generate(dm_target, tparams, dm, dp,
+        out, stats = speculative_generate(module, tparams, dm, dp,
                                           prompt, new_tokens, K=K)
         float(jax.device_get(out[0, -1]))
         return stats
 
-    dm_target = module
     # Warm all three compiled paths.
     plain_once()
     stats_prefix = spec_once(draft, dparams)

@@ -565,7 +565,8 @@ def row_spec():
     rec["device_kind"] = _device_kind()
     return record_history(rec, HISTORY, better="max", rel_threshold=0.15,
                           key_fields=("metric", "device_kind", "batch",
-                                      "prompt_len", "new_tokens", "K"))
+                                      "prompt_len", "new_tokens", "K",
+                                      "draft_layers"))
 
 
 def row_serve():

@@ -416,12 +416,12 @@ def cmd_generate(args) -> int:
         try:
             draft, dparams = prefix_draft(module, params,
                                           args.draft_layers)
-        except ValueError as e:
+            out, stats = speculative_generate(
+                module, params, draft, dparams, prompt,
+                max_new_tokens=args.max_new_tokens, K=args.spec_k,
+                eos_id=args.eos_id)
+        except ValueError as e:  # bad --draft-layers / --spec-k / window
             raise SystemExit(str(e))
-        out, stats = speculative_generate(
-            module, params, draft, dparams, prompt,
-            max_new_tokens=args.max_new_tokens, K=args.spec_k,
-            eos_id=args.eos_id)
     else:
         out = generate(module, params, prompt,
                        max_new_tokens=args.max_new_tokens,
