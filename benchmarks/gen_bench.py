@@ -112,6 +112,7 @@ def run_concurrent(model: str = "llama_tiny", clients: int = 4,
     import jax.numpy as jnp
 
     from serverless_learn_tpu.models.registry import get_model
+    from serverless_learn_tpu.telemetry import MetricsRegistry
 
     bundle = get_model(model)
     module = bundle.module
@@ -123,17 +124,21 @@ def run_concurrent(model: str = "llama_tiny", clients: int = 4,
                            module.cfg.vocab_size))]
 
     def make_engine(width: int):
+        # Private registry per engine: the bench attaches this arm's
+        # queue-wait/TTFT percentiles to its row without cross-arm (or
+        # cross-process-default) contamination.
+        reg = MetricsRegistry()
         if engine == "continuous":
             from serverless_learn_tpu.inference.continuous import (
                 ContinuousBatchingEngine)
 
             return ContinuousBatchingEngine(module, params,
                                             max_slots=width,
-                                            chunk_size=32)
+                                            chunk_size=32, registry=reg)
         from serverless_learn_tpu.inference.batching import BatchingEngine
 
         return BatchingEngine(module, params, max_batch=width,
-                              batch_wait_ms=5.0)
+                              batch_wait_ms=5.0, registry=reg)
 
     def measure(width: int):
         eng = make_engine(width)
@@ -177,8 +182,12 @@ def run_concurrent(model: str = "llama_tiny", clients: int = 4,
             # could form (grouping is timing-dependent: a straggler thread
             # can split 4 clients into groups of 3+1, and an uncompiled
             # bucket inside the timed window would bill a multi-second XLA
-            # compile as serving time). The continuous engine's chunk shape
-            # is bucket-independent; its warm compiles admit buckets.
+            # compile as serving time). Every power-of-two bucket up to
+            # min(clients, width) is covered; the continuous engine's
+            # chunk shape is bucket-independent and its warm() gates the
+            # dispatcher so each size admits as ONE bucket — admission
+            # splits were thread-timing-dependent before (a size-2 warm
+            # admitting 1+1 compiled only the nb=1 admit; ADVICE round 5).
             sizes = {1}
             b = 1
             while b < min(clients, width):
@@ -187,12 +196,12 @@ def run_concurrent(model: str = "llama_tiny", clients: int = 4,
             eng.warm(prompt_len, new_tokens, batch_sizes=sorted(sizes))
             round_trip()  # warm the queue path itself
             dt, lat = round_trip()
-            return clients * reqs * new_tokens / dt, lat
+            return clients * reqs * new_tokens / dt, lat, eng.registry
         finally:
             eng.stop()
 
-    serialized, _ = measure(1)
-    batched, lat = measure(clients * 2)
+    serialized, _, _ = measure(1)
+    batched, lat, reg = measure(clients * 2)
     rec = {
         "metric": f"{model}_serve_concurrent_tokens_per_sec",
         "clients": clients, "prompt_len": prompt_len,
@@ -204,6 +213,16 @@ def run_concurrent(model: str = "llama_tiny", clients: int = 4,
         "p95_latency_ms": round(lat[min(len(lat) - 1,
                                         int(len(lat) * 0.95))] * 1e3, 1),
     }
+    # Telemetry-substrate percentiles (engine-side spans, warm traffic
+    # included): queue wait and TTFT ride the row so BENCH_*.json rounds
+    # can track serving latency shape, not just aggregate throughput.
+    for hname, key in (("slt_request_queue_wait_seconds", "queue_wait"),
+                       ("slt_request_ttft_seconds", "ttft")):
+        h = reg.histogram(hname, engine=engine)
+        for q, sfx in ((0.5, "p50"), (0.95, "p95")) if h.count else ():
+            p = h.percentile(q)
+            if p is not None:
+                rec[f"{key}_{sfx}_ms"] = round(p * 1e3, 2)
     if engine != "static":
         rec["metric"] = f"{model}_serve_{engine}_tokens_per_sec"
         rec["engine"] = engine

@@ -15,7 +15,12 @@ Here one typed CLI fronts everything:
     python -m serverless_learn_tpu shard-server # native data-plane daemon
     python -m serverless_learn_tpu publish      # push a dataset to the data plane
     python -m serverless_learn_tpu stats        # scrape a daemon's load/RPC stats
+    python -m serverless_learn_tpu top          # live cluster telemetry view
     python -m serverless_learn_tpu models       # list registered model families
+
+Every long-running command takes ``--metrics-port N`` to expose a
+Prometheus-style ``/metrics`` endpoint (``telemetry/``); ``top`` polls one
+or more of those endpoints into a refreshing single-screen cluster view.
 
 Configs come from ``--config FILE.json`` plus ``--set dotted.key=value``
 overrides plus dedicated flags (flags win).
@@ -133,6 +138,9 @@ def _add_train_flags(p: argparse.ArgumentParser):
                    help="checkpoint namespace inside the store (an elastic "
                         "worker saves under its --name)")
     p.add_argument("--profile-dir", help="capture a jax.profiler trace here")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="serve /metrics (Prometheus text) + /metrics.json "
+                        "from this port (0 = auto; scraped by `top`)")
     p.add_argument("-v", "--verbose", action="store_true")
     # Multi-host: either serverless bootstrap via the native coordinator
     # (--world-size) or explicit topology (--num-processes/--process-id).
@@ -147,6 +155,21 @@ def _add_train_flags(p: argparse.ArgumentParser):
                    help="explicit JAX coordination service address")
     p.add_argument("--num-processes", type=int)
     p.add_argument("--process-id", type=int)
+
+
+def _start_metrics(args):
+    """Start the /metrics exporter when --metrics-port is given; the
+    caller owns stop(). Logs the bound address so `top` users can copy it
+    (port 0 auto-assigns)."""
+    port = getattr(args, "metrics_port", None)
+    if port is None:
+        return None
+    from serverless_learn_tpu.telemetry import MetricsExporter
+    from serverless_learn_tpu.utils.metrics import log_json
+
+    exp = MetricsExporter(port=port).start()
+    log_json({"event": "metrics", "addr": exp.addr}, stream=sys.stdout)
+    return exp
 
 
 def _make_checkpointer(args, name: Optional[str] = None):
@@ -190,6 +213,7 @@ def cmd_train(args) -> int:
                 "--num-processes requires --jax-coordinator and --process-id")
         initialize(args.jax_coordinator, args.num_processes, args.process_id)
 
+    exporter = _start_metrics(args)
     try:
         cfg = _config_from_args(args)
         ckpt = _make_checkpointer(args)
@@ -235,6 +259,8 @@ def cmd_train(args) -> int:
                   **{k: round(v, 3) for k, v in summary.items()},
                   "spans": get_tracer().summary()}, stream=sys.stdout)
     finally:
+        if exporter is not None:
+            exporter.stop()
         if world is not None:
             world.shutdown()
     return 0
@@ -459,9 +485,13 @@ def cmd_serve(args) -> int:
                               max_batch=args.max_batch,
                               batch_wait_ms=args.batch_wait_ms,
                               engine=args.serve_engine,
-                              chunk_size=args.chunk_size)
+                              chunk_size=args.chunk_size,
+                              metrics_port=args.metrics_port,
+                              event_log_path=args.events_log)
     log_json({"event": "serving", "addr": server.addr,
-              "model": cfg.model}, stream=sys.stdout)
+              "model": cfg.model,
+              **({"metrics_addr": server.metrics_addr}
+                 if server.metrics_addr else {})}, stream=sys.stdout)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -518,11 +548,17 @@ def cmd_diloco(args) -> int:
     island = DilocoIsland(
         cfg, store, args.coordinator, args.run_name,
         source_factory=source_factory,
-        round_timeout_s=args.round_timeout_s)
+        round_timeout_s=args.round_timeout_s,
+        liveness_factor=args.liveness_factor)
     log_json({"event": "diloco_island_up", "run": args.run_name,
               "worker_id": island.agent.worker_id,
               "inner_steps": island.inner_steps}, stream=sys.stdout)
-    rep = island.run_rounds(args.rounds)
+    exporter = _start_metrics(args)
+    try:
+        rep = island.run_rounds(args.rounds)
+    finally:
+        if exporter is not None:
+            exporter.stop()
     log_json({"event": "diloco_island_done", "rounds": rep.rounds_done,
               "steps": rep.steps_done, "led_rounds": rep.led_rounds,
               "joined_at_round": rep.joined_at_round,
@@ -559,40 +595,45 @@ def cmd_worker(args) -> int:
     else:
         store = ShardServerStore(cfg.control.shard_server_addr)
 
-    if args.multihost:
-        from serverless_learn_tpu.training.elastic_multihost import (
-            ElasticHostSupervisor)
+    exporter = _start_metrics(args)
+    try:
+        if args.multihost:
+            from serverless_learn_tpu.training.elastic_multihost import (
+                ElasticHostSupervisor)
 
-        sup = ElasticHostSupervisor(
+            sup = ElasticHostSupervisor(
+                cfg, store,
+                coordinator_addr=cfg.control.coordinator_addr,
+                run_name=args.multihost,
+                label=args.name or None,
+                advertise_host=args.advertise_host,
+                n_chips=args.chips,
+                min_hosts=args.min_hosts,
+                verbose=args.verbose,
+            )
+            gens = sup.run()
+            log_json({"event": "worker_done", "multihost": args.multihost,
+                      "generations": len(gens),
+                      "final_step": gens[-1].end_step if gens else None},
+                     stream=sys.stdout)
+            return 0
+
+        from serverless_learn_tpu.training.elastic import ElasticTrainer
+
+        et = ElasticTrainer(
             cfg, store,
             coordinator_addr=cfg.control.coordinator_addr,
-            run_name=args.multihost,
-            label=args.name or None,
-            advertise_host=args.advertise_host,
-            n_chips=args.chips,
-            min_hosts=args.min_hosts,
+            advertise_addr=args.advertise,
+            name=args.name or f"worker-{socket.gethostname()}-{os.getpid()}",
             verbose=args.verbose,
         )
-        gens = sup.run()
-        log_json({"event": "worker_done", "multihost": args.multihost,
-                  "generations": len(gens),
-                  "final_step": gens[-1].end_step if gens else None},
-                 stream=sys.stdout)
-        return 0
-
-    from serverless_learn_tpu.training.elastic import ElasticTrainer
-
-    et = ElasticTrainer(
-        cfg, store,
-        coordinator_addr=cfg.control.coordinator_addr,
-        advertise_addr=args.advertise,
-        name=args.name or f"worker-{socket.gethostname()}-{os.getpid()}",
-        verbose=args.verbose,
-    )
-    state, losses = et.run()
-    log_json({"event": "worker_done", "steps": len(losses),
-              "final_loss": losses[-1] if losses else None,
-              "transitions": len(et.transitions)}, stream=sys.stdout)
+        state, losses = et.run()
+        log_json({"event": "worker_done", "steps": len(losses),
+                  "final_loss": losses[-1] if losses else None,
+                  "transitions": len(et.transitions)}, stream=sys.stdout)
+    finally:
+        if exporter is not None:
+            exporter.stop()
     return 0
 
 
@@ -682,12 +723,17 @@ def cmd_publish(args) -> int:
 def cmd_stats(args) -> int:
     from serverless_learn_tpu.control.client import (
         CoordinatorClient, ShardClient)
+    from serverless_learn_tpu.telemetry import publish_rpc_stats
     from serverless_learn_tpu.utils.tracing import rpc_stats
 
     cls = CoordinatorClient if args.kind == "coordinator" else ShardClient
     c = cls(args.addr)
     rep = c.stats()
     out = {"rpc": rpc_stats(rep)}
+    # Mirror the scrape into the process registry as slt_rpc_* series so a
+    # co-resident exporter (--metrics-port elsewhere in this process) and
+    # `top` see daemon RPC latencies beside host metrics.
+    publish_rpc_stats(out["rpc"], daemon=args.kind)
     if args.kind == "shard-server":
         out["bytes_served"] = rep.bytes_served
         out["bytes_stored"] = rep.bytes_stored
@@ -698,6 +744,17 @@ def cmd_stats(args) -> int:
     c.close()
     print(json.dumps(out, indent=2))
     return 0
+
+
+def cmd_top(args) -> int:
+    """Live cluster telemetry: poll /metrics endpoints, render one screen
+    (per-worker throughput, inference latency percentiles, membership)."""
+    from serverless_learn_tpu.telemetry.top import run_top
+
+    endpoints = []
+    for chunk in args.endpoints:
+        endpoints.extend(e for e in chunk.split(",") if e.strip())
+    return run_top(endpoints, interval_s=args.interval, once=args.once)
 
 
 def cmd_models(args) -> int:
@@ -768,6 +825,9 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--chunk-size", type=int, default=32,
                     help="decode tokens per jitted chunk between admission "
                          "boundaries (continuous engine)")
+    sv.add_argument("--events-log", metavar="PATH", default=None,
+                    help="append one JSONL span record per request here "
+                         "(submit/admit/first_token/done marks)")
     sv.set_defaults(fn=cmd_serve)
 
     w = sub.add_parser("worker", help="elastic worker: join a cluster & train")
@@ -859,6 +919,10 @@ def build_parser() -> argparse.ArgumentParser:
     dl.add_argument("--round-timeout-s", type=float, default=60.0,
                     help="leader waits at most this long for straggler "
                          "deltas before averaging what's posted")
+    dl.add_argument("--liveness-factor", type=float, default=3.0,
+                    help="non-leader escape hatch: after this many "
+                         "round-timeouts without a new anchor, re-check "
+                         "LATEST and challenge a hung leader")
     dl.set_defaults(fn=cmd_diloco)
 
     st = sub.add_parser("stats", help="scrape a daemon's load/RPC stats")
@@ -866,6 +930,18 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--kind", choices=["coordinator", "shard-server"],
                     default="shard-server")
     st.set_defaults(fn=cmd_stats)
+
+    tp = sub.add_parser("top", help="live cluster telemetry: poll /metrics "
+                                    "endpoints, one-screen view")
+    tp.add_argument("endpoints", nargs="+", metavar="HOST:PORT",
+                    help="metrics endpoints (comma- or space-separated), "
+                         "as printed by --metrics-port")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds")
+    tp.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (no screen control; "
+                         "counter rates need two polls and show as '-')")
+    tp.set_defaults(fn=cmd_top)
 
     m = sub.add_parser("models", help="list registered model families")
     m.set_defaults(fn=cmd_models)

@@ -48,6 +48,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from serverless_learn_tpu.inference.generate import generate
+from serverless_learn_tpu.telemetry import (RATE_BUCKETS, SIZE_BUCKETS,
+                                            Span, get_registry)
 
 
 def _bucket(n: int, floor: int = 8) -> int:
@@ -68,6 +70,7 @@ class _Pending:
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[dict] = None
     group_key: tuple = ()  # set by the engine (includes padded shapes)
+    span: Optional[Span] = None  # request trace: submit/admit/done
 
 
 def _shape_buckets(prompt_len: int, max_new: int,
@@ -91,13 +94,37 @@ class BatchingEngine:
     """Owns the device; coalesces submitted requests into batched decodes."""
 
     def __init__(self, module, params, max_batch: int = 8,
-                 batch_wait_ms: float = 3.0):
+                 batch_wait_ms: float = 3.0, registry=None):
         self.module = module
         self.params = params
         self.max_batch = max_batch
         self.batch_wait_s = batch_wait_ms / 1e3
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
+        reg = registry or get_registry()
+        self.registry = reg
+        lbl = {"engine": "static"}
+        self._m_requests = reg.counter(
+            "slt_requests_total", "requests accepted by the engine", **lbl)
+        self._m_finished = reg.counter("slt_requests_finished_total", **lbl)
+        self._m_tokens = reg.counter(
+            "slt_decode_tokens_total", "tokens returned to callers", **lbl)
+        self._m_qwait = reg.histogram(
+            "slt_request_queue_wait_seconds",
+            "submit -> batched dispatch", **lbl)
+        # This engine runs each group to completion, so first token and
+        # last token reach the host together: TTFT == latency here by
+        # construction (the continuous engine is where they part ways).
+        self._m_ttft = reg.histogram(
+            "slt_request_ttft_seconds", "submit -> first token on host",
+            **lbl)
+        self._m_latency = reg.histogram(
+            "slt_request_latency_seconds", "submit -> final token", **lbl)
+        self._m_admit_sz = reg.histogram(
+            "slt_admit_batch_size", "requests per coalesced group",
+            buckets=SIZE_BUCKETS, **lbl)
+        self._m_tps = reg.histogram(
+            "slt_request_tokens_per_sec", buckets=RATE_BUCKETS, **lbl)
         self._thread = threading.Thread(target=self._dispatch_loop,
                                         daemon=True)
         self._thread.start()
@@ -133,6 +160,8 @@ class BatchingEngine:
         p.group_key = (temperature, top_k, eos_id,
                        seed if temperature > 0 else None,
                        _shape_buckets(len(prompt), max_new, max_seq))
+        p.span = Span("request")
+        self._m_requests.inc()
         self._q.put(p)
         if not p.done.wait(timeout_s):
             return {"error": "generation timed out in the admission queue"}
@@ -186,9 +215,15 @@ class BatchingEngine:
 
         prompts = np.zeros((batch_bucket, prompt_bucket), np.int32)
         lengths = np.ones((batch_bucket,), np.int32)  # pad rows: len 1
+        self._m_admit_sz.observe(n)
         for i, p in enumerate(group):
             prompts[i, :len(p.prompt)] = p.prompt
             lengths[i] = len(p.prompt)
+            if p.span is not None:
+                p.span.mark("admit")
+                wait = p.span.between(None, "admit")
+                if wait is not None:
+                    self._m_qwait.observe(wait)
         # Pad rows replicate row 0 so they can't inject out-of-range ids.
         for i in range(n, batch_bucket):
             prompts[i] = prompts[0]
@@ -205,6 +240,17 @@ class BatchingEngine:
         for i, p in enumerate(group):
             p.result = {"new_tokens": [int(t) for t in new[i, :p.max_new]],
                         "batch_size": n}
+            self._m_finished.inc()
+            self._m_tokens.inc(p.max_new)
+            if p.span is not None:
+                p.span.mark("first_token")
+                p.span.mark("done")
+                lat = p.span.between(None, "done")
+                if lat is not None:
+                    self._m_ttft.observe(lat)
+                    self._m_latency.observe(lat)
+                    if lat > 0:
+                        self._m_tps.observe(p.max_new / lat)
             p.done.set()
 
     def warm(self, prompt_len: int, max_new: int, temperature: float = 0.0,

@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -65,6 +66,8 @@ import numpy as np
 
 from serverless_learn_tpu.inference.batching import _bucket
 from serverless_learn_tpu.inference.generate import init_cache
+from serverless_learn_tpu.telemetry import (RATE_BUCKETS, SIZE_BUCKETS,
+                                            Span, get_registry)
 
 
 def _fold_keys(seeds: jax.Array, positions: jax.Array) -> jax.Array:
@@ -111,6 +114,11 @@ class _Request:
     finished: bool = False
     admitted: bool = False  # False: still queued; True: decoding in a slot
     peak_batch: int = 1  # live slots alongside this request (stats)
+    # Set by submit() on timeout: the caller is gone, so _admit/_harvest
+    # retire the slot (or drop the queue entry) at the next boundary
+    # instead of decoding an abandoned request to its full budget.
+    cancelled: bool = False
+    span: Optional[Span] = None  # request trace: submit/admit/first/done
 
 
 class ContinuousBatchingEngine:
@@ -118,7 +126,7 @@ class ContinuousBatchingEngine:
 
     def __init__(self, module, params, max_slots: int = 8,
                  chunk_size: int = 32, pipeline_depth: int = 2,
-                 max_top_k: int = 64):
+                 max_top_k: int = 64, registry=None, event_log=None):
         self.module = module
         self.params = params
         self.max_slots = max_slots
@@ -135,6 +143,42 @@ class ContinuousBatchingEngine:
         self.chunks_run = 0
         self.requests_admitted = 0
         self.requests_finished = 0
+        self.requests_cancelled = 0
+        # warm() raises this so a known batch size admits as ONE bucket
+        # (compiling deterministically) instead of splitting on thread
+        # arrival timing; 1 in normal service.
+        self._min_admit = 1
+        self.event_log = event_log
+        reg = registry or get_registry()
+        self.registry = reg
+        lbl = {"engine": "continuous"}
+        self._m_requests = reg.counter(
+            "slt_requests_total", "requests accepted by the engine", **lbl)
+        self._m_finished = reg.counter("slt_requests_finished_total", **lbl)
+        self._m_cancelled = reg.counter(
+            "slt_requests_cancelled_total",
+            "submit() timeouts whose slot/queue entry was retired", **lbl)
+        self._m_tokens = reg.counter(
+            "slt_decode_tokens_total", "tokens returned to callers", **lbl)
+        self._m_chunks = reg.counter("slt_decode_chunks_total", **lbl)
+        self._m_qwait = reg.histogram(
+            "slt_request_queue_wait_seconds", "submit -> slot admission",
+            **lbl)
+        self._m_ttft = reg.histogram(
+            "slt_request_ttft_seconds", "submit -> first token on host",
+            **lbl)
+        self._m_latency = reg.histogram(
+            "slt_request_latency_seconds", "submit -> final token", **lbl)
+        self._m_per_tok = reg.histogram(
+            "slt_decode_seconds_per_token",
+            "per-token decode time after the first token", **lbl)
+        self._m_admit_sz = reg.histogram(
+            "slt_admit_batch_size", "requests per admit boundary",
+            buckets=SIZE_BUCKETS, **lbl)
+        self._m_tps = reg.histogram(
+            "slt_request_tokens_per_sec", buckets=RATE_BUCKETS, **lbl)
+        self._m_slots = reg.gauge(
+            "slt_slots_in_use", "occupied decode slots", **lbl)
         self._thread = threading.Thread(target=self._dispatch_loop,
                                         daemon=True)
         self._thread.start()
@@ -251,8 +295,15 @@ class ContinuousBatchingEngine:
         r = _Request(prompt=list(prompt), max_new=max_new,
                      temperature=float(temperature), top_k=int(top_k),
                      eos_id=eos_id, seed=int(seed))
+        r.span = Span("request")
+        self._m_requests.inc()
         self._q.put(r)
         if not r.done.wait(timeout_s):
+            # The caller is abandoning this request. Flag it so the
+            # dispatcher retires the slot (or queue entry) at the next
+            # admit/harvest boundary — an abandoned request must not keep
+            # decoding to full budget ahead of live traffic (ADVICE.md).
+            r.cancelled = True
             where = ("mid-decode" if r.admitted
                      else "in the admission queue")
             return {"error": f"generation timed out {where}"}
@@ -263,10 +314,30 @@ class ContinuousBatchingEngine:
     def _free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self._slots) if r is None]
 
+    def _cancel(self, r: _Request):
+        """Retire an abandoned request: its submitter already returned."""
+        r.finished = True
+        r.result = {"error": "cancelled after submit timeout"}
+        self.requests_cancelled += 1
+        self._m_cancelled.inc()
+        if r.span is not None:
+            r.span.mark("cancelled")
+            if self.event_log is not None:
+                self.event_log.emit(r.span.to_event())
+
     def _admit(self, staged: List[_Request]) -> Optional[tuple]:
+        # Timed-out submitters never decode: drop their queue entries
+        # before they ever take a slot.
+        keep = []
+        for r in staged:
+            if r.cancelled and not r.finished:
+                self._cancel(r)
+            elif not r.finished:
+                keep.append(r)
+        staged[:] = keep
         free = self._free_slots()
         n = min(len(free), len(staged))
-        if n == 0:
+        if n < max(1, min(self._min_admit, self.max_slots)):
             return None
         batch = [staged.pop(0) for _ in range(n)]
         ids = free[:n]
@@ -290,8 +361,15 @@ class ContinuousBatchingEngine:
             seed[i] = r.seed & 0xFFFFFFFF
             r.admitted = True
             self._slots[ids[i]] = r
+            if r.span is not None:
+                r.span.mark("admit")
+                wait = r.span.between(None, "admit")
+                if wait is not None:
+                    self._m_qwait.observe(wait)
         self.requests_admitted += n
+        self._m_admit_sz.observe(n)
         live = self.max_slots - len(self._free_slots())
+        self._m_slots.set(live)
         for r in self._slots:
             if r is not None:
                 r.peak_batch = max(r.peak_batch, live)
@@ -319,6 +397,19 @@ class ContinuousBatchingEngine:
         for sid, r in snapshot:
             if r.finished:
                 continue  # tokens from a chunk dispatched before retirement
+            if r.cancelled:
+                # Submit timed out mid-decode: retire the slot at this
+                # boundary; the freed slot admits queued live traffic at
+                # the next _admit instead of decoding to full budget.
+                self._cancel(r)
+                if self._slots[sid] is r:
+                    self._slots[sid] = None
+                continue
+            if r.span is not None and "first_token" not in r.span.marks:
+                r.span.mark("first_token")
+                ttft = r.span.between(None, "first_token")
+                if ttft is not None:
+                    self._m_ttft.observe(ttft)
             for t in rows[sid]:
                 r.tokens.append(int(t))
                 if len(r.tokens) >= r.max_new:
@@ -335,9 +426,26 @@ class ContinuousBatchingEngine:
                 r.result = {"new_tokens": r.tokens[:r.max_new],
                             "batch_size": r.peak_batch}
                 self.requests_finished += 1
+                self._m_finished.inc()
+                self._m_tokens.inc(r.max_new)
+                if r.span is not None:
+                    r.span.mark("done")
+                    lat = r.span.between(None, "done")
+                    if lat is not None:
+                        self._m_latency.observe(lat)
+                        if lat > 0:
+                            self._m_tps.observe(r.max_new / lat)
+                    decode = r.span.between("first_token", "done")
+                    if decode is not None and r.max_new > 1:
+                        self._m_per_tok.observe(decode / (r.max_new - 1))
+                    if self.event_log is not None:
+                        r.span.meta["max_new"] = r.max_new
+                        r.span.meta["batch_size"] = r.peak_batch
+                        self.event_log.emit(r.span.to_event())
                 if self._slots[sid] is r:
                     self._slots[sid] = None
                 r.done.set()
+        self._m_slots.set(self.max_slots - len(self._free_slots()))
 
     def _dispatch_loop(self):
         futures: deque = deque()
@@ -362,6 +470,7 @@ class ContinuousBatchingEngine:
                     self._state, toks = self._chunk_jit(self.params,
                                                         self._state)
                     self.chunks_run += 1
+                    self._m_chunks.inc()
                     # Start the D2H transfer NOW, behind the enqueued
                     # compute: on a tunneled dev chip a device_get costs
                     # ~100 ms of round trip, and serial per-chunk fetches
@@ -408,7 +517,15 @@ class ContinuousBatchingEngine:
     def warm(self, prompt_len: int, max_new: int, batch_sizes=(1,),
              temperature: float = 0.0, top_k: int = 0):
         """Pre-compile the admit buckets + the chunk for a known workload
-        by pushing synthetic requests through the real dispatcher."""
+        by pushing synthetic requests through the real dispatcher.
+
+        Each batch size admits ATOMICALLY: ``_min_admit`` gates the
+        dispatcher until all ``n`` warm requests are staged, so warm
+        deterministically compiles the admit bucket for n — without the
+        gate, admission splits were thread-arrival-timing-dependent (a
+        size-2 warm could admit as 1+1, compiling only the nb=1 bucket)
+        and the timed round could pay an XLA compile the warm was
+        supposed to absorb (ADVICE.md round 5)."""
         del max_new  # chunk shape is workload-independent
         for n in batch_sizes:
             results = [None] * n
@@ -418,12 +535,16 @@ class ContinuousBatchingEngine:
                     [1] * prompt_len, min(2, self.chunk_size),
                     temperature, top_k, None, 0)
 
-            threads = [threading.Thread(target=_one, args=(i,))
-                       for i in range(n)]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join(timeout=600)
+            self._min_admit = min(n, self.max_slots)
+            try:
+                threads = [threading.Thread(target=_one, args=(i,))
+                           for i in range(n)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=600)
+            finally:
+                self._min_admit = 1
             bad = [r for r in results if not r or "error" in r]
             if bad:
                 # A warm that compiled nothing must not return as if it

@@ -41,10 +41,18 @@ class GenerationServer:
     def __init__(self, module, params, host: str = "127.0.0.1",
                  port: int = 0, conn_timeout_s: float = 60.0,
                  max_batch: int = 8, batch_wait_ms: float = 3.0,
-                 engine: str = "continuous", chunk_size: int = 32):
+                 engine: str = "continuous", chunk_size: int = 32,
+                 registry=None, metrics_port: Optional[int] = None,
+                 event_log_path: Optional[str] = None):
+        from serverless_learn_tpu.telemetry import (JsonlEventLog,
+                                                    get_registry)
+
         self.module = module
         self.params = params
         self.conn_timeout_s = conn_timeout_s
+        self.registry = registry or get_registry()
+        self.event_log = (JsonlEventLog(event_log_path)
+                          if event_log_path else None)
         if engine == "continuous":
             # Slot-level scheduler (round-5): admits at chunk boundaries,
             # retires at EOS, FIFO — no group keys, nothing starves.
@@ -52,7 +60,8 @@ class GenerationServer:
                 ContinuousBatchingEngine)
 
             self.engine = ContinuousBatchingEngine(
-                module, params, max_slots=max_batch, chunk_size=chunk_size)
+                module, params, max_slots=max_batch, chunk_size=chunk_size,
+                registry=self.registry, event_log=self.event_log)
         elif engine == "static":
             # Round-4 group coalescer, kept for comparison benches.
             from serverless_learn_tpu.inference.batching import (
@@ -60,10 +69,27 @@ class GenerationServer:
 
             self.engine = BatchingEngine(module, params,
                                          max_batch=max_batch,
-                                         batch_wait_ms=batch_wait_ms)
+                                         batch_wait_ms=batch_wait_ms,
+                                         registry=self.registry)
         else:
             raise ValueError(f"unknown engine {engine!r}: "
                              "expected 'continuous' or 'static'")
+        # Scrapeable telemetry endpoint (slt top / Prometheus). None = off;
+        # 0 = auto-assign (the addr rides in self.metrics_addr).
+        self._exporter = None
+        self.metrics_addr: Optional[str] = None
+        if metrics_port is not None:
+            from serverless_learn_tpu.telemetry import MetricsExporter
+
+            self._exporter = MetricsExporter(self.registry, host=host,
+                                             port=metrics_port).start()
+            self.metrics_addr = self._exporter.addr
+        self._m_requests = self.registry.counter(
+            "slt_server_requests_total", "requests answered over the wire")
+        self._m_errors = self.registry.counter(
+            "slt_server_errors_total", "error replies (validation + engine)")
+        self._m_latency = self.registry.histogram(
+            "slt_server_request_seconds", "handle() wall time")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -82,6 +108,21 @@ class GenerationServer:
     # -- request handling --------------------------------------------------
 
     def handle(self, req: dict) -> dict:
+        try:
+            rep = self._handle(req)
+        except Exception:
+            # The caller turns this into an error reply; count it as one.
+            self._m_requests.inc()
+            self._m_errors.inc()
+            raise
+        self._m_requests.inc()
+        if "error" in rep:
+            self._m_errors.inc()
+        elif "latency_ms" in rep:
+            self._m_latency.observe(rep["latency_ms"] / 1e3)
+        return rep
+
+    def _handle(self, req: dict) -> dict:
         t0 = time.perf_counter()
         prompt = req.get("prompt")
         if (not isinstance(prompt, list) or not prompt
@@ -216,6 +257,8 @@ class GenerationServer:
         for t, _ in live:
             t.join(timeout=30.0)
         self.engine.stop()
+        if self._exporter is not None:
+            self._exporter.stop()
 
 
 def request(addr: str, req: dict, timeout: float = 120.0) -> dict:

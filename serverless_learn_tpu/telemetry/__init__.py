@@ -1,0 +1,48 @@
+"""Unified cluster telemetry.
+
+One process-wide :class:`MetricsRegistry` (counters / gauges / fixed-bucket
+histograms) plus request :class:`Span` tracing, exposed over HTTP by
+:class:`MetricsExporter` (`/metrics` Prometheus text, `/metrics.json`) and
+rendered live by ``slt top`` (``telemetry/top.py``). Every layer publishes
+into it: the inference engines (queue-wait, admit batch size, TTFT,
+per-token decode time, tokens/s, cancellations), the training loop (step
+time, samples/sec/chip, MFU, grad-accum), the elastic/DiLoCo control plane
+(membership, heartbeat RTT, lease expiries, round lag, liveness escapes),
+and the native daemons' ``StatsReply`` via :func:`publish_rpc_stats`.
+
+See the "Observability" section of ``docs/ARCHITECTURE.md`` for the metric
+naming scheme and endpoint formats.
+"""
+
+from serverless_learn_tpu.telemetry.exporter import (MetricsExporter,
+                                                     fetch_text)
+from serverless_learn_tpu.telemetry.registry import (LATENCY_BUCKETS,
+                                                     RATE_BUCKETS,
+                                                     SIZE_BUCKETS, Counter,
+                                                     Gauge, Histogram,
+                                                     JsonlEventLog,
+                                                     MetricsRegistry, Span,
+                                                     get_registry)
+
+__all__ = [
+    "LATENCY_BUCKETS", "RATE_BUCKETS", "SIZE_BUCKETS",
+    "Counter", "Gauge", "Histogram", "JsonlEventLog", "MetricsRegistry",
+    "MetricsExporter", "Span", "fetch_text", "get_registry",
+    "publish_rpc_stats",
+]
+
+
+def publish_rpc_stats(summary, registry=None, daemon: str = ""):
+    """Scrape a ``tracing.rpc_stats``/``Tracer.summary``-shaped dict into
+    the registry, one series per RPC. Gauges, not counters: the values are
+    cumulative totals owned by the daemon — re-scraping overwrites, so a
+    daemon restart never double-counts."""
+    reg = registry or get_registry()
+    for name, s in summary.items():
+        labels = {"rpc": name.split("/", 1)[-1]}
+        if daemon:
+            labels["daemon"] = daemon
+        reg.gauge("slt_rpc_calls", **labels).set(s.get("count", 0))
+        reg.gauge("slt_rpc_time_seconds", **labels).set(s.get("total_s", 0.0))
+        reg.gauge("slt_rpc_max_seconds", **labels).set(s.get("max_s", 0.0))
+    return reg

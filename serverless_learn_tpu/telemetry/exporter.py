@@ -1,0 +1,87 @@
+"""HTTP metrics endpoint: a tiny stdlib thread serving the registry.
+
+    GET /metrics       Prometheus text exposition (0.0.4)
+    GET /metrics.json  nested JSON snapshot (same data, typed)
+    GET /healthz       {"ok": true}
+
+One ThreadingHTTPServer on a daemon thread — zero dependencies, safe to
+embed in a serving process (scrapes read a consistent snapshot under the
+registry lock; they never touch the device). Every process that wants to
+appear in ``slt top`` starts one of these (``--metrics-port`` on the CLI's
+serve/train/worker/diloco commands).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from serverless_learn_tpu.telemetry.registry import (MetricsRegistry,
+                                                     get_registry)
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsExporter:
+    """Serve one registry over HTTP from a background thread."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.registry = registry or get_registry()
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # no per-scrape stderr spam
+                pass
+
+            def _reply(self, code: int, ctype: str, body: bytes):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                try:
+                    if path == "/metrics":
+                        body = exporter.registry.render_prometheus()
+                        self._reply(200, PROM_CONTENT_TYPE, body.encode())
+                    elif path == "/metrics.json":
+                        body = json.dumps(exporter.registry.snapshot())
+                        self._reply(200, "application/json", body.encode())
+                    elif path == "/healthz":
+                        self._reply(200, "application/json", b'{"ok": true}')
+                    else:
+                        self._reply(404, "text/plain", b"not found\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # scraper hung up mid-reply; nothing to salvage
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.addr = f"{host}:{self._httpd.server_address[1]}"
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsExporter":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def fetch_text(addr: str, path: str = "/metrics",
+               timeout: float = 5.0) -> str:
+    """One scrape of ``host:port`` (no scheme) — the client `slt top` and
+    the endpoint tests share."""
+    from urllib.request import urlopen
+
+    with urlopen(f"http://{addr}{path}", timeout=timeout) as r:
+        return r.read().decode()

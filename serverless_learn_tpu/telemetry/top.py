@@ -1,0 +1,251 @@
+"""`slt top`: a refreshing single-screen cluster view.
+
+Polls one or more `/metrics` endpoints (``telemetry/exporter.py``) and
+renders per-worker throughput, inference latency percentiles, slot
+occupancy, training step rate/MFU and membership churn in one table —
+the "what is the cluster doing right now?" the reference answered with
+std::cout narration. ``--once`` prints a single snapshot (totals and
+gauges; rates need two polls); live mode recomputes counter rates from
+successive scrapes and redraws in place.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from serverless_learn_tpu.telemetry.exporter import fetch_text
+from serverless_learn_tpu.telemetry.registry import percentile_from_buckets
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """Parse an exposition into
+    {"types": {name: type}, "values": {name: summed value},
+     "hists": {name: {"buckets": [...], "cumulative": [...],
+                      "sum": s, "count": c}}}.
+    Series are summed across labels — `slt top` shows per-endpoint rollups,
+    not per-label drilldowns."""
+    types: Dict[str, str] = {}
+    values: Dict[str, float] = {}
+    hists: Dict[str, dict] = {}
+
+    def hist_for(name: str) -> dict:
+        return hists.setdefault(
+            name, {"bucket_counts": {}, "sum": 0.0, "count": 0})
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        try:
+            series, val_s = line.rsplit(" ", 1)
+            value = float(val_s)
+        except ValueError:
+            continue
+        name, labels = series, {}
+        if "{" in series:
+            name, _, rest = series.partition("{")
+            for item in rest.rstrip("}").split(","):
+                if "=" in item:
+                    k, _, v = item.partition("=")
+                    labels[k.strip()] = v.strip().strip('"')
+        base = name
+        for sfx in ("_bucket", "_sum", "_count"):
+            if name.endswith(sfx) and types.get(name[:-len(sfx)]) == \
+                    "histogram":
+                base = name[:-len(sfx)]
+                h = hist_for(base)
+                if sfx == "_bucket":
+                    le = labels.get("le", "+Inf")
+                    key = float("inf") if le == "+Inf" else float(le)
+                    h["bucket_counts"][key] = (
+                        h["bucket_counts"].get(key, 0.0) + value)
+                elif sfx == "_sum":
+                    h["sum"] += value
+                else:
+                    h["count"] += int(value)
+                break
+        else:
+            values[name] = values.get(name, 0.0) + value
+    out_h = {}
+    for name, h in hists.items():
+        les = sorted(h["bucket_counts"])
+        out_h[name] = {
+            "buckets": [le for le in les if le != float("inf")],
+            "cumulative": [h["bucket_counts"][le] for le in les],
+            "sum": h["sum"], "count": h["count"]}
+    return {"types": types, "values": values, "hists": out_h}
+
+
+def _p(h: Optional[dict], q: float) -> Optional[float]:
+    if not h or not h["count"]:
+        return None
+    return percentile_from_buckets(h["buckets"], h["cumulative"], q)
+
+
+def _ms(x: Optional[float]) -> str:
+    return "-" if x is None else f"{x * 1e3:.1f}"
+
+
+def _num(x: Optional[float], nd: int = 1) -> str:
+    if x is None:
+        return "-"
+    return f"{x:.{nd}f}" if abs(x) < 1e5 else f"{x:.3g}"
+
+
+class EndpointState:
+    """One endpoint's latest scrape plus the previous one for rates."""
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.data: Optional[dict] = None
+        self.prev: Optional[dict] = None
+        self.t: Optional[float] = None
+        self.t_prev: Optional[float] = None
+        self.error: Optional[str] = None
+
+    def poll(self):
+        self.prev, self.t_prev = self.data, self.t
+        try:
+            self.data = parse_prometheus_text(fetch_text(self.addr))
+            self.t = time.monotonic()
+            self.error = None
+        except Exception as e:
+            self.data, self.error = None, f"{type(e).__name__}: {e}"
+
+    def rate(self, name: str) -> Optional[float]:
+        """Counter rate between the last two polls; None on one poll."""
+        if (self.data is None or self.prev is None
+                or self.t is None or self.t_prev is None):
+            return None
+        dt = self.t - self.t_prev
+        if dt <= 0:
+            return None
+        now = self.data["values"].get(name)
+        before = self.prev["values"].get(name)
+        if now is None or before is None:
+            return None
+        return max(0.0, (now - before) / dt)
+
+    def val(self, name: str) -> Optional[float]:
+        if self.data is None:
+            return None
+        return self.data["values"].get(name)
+
+    def hist(self, name: str) -> Optional[dict]:
+        if self.data is None:
+            return None
+        return self.data["hists"].get(name)
+
+
+def render(states: List[EndpointState]) -> str:
+    """One screenful: a roles line per endpoint. A process exposing both
+    trainer and inference metrics (tests, co-located workers) gets a line
+    per role."""
+    lines = [f"slt top — {len(states)} endpoint(s) — "
+             + time.strftime("%H:%M:%S")]
+    infer_rows: List[List[str]] = []
+    train_rows: List[List[str]] = []
+    other_rows: List[str] = []
+    for st in states:
+        if st.data is None:
+            other_rows.append(f"  {st.addr:<22} DOWN  {st.error}")
+            continue
+        roles = 0
+        if (st.val("slt_requests_total") is not None
+                or st.val("slt_server_requests_total") is not None):
+            roles += 1
+            tok_rate = st.rate("slt_decode_tokens_total")
+            infer_rows.append([
+                st.addr,
+                _num(st.val("slt_requests_total"), 0),
+                _num(st.val("slt_server_errors_total") or 0, 0),
+                _num(st.val("slt_requests_cancelled_total") or 0, 0),
+                f"{_num(st.val('slt_slots_in_use'), 0)}",
+                _ms(_p(st.hist("slt_request_queue_wait_seconds"), 0.5))
+                + "/" + _ms(_p(st.hist("slt_request_queue_wait_seconds"),
+                               0.95)),
+                _ms(_p(st.hist("slt_request_ttft_seconds"), 0.5)) + "/"
+                + _ms(_p(st.hist("slt_request_ttft_seconds"), 0.95)),
+                _ms(_p(st.hist("slt_request_latency_seconds"), 0.95)),
+                _num(st.val("slt_decode_tokens_total"), 0),
+                "-" if tok_rate is None else _num(tok_rate),
+            ])
+        if st.val("slt_train_steps_total") is not None:
+            roles += 1
+            train_rows.append([
+                st.addr,
+                _num(st.val("slt_train_steps_total"), 0),
+                _ms(_p(st.hist("slt_train_step_seconds"), 0.5)),
+                _num(st.val("slt_train_samples_per_sec")),
+                _num(st.val("slt_train_samples_per_sec_per_chip")),
+                _num(st.val("slt_train_mfu"), 3),
+                _num(st.val("slt_train_loss"), 4),
+                _num(st.val("slt_membership_size"), 0),
+                _num(st.val("slt_membership_epoch"), 0),
+                _num(st.val("slt_diloco_rounds_total"), 0),
+            ])
+        if roles == 0:
+            other_rows.append(f"  {st.addr:<22} up (no slt_ metrics yet)")
+    if infer_rows:
+        lines.append("")
+        lines.append("  INFERENCE")
+        header = ["endpoint", "reqs", "err", "cancel", "slots",
+                  "qwait p50/p95 ms", "ttft p50/p95 ms", "lat p95 ms",
+                  "tokens", "tok/s"]
+        lines += _table(header, infer_rows)
+    if train_rows:
+        lines.append("")
+        lines.append("  TRAINING")
+        header = ["endpoint", "step", "step p50 ms", "samples/s",
+                  "sps/chip", "mfu", "loss", "members", "epoch", "rounds"]
+        lines += _table(header, train_rows)
+    if other_rows:
+        lines.append("")
+        lines += other_rows
+    return "\n".join(lines) + "\n"
+
+
+def _table(header: List[str], rows: List[List[str]]) -> List[str]:
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+              for i in range(len(header))]
+    out = ["  " + "  ".join(h.ljust(w) for h, w in zip(header, widths))]
+    for r in rows:
+        out.append("  " + "  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return out
+
+
+def run_top(endpoints: List[str], interval_s: float = 2.0,
+            once: bool = False, iterations: Optional[int] = None,
+            stream=None) -> int:
+    """Poll + render loop. ``once``: single snapshot, no screen control.
+    ``iterations`` bounds the live loop (tests); default runs until ^C."""
+    stream = stream or sys.stdout
+    states = [EndpointState(e.strip()) for e in endpoints if e.strip()]
+    if not states:
+        print("no endpoints given", file=sys.stderr)
+        return 2
+    for st in states:
+        st.poll()
+    if once:
+        stream.write(render(states))
+        stream.flush()
+        return 0
+    n = 0
+    try:
+        while iterations is None or n < iterations:
+            time.sleep(interval_s)
+            for st in states:
+                st.poll()
+            stream.write("\x1b[2J\x1b[H" + render(states))
+            stream.flush()
+            n += 1
+    except KeyboardInterrupt:
+        pass
+    return 0

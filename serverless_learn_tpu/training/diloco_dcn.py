@@ -65,6 +65,7 @@ from flax import serialization
 
 from serverless_learn_tpu.config import ExperimentConfig
 from serverless_learn_tpu.control.client import WorkerAgent
+from serverless_learn_tpu.telemetry import get_registry
 from serverless_learn_tpu.training.train_step import build_trainer
 
 
@@ -120,7 +121,8 @@ class DilocoIsland:
                  outer_momentum: Optional[float] = None,
                  round_timeout_s: float = 20.0, poll_s: float = 0.05,
                  source_factory: Optional[Callable] = None,
-                 init_timeout_s: float = 30.0):
+                 init_timeout_s: float = 30.0,
+                 liveness_factor: float = 3.0, registry=None):
         lcfg = config.local_sgd
         self.config = config
         self.store = store
@@ -132,6 +134,21 @@ class DilocoIsland:
         self.round_timeout_s = round_timeout_s
         self.poll_s = poll_s
         self.init_timeout_s = init_timeout_s
+        # Non-leader escape hatch (ADVICE round 5): no new anchor for
+        # liveness_factor * round_timeout_s means the leader is hung —
+        # lease expiry detects crashed processes, not processes whose
+        # heartbeat thread outlives a wedged training thread.
+        self.liveness_factor = liveness_factor
+        reg = registry or get_registry()
+        self._m_rounds = reg.counter("slt_diloco_rounds_total")
+        self._m_led = reg.counter("slt_diloco_led_rounds_total")
+        self._m_escapes = reg.counter(
+            "slt_diloco_liveness_escapes_total",
+            "rounds a non-leader force-led past a hung leader")
+        self._m_round = reg.gauge("slt_diloco_round", "current outer round")
+        self._m_lag = reg.gauge(
+            "slt_diloco_anchor_lag_rounds",
+            "LATEST round minus this island's round, when last checked")
         if self.inner_steps < 1:
             raise ValueError(f"inner_steps must be >= 1, "
                              f"got {self.inner_steps}")
@@ -254,15 +271,31 @@ class DilocoIsland:
             state = self._adopt(state, anchor)
             rnd += 1
             self.report.rounds_done += 1
+            self._m_rounds.inc()
+            self._m_round.set(rnd)
         self.final_params = anchor
         self.agent.stop()
         return self.report
 
     def _await_next_anchor(self, rnd: int, anchor, trace, template):
         """Poll for round ``rnd+1``'s anchor; assume leadership if this
-        island is (or becomes, via lease expiry) the lowest live id."""
+        island is (or becomes, via lease expiry) the lowest live id.
+
+        Non-leaders get a bounded wait too: only the lowest live id
+        applied ``round_timeout_s`` before, so a leader whose heartbeat
+        thread stayed alive while its training thread wedged kept its
+        lease forever and every other island span here unboundedly
+        (ADVICE round 5). After ``liveness_factor * round_timeout_s``
+        without a new anchor this island re-checks LATEST (anchor still
+        advancing? keep waiting) and otherwise CHALLENGES leadership —
+        it leads the round itself from whatever deltas are posted. A
+        later publish by the unwedged leader double-publishes, which the
+        protocol already tolerates (atomic PUT, last wins, both anchors
+        valid averages)."""
         next_key = self._k(f"round-{rnd + 1}", "anchor")
         deadline = time.monotonic() + self.round_timeout_s
+        escape_at = (time.monotonic()
+                     + self.liveness_factor * self.round_timeout_s)
         while not self.store.exists(next_key):
             if self._aborted():
                 return anchor
@@ -271,11 +304,28 @@ class DilocoIsland:
             # re-registers the agent under a NEW id, and a hoisted read
             # would compare a dead id against live membership forever.
             wid = self.agent.worker_id
-            if wid == min(live, default=wid):
+            challenge = False
+            if wid != min(live, default=wid) and \
+                    time.monotonic() > escape_at:
+                latest = self._latest_round()
+                self._m_lag.set(max(0, (latest or rnd) - rnd))
+                if latest is not None and latest > rnd:
+                    # Anchors ARE advancing (LATEST moved between our
+                    # exists() polls — e.g. a transient store error hid
+                    # the key); keep waiting on a fresh window.
+                    escape_at = (time.monotonic()
+                                 + self.liveness_factor
+                                 * self.round_timeout_s)
+                else:
+                    self._m_escapes.inc()
+                    challenge = True
+            if wid == min(live, default=wid) or challenge:
                 posted = set(self._deltas_for(rnd))
                 waiting_on = [i for i in live if i not in posted]
-                if not waiting_on or time.monotonic() > deadline:
+                if challenge or not waiting_on \
+                        or time.monotonic() > deadline:
                     self.report.led_rounds += 1
+                    self._m_led.inc()
                     self._lead(rnd, sorted(posted), anchor, trace, template)
                     return anchor
             time.sleep(self.poll_s)

@@ -39,6 +39,7 @@ from serverless_learn_tpu.config import (ExperimentConfig,
 from serverless_learn_tpu.control.client import WorkerAgent
 from serverless_learn_tpu.data.datasets import Prefetcher
 from serverless_learn_tpu.parallel.mesh import make_mesh
+from serverless_learn_tpu.telemetry import get_registry
 from serverless_learn_tpu.training.checkpoint import Checkpointer
 from serverless_learn_tpu.training.loop import make_source
 from serverless_learn_tpu.training.train_step import build_trainer
@@ -173,6 +174,14 @@ class ElasticTrainer:
         num_steps = num_steps or self.config.train.num_steps
         if self._agent is not None:
             self._start_agent()
+        reg = get_registry()
+        m_steps = reg.counter("slt_train_steps_total", "optimizer steps run")
+        m_loss = reg.gauge("slt_train_loss")
+        m_members = reg.gauge("slt_membership_size",
+                              "live workers in the stripe")
+        m_epoch = reg.gauge("slt_membership_epoch")
+        m_remesh = reg.counter("slt_remesh_total",
+                               "mesh formations (first one included)")
         losses: List[float] = []
         state = None
         source = None
@@ -233,6 +242,9 @@ class ElasticTrainer:
                                     n_devices=len(devices),
                                     stripe=(rank, size),
                                     mesh=mesh_cfg.nontrivial_axes()))
+                m_remesh.inc()
+                m_epoch.set(epoch)
+                m_members.set(size)
                 if self.verbose:
                     log_json({"event": "mesh_formed", "epoch": epoch,
                               "n_devices": len(devices), "step": step,
@@ -263,6 +275,8 @@ class ElasticTrainer:
                         loss = float(jax.device_get(metrics["loss"]))
                         losses.append(loss)
                         step += 1
+                        m_steps.inc()
+                        m_loss.set(loss)
                         if self._agent is not None:
                             self._agent.report(step, loss,
                                                flow=prefetch.depth())

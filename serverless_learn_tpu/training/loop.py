@@ -15,6 +15,7 @@ import numpy as np
 
 from serverless_learn_tpu.config import ExperimentConfig
 from serverless_learn_tpu.data.datasets import Prefetcher, SyntheticSource
+from serverless_learn_tpu.telemetry import get_registry
 from serverless_learn_tpu.training.train_step import Trainer, build_trainer
 from serverless_learn_tpu.utils.metrics import ThroughputMeter, log_json
 from serverless_learn_tpu.utils.tracing import get_tracer, step_annotation
@@ -177,6 +178,20 @@ def run_training(
     meter.start()
     start_step = int(jax.device_get(state.step))
     tracer = get_tracer()
+    # Cluster telemetry (scraped by /metrics + `slt top`): per-step
+    # counters/gauges are a handful of float ops per step — noise next to
+    # a device step — and give the serving/elastic planes' dashboards the
+    # same substrate the inference engines publish into.
+    reg = get_registry()
+    m_steps = reg.counter("slt_train_steps_total", "optimizer steps run")
+    m_step_t = reg.histogram("slt_train_step_seconds", "wall time per step")
+    m_sps = reg.gauge("slt_train_samples_per_sec")
+    m_sps_chip = reg.gauge("slt_train_samples_per_sec_per_chip")
+    m_loss = reg.gauge("slt_train_loss")
+    reg.gauge("slt_train_grad_accum",
+              "microbatches per step").set(config.train.grad_accum)
+    reg.gauge("slt_train_batch_size").set(config.train.batch_size)
+    reg.gauge("slt_train_n_chips").set(trainer.mesh.size)
     last_batch = None
     try:
         for i, batch in zip(range(start_step, config.train.num_steps), prefetch):
@@ -189,6 +204,12 @@ def run_training(
                 metrics = {k: float(v)
                            for k, v in jax.device_get(metrics).items()}
             stats = meter.record(i + 1, metrics)
+            m_steps.inc()
+            m_step_t.observe(stats.step_time_s)
+            m_sps.set(stats.samples_per_sec)
+            m_sps_chip.set(stats.samples_per_sec / max(trainer.mesh.size, 1))
+            if "loss" in metrics:
+                m_loss.set(metrics["loss"])
             if verbose and (i + 1) % config.train.log_every == 0:
                 log_json({"step": stats.step, "step_time_s": round(stats.step_time_s, 5),
                           "samples_per_sec": round(stats.samples_per_sec, 1),
@@ -223,4 +244,11 @@ def run_training(
         meter.flops_per_step = compiled_step_flops(
             trainer.step_fn, state, last_batch,
             n_devices=trainer.mesh.size)
+        summary = meter.steady_state()
+        if "mfu" in summary:
+            reg.gauge("slt_train_mfu",
+                      "model FLOPs utilization").set(summary["mfu"])
+        if "tflops_per_sec_per_chip" in summary:
+            reg.gauge("slt_train_tflops_per_sec_per_chip").set(
+                summary["tflops_per_sec_per_chip"])
     return state, meter

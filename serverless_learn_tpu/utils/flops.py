@@ -64,6 +64,12 @@ def compiled_step_flops(step_fn, *args, n_devices: int = 1
         return None
     if not analysis:
         return None
+    # jax used to return one dict; newer versions return a one-element
+    # list of per-computation dicts. Accept both.
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict):
+        return None
     flops = analysis.get("flops")
     return float(flops) * n_devices if flops else None
 

@@ -3,6 +3,11 @@
 Successor of the reference's observability story — unconditional ``std::cout``
 narration on every RPC (SURVEY.md §5 "Metrics") — as step-timed counters with
 JSON-line output. samples/sec/chip is BASELINE.json's primary metric.
+
+This module stays the *local* accounting the training loop returns
+(per-run history, steady-state aggregation); the cluster-facing,
+scrapeable view of the same quantities is published into
+``telemetry/`` (``slt_train_*`` series on the /metrics endpoint).
 """
 
 from __future__ import annotations

@@ -17,6 +17,11 @@ This module is the rebuild's tracing story, in three parts:
 * **Daemon scrape** — ``rpc_stats(client)`` turns a Coordinator/Shard
   ``StatsReply`` into the same dict shape as ``Tracer.summary()``, so one
   report covers Python hosts and C++ daemons.
+
+Cluster-wide, scrapeable telemetry (counters/gauges/histograms, the
+``/metrics`` endpoint, ``slt top``) lives in ``telemetry/``;
+``telemetry.publish_rpc_stats`` lifts this module's scrape shape into
+that registry.
 """
 
 from __future__ import annotations
