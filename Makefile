@@ -1,10 +1,18 @@
 # Developer entry points. The Python package needs no build; `native/` holds
 # the C++ control/data-plane daemons.
 
-.PHONY: test test-all native tsan bench lm-bench data-bench gen-bench dryrun clean
+.PHONY: test test-all lint native tsan bench lm-bench data-bench gen-bench dryrun clean
 
 test:  ## fast tier (<2 min on CPU); compile-heavy tests are marked slow
 	python -m pytest tests/ -q -m "not slow"
+
+lint:  ## ruff (when installed) + bytecode-compile every tree we ship
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check serverless_learn_tpu tests benchmarks; \
+	else \
+		echo "ruff not installed; skipping style pass"; \
+	fi
+	python -m compileall -q serverless_learn_tpu tests benchmarks bench.py
 
 test-all:  ## the full suite (~13 min on CPU)
 	python -m pytest tests/ -q

@@ -44,10 +44,12 @@
 #include "log.h"
 #include "rpc_stats.h"
 #include "slt.pb.h"
+#include "trace.h"
 
 namespace {
 
 slt::RpcStats g_rpc_stats;
+slt::SpanLog* g_span_log = nullptr;  // --events_log; null = tracing off
 
 struct WorkerRec {
   uint64_t id;
@@ -306,6 +308,16 @@ void serve_conn(Coordinator* coord, int fd) {
   while (slt::read_frame(fd, &type, &payload)) {
     std::string out;
     uint8_t out_type;
+    // Server-side span for traced requests: the client stamped field 15
+    // (TraceContext) on the request; scanning it needs no regenerated
+    // protobuf code (native/trace.h). Paired with the client's RPC span
+    // by `slt trace` for causal chaining AND clock-skew correction.
+    slt::TraceCtx trace_ctx;
+    double span_t0 = 0.0;
+    if (g_span_log != nullptr) {
+      trace_ctx = slt::parse_trace_ctx(payload);
+      if (trace_ctx.present) span_t0 = slt::unix_now_s();
+    }
     slt::ScopedRpcTimer timer(&g_rpc_stats, type);
     switch (type) {
       case slt::MSG_REGISTER_REQ: {
@@ -351,6 +363,10 @@ void serve_conn(Coordinator* coord, int fd) {
         break;
       }
     }
+    if (g_span_log != nullptr && trace_ctx.present) {
+      g_span_log->Emit(slt::msg_type_span_name(type), trace_ctx, span_t0,
+                       slt::unix_now_s() - span_t0);
+    }
     if (!slt::write_frame(fd, out_type, out)) break;
   }
   ::close(fd);
@@ -365,12 +381,16 @@ int main(int argc, char** argv) {
   uint32_t lease_ttl_ms = 5000;
   uint32_t sweep_ms = 500;
   std::string state_file;
+  std::string events_log;
   for (int i = 1; i < argc - 1; i++) {
     if (!strcmp(argv[i], "--port")) port = atoi(argv[++i]);
     else if (!strcmp(argv[i], "--lease_ttl_ms")) lease_ttl_ms = atoi(argv[++i]);
     else if (!strcmp(argv[i], "--sweep_ms")) sweep_ms = atoi(argv[++i]);
     else if (!strcmp(argv[i], "--state_file")) state_file = argv[++i];
+    else if (!strcmp(argv[i], "--events_log")) events_log = argv[++i];
   }
+  if (!events_log.empty())
+    g_span_log = new slt::SpanLog(events_log, "coordinator");
   // Heap-allocated and deliberately leaked: detached connection threads
   // may still hold the pointer when main returns — destroying the
   // coordinator (and its mutex) under them would be use-after-free. The

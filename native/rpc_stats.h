@@ -28,7 +28,12 @@ struct RpcCounters {
 class RpcStats {
  public:
   void Record(uint8_t msg_type, uint64_t us) {
-    if (msg_type >= kMaxMsgType) return;
+    // Tags >= kMaxMsgType (a newer peer speaking message types this build
+    // predates) were silently DROPPED before — their count and max
+    // latency simply vanished from StatsReply. They now aggregate into a
+    // dedicated overflow slot, reported as msg_type == kMaxMsgType (the
+    // Python scrape names it "other"; see utils/tracing.MSG_TYPE_NAMES).
+    if (msg_type > kMaxMsgType) msg_type = kMaxMsgType;
     auto& c = counters_[msg_type];
     c.count.fetch_add(1, std::memory_order_relaxed);
     c.total_us.fetch_add(us, std::memory_order_relaxed);
@@ -40,7 +45,7 @@ class RpcStats {
   }
 
   void Fill(slt::StatsReply* rep) const {
-    for (int t = 0; t < kMaxMsgType; t++) {
+    for (int t = 0; t <= kMaxMsgType; t++) {
       uint64_t n = counters_[t].count.load(std::memory_order_relaxed);
       if (n == 0) continue;
       auto* s = rep->add_rpc();
@@ -52,7 +57,7 @@ class RpcStats {
   }
 
  private:
-  RpcCounters counters_[kMaxMsgType];
+  RpcCounters counters_[kMaxMsgType + 1];  // last slot: tag overflow
 };
 
 class ScopedRpcTimer {

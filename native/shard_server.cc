@@ -17,7 +17,7 @@
 //  * unknown keys return an error chunk — the reference called exit(1) on an
 //    unexpected file number (src/file_server.cc:107-110).
 //
-// Usage: shard_server [--port 50053] [--root DIR]
+// Usage: shard_server [--port 50053] [--root DIR] [--events_log PATH]
 
 #include <atomic>
 #include <unistd.h>
@@ -37,10 +37,12 @@
 #include "log.h"
 #include "rpc_stats.h"
 #include "slt.pb.h"
+#include "trace.h"
 
 namespace {
 
 slt::RpcStats g_rpc_stats;
+slt::SpanLog* g_span_log = nullptr;  // --events_log; null = tracing off
 
 struct Stats {
   std::atomic<uint64_t> bytes_served{0};
@@ -476,6 +478,13 @@ void serve_conn(int fd) {
   uint8_t type;
   std::string payload;
   while (slt::read_frame(fd, &type, &payload)) {
+    // Server-side span for traced requests (see coordinator.cc / trace.h).
+    slt::TraceCtx trace_ctx;
+    double span_t0 = 0.0;
+    if (g_span_log != nullptr) {
+      trace_ctx = slt::parse_trace_ctx(payload);
+      if (trace_ctx.present) span_t0 = slt::unix_now_s();
+    }
     slt::ScopedRpcTimer timer(&g_rpc_stats, type);
     switch (type) {
       case slt::MSG_FETCH_REQ: {
@@ -540,6 +549,10 @@ void serve_conn(int fd) {
         break;
       }
     }
+    if (g_span_log != nullptr && trace_ctx.present) {
+      g_span_log->Emit(slt::msg_type_span_name(type), trace_ctx, span_t0,
+                       slt::unix_now_s() - span_t0);
+    }
   }
   ::close(fd);
 }
@@ -548,10 +561,14 @@ void serve_conn(int fd) {
 
 int main(int argc, char** argv) {
   int port = 50053;
+  std::string events_log;
   for (int i = 1; i < argc - 1; i++) {
     if (!strcmp(argv[i], "--port")) port = atoi(argv[++i]);
     else if (!strcmp(argv[i], "--root")) g_root = argv[++i];
+    else if (!strcmp(argv[i], "--events_log")) events_log = argv[++i];
   }
+  if (!events_log.empty())
+    g_span_log = new slt::SpanLog(events_log, "shard-server");
   mkdirs_for(g_root + "/x");
   int lfd = slt::listen_on(port);
   if (lfd < 0) {

@@ -1,0 +1,60 @@
+// Standalone test for native/trace.h (no protobuf dependency): the input
+// payloads below are real serializations produced by gen/slt_pb2.py, so
+// the wire-format scanner is exercised against genuine protoc output.
+// Run via `make -C native test-trace-h`.
+
+#include <cassert>
+#include <cstdio>
+#include <string>
+
+#include "../trace.h"
+
+namespace {
+
+std::string from_hex(const std::string& hex) {
+  std::string out;
+  for (size_t i = 0; i + 1 < hex.size(); i += 2)
+    out.push_back(static_cast<char>(
+        std::stoi(hex.substr(i, 2), nullptr, 16)));
+  return out;
+}
+
+}  // namespace
+
+int main() {
+  // RegisterRequest{addr, name, n_chips, exclusive_name, trace{...}}.
+  slt::TraceCtx c = slt::parse_trace_ctx(from_hex(
+      "0a0d31302e302e302e313a3530303012027731180428017a360a203061663736"
+      "3531393136636434336464383434386562323131633830333139631210623761"
+      "643662373136393230333333311801"));
+  assert(c.present);
+  assert(c.trace_id == "0af7651916cd43dd8448eb211c80319c");
+  assert(c.span_id == "b7ad6b7169203331");
+
+  // HeartbeatRequest without a trace field -> absent, not garbage.
+  c = slt::parse_trace_ctx(from_hex(
+      "0807107b19000000000000f83f2002"));
+  assert(!c.present);
+
+  // FetchRequest with a trace (varints + bools skipped correctly).
+  c = slt::parse_trace_ctx(from_hex(
+      "0a0a64732f73686172642d3010802028017a340a203131313131313131313131"
+      "3131313131313131313131313131313131313131311210323232323232323232"
+      "32323232323232"));
+  assert(c.present);
+  assert(c.trace_id == std::string(32, '1'));
+  assert(c.span_id == std::string(16, '2'));
+
+  // Truncated / hostile payloads must not read out of bounds or "find" a
+  // context.
+  assert(!slt::parse_trace_ctx("").present);
+  assert(!slt::parse_trace_ctx("\x7a").present);             // tag, no len
+  assert(!slt::parse_trace_ctx("\x7a\xff\xff\xff").present);  // huge len
+  assert(!slt::parse_trace_ctx(std::string("\x7a\x02\x0a\x09", 4)).present);
+
+  // Empty sub-ids -> not present (nothing to chain to).
+  assert(!slt::parse_trace_ctx(from_hex("7a040a001200")).present);
+
+  std::printf("trace_h_test: all assertions passed\n");
+  return 0;
+}

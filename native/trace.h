@@ -1,0 +1,209 @@
+// Distributed-trace support for the native daemons.
+//
+// Python clients stamp outgoing requests with the optional
+// `TraceContext trace = 15` field (proto/slt.proto). The daemons in this
+// image are built against a pre-bump slt.pb.h (no protoc available to
+// regenerate), so TraceContext is extracted with a ~40-line protobuf
+// wire-format scan instead of the generated parser: field 15 was chosen
+// as the uniform trace slot on EVERY request message precisely so one
+// single-byte tag (0x7a = (15<<3)|2) covers all of them. Untraced or
+// malformed payloads simply yield present=false — tracing must never
+// affect RPC handling.
+//
+// SpanLog appends one JSON object per served, traced frame to
+// --events_log, in the same record shape telemetry/tracing.py emits, so
+// `slt trace` merges daemon server-side spans with Python client-side
+// spans into one causal timeline (and pairs them for clock-skew
+// correction).
+
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <ctime>
+#include <mutex>
+#include <string>
+
+#include <sys/time.h>
+#include <unistd.h>
+
+namespace slt {
+
+struct TraceCtx {
+  bool present = false;
+  std::string trace_id;
+  std::string span_id;
+};
+
+namespace trace_internal {
+
+// Reads a base-128 varint at [p, end); advances p. Returns false on
+// truncation/overflow.
+inline bool read_varint(const char*& p, const char* end, uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (p < end && shift < 64) {
+    uint8_t b = static_cast<uint8_t>(*p++);
+    v |= static_cast<uint64_t>(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+// Skips one field of the given wire type; advances p.
+inline bool skip_field(const char*& p, const char* end, uint32_t wt) {
+  uint64_t n;
+  switch (wt) {
+    case 0:  // varint
+      return read_varint(p, end, &n);
+    case 1:  // fixed64
+      if (end - p < 8) return false;
+      p += 8;
+      return true;
+    case 2:  // length-delimited
+      if (!read_varint(p, end, &n) ||
+          n > static_cast<uint64_t>(end - p)) return false;
+      p += n;
+      return true;
+    case 5:  // fixed32
+      if (end - p < 4) return false;
+      p += 4;
+      return true;
+    default:
+      return false;  // groups/unknown: give up on the scan
+  }
+}
+
+}  // namespace trace_internal
+
+// Extracts TraceContext (field `field_num`, default 15) from a serialized
+// request message without generated code.
+inline TraceCtx parse_trace_ctx(const std::string& payload,
+                                uint32_t field_num = 15) {
+  using trace_internal::read_varint;
+  using trace_internal::skip_field;
+  TraceCtx ctx;
+  const char* p = payload.data();
+  const char* end = p + payload.size();
+  while (p < end) {
+    uint64_t key;
+    if (!read_varint(p, end, &key)) return ctx;
+    uint32_t field = static_cast<uint32_t>(key >> 3);
+    uint32_t wt = static_cast<uint32_t>(key & 7);
+    if (field == field_num && wt == 2) {
+      uint64_t len;
+      if (!read_varint(p, end, &len) ||
+          len > static_cast<uint64_t>(end - p)) return ctx;
+      const char* q = p;
+      const char* qend = p + len;
+      while (q < qend) {
+        uint64_t skey;
+        if (!read_varint(q, qend, &skey)) break;
+        uint32_t sfield = static_cast<uint32_t>(skey >> 3);
+        uint32_t swt = static_cast<uint32_t>(skey & 7);
+        if ((sfield == 1 || sfield == 2) && swt == 2) {
+          uint64_t slen;
+          if (!read_varint(q, qend, &slen) ||
+              slen > static_cast<uint64_t>(qend - q)) break;
+          std::string val(q, slen);
+          q += slen;
+          if (sfield == 1) ctx.trace_id = val;
+          else ctx.span_id = val;
+        } else if (!skip_field(q, qend, swt)) {
+          break;
+        }
+      }
+      ctx.present = !ctx.trace_id.empty() && !ctx.span_id.empty();
+      return ctx;
+    }
+    if (!skip_field(p, end, wt)) return ctx;
+  }
+  return ctx;
+}
+
+inline double unix_now_s() {
+  struct timeval tv;
+  ::gettimeofday(&tv, nullptr);
+  return static_cast<double>(tv.tv_sec) + tv.tv_usec / 1e6;
+}
+
+// Append-only JSONL span sink; same record shape as the Python side's
+// telemetry/tracing.emit_span. Thread-safe; I/O failures are swallowed
+// (tracing must never take the daemon down).
+class SpanLog {
+ public:
+  // `node` defaults to "<role>-<pid>" — unique per process, like Python's.
+  SpanLog(const std::string& path, const std::string& role)
+      : path_(path), node_(role + "-" + std::to_string(::getpid())) {}
+
+  bool enabled() const { return !path_.empty(); }
+  const std::string& node() const { return node_; }
+
+  // Emits one server-side span; span_id is synthesized from a counter
+  // (the daemon has no other span identity to mint).
+  void Emit(const std::string& name, const TraceCtx& ctx, double t0_unix_s,
+            double duration_s) {
+    if (path_.empty() || !ctx.present) return;
+    char buf[1024];  // ids are capped at 128 chars each by json_safe
+    uint64_t sid;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      sid = ++seq_;
+    }
+    std::snprintf(
+        buf, sizeof(buf),
+        "{\"event\":\"span\",\"span\":\"%s\",\"node\":\"%s\","
+        "\"trace_id\":\"%s\",\"span_id\":\"srv-%llx-%llu\","
+        "\"parent_id\":\"%s\",\"t0_unix_s\":%.6f,\"duration_s\":%.6f}\n",
+        json_safe(name).c_str(), json_safe(node_).c_str(),
+        json_safe(ctx.trace_id).c_str(),
+        static_cast<unsigned long long>(::getpid()),
+        static_cast<unsigned long long>(sid),
+        json_safe(ctx.span_id).c_str(), t0_unix_s, duration_s);
+    std::lock_guard<std::mutex> lk(mu_);
+    FILE* f = ::fopen(path_.c_str(), "a");
+    if (!f) return;
+    ::fputs(buf, f);
+    ::fclose(f);
+  }
+
+ private:
+  // Trace ids are hex from our own clients, but the log must stay valid
+  // JSON even against a hostile peer: drop quotes/backslashes/control.
+  static std::string json_safe(const std::string& s) {
+    std::string out;
+    out.reserve(s.size());
+    for (char c : s) {
+      if (c == '"' || c == '\\' || static_cast<uint8_t>(c) < 0x20) continue;
+      out.push_back(c);
+    }
+    return out.substr(0, 128);
+  }
+
+  const std::string path_;
+  const std::string node_;
+  std::mutex mu_;
+  uint64_t seq_ = 0;
+};
+
+// framing.h MsgType tag -> span name (mirrors utils/tracing.MSG_TYPE_NAMES).
+inline const char* msg_type_span_name(uint8_t t) {
+  switch (t) {
+    case 1: return "rpc/register";
+    case 3: return "rpc/heartbeat";
+    case 5: return "rpc/deregister";
+    case 6: return "rpc/membership";
+    case 20: return "rpc/manifest";
+    case 22: return "rpc/fetch";
+    case 24: return "rpc/put";
+    case 25: return "rpc/stats";
+    case 27: return "rpc/delete";
+    default: return "rpc/other";
+  }
+}
+
+}  // namespace slt

@@ -16,6 +16,7 @@ Here one typed CLI fronts everything:
     python -m serverless_learn_tpu publish      # push a dataset to the data plane
     python -m serverless_learn_tpu stats        # scrape a daemon's load/RPC stats
     python -m serverless_learn_tpu top          # live cluster telemetry view
+    python -m serverless_learn_tpu trace        # cross-node timeline from span logs
     python -m serverless_learn_tpu models       # list registered model families
 
 Every long-running command takes ``--metrics-port N`` to expose a
@@ -137,10 +138,24 @@ def _add_train_flags(p: argparse.ArgumentParser):
     p.add_argument("--checkpoint-name", default="ckpt",
                    help="checkpoint namespace inside the store (an elastic "
                         "worker saves under its --name)")
-    p.add_argument("--profile-dir", help="capture a jax.profiler trace here")
+    p.add_argument("--profile-dir", help="capture a jax.profiler trace here "
+                        "(train: brackets the run; serve: arms the "
+                        "on-demand /debug/profile?seconds=N endpoint)")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="serve /metrics (Prometheus text) + /metrics.json "
                         "from this port (0 = auto; scraped by `top`)")
+    p.add_argument("--events-log", metavar="PATH", default=None,
+                   help="append one JSONL span record per request/RPC/"
+                        "round here (this node's half of an `slt trace` "
+                        "timeline); also arms the flight recorder")
+    p.add_argument("--flight-dir", metavar="DIR", default=None,
+                   help="write flight-recorder dumps (last spans/events + "
+                        "metrics + device memory) here on SIGTERM/crash/"
+                        "lease expiry (default: the events log's "
+                        "directory, or cwd)")
+    p.add_argument("--node", default=None,
+                   help="node name stamped on span records (default "
+                        "<hostname>-<pid>; SLT_NODE env overrides)")
     p.add_argument("-v", "--verbose", action="store_true")
     # Multi-host: either serverless bootstrap via the native coordinator
     # (--world-size) or explicit topology (--num-processes/--process-id).
@@ -170,6 +185,29 @@ def _start_metrics(args):
     exp = MetricsExporter(port=port).start()
     log_json({"event": "metrics", "addr": exp.addr}, stream=sys.stdout)
     return exp
+
+
+def _init_tracing_from_args(args):
+    """Arm distributed tracing + the flight recorder when the user asked
+    for either (--events-log / --flight-dir / --node). Installing the
+    flight handlers means a SIGTERM'd or crashing process leaves a
+    flight-<node>-<ts>.json with its last spans (`slt trace` ingests it)."""
+    events_log = getattr(args, "events_log", None)
+    flight_dir = getattr(args, "flight_dir", None)
+    node = getattr(args, "node", None)
+    if not (events_log or flight_dir or node):
+        return
+    from serverless_learn_tpu.telemetry import init_tracing
+    from serverless_learn_tpu.utils.metrics import log_json
+
+    if flight_dir is None:
+        flight_dir = (os.path.dirname(os.path.abspath(events_log))
+                      if events_log else ".")
+    name = init_tracing(node=node, events_log=events_log,
+                        flight_dir=flight_dir)
+    log_json({"event": "tracing", "node": name,
+              **({"events_log": events_log} if events_log else {}),
+              "flight_dir": flight_dir}, stream=sys.stdout)
 
 
 def _make_checkpointer(args, name: Optional[str] = None):
@@ -213,6 +251,7 @@ def cmd_train(args) -> int:
                 "--num-processes requires --jax-coordinator and --process-id")
         initialize(args.jax_coordinator, args.num_processes, args.process_id)
 
+    _init_tracing_from_args(args)
     exporter = _start_metrics(args)
     try:
         cfg = _config_from_args(args)
@@ -476,6 +515,7 @@ def cmd_serve(args) -> int:
 
     if args.world_size or args.num_processes:
         raise SystemExit("`serve` is single-process")
+    _init_tracing_from_args(args)
     cfg = _serving_config(_config_from_args(args))
     trainer = _build_inference_trainer(cfg)
     params, _ = _load_inference_params(args, cfg, trainer)
@@ -487,7 +527,8 @@ def cmd_serve(args) -> int:
                               engine=args.serve_engine,
                               chunk_size=args.chunk_size,
                               metrics_port=args.metrics_port,
-                              event_log_path=args.events_log)
+                              event_log_path=args.events_log,
+                              profile_dir=args.profile_dir)
     log_json({"event": "serving", "addr": server.addr,
               "model": cfg.model,
               **({"metrics_addr": server.metrics_addr}
@@ -515,6 +556,7 @@ def cmd_diloco(args) -> int:
 
     if not args.coordinator:
         raise SystemExit("diloco requires --coordinator")
+    _init_tracing_from_args(args)
     cfg = _config_from_args(args)
     if args.store_dir:
         store = LocalStore(args.store_dir)
@@ -587,6 +629,7 @@ def cmd_worker(args) -> int:
             "--world-size/--num-processes form a fixed multi-host group and "
             "apply to `train`; `worker` is elastic (it re-meshes on "
             "membership changes instead — see --multihost)")
+    _init_tracing_from_args(args)
     cfg = _config_from_args(args)
     if args.checkpoint_store:
         store = ShardServerStore(args.checkpoint_store)
@@ -638,29 +681,45 @@ def cmd_worker(args) -> int:
 
 
 def _exec_daemon(binary: str, argv: List[str]) -> int:
-    from serverless_learn_tpu.control.client import _BIN, ensure_native_built
+    from serverless_learn_tpu.control.client import _BIN
 
-    if not ensure_native_built():
-        print("native build failed (see native/Makefile)", file=sys.stderr)
-        return 1
     path = os.path.join(_BIN, binary)
     os.execv(path, [path] + argv)  # replaces this process, like the reference
 
 
 def cmd_coordinator(args) -> int:
+    from serverless_learn_tpu.control.daemons import native_daemon_usable
+
     argv = ["--port", str(args.port),
             "--lease_ttl_ms", str(args.lease_ttl_ms),
             "--sweep_ms", str(args.sweep_ms)]
     if args.state_file:
         argv += ["--state_file", args.state_file]
-    return _exec_daemon("coordinator", argv)
+    if args.events_log:
+        argv += ["--events_log", args.events_log]
+    if native_daemon_usable("coordinator"):
+        return _exec_daemon("coordinator", argv)
+    # Committed binaries can't run in this image (glibc/libprotobuf
+    # mismatch) and there's no toolchain to rebuild: serve the same wire
+    # protocol from the pure-Python twin instead of dying.
+    from serverless_learn_tpu.control.py_daemons import main_coordinator
+
+    return main_coordinator(argv)
 
 
 def cmd_shard_server(args) -> int:
+    from serverless_learn_tpu.control.daemons import native_daemon_usable
+
     argv = ["--port", str(args.port)]
     if args.root:
         argv += ["--root", args.root]
-    return _exec_daemon("shard_server", argv)
+    if args.events_log:
+        argv += ["--events_log", args.events_log]
+    if native_daemon_usable("shard_server"):
+        return _exec_daemon("shard_server", argv)
+    from serverless_learn_tpu.control.py_daemons import main_shard_server
+
+    return main_shard_server(argv)
 
 
 def cmd_publish(args) -> int:
@@ -746,6 +805,31 @@ def cmd_stats(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Merge per-node span logs (--events-log JSONL, daemon --events_log,
+    flight-recorder dumps) into one skew-corrected causal timeline: a
+    Perfetto/chrome://tracing `trace_event` JSON plus a critical-path
+    summary on stdout."""
+    from serverless_learn_tpu.telemetry import timeline
+
+    tl = timeline.reconstruct(args.logs, skew=not args.no_skew,
+                              root=args.root)
+    if args.trace_id:
+        tl.spans = [s for s in tl.spans if s.trace_id == args.trace_id]
+    if not tl.spans:
+        print(json.dumps({"error": "no spans found in the given logs",
+                          "skipped_records": tl.skipped}), file=sys.stderr)
+        return 1
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(timeline.to_trace_events(tl), f)
+    summary = timeline.summarize(tl, top=args.top)
+    if args.out:
+        summary["out"] = args.out
+    print(json.dumps(summary, indent=None if args.compact else 2))
+    return 0
+
+
 def cmd_top(args) -> int:
     """Live cluster telemetry: poll /metrics endpoints, render one screen
     (per-worker throughput, inference latency percentiles, membership)."""
@@ -825,9 +909,6 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--chunk-size", type=int, default=32,
                     help="decode tokens per jitted chunk between admission "
                          "boundaries (continuous engine)")
-    sv.add_argument("--events-log", metavar="PATH", default=None,
-                    help="append one JSONL span record per request here "
-                         "(submit/admit/first_token/done marks)")
     sv.set_defaults(fn=cmd_serve)
 
     w = sub.add_parser("worker", help="elastic worker: join a cluster & train")
@@ -863,11 +944,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="persist membership here: a restarted coordinator "
                         "resumes the same epoch and worker ids, so "
                         "heartbeating workers carry on without re-mesh churn")
+    c.add_argument("--events-log", metavar="PATH", default=None,
+                   help="append a JSONL server-side span per traced RPC "
+                        "(requests carrying TraceContext) — one input of "
+                        "`slt trace`")
     c.set_defaults(fn=cmd_coordinator)
 
     s = sub.add_parser("shard-server", help="run the data-plane daemon")
     s.add_argument("--port", type=int, default=50053)
     s.add_argument("--root", help="blob root directory")
+    s.add_argument("--events-log", metavar="PATH", default=None,
+                   help="append a JSONL server-side span per traced RPC")
     s.set_defaults(fn=cmd_shard_server)
 
     pub = sub.add_parser("publish",
@@ -930,6 +1017,31 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--kind", choices=["coordinator", "shard-server"],
                     default="shard-server")
     st.set_defaults(fn=cmd_stats)
+
+    tr = sub.add_parser("trace",
+                        help="merge multi-node span logs into one skew-"
+                             "corrected timeline (Perfetto trace_event "
+                             "JSON + critical-path report)")
+    tr.add_argument("logs", nargs="+", metavar="LOG",
+                    help="JSONL span logs (--events-log), daemon "
+                         "--events_log files, flight-*.json dumps, or "
+                         "directories/globs of them")
+    tr.add_argument("--out", metavar="FILE", default=None,
+                    help="write Chrome/Perfetto trace_event JSON here "
+                         "(load at ui.perfetto.dev or chrome://tracing)")
+    tr.add_argument("--no-skew", action="store_true",
+                    help="trust each node's wall clock instead of "
+                         "correcting skew from client/server span pairs")
+    tr.add_argument("--root", default=None,
+                    help="anchor clock correction at this node "
+                         "(default: the node with the most spans)")
+    tr.add_argument("--trace-id", default=None,
+                    help="restrict the timeline to one trace")
+    tr.add_argument("--top", type=int, default=5,
+                    help="slowest traces / critical-path hops to report")
+    tr.add_argument("--compact", action="store_true",
+                    help="single-line JSON summary (for scripts)")
+    tr.set_defaults(fn=cmd_trace)
 
     tp = sub.add_parser("top", help="live cluster telemetry: poll /metrics "
                                     "endpoints, one-screen view")

@@ -1,8 +1,14 @@
-"""Launch/manage the native daemons from Python (tests, demos, CLI).
+"""Launch/manage the control/data-plane daemons from Python (tests, demos).
 
 The reference was operated by hand: run ``./file_server``, ``./master``, then
 ``./worker ADDR`` per node (SURVEY.md §4). These helpers spawn the C++
 successors as subprocesses and wait for their ports to accept connections.
+
+Since PR 2 they also degrade: when the committed native binaries cannot run
+in this image (glibc / libprotobuf mismatch — probed once per process, not
+assumed), the pure-Python protocol twins (``control/py_daemons.py``) are
+spawned instead, same flags, same wire contract. A dead child is detected
+immediately instead of burning the full port-wait timeout.
 """
 
 from __future__ import annotations
@@ -10,15 +16,23 @@ from __future__ import annotations
 import os
 import socket
 import subprocess
+import sys
 import time
-from typing import Optional
+from typing import List, Optional
 
 from serverless_learn_tpu.control.client import ensure_native_built, _BIN
 
+_usable_cache: dict = {}
 
-def _wait_port(port: int, host: str = "127.0.0.1", timeout: float = 10.0):
+
+def _wait_port(port: int, host: str = "127.0.0.1", timeout: float = 10.0,
+               proc: Optional[subprocess.Popen] = None):
     deadline = time.time() + timeout
     while time.time() < deadline:
+        if proc is not None and proc.poll() is not None:
+            raise TimeoutError(
+                f"daemon exited with rc={proc.returncode} before "
+                f"port {port} came up")
         try:
             with socket.create_connection((host, port), timeout=0.5):
                 return True
@@ -27,27 +41,74 @@ def _wait_port(port: int, host: str = "127.0.0.1", timeout: float = 10.0):
     raise TimeoutError(f"port {port} not ready after {timeout}s")
 
 
+def native_daemon_usable(binary: str = "coordinator") -> bool:
+    """Can the committed native binary actually RUN here? Binaries exist in
+    git, but an image with an older glibc/libprotobuf can't execute them
+    (loader error, instant exit). Probed by spawning once on an ephemeral
+    port; cached per process."""
+    if binary in _usable_cache:
+        return _usable_cache[binary]
+    ok = False
+    if ensure_native_built():
+        path = os.path.join(_BIN, binary)
+        try:
+            proc = subprocess.Popen([path, "--port", "0"],
+                                    stdout=subprocess.DEVNULL,
+                                    stderr=subprocess.DEVNULL)
+            time.sleep(0.3)
+            ok = proc.poll() is None
+            proc.terminate()
+            try:
+                proc.wait(timeout=2)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        except OSError:
+            ok = False
+    _usable_cache[binary] = ok
+    return ok
+
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _spawn(cmd: List[str], port: int) -> subprocess.Popen:
+    # The package is used from a source checkout (not pip-installed):
+    # python-daemon children need the repo root importable.
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL, env=env)
+    _wait_port(port, proc=proc)
+    return proc
+
+
 def start_coordinator(port: int = 50052, lease_ttl_ms: int = 5000,
                       sweep_ms: int = 200,
-                      state_file: Optional[str] = None) -> subprocess.Popen:
-    assert ensure_native_built(), "native build failed"
-    cmd = [os.path.join(_BIN, "coordinator"), "--port", str(port),
-           "--lease_ttl_ms", str(lease_ttl_ms), "--sweep_ms", str(sweep_ms)]
+                      state_file: Optional[str] = None,
+                      events_log: Optional[str] = None) -> subprocess.Popen:
+    args = ["--port", str(port), "--lease_ttl_ms", str(lease_ttl_ms),
+            "--sweep_ms", str(sweep_ms)]
     if state_file:
-        cmd += ["--state_file", state_file]
-    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
-                            stderr=subprocess.DEVNULL)
-    _wait_port(port)
-    return proc
+        args += ["--state_file", state_file]
+    if events_log:
+        args += ["--events_log", events_log]
+    if native_daemon_usable("coordinator"):
+        return _spawn([os.path.join(_BIN, "coordinator")] + args, port)
+    return _spawn([sys.executable, "-m",
+                   "serverless_learn_tpu.control.py_daemons",
+                   "coordinator"] + args, port)
 
 
-def start_shard_server(port: int = 50053, root: Optional[str] = None
-                       ) -> subprocess.Popen:
-    assert ensure_native_built(), "native build failed"
-    cmd = [os.path.join(_BIN, "shard_server"), "--port", str(port)]
+def start_shard_server(port: int = 50053, root: Optional[str] = None,
+                       events_log: Optional[str] = None) -> subprocess.Popen:
+    args = ["--port", str(port)]
     if root:
-        cmd += ["--root", root]
-    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
-                            stderr=subprocess.DEVNULL)
-    _wait_port(port)
-    return proc
+        args += ["--root", root]
+    if events_log:
+        args += ["--events_log", events_log]
+    if native_daemon_usable("shard_server"):
+        return _spawn([os.path.join(_BIN, "shard_server")] + args, port)
+    return _spawn([sys.executable, "-m",
+                   "serverless_learn_tpu.control.py_daemons",
+                   "shard-server"] + args, port)

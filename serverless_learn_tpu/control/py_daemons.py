@@ -1,0 +1,737 @@
+"""Pure-Python daemons: protocol-compatible fallbacks for native/bin.
+
+The Python *client* side has always degraded gracefully — ``Transport``
+falls back to stdlib sockets when ``libslt.so`` won't load. The daemons
+had no such story: in an image whose glibc/libprotobuf don't match the
+committed binaries (this dev container: binaries want glibc 2.34 +
+libprotobuf.so.32, the system has older glibc + .so.23 and no protoc to
+rebuild), every daemon-backed test and demo died on "port not ready".
+These servers speak the exact framing + slt.proto wire contract of
+``native/coordinator.cc`` / ``native/shard_server.cc`` — same message
+semantics, same stats RPC, same durability (atomic tmp+rename state file,
+CRC sidecars) — so ``control/daemons.py`` and the CLI can transparently
+substitute them when the native binaries are unusable.
+
+They are fallbacks, not replacements: the C++ daemons stay the production
+path (no GIL, lower latency); known gaps are listed per class. Both
+daemons understand the PR-2 ``TraceContext trace = 15`` field natively
+(they import the regenerated ``slt_pb2``) and emit server-side span
+records to ``--events-log`` in the shape ``telemetry/tracing.py`` emits —
+so a 2-process coordinator+worker run yields a cross-process parented
+chain for ``slt trace`` even where the native build is impossible.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+import zlib
+from typing import Dict, Optional
+
+from serverless_learn_tpu.control import client as _client
+from serverless_learn_tpu.utils.tracing import MSG_TYPE_NAMES
+
+_CHUNK = 1024 * 1024
+
+
+def _now_ms() -> int:
+    return int(time.monotonic() * 1000)
+
+
+class _RpcStats:
+    """Python mirror of native/rpc_stats.h (incl. the overflow slot)."""
+
+    K_MAX = 32
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[int, list] = {}  # tag -> [count, total_us, max_us]
+
+    def record(self, msg_type: int, us: float):
+        tag = msg_type if msg_type <= self.K_MAX else self.K_MAX
+        with self._lock:
+            s = self._stats.setdefault(tag, [0, 0, 0])
+            s[0] += 1
+            s[1] += int(us)
+            s[2] = max(s[2], int(us))
+
+    def fill(self, rep):
+        with self._lock:
+            for tag in sorted(self._stats):
+                count, total, mx = self._stats[tag]
+                r = rep.rpc.add()
+                r.msg_type = tag
+                r.count = count
+                r.total_us = total
+                r.max_us = mx
+
+
+class _SpanLog:
+    """JSONL server-side span sink (same record shape as tracing.py)."""
+
+    def __init__(self, path: Optional[str], role: str):
+        self.path = path
+        self.node = f"{role}-{os.getpid()}"
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def emit(self, msg_type: int, trace_id: str, parent_id: str,
+             t0_unix: float, duration_s: float):
+        if not self.path or not trace_id or not parent_id:
+            return
+        name = MSG_TYPE_NAMES.get(msg_type, "other")
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        rec = {"event": "span", "span": f"rpc/{name}", "node": self.node,
+               "trace_id": trace_id[:128],
+               "span_id": f"srv-{os.getpid():x}-{seq}",
+               "parent_id": parent_id[:128],
+               "t0_unix_s": round(t0_unix, 6),
+               "duration_s": round(duration_s, 6)}
+        try:
+            with self._lock, open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass
+
+
+class _FramedServer:
+    """Accept loop + per-connection threads over the 5-byte frame format."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.pb = _client._pb2()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.port = self._sock.getsockname()[1]
+        self.addr = f"{host}:{self.port}"
+        self._stop = threading.Event()
+        self._threads: list = []
+        self.rpc_stats = _RpcStats()
+
+    # -- framing ----
+    @staticmethod
+    def _recv_exact(conn, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            part = conn.recv(n - len(buf))
+            if not part:
+                raise ConnectionError("peer closed")
+            buf += part
+        return buf
+
+    @classmethod
+    def _recv_frame(cls, conn):
+        length, mtype = struct.unpack(">IB", cls._recv_exact(conn, 5))
+        if length > 64 * 1024 * 1024:
+            raise ConnectionError("frame too large")
+        return mtype, cls._recv_exact(conn, length) if length else b""
+
+    @staticmethod
+    def _send_frame(conn, mtype: int, payload: bytes):
+        conn.sendall(struct.pack(">IB", len(payload), mtype) + payload)
+
+    # -- lifecycle ----
+    def start(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def serve_forever(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve_conn_safe, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _serve_conn_safe(self, conn):
+        try:
+            with conn:
+                while not self._stop.is_set():
+                    try:
+                        mtype, payload = self._recv_frame(conn)
+                    except (ConnectionError, OSError, struct.error):
+                        return
+                    t0 = time.perf_counter()
+                    try:
+                        self.handle(conn, mtype, payload)
+                    finally:
+                        self.rpc_stats.record(
+                            mtype, (time.perf_counter() - t0) * 1e6)
+        except Exception:
+            pass  # one bad connection must never kill the daemon
+
+    def handle(self, conn, mtype: int, payload: bytes):
+        raise NotImplementedError
+
+    def _unknown(self, conn):
+        ack = self.pb.Ack(ok=False, error="unknown message type")
+        self._send_frame(conn, _client.MSG_ACK, ack.SerializeToString())
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _trace_of(req):
+    """(trace_id, parent_span_id) from a request's optional trace field."""
+    try:
+        if req.HasField("trace"):
+            return req.trace.trace_id, req.trace.span_id
+    except ValueError:
+        pass
+    return "", ""
+
+
+class PyCoordinator(_FramedServer):
+    """Membership daemon: lease-based register/heartbeat/evict, durable
+    state file, stats, server-side trace spans. Mirrors
+    ``native/coordinator.cc`` semantics 1:1 (same epoch-bump points, same
+    exclusive-name refusal wording intent, restored-worker lease grace)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 lease_ttl_ms: int = 5000, sweep_ms: int = 500,
+                 state_file: Optional[str] = None,
+                 events_log: Optional[str] = None):
+        super().__init__(host, port)
+        self.lease_ttl_ms = lease_ttl_ms
+        self.sweep_ms = sweep_ms
+        self.state_file = state_file
+        self.span_log = _SpanLog(events_log, "coordinator")
+        self._mu = threading.Lock()
+        self._workers: Dict[int, dict] = {}
+        self._next_id = 1
+        self._epoch = 0
+        self._load_state()
+        self._sweeper = threading.Thread(target=self._sweep_loop, daemon=True)
+        self._sweeper.start()
+
+    # -- durability ----
+    def _save_state_locked(self):
+        if not self.state_file:
+            return
+        st = self.pb.CoordinatorState(next_id=self._next_id,
+                                      epoch=self._epoch)
+        for wid, rec in self._workers.items():
+            p = st.peers.add()
+            p.worker_id = wid
+            p.addr = rec["addr"]
+            p.name = rec["name"]
+            p.n_chips = rec["n_chips"]
+        tmp = self.state_file + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(st.SerializeToString())
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.state_file)
+        except OSError:
+            pass
+
+    def _load_state(self):
+        if not self.state_file or not os.path.exists(self.state_file):
+            return
+        try:
+            with open(self.state_file, "rb") as f:
+                st = self.pb.CoordinatorState.FromString(f.read())
+        except (OSError, Exception):
+            return
+        self._next_id = st.next_id or 1
+        self._epoch = st.epoch
+        seen = _now_ms()  # one lease of grace, as the native daemon grants
+        for p in st.peers:
+            self._workers[p.worker_id] = {
+                "addr": p.addr, "name": p.name, "n_chips": p.n_chips,
+                "last_seen": seen, "step": 0, "metric": 0.0, "flow": 0}
+
+    # -- membership core ----
+    def _fill_peers(self, peers):
+        for wid, rec in sorted(self._workers.items()):
+            p = peers.add()
+            p.worker_id = wid
+            p.addr = rec["addr"]
+            p.name = rec["name"]
+            p.n_chips = rec["n_chips"]
+
+    def _sweep_loop(self):
+        while not self._stop.wait(self.sweep_ms / 1000.0):
+            cutoff = _now_ms() - self.lease_ttl_ms
+            with self._mu:
+                dead = [wid for wid, rec in self._workers.items()
+                        if rec["last_seen"] < cutoff]
+                for wid in dead:
+                    del self._workers[wid]
+                if dead:
+                    self._epoch += 1
+                    self._save_state_locked()
+
+    # -- RPC dispatch ----
+    def handle(self, conn, mtype: int, payload: bytes):
+        pb = self.pb
+        span_t0 = time.time()
+        trace = ("", "")
+        if mtype == _client.MSG_REGISTER_REQ:
+            req = pb.RegisterRequest.FromString(payload)
+            trace = _trace_of(req)
+            rep = pb.RegisterReply()
+            with self._mu:
+                holder = next(
+                    (wid for wid, rec in self._workers.items()
+                     if req.exclusive_name and rec["name"] == req.name),
+                    None)
+                if holder is not None:
+                    rep.ok = False
+                    rep.epoch = self._epoch
+                    rep.error = (
+                        f"name '{req.name}' already held by live worker "
+                        f"{holder}; pick a unique name (it is the "
+                        f"checkpoint namespace), or wait out the holder's "
+                        f"lease")
+                else:
+                    wid = self._next_id
+                    self._next_id += 1
+                    self._workers[wid] = {
+                        "addr": req.addr, "name": req.name,
+                        "n_chips": req.n_chips, "last_seen": _now_ms(),
+                        "step": 0, "metric": 0.0, "flow": 0}
+                    self._epoch += 1
+                    self._save_state_locked()
+                    rep.ok = True
+                    rep.worker_id = wid
+                    rep.epoch = self._epoch
+                    rep.lease_ttl_ms = self.lease_ttl_ms
+            self._send_frame(conn, _client.MSG_REGISTER_REP,
+                             rep.SerializeToString())
+        elif mtype == _client.MSG_HEARTBEAT_REQ:
+            req = pb.HeartbeatRequest.FromString(payload)
+            trace = _trace_of(req)
+            rep = pb.HeartbeatReply()
+            with self._mu:
+                rec = self._workers.get(req.worker_id)
+                if rec is None:
+                    rep.ok = False  # lease expired: tell it to re-register
+                    rep.epoch = self._epoch
+                else:
+                    rec["last_seen"] = _now_ms()
+                    rec["step"] = req.step
+                    rec["metric"] = req.metric
+                    rec["flow"] = req.flow
+                    rep.ok = True
+                    rep.epoch = self._epoch
+                    self._fill_peers(rep.peers)
+            self._send_frame(conn, _client.MSG_HEARTBEAT_REP,
+                             rep.SerializeToString())
+        elif mtype == _client.MSG_DEREGISTER_REQ:
+            req = pb.DeregisterRequest.FromString(payload)
+            trace = _trace_of(req)
+            ack = pb.Ack()
+            with self._mu:
+                if req.worker_id in self._workers:
+                    del self._workers[req.worker_id]
+                    self._epoch += 1
+                    self._save_state_locked()
+                    ack.ok = True
+                else:
+                    ack.ok = False
+                    ack.error = "unknown worker"
+            self._send_frame(conn, _client.MSG_ACK, ack.SerializeToString())
+        elif mtype == _client.MSG_MEMBERSHIP_REQ:
+            rep = pb.MembershipReply()
+            with self._mu:
+                rep.epoch = self._epoch
+                self._fill_peers(rep.peers)
+            self._send_frame(conn, _client.MSG_MEMBERSHIP_REP,
+                             rep.SerializeToString())
+        elif mtype == _client.MSG_STATS_REQ:
+            rep = pb.StatsReply()
+            self.rpc_stats.fill(rep)
+            with self._mu:
+                for wid, rec in sorted(self._workers.items()):
+                    f = rep.flows.add()
+                    f.worker_id = wid
+                    f.flow = rec["flow"]
+                    f.step = rec["step"]
+                    f.metric = rec["metric"]
+            self._send_frame(conn, _client.MSG_STATS_REP,
+                             rep.SerializeToString())
+        else:
+            self._unknown(conn)
+        if trace[0]:
+            self.span_log.emit(mtype, trace[0], trace[1], span_t0,
+                               time.time() - span_t0)
+
+
+class PyShardServer(_FramedServer):
+    """Data-plane daemon: manifest/fetch/put/delete/stats over a blob root.
+
+    Mirrors ``native/shard_server.cc``: CRC-32 sidecars written on PUT and
+    verified on full-file fetch, a CRC terminator chunk on every fetch
+    stream, atomic tmp+rename writes, ``synthetic:<bytes>`` keys, path-
+    traversal refusal, error chunks instead of dropped connections, and
+    flow-aware pacing (well-fed streams sleep between chunks while a
+    starved stream — ``flow_present`` with ``flow == 0`` — is in flight;
+    ``throttled_chunks``/``starved_streams_served`` surface it in stats).
+    Gap vs native: whole-blob reads (no mmap'd zero-copy serving).
+    """
+
+    SIDECAR = ".slt-crc"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 root: Optional[str] = None,
+                 events_log: Optional[str] = None):
+        super().__init__(host, port)
+        self.root = root or "/tmp/slt-shards"
+        os.makedirs(self.root, exist_ok=True)
+        self.span_log = _SpanLog(events_log, "shard-server")
+        self._mu = threading.Lock()
+        self.bytes_served = 0
+        self.bytes_stored = 0
+        self.active_streams = 0
+        self.crc_failures = 0
+        self.throttled_chunks = 0
+        self.starved_streams_served = 0
+        self._starved_in_flight = 0
+        self._put_locks: Dict[str, threading.Lock] = {}
+
+    # -- keys ----
+    def _key_ok(self, key: str) -> bool:
+        if not key or key.startswith("/") or ".." in key.split("/"):
+            return False
+        return not key.endswith(self.SIDECAR)  # reserved namespace
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key)
+
+    def _sidecar(self, key: str) -> str:
+        return self._path(key) + self.SIDECAR
+
+    @staticmethod
+    def _synthetic(key: str) -> Optional[bytes]:
+        # "synthetic:<bytes>": deterministic pseudo-random blob, generated
+        # on demand (native keeps the same contract).
+        if not key.startswith("synthetic:"):
+            return None
+        try:
+            n = int(key.split(":", 1)[1])
+        except ValueError:
+            return None
+        out = bytearray()
+        seed = zlib.crc32(key.encode())
+        x = seed or 1
+        while len(out) < n:
+            x = (x * 6364136223846793005 + 1442695040888963407) & (2**64 - 1)
+            out += x.to_bytes(8, "little")
+        return bytes(out[:n])
+
+    # -- RPC dispatch ----
+    def handle(self, conn, mtype: int, payload: bytes):
+        pb = self.pb
+        span_t0 = time.time()
+        trace = ("", "")
+        if mtype == _client.MSG_MANIFEST_REQ:
+            req = pb.ManifestRequest.FromString(payload)
+            trace = _trace_of(req)
+            self._handle_manifest(conn, req)
+        elif mtype == _client.MSG_FETCH_REQ:
+            req = pb.FetchRequest.FromString(payload)
+            trace = _trace_of(req)
+            self._handle_fetch(conn, req)
+        elif mtype == _client.MSG_PUT_REQ:
+            req = pb.PutRequest.FromString(payload)
+            trace = _trace_of(req)
+            self._handle_put(conn, req)
+        elif mtype == _client.MSG_DELETE_REQ:
+            req = pb.DeleteRequest.FromString(payload)
+            trace = _trace_of(req)
+            ack = pb.Ack()
+            if not self._key_ok(req.key):
+                ack.ok = False
+                ack.error = "bad key"
+            else:
+                try:
+                    os.unlink(self._path(req.key))
+                    try:
+                        os.unlink(self._sidecar(req.key))
+                    except OSError:
+                        pass
+                    ack.ok = True
+                except OSError:
+                    ack.ok = False
+                    ack.error = f"no such key: {req.key}"
+            self._send_frame(conn, _client.MSG_ACK, ack.SerializeToString())
+        elif mtype == _client.MSG_STATS_REQ:
+            rep = pb.StatsReply()
+            with self._mu:
+                rep.bytes_served = self.bytes_served
+                rep.bytes_stored = self.bytes_stored
+                rep.active_streams = self.active_streams
+                rep.crc_failures = self.crc_failures
+                rep.throttled_chunks = self.throttled_chunks
+                rep.starved_streams_served = self.starved_streams_served
+            self.rpc_stats.fill(rep)
+            self._send_frame(conn, _client.MSG_STATS_REP,
+                             rep.SerializeToString())
+        else:
+            self._unknown(conn)
+        if trace[0]:
+            self.span_log.emit(mtype, trace[0], trace[1], span_t0,
+                               time.time() - span_t0)
+
+    def _stored_crc(self, key: str) -> Optional[int]:
+        try:
+            with open(self._sidecar(key)) as f:
+                blob = json.load(f)
+            st = os.stat(self._path(key))
+            if blob.get("inode") not in (None, st.st_ino):
+                return None  # sidecar paired with a different blob
+            return int(blob["crc32"]) & 0xFFFFFFFF
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def _handle_manifest(self, conn, req):
+        pb = self.pb
+        rep = pb.ManifestReply()
+        syn = self._synthetic(req.dataset)
+        if syn is not None:
+            rep.ok = True
+            b = rep.blobs.add()
+            b.key = req.dataset
+            b.size = len(syn)
+            b.crc32 = zlib.crc32(syn)
+        elif not self._key_ok(req.dataset or "x"):
+            rep.ok = False
+            rep.error = "bad dataset"
+        else:
+            base = (os.path.join(self.root, req.dataset) if req.dataset
+                    else self.root)
+            rep.ok = True
+            if os.path.isdir(base):
+                for dirpath, _, files in sorted(os.walk(base)):
+                    for fn in sorted(files):
+                        if fn.endswith(self.SIDECAR) or fn.endswith(".tmp"):
+                            continue
+                        full = os.path.join(dirpath, fn)
+                        key = os.path.relpath(full, self.root)
+                        b = rep.blobs.add()
+                        b.key = key
+                        b.size = os.path.getsize(full)
+                        b.crc32 = self._stored_crc(key) or 0
+        self._send_frame(conn, _client.MSG_MANIFEST_REP,
+                         rep.SerializeToString())
+
+    def _error_chunk(self, conn, msg: str):
+        chunk = self.pb.ChunkMsg(error=msg, last=True)
+        self._send_frame(conn, _client.MSG_CHUNK, chunk.SerializeToString())
+
+    def _handle_fetch(self, conn, req):
+        pb = self.pb
+        if not self._key_ok(req.key) and not req.key.startswith("synthetic:"):
+            self._error_chunk(conn, "bad key")
+            return
+        syn = self._synthetic(req.key)
+        if syn is not None:
+            data = syn
+        else:
+            try:
+                with open(self._path(req.key), "rb") as f:
+                    data = f.read()
+            except OSError:
+                self._error_chunk(conn, f"no such key: {req.key}")
+                return
+            if req.offset == 0 and (req.length == 0
+                                    or req.length >= len(data)):
+                # Full-file fetch (explicit full length included: clients
+                # resolve length via the manifest first): verify disk
+                # bytes against the PUT-time sidecar BEFORE serving —
+                # silent disk corruption becomes a loud error chunk
+                # (native contract).
+                want = self._stored_crc(req.key)
+                if want is not None and zlib.crc32(data) != want:
+                    with self._mu:
+                        self.crc_failures += 1
+                    self._error_chunk(conn,
+                                      f"stored blob corrupt: {req.key}")
+                    return
+        start = min(req.offset, len(data))
+        end = len(data) if req.length == 0 else min(start + req.length,
+                                                    len(data))
+        view = data[start:end]
+        starved = bool(req.flow_present) and req.flow == 0
+        with self._mu:
+            self.active_streams += 1
+            if starved:
+                self.starved_streams_served += 1
+                self._starved_in_flight += 1
+        try:
+            crc = 0
+            off = start
+            pos = 0
+            while pos < len(view):
+                part = view[pos:pos + _CHUNK]
+                crc = zlib.crc32(part, crc)
+                chunk = pb.ChunkMsg(data=part, offset=off)
+                self._send_frame(conn, _client.MSG_CHUNK,
+                                 chunk.SerializeToString())
+                off += len(part)
+                pos += len(part)
+                if not starved:
+                    # Yield bandwidth to starved streams: a well-fed
+                    # consumer (deeper prefetch queue => longer pause)
+                    # sleeps between chunks while anyone is starving.
+                    with self._mu:
+                        starving_now = self._starved_in_flight > 0
+                    if starving_now and pos < len(view):
+                        with self._mu:
+                            self.throttled_chunks += 1
+                        depth = req.flow if req.flow_present else 1
+                        time.sleep(min(0.002 * max(1, depth), 0.02))
+            term = pb.ChunkMsg(offset=off, last=True, crc32=crc,
+                               crc_present=True)
+            self._send_frame(conn, _client.MSG_CHUNK,
+                             term.SerializeToString())
+            with self._mu:
+                self.bytes_served += len(view)
+        finally:
+            with self._mu:
+                self.active_streams -= 1
+                if starved:
+                    self._starved_in_flight -= 1
+
+    def _handle_put(self, conn, req):
+        pb = self.pb
+        key_ok = self._key_ok(req.key)
+        received = bytearray()
+        while True:  # drain the stream even for a doomed put
+            mtype, payload = self._recv_frame(conn)
+            if mtype != _client.MSG_CHUNK:
+                self._send_frame(conn, _client.MSG_ACK, pb.Ack(
+                    ok=False, error="expected chunk").SerializeToString())
+                return
+            chunk = pb.ChunkMsg.FromString(payload)
+            if chunk.data:
+                received += chunk.data
+            if chunk.last:
+                break
+        ack = pb.Ack()
+        crc = zlib.crc32(bytes(received))
+        if not key_ok:
+            ack.ok = False
+            ack.error = "bad key"
+        elif req.crc_present and crc != req.crc32:
+            with self._mu:
+                self.crc_failures += 1
+            ack.ok = False
+            ack.error = "crc mismatch"
+        else:
+            path = self._path(req.key)
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            lock_key = req.key
+            with self._mu:
+                lk = self._put_locks.setdefault(lock_key, threading.Lock())
+            with lk:
+                tmp = f"{path}.{os.getpid()}-{threading.get_ident()}.tmp"
+                try:
+                    with open(tmp, "wb") as f:
+                        f.write(received)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(tmp, path)
+                    st = os.stat(path)
+                    with open(self._sidecar(req.key) + ".tmp", "w") as f:
+                        json.dump({"crc32": crc, "inode": st.st_ino}, f)
+                    os.replace(self._sidecar(req.key) + ".tmp",
+                               self._sidecar(req.key))
+                    with self._mu:
+                        self.bytes_stored += len(received)
+                    ack.ok = True
+                except OSError as e:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+                    ack.ok = False
+                    ack.error = f"write failed: {e}"
+        self._send_frame(conn, _client.MSG_ACK, ack.SerializeToString())
+
+
+def _run_until_sigterm(srv) -> int:
+    """Serve until SIGTERM/SIGINT; exit 0 like the native daemons (tests
+    assert a clean shutdown; durable state was already saved per change)."""
+    import signal
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    srv.start()
+    try:
+        while not stop.wait(0.1):
+            pass
+    finally:
+        srv.stop()
+    return 0
+
+
+def main_coordinator(argv) -> int:
+    """`slt coordinator` fallback entry (same flags as the native daemon)."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=50052)
+    p.add_argument("--lease_ttl_ms", type=int, default=5000)
+    p.add_argument("--sweep_ms", type=int, default=500)
+    p.add_argument("--state_file", default=None)
+    p.add_argument("--events_log", default=None)
+    args = p.parse_args(argv)
+    srv = PyCoordinator(host="0.0.0.0", port=args.port,
+                        lease_ttl_ms=args.lease_ttl_ms,
+                        sweep_ms=args.sweep_ms, state_file=args.state_file,
+                        events_log=args.events_log)
+    print(json.dumps({"event": "py_coordinator_up", "addr": srv.addr}),
+          flush=True)
+    return _run_until_sigterm(srv)
+
+
+def main_shard_server(argv) -> int:
+    """`slt shard-server` fallback entry (same flags as the native daemon)."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=50053)
+    p.add_argument("--root", default=None)
+    p.add_argument("--events_log", default=None)
+    args = p.parse_args(argv)
+    srv = PyShardServer(host="0.0.0.0", port=args.port, root=args.root,
+                        events_log=args.events_log)
+    print(json.dumps({"event": "py_shard_server_up", "addr": srv.addr,
+                      "root": srv.root}), flush=True)
+    return _run_until_sigterm(srv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    role = sys.argv[1] if len(sys.argv) > 1 else ""
+    if role == "coordinator":
+        sys.exit(main_coordinator(sys.argv[2:]))
+    if role == "shard-server":
+        sys.exit(main_shard_server(sys.argv[2:]))
+    print("usage: python -m serverless_learn_tpu.control.py_daemons "
+          "{coordinator|shard-server} [flags]", file=sys.stderr)
+    sys.exit(2)

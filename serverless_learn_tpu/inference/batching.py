@@ -135,7 +135,7 @@ class BatchingEngine:
 
     def submit(self, prompt: List[int], max_new: int, temperature: float,
                top_k: int, eos_id: Optional[int], seed: int,
-               timeout_s: float = 600.0) -> dict:
+               timeout_s: float = 600.0, trace=None) -> dict:
         """Blocks until the dispatcher serves this request; returns either
         {"new_tokens": [...]} or {"error": ...}."""
         max_seq = self.module.cfg.max_seq_len
@@ -160,7 +160,9 @@ class BatchingEngine:
         p.group_key = (temperature, top_k, eos_id,
                        seed if temperature > 0 else None,
                        _shape_buckets(len(prompt), max_new, max_seq))
-        p.span = Span("request")
+        p.span = (Span("request", trace_id=trace.trace_id,
+                       parent_id=trace.span_id)
+                  if trace is not None else Span("request"))
         self._m_requests.inc()
         self._q.put(p)
         if not p.done.wait(timeout_s):

@@ -67,7 +67,9 @@ import numpy as np
 from serverless_learn_tpu.inference.batching import _bucket
 from serverless_learn_tpu.inference.generate import init_cache
 from serverless_learn_tpu.telemetry import (RATE_BUCKETS, SIZE_BUCKETS,
-                                            Span, get_registry)
+                                            Span, TraceContext, get_registry)
+from serverless_learn_tpu.telemetry import flight
+from serverless_learn_tpu.telemetry.tracing import node_name
 
 
 def _fold_keys(seeds: jax.Array, positions: jax.Array) -> jax.Array:
@@ -277,10 +279,15 @@ class ContinuousBatchingEngine:
 
     def submit(self, prompt: List[int], max_new: int, temperature: float,
                top_k: int, eos_id: Optional[int], seed: int,
-               timeout_s: float = 600.0) -> dict:
+               timeout_s: float = 600.0,
+               trace: Optional[TraceContext] = None) -> dict:
         """Blocks until the dispatcher finishes this request; returns
         {"new_tokens": [...]} or {"error": ...}. Same contract as
-        ``BatchingEngine.submit`` so the server swaps engines freely."""
+        ``BatchingEngine.submit`` so the server swaps engines freely.
+        ``trace``: the caller's trace context (e.g. from an ``X-SLT-Trace``
+        / ``"traceparent"`` member on the wire request) — the request span
+        chains under it, completing the client -> server causal edge in
+        `slt trace` timelines."""
         max_seq = self.module.cfg.max_seq_len
         if len(prompt) == 0:
             return {"error": "prompt must contain at least one token"}
@@ -295,7 +302,11 @@ class ContinuousBatchingEngine:
         r = _Request(prompt=list(prompt), max_new=max_new,
                      temperature=float(temperature), top_k=int(top_k),
                      eos_id=eos_id, seed=int(seed))
-        r.span = Span("request")
+        if trace is not None:
+            r.span = Span("request", trace_id=trace.trace_id,
+                          parent_id=trace.span_id)
+        else:
+            r.span = Span("request")
         self._m_requests.inc()
         self._q.put(r)
         if not r.done.wait(timeout_s):
@@ -314,6 +325,15 @@ class ContinuousBatchingEngine:
     def _free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self._slots) if r is None]
 
+    def _emit_span(self, span) -> None:
+        """Span record -> the JSONL event log (node-stamped, so multi-node
+        logs merge cleanly in `slt trace`) + the flight-recorder ring."""
+        rec = span.to_event()
+        rec.setdefault("node", node_name())
+        if self.event_log is not None:
+            self.event_log.emit(rec)
+        flight.record(rec)
+
     def _cancel(self, r: _Request):
         """Retire an abandoned request: its submitter already returned."""
         r.finished = True
@@ -322,8 +342,7 @@ class ContinuousBatchingEngine:
         self._m_cancelled.inc()
         if r.span is not None:
             r.span.mark("cancelled")
-            if self.event_log is not None:
-                self.event_log.emit(r.span.to_event())
+            self._emit_span(r.span)
 
     def _admit(self, staged: List[_Request]) -> Optional[tuple]:
         # Timed-out submitters never decode: drop their queue entries
@@ -438,10 +457,9 @@ class ContinuousBatchingEngine:
                     decode = r.span.between("first_token", "done")
                     if decode is not None and r.max_new > 1:
                         self._m_per_tok.observe(decode / (r.max_new - 1))
-                    if self.event_log is not None:
-                        r.span.meta["max_new"] = r.max_new
-                        r.span.meta["batch_size"] = r.peak_batch
-                        self.event_log.emit(r.span.to_event())
+                    r.span.meta["max_new"] = r.max_new
+                    r.span.meta["batch_size"] = r.peak_batch
+                    self._emit_span(r.span)
                 if self._slots[sid] is r:
                     self._slots[sid] = None
                 r.done.set()

@@ -43,7 +43,8 @@ class GenerationServer:
                  max_batch: int = 8, batch_wait_ms: float = 3.0,
                  engine: str = "continuous", chunk_size: int = 32,
                  registry=None, metrics_port: Optional[int] = None,
-                 event_log_path: Optional[str] = None):
+                 event_log_path: Optional[str] = None,
+                 profile_dir: Optional[str] = None):
         from serverless_learn_tpu.telemetry import (JsonlEventLog,
                                                     get_registry)
 
@@ -81,8 +82,11 @@ class GenerationServer:
         if metrics_port is not None:
             from serverless_learn_tpu.telemetry import MetricsExporter
 
+            # profile_dir arms /debug/profile: an on-demand jax.profiler
+            # capture from a live serving node, no restart required.
             self._exporter = MetricsExporter(self.registry, host=host,
-                                             port=metrics_port).start()
+                                             port=metrics_port,
+                                             profile_dir=profile_dir).start()
             self.metrics_addr = self._exporter.addr
         self._m_requests = self.registry.counter(
             "slt_server_requests_total", "requests answered over the wire")
@@ -124,6 +128,13 @@ class GenerationServer:
 
     def _handle(self, req: dict) -> dict:
         t0 = time.perf_counter()
+        # Optional W3C-style trace context on the wire request: the engine
+        # span chains under the CLIENT's span, so `slt trace` over the
+        # client's and this server's span logs shows one causal chain.
+        # Malformed values parse to None — tracing never fails a request.
+        from serverless_learn_tpu.telemetry import parse_traceparent
+
+        trace = parse_traceparent(req.get("traceparent"))
         prompt = req.get("prompt")
         if (not isinstance(prompt, list) or not prompt
                 or not all(isinstance(t, int) for t in prompt)):
@@ -140,15 +151,18 @@ class GenerationServer:
             prompt, max_new, temperature=float(req.get("temperature", 0.0)),
             top_k=int(req.get("top_k", 0)),
             eos_id=None if eos is None else int(eos),
-            seed=int(req.get("seed", 0)))
+            seed=int(req.get("seed", 0)), trace=trace)
         if "error" in rep:
             return rep
         with self._stats_lock:
             self.requests_served += 1
-        return {"tokens": prompt + rep["new_tokens"],
-                "new_tokens": rep["new_tokens"],
-                "batch_size": rep.get("batch_size", 1),
-                "latency_ms": round((time.perf_counter() - t0) * 1e3, 2)}
+        out = {"tokens": prompt + rep["new_tokens"],
+               "new_tokens": rep["new_tokens"],
+               "batch_size": rep.get("batch_size", 1),
+               "latency_ms": round((time.perf_counter() - t0) * 1e3, 2)}
+        if trace is not None:
+            out["trace_id"] = trace.trace_id  # echo for client correlation
+        return out
 
     # -- socket loop -------------------------------------------------------
 

@@ -10,9 +10,16 @@ time, samples/sec/chip, MFU, grad-accum), the elastic/DiLoCo control plane
 (membership, heartbeat RTT, lease expiries, round lag, liveness escapes),
 and the native daemons' ``StatsReply`` via :func:`publish_rpc_stats`.
 
+PR 2 adds the distributed-tracing layer: W3C-style context propagation
+(``telemetry/tracing.py``), the crash-dump flight recorder
+(``telemetry/flight.py``), and cross-node timeline reconstruction for
+``slt trace`` (``telemetry/timeline.py``).
+
 See the "Observability" section of ``docs/ARCHITECTURE.md`` for the metric
-naming scheme and endpoint formats.
+naming scheme, endpoint formats, and the tracing data flow.
 """
+
+import math
 
 from serverless_learn_tpu.telemetry.exporter import (MetricsExporter,
                                                      fetch_text)
@@ -23,26 +30,57 @@ from serverless_learn_tpu.telemetry.registry import (LATENCY_BUCKETS,
                                                      JsonlEventLog,
                                                      MetricsRegistry, Span,
                                                      get_registry)
+from serverless_learn_tpu.telemetry.tracing import (TraceContext,
+                                                    current_context,
+                                                    init_tracing,
+                                                    parse_traceparent)
 
 __all__ = [
     "LATENCY_BUCKETS", "RATE_BUCKETS", "SIZE_BUCKETS",
     "Counter", "Gauge", "Histogram", "JsonlEventLog", "MetricsRegistry",
-    "MetricsExporter", "Span", "fetch_text", "get_registry",
+    "MetricsExporter", "Span", "TraceContext", "current_context",
+    "fetch_text", "get_registry", "init_tracing", "parse_traceparent",
     "publish_rpc_stats",
 ]
+
+
+def _finite_nonneg(v) -> float:
+    """Bounds guard for scraped values: a daemon-reported stat must land as
+    a usable gauge or not at all — NaN/inf/negative (clock skew, torn
+    reads, a hostile reply) clamp to 0 instead of poisoning the series."""
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return 0.0
+    if not math.isfinite(f) or f < 0:
+        return 0.0
+    return f
 
 
 def publish_rpc_stats(summary, registry=None, daemon: str = ""):
     """Scrape a ``tracing.rpc_stats``/``Tracer.summary``-shaped dict into
     the registry, one series per RPC. Gauges, not counters: the values are
     cumulative totals owned by the daemon — re-scraping overwrites, so a
-    daemon restart never double-counts."""
+    daemon restart never double-counts.
+
+    Bounds handling: entries are validated, not trusted. Non-dict rows are
+    skipped; count/total/max clamp to finite non-negatives; names from
+    out-of-range MsgType tags (``msg_<N>`` for gaps in the table, "other"
+    for the daemons' >= kMaxMsgType overflow slot — see
+    ``utils/tracing.MSG_TYPE_NAMES``) publish like any other series, so a
+    tag this build doesn't know can no longer silently drop its max
+    latency from the scrape."""
     reg = registry or get_registry()
     for name, s in summary.items():
-        labels = {"rpc": name.split("/", 1)[-1]}
+        if not isinstance(s, dict):
+            continue
+        labels = {"rpc": str(name).split("/", 1)[-1][:64]}
         if daemon:
             labels["daemon"] = daemon
-        reg.gauge("slt_rpc_calls", **labels).set(s.get("count", 0))
-        reg.gauge("slt_rpc_time_seconds", **labels).set(s.get("total_s", 0.0))
-        reg.gauge("slt_rpc_max_seconds", **labels).set(s.get("max_s", 0.0))
+        reg.gauge("slt_rpc_calls", **labels).set(
+            _finite_nonneg(s.get("count", 0)))
+        reg.gauge("slt_rpc_time_seconds", **labels).set(
+            _finite_nonneg(s.get("total_s", 0.0)))
+        reg.gauge("slt_rpc_max_seconds", **labels).set(
+            _finite_nonneg(s.get("max_s", 0.0)))
     return reg

@@ -1,35 +1,52 @@
 """HTTP metrics endpoint: a tiny stdlib thread serving the registry.
 
-    GET /metrics       Prometheus text exposition (0.0.4)
-    GET /metrics.json  nested JSON snapshot (same data, typed)
-    GET /healthz       {"ok": true}
+    GET /metrics                   Prometheus text exposition (0.0.4)
+    GET /metrics.json              nested JSON snapshot (same data, typed)
+    GET /healthz                   {"ok": true}
+    GET /debug/profile?seconds=N   capture a jax.profiler device trace
+                                   (enabled by `serve --profile-dir DIR`)
 
 One ThreadingHTTPServer on a daemon thread — zero dependencies, safe to
 embed in a serving process (scrapes read a consistent snapshot under the
 registry lock; they never touch the device). Every process that wants to
 appear in ``slt top`` starts one of these (``--metrics-port`` on the CLI's
 serve/train/worker/diloco commands).
+
+``/debug/profile`` makes ``--profile-dir`` useful on a LIVE node: instead
+of restarting the server to bracket a run with ``jax.profiler.trace``, an
+operator curls the endpoint and gets an on-demand N-second device trace
+written under the configured directory (TensorBoard/Perfetto loadable).
+One capture at a time (the profiler is process-global); an ``X-SLT-Trace``
+traceparent header on the request records the capture as a span in the
+caller's distributed trace.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 from serverless_learn_tpu.telemetry.registry import (MetricsRegistry,
                                                      get_registry)
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+MAX_PROFILE_SECONDS = 60.0
 
 
 class MetricsExporter:
     """Serve one registry over HTTP from a background thread."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 profile_dir: Optional[str] = None):
         self.registry = registry or get_registry()
+        self.profile_dir = profile_dir
+        self._profile_lock = threading.Lock()
         exporter = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -43,8 +60,13 @@ class MetricsExporter:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _reply_json(self, code: int, obj: dict):
+                self._reply(code, "application/json",
+                            json.dumps(obj).encode())
+
             def do_GET(self):
-                path = self.path.split("?")[0]
+                url = urlparse(self.path)
+                path = url.path
                 try:
                     if path == "/metrics":
                         body = exporter.registry.render_prometheus()
@@ -54,6 +76,11 @@ class MetricsExporter:
                         self._reply(200, "application/json", body.encode())
                     elif path == "/healthz":
                         self._reply(200, "application/json", b'{"ok": true}')
+                    elif path == "/debug/profile":
+                        code, obj = exporter._profile(
+                            parse_qs(url.query),
+                            self.headers.get("X-SLT-Trace"))
+                        self._reply_json(code, obj)
                     else:
                         self._reply(404, "text/plain", b"not found\n")
                 except (BrokenPipeError, ConnectionResetError):
@@ -63,6 +90,47 @@ class MetricsExporter:
         self._httpd.daemon_threads = True
         self.addr = f"{host}:{self._httpd.server_address[1]}"
         self._thread: Optional[threading.Thread] = None
+
+    # -- on-demand device profiling ---------------------------------------
+
+    def _profile(self, query: dict, trace_header: Optional[str]):
+        """Handle /debug/profile: returns (http_code, reply_json)."""
+        if not self.profile_dir:
+            return 404, {"ok": False,
+                         "error": "profiling disabled; start this process "
+                                  "with --profile-dir DIR to enable"}
+        try:
+            seconds = float(query.get("seconds", ["3"])[0])
+        except ValueError:
+            return 400, {"ok": False, "error": "seconds must be a number"}
+        if not (0 < seconds <= MAX_PROFILE_SECONDS):
+            return 400, {"ok": False,
+                         "error": f"seconds must be in (0, "
+                                  f"{MAX_PROFILE_SECONDS:g}]"}
+        if not self._profile_lock.acquire(blocking=False):
+            return 409, {"ok": False,
+                         "error": "a profile capture is already running"}
+        try:
+            from serverless_learn_tpu.telemetry import tracing as ttrace
+
+            parent = ttrace.parse_traceparent(trace_header)
+            out_dir = os.path.join(self.profile_dir,
+                                   f"profile-{int(time.time())}")
+            with ttrace.span("debug/profile", parent=parent,
+                             emit=parent is not None, dir=out_dir,
+                             seconds=seconds):
+                import jax.profiler
+
+                jax.profiler.start_trace(out_dir)
+                try:
+                    time.sleep(seconds)
+                finally:
+                    jax.profiler.stop_trace()
+            return 200, {"ok": True, "dir": out_dir, "seconds": seconds}
+        except Exception as e:
+            return 500, {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        finally:
+            self._profile_lock.release()
 
     def start(self) -> "MetricsExporter":
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -78,10 +146,11 @@ class MetricsExporter:
 
 
 def fetch_text(addr: str, path: str = "/metrics",
-               timeout: float = 5.0) -> str:
+               timeout: float = 5.0, headers: Optional[dict] = None) -> str:
     """One scrape of ``host:port`` (no scheme) — the client `slt top` and
-    the endpoint tests share."""
-    from urllib.request import urlopen
+    the endpoint tests share. ``headers`` rides extras (X-SLT-Trace)."""
+    from urllib.request import Request, urlopen
 
-    with urlopen(f"http://{addr}{path}", timeout=timeout) as r:
+    req = Request(f"http://{addr}{path}", headers=headers or {})
+    with urlopen(req, timeout=timeout) as r:
         return r.read().decode()

@@ -1,0 +1,183 @@
+"""Flight recorder: post-mortem forensics for a dying node.
+
+A bounded in-memory ring holds the last N span/lifecycle events of this
+process (every ``tracing.emit_span`` feeds it, plus explicit ``record``
+calls from the training/elastic/serving layers). On SIGTERM, on an
+unhandled exception (main thread or any worker thread), or on a control
+-plane lease expiry, the ring — together with a metrics-registry snapshot
+and a ``jax`` device-memory snapshot when one is cheaply available — is
+dumped to ``flight-<node>-<timestamp>.json`` so "what was this node doing
+when it died" survives the node. ``slt trace`` ingests the dumps alongside
+live JSONL span logs.
+
+The recorder always exists (recording into a ring is a deque append);
+``install()`` arms the dump-on-death handlers and fixes the output
+directory. Dumps are best-effort everywhere: a full disk or a torn-down
+interpreter must never turn a clean SIGTERM into a hang or a traceback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+DEFAULT_CAPACITY = 2048
+
+_lock = threading.Lock()
+_ring: deque = deque(maxlen=DEFAULT_CAPACITY)
+_installed = False
+_flight_dir: Optional[str] = None
+_prev_sigterm = None
+_prev_excepthook = None
+_prev_thread_hook = None
+
+
+def record(event: dict):
+    """Append one event to the ring (thread-safe, bounded, never raises)."""
+    try:
+        with _lock:
+            _ring.append(dict(event, flight_ts=round(time.time(), 6)))
+    except Exception:
+        pass
+
+
+def events() -> List[dict]:
+    with _lock:
+        return list(_ring)
+
+
+def set_capacity(n: int):
+    global _ring
+    with _lock:
+        _ring = deque(_ring, maxlen=max(1, int(n)))
+
+
+def installed() -> bool:
+    return _installed
+
+
+def _device_memory() -> Optional[list]:
+    """Per-device memory stats, only if jax is ALREADY imported (a crash
+    handler must not pay a cold jax import) and the backend reports them
+    (CPU returns None/raises; TPU/GPU give bytes_in_use etc.)."""
+    if "jax" not in sys.modules:
+        return None
+    try:
+        import jax
+
+        out = []
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if stats:
+                out.append({"device": str(d), **{k: v for k, v in
+                                                 stats.items()}})
+        return out or None
+    except Exception:
+        return None
+
+
+def dump(reason: str, dir: Optional[str] = None) -> Optional[str]:
+    """Write the flight file; returns its path (None on failure)."""
+    from serverless_learn_tpu.telemetry import get_registry
+    from serverless_learn_tpu.telemetry.tracing import node_name
+
+    try:
+        node = node_name()
+        out_dir = dir or _flight_dir or "."
+        safe = "".join(c if (c.isalnum() or c in "-_.") else "_"
+                       for c in node)
+        path = os.path.join(out_dir, f"flight-{safe}-{int(time.time())}.json")
+        payload = {
+            "event": "flight_dump",
+            "node": node,
+            "pid": os.getpid(),
+            "reason": reason,
+            "dumped_at_unix_s": round(time.time(), 6),
+            "events": events(),
+        }
+        try:
+            payload["metrics"] = get_registry().snapshot()
+        except Exception:
+            pass
+        mem = _device_memory()
+        if mem is not None:
+            payload["device_memory"] = mem
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        return path
+    except Exception:
+        return None
+
+
+def maybe_dump(reason: str) -> Optional[str]:
+    """Dump only when handlers are installed — library code (WorkerAgent on
+    lease expiry) calls this so bare clients never spray files."""
+    if not _installed:
+        return None
+    return dump(reason)
+
+
+def _on_sigterm(signum, frame):
+    dump("sigterm")
+    # Restore whatever was there before and re-deliver, so the process
+    # still dies with the default/user semantics (exit code 143 etc.).
+    prev = _prev_sigterm
+    if callable(prev):
+        prev(signum, frame)
+        return
+    signal.signal(signal.SIGTERM, prev if prev is not None
+                  else signal.SIG_DFL)
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+def _on_excepthook(exc_type, exc, tb):
+    if not issubclass(exc_type, (KeyboardInterrupt, SystemExit)):
+        dump(f"unhandled:{exc_type.__name__}")
+    (_prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+
+def _on_thread_hook(args):
+    if not issubclass(args.exc_type, SystemExit):
+        dump(f"thread-unhandled:{args.exc_type.__name__}")
+    if _prev_thread_hook is not None:
+        _prev_thread_hook(args)
+
+
+def install(flight_dir: Optional[str] = None,
+            capacity: Optional[int] = None) -> bool:
+    """Arm dump-on-death: SIGTERM handler + sys/threading excepthooks.
+    Idempotent; returns True when armed (False off the main thread, where
+    signal handlers cannot be set — hooks still work via a direct call)."""
+    global _installed, _flight_dir, _prev_sigterm, _prev_excepthook
+    global _prev_thread_hook
+    if flight_dir:
+        _flight_dir = flight_dir
+    if capacity:
+        set_capacity(capacity)
+    if _installed:
+        return True
+    _prev_excepthook = sys.excepthook
+    sys.excepthook = _on_excepthook
+    _prev_thread_hook = getattr(threading, "excepthook", None)
+    if _prev_thread_hook is not None:
+        threading.excepthook = _on_thread_hook
+    try:
+        _prev_sigterm = signal.getsignal(signal.SIGTERM)
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):
+        # Not the main thread: no signal hook, but hooks above are armed.
+        _installed = True
+        return False
+    _installed = True
+    return True

@@ -27,7 +27,6 @@ histogram story and the per-request story can never drift apart.
 
 from __future__ import annotations
 
-import itertools
 import json
 import os
 import threading
@@ -347,7 +346,9 @@ def get_registry() -> MetricsRegistry:
 
 # -- request spans -----------------------------------------------------------
 
-_span_seq = itertools.count(1)
+
+def _rand_hex(n_bytes: int) -> str:
+    return os.urandom(n_bytes).hex()
 
 
 class Span:
@@ -357,15 +358,27 @@ class Span:
     request flowing through the pipeline; writers hand off with the
     request). ``between`` returns durations for histogram observation;
     ``to_event`` is the JSONL event-log record shape.
+
+    Since PR 2 a span also carries distributed-trace identity — a W3C-style
+    128-bit ``trace_id``, its own 64-bit ``span_id``, an optional
+    ``parent_id`` (the caller's span, possibly in ANOTHER process), and the
+    wall-clock start ``t0_unix`` — so per-node JSONL logs can be merged
+    into one causal cross-node timeline by ``telemetry/timeline.py``.
+    Marks stay on the monotonic clock; only the anchor is wall time.
     """
 
-    __slots__ = ("name", "trace_id", "t0", "marks", "meta")
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "t0",
+                 "t0_unix", "marks", "meta")
 
-    def __init__(self, name: str, trace_id: Optional[str] = None):
+    def __init__(self, name: str, trace_id: Optional[str] = None,
+                 parent_id: Optional[str] = None,
+                 span_id: Optional[str] = None):
         self.name = name
-        self.trace_id = (trace_id
-                         or f"{os.getpid():x}-{next(_span_seq):x}")
+        self.trace_id = trace_id or _rand_hex(16)
+        self.span_id = span_id or _rand_hex(8)
+        self.parent_id = parent_id
         self.t0 = time.perf_counter()
+        self.t0_unix = time.time()
         self.marks: Dict[str, float] = {}
         self.meta: Dict[str, object] = {}
 
@@ -385,12 +398,28 @@ class Span:
             return None
         return self.marks[b] - start
 
+    @property
+    def duration_s(self) -> float:
+        """Span start to its latest mark (0.0 while unmarked)."""
+        return max(self.marks.values()) if self.marks else 0.0
+
+    def finish(self) -> float:
+        """Mark the canonical end ("done"); returns the duration."""
+        self.mark("done")
+        return self.duration_s
+
     def to_event(self) -> dict:
-        return {"event": "span", "span": self.name,
-                "trace_id": self.trace_id,
-                "marks_s": {k: round(v, 6)
-                            for k, v in sorted(self.marks.items())},
-                **{k: v for k, v in self.meta.items()}}
+        rec = {"event": "span", "span": self.name,
+               "trace_id": self.trace_id,
+               "span_id": self.span_id,
+               "t0_unix_s": round(self.t0_unix, 6),
+               "duration_s": round(self.duration_s, 6),
+               "marks_s": {k: round(v, 6)
+                           for k, v in sorted(self.marks.items())},
+               **{k: v for k, v in self.meta.items()}}
+        if self.parent_id:
+            rec["parent_id"] = self.parent_id
+        return rec
 
 
 class JsonlEventLog:

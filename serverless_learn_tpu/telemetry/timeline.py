@@ -1,0 +1,334 @@
+"""Cross-node timeline reconstruction (`slt trace`).
+
+Input: any mix of per-node JSONL span logs (``--events-log``, the native
+daemons' ``--events_log``) and flight-recorder dumps
+(``telemetry/flight.py``). Output: one causal, clock-skew-corrected
+timeline — a Chrome/Perfetto ``trace_event`` JSON plus a critical-path
+summary — answering "where did this request's time actually go" across
+worker, coordinator, shard server and serving engine.
+
+**Clock skew.** Every node stamps spans with ITS OWN wall clock; merging
+raw timestamps across hosts produces children that start before their
+parents. Each client RPC span brackets its server-side counterpart
+(request leaves after the client span opens, reply lands before it
+closes), so a matched (client span → server span) pair yields a bounded
+offset estimate exactly as Cristian's algorithm extracts time from an RTT
+— the midpoint difference, with the client span's RTT bounding the error.
+``WorkerAgent``'s 1 Hz heartbeats make worker↔coordinator pairs plentiful
+for free. Per node pair we take the median midpoint difference, then
+anchor everything to a root node (most-spans by default) through the
+pair graph, so nodes that never talk directly still get corrected through
+a common peer.
+
+**Critical path.** Within one trace, a span's *self time* is its duration
+minus the time covered by its child spans — the per-hop attribution that
+says "the fetch itself was fast; the coordinator sat on the request".
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class TSpan:
+    """One normalized span record on the shared timeline."""
+
+    name: str
+    node: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    start: float  # unix seconds, this node's clock (corrected later)
+    duration: float
+    marks: Dict[str, float] = field(default_factory=dict)
+    meta: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def end(self) -> float:
+        return self.start + self.duration
+
+    @property
+    def mid(self) -> float:
+        return self.start + self.duration / 2.0
+
+
+@dataclass
+class Timeline:
+    spans: List[TSpan]
+    offsets: Dict[str, float]           # node -> seconds ADDED to its clock
+    root_node: str
+    skipped: int                        # records without trace identity
+    pair_samples: Dict[Tuple[str, str], int] = field(default_factory=dict)
+
+    @property
+    def nodes(self) -> List[str]:
+        return sorted({s.node for s in self.spans})
+
+    def traces(self) -> Dict[str, List[TSpan]]:
+        out: Dict[str, List[TSpan]] = {}
+        for s in self.spans:
+            out.setdefault(s.trace_id, []).append(s)
+        return out
+
+
+# -- loading -----------------------------------------------------------------
+
+_META_KEYS = {"event", "span", "trace_id", "span_id", "parent_id", "node",
+              "t0_unix_s", "duration_s", "marks_s", "ts", "flight_ts"}
+
+
+def _expand_paths(paths: List[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*.jsonl")))
+                         + sorted(glob.glob(os.path.join(p, "*.json"))))
+        elif any(c in p for c in "*?["):
+            files.extend(sorted(glob.glob(p)))
+        else:
+            files.append(p)
+    return files
+
+
+def load_events(paths: List[str]) -> List[dict]:
+    """Read JSONL span logs and flight dumps into a flat record list.
+    Unparseable lines are skipped (a crash can tear a final line)."""
+    records: List[dict] = []
+    for path in _expand_paths(paths):
+        try:
+            with open(path) as f:
+                head = f.read(1)
+                f.seek(0)
+                if head == "{":  # flight dump OR single-object json
+                    try:
+                        obj = json.load(f)
+                    except json.JSONDecodeError:
+                        f.seek(0)
+                        obj = None
+                    if isinstance(obj, dict):
+                        if obj.get("event") == "flight_dump":
+                            node = obj.get("node")
+                            for ev in obj.get("events", []):
+                                if node and "node" not in ev:
+                                    ev = dict(ev, node=node)
+                                records.append(ev)
+                        else:
+                            records.append(obj)
+                        continue
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        records.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+        except OSError:
+            continue
+    return records
+
+
+def normalize(records: List[dict]) -> Tuple[List[TSpan], int]:
+    """Span-shaped records -> TSpans; returns (spans, skipped). Records
+    without cross-node identity (pre-PR2 spans, lifecycle events) are
+    counted, not fatal."""
+    spans: List[TSpan] = []
+    skipped = 0
+    for rec in records:
+        if rec.get("event") != "span":
+            continue
+        trace_id, span_id = rec.get("trace_id"), rec.get("span_id")
+        t0 = rec.get("t0_unix_s")
+        if not trace_id or not span_id or t0 is None:
+            skipped += 1
+            continue
+        marks = rec.get("marks_s") or {}
+        dur = rec.get("duration_s")
+        if dur is None:
+            dur = max(marks.values()) if marks else 0.0
+        spans.append(TSpan(
+            name=str(rec.get("span", "span")),
+            node=str(rec.get("node", "?")),
+            trace_id=str(trace_id), span_id=str(span_id),
+            parent_id=rec.get("parent_id") or None,
+            start=float(t0), duration=max(0.0, float(dur)),
+            marks={str(k): float(v) for k, v in marks.items()},
+            meta={k: v for k, v in rec.items() if k not in _META_KEYS}))
+    return spans, skipped
+
+
+# -- clock-skew estimation ---------------------------------------------------
+
+def _median(vals: List[float]) -> float:
+    vals = sorted(vals)
+    n = len(vals)
+    mid = n // 2
+    return vals[mid] if n % 2 else (vals[mid - 1] + vals[mid]) / 2.0
+
+
+def estimate_offsets(spans: List[TSpan], root: Optional[str] = None
+                     ) -> Tuple[Dict[str, float], str,
+                                Dict[Tuple[str, str], int]]:
+    """Per-node clock offsets (seconds to ADD to that node's timestamps)
+    anchored at ``root``. Cristian-style: for every cross-node (client
+    parent → server child) span pair, the child's clock maps into the
+    parent's as ``t + (mid(parent) - mid(child))``; medians per node pair,
+    then BFS through the pair graph from the root."""
+    by_id = {s.span_id: s for s in spans}
+    samples: Dict[Tuple[str, str], List[float]] = {}
+    for s in spans:
+        p = by_id.get(s.parent_id or "")
+        if p is None or p.node == s.node:
+            continue
+        samples.setdefault((p.node, s.node), []).append(p.mid - s.mid)
+    nodes = {s.node for s in spans}
+    if not nodes:
+        return {}, root or "?", {}
+    if root is None or root not in nodes:
+        counts = {n: 0 for n in nodes}
+        for s in spans:
+            counts[s.node] += 1
+        root = max(sorted(nodes), key=lambda n: counts[n])
+    adj: Dict[str, List[Tuple[str, float]]] = {}
+    for (a, b), vals in samples.items():
+        med = _median(vals)
+        # med maps b's clock into a's frame; the reverse edge negates.
+        adj.setdefault(a, []).append((b, med))
+        adj.setdefault(b, []).append((a, -med))
+    offsets = {root: 0.0}
+    queue = [root]
+    while queue:
+        n = queue.pop(0)
+        for m, off in adj.get(n, []):
+            if m not in offsets:
+                offsets[m] = offsets[n] + off
+                queue.append(m)
+    for n in nodes:
+        offsets.setdefault(n, 0.0)  # unreachable nodes: trust their clock
+    return offsets, root, {k: len(v) for k, v in samples.items()}
+
+
+def reconstruct(paths: List[str], skew: bool = True,
+                root: Optional[str] = None) -> Timeline:
+    """Logs -> one merged Timeline with corrected ``start`` times."""
+    spans, skipped = normalize(load_events(paths))
+    if skew:
+        offsets, root_node, pairs = estimate_offsets(spans, root)
+    else:
+        offsets = {s.node: 0.0 for s in spans}
+        root_node, pairs = root or "?", {}
+    for s in spans:
+        s.start += offsets.get(s.node, 0.0)
+    return Timeline(spans=spans, offsets=offsets, root_node=root_node,
+                    skipped=skipped, pair_samples=pairs)
+
+
+# -- critical path -----------------------------------------------------------
+
+def critical_path(trace_spans: List[TSpan]) -> List[dict]:
+    """Per-hop attribution for one trace: each span's self time (duration
+    minus time covered by its children, clipped to the span), worst first."""
+    children: Dict[str, List[TSpan]] = {}
+    for s in trace_spans:
+        if s.parent_id:
+            children.setdefault(s.parent_id, []).append(s)
+    rows = []
+    for s in trace_spans:
+        covered = 0.0
+        for c in children.get(s.span_id, []):
+            covered += max(0.0, min(c.end, s.end) - max(c.start, s.start))
+        rows.append({"span": s.name, "node": s.node,
+                     "span_id": s.span_id, "parent_id": s.parent_id,
+                     "start_s": round(s.start, 6),
+                     "duration_s": round(s.duration, 6),
+                     "self_s": round(max(0.0, s.duration - covered), 6)})
+    rows.sort(key=lambda r: -r["self_s"])
+    return rows
+
+
+def chain_depth(trace_spans: List[TSpan]) -> int:
+    """Longest parent→child chain (cross- or in-process) in the trace."""
+    by_id = {s.span_id: s for s in trace_spans}
+    best = 0
+    for s in trace_spans:
+        d, cur, seen = 1, s, set()
+        while cur.parent_id and cur.parent_id in by_id \
+                and cur.parent_id not in seen:
+            seen.add(cur.parent_id)
+            cur = by_id[cur.parent_id]
+            d += 1
+        best = max(best, d)
+    return best
+
+
+# -- Chrome/Perfetto export --------------------------------------------------
+
+def to_trace_events(tl: Timeline) -> dict:
+    """``trace_event`` JSON (Perfetto / chrome://tracing loadable): one
+    complete ("X") event per span, one process lane per node, one thread
+    lane per trace within a node, timestamps rebased to the earliest span."""
+    pids = {node: i + 1 for i, node in enumerate(tl.nodes)}
+    t_base = min((s.start for s in tl.spans), default=0.0)
+    events: List[dict] = []
+    for node, pid in pids.items():
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": node}})
+        events.append({"ph": "M", "name": "process_sort_index", "pid": pid,
+                       "tid": 0, "args": {"sort_index": pid}})
+    tids: Dict[Tuple[str, str], int] = {}
+    next_tid: Dict[str, int] = {}
+    for s in sorted(tl.spans, key=lambda s: s.start):
+        key = (s.node, s.trace_id)
+        if key not in tids:
+            next_tid[s.node] = next_tid.get(s.node, 0) + 1
+            tids[key] = next_tid[s.node]
+        args = {"trace_id": s.trace_id, "span_id": s.span_id}
+        if s.parent_id:
+            args["parent_id"] = s.parent_id
+        if s.marks:
+            args["marks_s"] = s.marks
+        args.update({k: v for k, v in s.meta.items()
+                     if isinstance(v, (str, int, float, bool))})
+        events.append({
+            "name": s.name, "cat": "slt", "ph": "X",
+            "ts": round((s.start - t_base) * 1e6, 3),
+            "dur": round(max(s.duration, 1e-6) * 1e6, 3),
+            "pid": pids[s.node], "tid": tids[key], "args": args})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {
+                "tool": "slt trace",
+                "root_node": tl.root_node,
+                "clock_offsets_s": {n: round(o, 6)
+                                    for n, o in tl.offsets.items()}}}
+
+
+def summarize(tl: Timeline, top: int = 5) -> dict:
+    """The `slt trace` stdout report: merged counts, per-node skew, and
+    critical-path attribution for the slowest traces."""
+    traces = tl.traces()
+    rows = []
+    for trace_id, spans in traces.items():
+        start = min(s.start for s in spans)
+        end = max(s.end for s in spans)
+        rows.append({"trace_id": trace_id,
+                     "spans": len(spans),
+                     "nodes": sorted({s.node for s in spans}),
+                     "chain_depth": chain_depth(spans),
+                     "duration_s": round(end - start, 6),
+                     "critical_path": critical_path(spans)[:top]})
+    rows.sort(key=lambda r: -r["duration_s"])
+    return {"spans": len(tl.spans),
+            "skipped_records": tl.skipped,
+            "nodes": tl.nodes,
+            "traces": len(traces),
+            "root_node": tl.root_node,
+            "clock_offsets_s": {n: round(o, 6)
+                                for n, o in tl.offsets.items()},
+            "skew_pair_samples": {f"{a}->{b}": n for (a, b), n
+                                  in sorted(tl.pair_samples.items())},
+            "slowest_traces": rows[:top]}

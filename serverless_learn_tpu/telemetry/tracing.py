@@ -1,0 +1,216 @@
+"""Distributed-trace context propagation (W3C traceparent style).
+
+PR 1 gave every request a :class:`~serverless_learn_tpu.telemetry.registry.
+Span`, but a span's identity died at the process boundary: the worker could
+time its register RPC, yet nothing connected that measurement to the
+coordinator's server-side handling, and "why was this request slow" had no
+cross-node answer. This module is the propagation layer:
+
+* **TraceContext** — (trace_id, span_id, flags), rendered as a W3C
+  ``traceparent`` header value ``00-<32 hex>-<16 hex>-<2 hex>`` so external
+  tooling can inject/extract it unchanged. The same triple rides the native
+  plane as the optional ``TraceContext trace = 15`` protobuf field
+  (``native/proto/slt.proto``) and the inference plane as a
+  ``"traceparent"`` member of the JSON-lines request object (plus an
+  ``X-SLT-Trace`` header on the debug HTTP endpoints).
+* **ambient context** — a :mod:`contextvars` slot holding the current
+  context. ``span(name)`` opens a child span, makes it current for the
+  block, and emits it on exit; RPC clients (``control/client.py``) read the
+  ambient context to stamp outgoing messages, so a ``with span(...)`` around
+  a training round automatically parents every fetch/put/heartbeat it
+  issues — across threads too, when the request object carries the context
+  explicitly (the continuous engine does).
+* **emission** — ``init_tracing(node=..., events_log=...)`` names this
+  process (the ``node`` field every record carries) and optionally opens a
+  per-node JSONL span sink. Every emitted span also lands in the bounded
+  in-memory ring of ``telemetry/flight.py``, so a crash dump contains the
+  last spans even when no log file was configured.
+
+``slt trace`` (``telemetry/timeline.py``) merges the per-node logs into one
+skew-corrected causal timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import re
+import socket
+import threading
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+from serverless_learn_tpu.telemetry.registry import (JsonlEventLog, Span,
+                                                     _rand_hex)
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """Immutable (trace, span) identity a caller hands to a callee."""
+
+    trace_id: str   # 32 lowercase hex chars (128-bit)
+    span_id: str    # 16 lowercase hex chars (64-bit): the CALLER's span
+    flags: int = 1  # bit 0: sampled
+
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{self.flags & 0xFF:02x}"
+
+
+def parse_traceparent(value) -> Optional[TraceContext]:
+    """``00-<trace_id>-<span_id>-<flags>`` -> TraceContext; None when the
+    value is absent or malformed (propagation is best-effort by design: a
+    bad header must never fail the request it rode in on)."""
+    if not isinstance(value, str):
+        return None
+    m = _TRACEPARENT_RE.match(value.strip().lower())
+    if not m:
+        return None
+    version, trace_id, span_id, flags = m.groups()
+    if version == "ff" or trace_id == "0" * 32 or span_id == "0" * 16:
+        return None  # forbidden values per the W3C spec
+    return TraceContext(trace_id, span_id, int(flags, 16))
+
+
+def new_context() -> TraceContext:
+    return TraceContext(_rand_hex(16), _rand_hex(8))
+
+
+_current: contextvars.ContextVar[Optional[TraceContext]] = \
+    contextvars.ContextVar("slt_trace_context", default=None)
+
+
+def current_context() -> Optional[TraceContext]:
+    return _current.get()
+
+
+def set_context(ctx: Optional[TraceContext]):
+    """Install ``ctx`` as the ambient context; returns a reset token."""
+    return _current.set(ctx)
+
+
+def reset_context(token):
+    _current.reset(token)
+
+
+# -- process identity + sinks ------------------------------------------------
+
+_state_lock = threading.Lock()
+_node: Optional[str] = None
+_event_log: Optional[JsonlEventLog] = None
+
+
+def node_name() -> str:
+    """This process's identity in every span record. ``SLT_NODE`` wins;
+    default ``<hostname>-<pid>`` is unique per process on a host."""
+    global _node
+    with _state_lock:
+        if _node is None:
+            _node = (os.environ.get("SLT_NODE")
+                     or f"{socket.gethostname()}-{os.getpid()}")
+        return _node
+
+
+def init_tracing(node: Optional[str] = None,
+                 events_log: Optional[str] = None,
+                 flight_dir: Optional[str] = None,
+                 install_flight: bool = True) -> str:
+    """Configure this process's tracing: its node name, an optional JSONL
+    span sink, and (default) the flight recorder's crash handlers. Returns
+    the node name. Idempotent; later calls may add a sink."""
+    global _node, _event_log
+    with _state_lock:
+        if node:
+            _node = node
+        if events_log:
+            _event_log = JsonlEventLog(events_log)
+    if install_flight:
+        from serverless_learn_tpu.telemetry import flight
+
+        flight.install(flight_dir=flight_dir)
+    return node_name()
+
+
+def tracing_enabled() -> bool:
+    """True once a JSONL sink exists — the signal RPC clients use to start
+    new root traces for otherwise-unparented calls (heartbeats)."""
+    with _state_lock:
+        return _event_log is not None
+
+
+def emit_span(span: Span):
+    """Record a finished span: JSONL sink (when configured) + the flight
+    ring (always; bounded and cheap). Never raises into the caller."""
+    try:
+        rec = span.to_event()
+        rec.setdefault("node", node_name())
+        with _state_lock:
+            log = _event_log
+        if log is not None:
+            log.emit(rec)
+        from serverless_learn_tpu.telemetry import flight
+
+        flight.record(rec)
+    except Exception:
+        pass
+
+
+# -- span scopes -------------------------------------------------------------
+
+@contextlib.contextmanager
+def span(name: str, parent: Optional[TraceContext] = None,
+         root: bool = False, emit: bool = True, **meta) -> Iterator[Span]:
+    """Open a child span of ``parent`` (default: the ambient context; a new
+    root trace when none), make it the ambient context for the block, mark
+    ``done`` and emit it on exit. ``root=True`` forces a fresh trace."""
+    if parent is None and not root:
+        parent = current_context()
+    if parent is None:
+        s = Span(name)
+    else:
+        s = Span(name, trace_id=parent.trace_id, parent_id=parent.span_id)
+    s.meta.update(meta)
+    token = set_context(TraceContext(s.trace_id, s.span_id))
+    try:
+        yield s
+    except BaseException as e:
+        s.meta["error"] = type(e).__name__
+        raise
+    finally:
+        reset_context(token)
+        s.finish()
+        if emit:
+            emit_span(s)
+
+
+@contextlib.contextmanager
+def client_span(name: str, **meta) -> Iterator[Optional[Span]]:
+    """RPC-client scope: child span when a trace is ambient, a fresh root
+    when tracing is initialized (so heartbeat chains exist without callers
+    opening scopes), and a no-op otherwise — bare library use (tests
+    constructing a ShardClient) must not allocate/emit per call."""
+    parent = current_context()
+    if parent is None and not tracing_enabled():
+        yield None
+        return
+    with span(name, parent=parent, **meta) as s:
+        yield s
+
+
+def attach_context(msg) -> Optional[TraceContext]:
+    """Stamp the ambient context onto an outgoing protobuf that has the
+    optional ``trace`` field (slt.proto field 15). Pre-bump generated
+    modules lack the field — degrade silently, the frame stays valid."""
+    ctx = current_context()
+    if ctx is None:
+        return None
+    try:
+        msg.trace.trace_id = ctx.trace_id
+        msg.trace.span_id = ctx.span_id
+        msg.trace.flags = ctx.flags
+    except AttributeError:
+        return None
+    return ctx

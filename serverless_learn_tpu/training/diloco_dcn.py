@@ -66,6 +66,7 @@ from flax import serialization
 from serverless_learn_tpu.config import ExperimentConfig
 from serverless_learn_tpu.control.client import WorkerAgent
 from serverless_learn_tpu.telemetry import get_registry
+from serverless_learn_tpu.telemetry import tracing as ttrace
 from serverless_learn_tpu.training.train_step import build_trainer
 
 
@@ -257,18 +258,26 @@ class DilocoIsland:
             if self._aborted():  # crash BEFORE posting: verdict churn case
                 return self.report
             # ---- outer boundary -----------------------------------------
-            delta = jax.tree_util.tree_map(
-                lambda a, p: a - p, anchor, _to_f32_host(state.params))
-            self.store.put(
-                self._k(f"round-{rnd}",
-                        f"delta-{self.agent.worker_id}"),
-                _pack(delta))
-            self._await_next_anchor(rnd, anchor, pub["trace"], params_t)
-            if self._aborted():  # crashed while waiting: no next anchor
-                return self.report
-            pub = self._fetch_anchor(rnd + 1, params_t)
-            anchor = pub["params"]
-            state = self._adopt(state, anchor)
+            # One span per boundary: the delta PUT and anchor GET issued
+            # inside inherit it (ambient context), so `slt trace` shows
+            # exactly where a slow round went — serialization, the store
+            # RPCs, or waiting out a straggler/leader.
+            with ttrace.span("diloco/round", round=rnd,
+                             worker_id=self.agent.worker_id) as rspan:
+                delta = jax.tree_util.tree_map(
+                    lambda a, p: a - p, anchor, _to_f32_host(state.params))
+                self.store.put(
+                    self._k(f"round-{rnd}",
+                            f"delta-{self.agent.worker_id}"),
+                    _pack(delta))
+                rspan.mark("delta_posted")
+                self._await_next_anchor(rnd, anchor, pub["trace"], params_t)
+                if self._aborted():  # crashed while waiting: no next anchor
+                    return self.report
+                rspan.mark("anchor_available")
+                pub = self._fetch_anchor(rnd + 1, params_t)
+                anchor = pub["params"]
+                state = self._adopt(state, anchor)
             rnd += 1
             self.report.rounds_done += 1
             self._m_rounds.inc()

@@ -39,7 +39,8 @@ from serverless_learn_tpu.config import (ExperimentConfig,
 from serverless_learn_tpu.control.client import WorkerAgent
 from serverless_learn_tpu.data.datasets import Prefetcher
 from serverless_learn_tpu.parallel.mesh import make_mesh
-from serverless_learn_tpu.telemetry import get_registry
+from serverless_learn_tpu.telemetry import flight, get_registry
+from serverless_learn_tpu.telemetry import tracing as ttrace
 from serverless_learn_tpu.training.checkpoint import Checkpointer
 from serverless_learn_tpu.training.loop import make_source
 from serverless_learn_tpu.training.train_step import build_trainer
@@ -191,6 +192,11 @@ class ElasticTrainer:
             while True:
                 self._remesh.clear()
                 epoch, devices = self._current_world()
+                # Each mesh formation is a span: `slt trace` shows how long
+                # drain -> save -> remesh -> restore took per epoch, and
+                # the flight ring keeps the transition in a crash dump.
+                remesh_cm = ttrace.span("elastic/remesh", epoch=epoch)
+                remesh_span = remesh_cm.__enter__()
                 # Largest prefix of the world's devices the policy can host:
                 # with model axes configured (tp=2, say) an odd device count
                 # is unsatisfiable, and idling the remainder beats dying —
@@ -211,6 +217,7 @@ class ElasticTrainer:
                 cfg = self.config.override(mesh=mesh_cfg)
                 mesh = make_mesh(mesh_cfg, devices=devices)
                 trainer = build_trainer(cfg, mesh=mesh)
+                remesh_span.mark("trainer_built")
                 rank, size = self._stripe()
                 if source_iter is None or (rank, size) != stripe:
                     # Honor the configured data plane: a shard server means
@@ -236,6 +243,7 @@ class ElasticTrainer:
                         shardings=trainer.state_shardings)
                 elif state is None:
                     state = trainer.init()
+                remesh_span.mark("restored")
                 step = int(jax.device_get(state.step))
                 self.transitions.append(
                     EpochTransition(epoch=epoch, step=step,
@@ -245,6 +253,11 @@ class ElasticTrainer:
                 m_remesh.inc()
                 m_epoch.set(epoch)
                 m_members.set(size)
+                remesh_span.meta.update(n_devices=len(devices), step=step)
+                remesh_cm.__exit__(None, None, None)
+                flight.record({"event": "mesh_formed", "epoch": epoch,
+                               "n_devices": len(devices), "step": step,
+                               "stripe": [rank, size]})
                 if self.verbose:
                     log_json({"event": "mesh_formed", "epoch": epoch,
                               "n_devices": len(devices), "step": step,

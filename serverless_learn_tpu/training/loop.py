@@ -15,7 +15,8 @@ import numpy as np
 
 from serverless_learn_tpu.config import ExperimentConfig
 from serverless_learn_tpu.data.datasets import Prefetcher, SyntheticSource
-from serverless_learn_tpu.telemetry import get_registry
+from serverless_learn_tpu.telemetry import flight, get_registry
+from serverless_learn_tpu.telemetry import tracing as ttrace
 from serverless_learn_tpu.training.train_step import Trainer, build_trainer
 from serverless_learn_tpu.utils.metrics import ThroughputMeter, log_json
 from serverless_learn_tpu.utils.tracing import get_tracer, step_annotation
@@ -193,6 +194,13 @@ def run_training(
     reg.gauge("slt_train_batch_size").set(config.train.batch_size)
     reg.gauge("slt_train_n_chips").set(trainer.mesh.size)
     last_batch = None
+    # One run-level trace span brackets the whole loop (children: every
+    # RPC a shard-streaming source issues inherits it via the ambient
+    # context) and per-step records feed the flight ring, so a dying
+    # trainer's dump shows its last steps, not just its last metrics.
+    run_span_cm = ttrace.span("train/run", steps=config.train.num_steps,
+                              model=config.model)
+    run_span = run_span_cm.__enter__()
     try:
         for i, batch in zip(range(start_step, config.train.num_steps), prefetch):
             last_batch = batch
@@ -204,6 +212,9 @@ def run_training(
                 metrics = {k: float(v)
                            for k, v in jax.device_get(metrics).items()}
             stats = meter.record(i + 1, metrics)
+            flight.record({"event": "train_step", "step": i + 1,
+                           "step_time_s": round(stats.step_time_s, 5),
+                           **{k: round(v, 5) for k, v in metrics.items()}})
             m_steps.inc()
             m_step_t.observe(stats.step_time_s)
             m_sps.set(stats.samples_per_sec)
@@ -232,6 +243,8 @@ def run_training(
             if step_callback is not None:
                 step_callback(i + 1, state, stats)
     finally:
+        run_span.meta["last_step"] = int(jax.device_get(state.step))
+        run_span_cm.__exit__(None, None, None)
         prefetch.close()
         if created_source and hasattr(source, "close"):
             source.close()

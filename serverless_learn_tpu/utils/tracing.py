@@ -33,9 +33,14 @@ from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 # framing.h MsgType tag -> human name, for daemon-scraped reports.
+# Mirrors native/rpc_stats.h: kMaxMsgType (32) is the overflow slot where
+# the daemons aggregate tags they don't know (a newer peer's message
+# types) instead of dropping their count/max silently.
+K_MAX_MSG_TYPE = 32
 MSG_TYPE_NAMES = {
     1: "register", 3: "heartbeat", 5: "deregister", 6: "membership",
     20: "manifest", 22: "fetch", 24: "put", 25: "stats", 27: "delete",
+    K_MAX_MSG_TYPE: "other",
 }
 
 
@@ -143,6 +148,11 @@ def rpc_stats(client_or_reply) -> Dict[str, Dict[str, float]]:
            else client_or_reply.stats())
     out: Dict[str, Dict[str, float]] = {}
     for s in rep.rpc:
+        # Tag bounds: gaps inside [0, kMaxMsgType) (e.g. the reserved 9-19
+        # range) render as msg_<N>; kMaxMsgType is the daemons' overflow
+        # slot ("other"); anything past it (a reply from a daemon built
+        # with a LARGER table) still lands as msg_<N> instead of being
+        # dropped — per-type max latency must survive unknown tags.
         name = MSG_TYPE_NAMES.get(s.msg_type, f"msg_{s.msg_type}")
         out[f"rpc/{name}"] = {
             "count": s.count,

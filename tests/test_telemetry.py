@@ -12,6 +12,7 @@ trainer and one inference server.
 """
 
 import json
+import os
 import threading
 import time
 
@@ -175,6 +176,95 @@ def test_publish_rpc_stats_lands_in_registry():
                       daemon="shard-server")
     assert 'slt_rpc_calls{daemon="shard-server",rpc="fetch"} 2' in \
         reg.render_prometheus()
+
+
+def test_rpc_stats_bounds_unknown_and_overflow_tags():
+    """Regression (PR 2 satellite): a StatsReply carrying MsgType tags the
+    scraper doesn't know — gaps inside the table (9..19), the daemons'
+    kMaxMsgType overflow slot (32), or tags past it from a daemon built
+    with a larger table — must keep their count AND max latency instead of
+    being dropped or colliding."""
+    from serverless_learn_tpu.utils.tracing import (K_MAX_MSG_TYPE,
+                                                    MSG_TYPE_NAMES,
+                                                    rpc_stats)
+
+    class _Stat:
+        def __init__(self, t, c, tot, mx):
+            self.msg_type, self.count = t, c
+            self.total_us, self.max_us = tot, mx
+
+    class _Reply:
+        rpc = [_Stat(3, 5, 1000, 800),           # known: heartbeat
+               _Stat(13, 2, 300, 200),           # sibling-range gap
+               _Stat(K_MAX_MSG_TYPE, 4, 900, 700),  # daemon overflow slot
+               _Stat(40, 1, 50, 50)]             # future daemon's tag
+
+    out = rpc_stats(_Reply())
+    assert set(out) == {"rpc/heartbeat", "rpc/msg_13", "rpc/other",
+                        "rpc/msg_40"}
+    assert out["rpc/other"]["max_s"] == pytest.approx(700e-6)
+    assert out["rpc/msg_40"]["max_s"] == pytest.approx(50e-6)
+    assert MSG_TYPE_NAMES[K_MAX_MSG_TYPE] == "other"
+
+    # publish_rpc_stats lands every series (max included) in the registry.
+    reg = MetricsRegistry()
+    publish_rpc_stats(out, reg, daemon="coordinator")
+    text = reg.render_prometheus()
+    for rpc in ("heartbeat", "msg_13", "other", "msg_40"):
+        assert f'slt_rpc_calls{{daemon="coordinator",rpc="{rpc}"}}' in text
+    assert 'slt_rpc_max_seconds{daemon="coordinator",rpc="other"}' in text
+
+
+def test_publish_rpc_stats_clamps_malformed_entries():
+    """Bounds handling: non-dict rows are skipped; NaN/inf/negative values
+    clamp to 0 rather than poisoning the gauges."""
+    reg = MetricsRegistry()
+    publish_rpc_stats(
+        {"rpc/fetch": {"count": -3, "total_s": float("nan"),
+                       "max_s": float("inf")},
+         "rpc/garbage": "not-a-dict",
+         "rpc/put": {"count": 2, "total_s": 0.5, "max_s": 0.4}},
+        reg, daemon="shard-server")
+    text = reg.render_prometheus()
+    assert 'slt_rpc_calls{daemon="shard-server",rpc="fetch"} 0' in text
+    assert 'slt_rpc_time_seconds{daemon="shard-server",rpc="fetch"} 0' in text
+    assert 'slt_rpc_max_seconds{daemon="shard-server",rpc="fetch"} 0' in text
+    assert "garbage" not in text
+    assert 'slt_rpc_max_seconds{daemon="shard-server",rpc="put"} 0.4' in text
+
+
+def test_debug_profile_endpoint(tmp_path):
+    """Satellite: /debug/profile captures an on-demand jax.profiler trace
+    from a live metrics server; disabled (404) without --profile-dir;
+    bad/oversized seconds are 400."""
+    import urllib.error
+
+    disabled = MetricsExporter(MetricsRegistry()).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            fetch_text(disabled.addr, "/debug/profile?seconds=1")
+        assert ei.value.code == 404
+    finally:
+        disabled.stop()
+
+    exp = MetricsExporter(MetricsRegistry(),
+                          profile_dir=str(tmp_path / "prof")).start()
+    try:
+        for q, code in (("seconds=abc", 400), ("seconds=9999", 400)):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                fetch_text(exp.addr, f"/debug/profile?{q}")
+            assert ei.value.code == code
+        rep = json.loads(fetch_text(exp.addr, "/debug/profile?seconds=0.2",
+                                    timeout=60))
+        assert rep["ok"] is True
+        assert os.path.isdir(rep["dir"])
+        # The capture produced profiler artifacts, not an empty dir.
+        found = []
+        for root, _, files in os.walk(rep["dir"]):
+            found += files
+        assert found, "profile capture wrote no files"
+    finally:
+        exp.stop()
 
 
 def test_top_renders_trainer_and_inference_sections():

@@ -1,0 +1,389 @@
+"""Distributed tracing, flight recorder, and `slt trace` timelines (PR 2).
+
+Fast tier: traceparent parse/format, ambient-context span chaining,
+worker→coordinator register/heartbeat propagation with a merged timeline,
+flight-recorder ring + SIGTERM dump (subprocess), skew estimation over
+synthetic two-node logs, Perfetto export shape.
+
+Slow tier: the acceptance path — a real 2-process run (coordinator daemon
++ a WorkerAgent host), `slt trace --out` over both logs producing a
+Perfetto-loadable file with a cross-process parented chain, plus injected
+clock skew recovered by the Cristian-pair estimator.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from serverless_learn_tpu.telemetry import flight
+from serverless_learn_tpu.telemetry import timeline as tln
+from serverless_learn_tpu.telemetry import tracing as ttrace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(autouse=True)
+def _isolate_tracing(monkeypatch):
+    """Each test gets a clean tracing/node state; the process-global sink
+    must not leak spans across tests."""
+    monkeypatch.setattr(ttrace, "_node", None)
+    monkeypatch.setattr(ttrace, "_event_log", None)
+    yield
+
+
+# -- context propagation (fast) ----------------------------------------------
+
+def test_traceparent_parse_format_roundtrip():
+    ctx = ttrace.new_context()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    back = ttrace.parse_traceparent(ctx.traceparent())
+    assert back == ctx
+    # Robustness: malformed values parse to None, never raise.
+    for bad in (None, 7, "", "hello", "00-zz-ff-01",
+                "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # zero trace id
+                "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # zero span id
+                "ff-" + "a" * 32 + "-" + "b" * 16 + "-01"):  # forbidden ver
+        assert ttrace.parse_traceparent(bad) is None, bad
+    # Case/whitespace tolerant.
+    assert ttrace.parse_traceparent(
+        " 00-" + "A" * 32 + "-" + "b" * 16 + "-01 ") is not None
+
+
+def test_span_scopes_nest_and_emit(tmp_path):
+    log = tmp_path / "spans.jsonl"
+    ttrace.init_tracing(node="n1", events_log=str(log),
+                        install_flight=False)
+    with ttrace.span("outer") as outer:
+        assert ttrace.current_context().span_id == outer.span_id
+        with ttrace.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    assert ttrace.current_context() is None
+    recs = [json.loads(l) for l in log.read_text().splitlines()]
+    assert [r["span"] for r in recs] == ["inner", "outer"]  # emit at exit
+    assert all(r["node"] == "n1" for r in recs)
+    assert all("t0_unix_s" in r and "duration_s" in r for r in recs)
+
+
+def test_attach_context_stamps_protobuf():
+    sys.path.insert(0, os.path.join(REPO, "native", "gen"))
+    import slt_pb2 as pb
+
+    req = pb.HeartbeatRequest(worker_id=1)
+    assert ttrace.attach_context(req) is None  # no ambient context: absent
+    assert not req.HasField("trace")
+    with ttrace.span("parent", emit=False):
+        ctx = ttrace.attach_context(req)
+        assert req.trace.trace_id == ctx.trace_id
+        assert req.trace.span_id == ctx.span_id
+        # Round-trips the wire.
+        back = pb.HeartbeatRequest.FromString(req.SerializeToString())
+        assert back.trace.trace_id == ctx.trace_id
+
+
+# -- worker -> coordinator propagation (fast; the satellite tier-1 test) -----
+
+def test_register_heartbeat_traceparent_chains_in_merged_timeline(tmp_path):
+    """worker→coordinator register/heartbeat through control/client.py with
+    an active trace: the merged timeline (worker JSONL + coordinator
+    --events_log) shows a parented chain root -> client RPC span [-> the
+    daemon's server-side span when the daemon logs spans]."""
+    from serverless_learn_tpu.control.client import CoordinatorClient
+    from serverless_learn_tpu.control.daemons import start_coordinator
+
+    port = _free_port()
+    coord_log = tmp_path / "coord.jsonl"
+    worker_log = tmp_path / "worker.jsonl"
+    proc = start_coordinator(port=port, lease_ttl_ms=5000, sweep_ms=100,
+                             events_log=str(coord_log))
+    try:
+        ttrace.init_tracing(node="worker-A", events_log=str(worker_log),
+                            install_flight=False)
+        c = CoordinatorClient(f"127.0.0.1:{port}")
+        with ttrace.span("worker/startup"):
+            rep = c.register("w:1", name="w1", n_chips=1)
+            assert rep.ok
+            assert c.heartbeat(rep.worker_id, step=1, metric=0.5).ok
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+    logs = [str(worker_log)]
+    if coord_log.exists():  # daemon-side spans need a trace-aware daemon
+        logs.append(str(coord_log))
+    tl = tln.reconstruct(logs)
+    traces = tl.traces()
+    assert len(traces) == 1
+    spans = next(iter(traces.values()))
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s.name, []).append(s)
+    root = by_name["worker/startup"][0]
+    reg = [s for s in by_name["rpc/register"] if s.node == "worker-A"][0]
+    hb = [s for s in by_name["rpc/heartbeat"] if s.node == "worker-A"][0]
+    assert reg.parent_id == root.span_id
+    assert hb.parent_id == root.span_id
+    assert tln.chain_depth(spans) >= 2
+    if coord_log.exists():
+        srv = [s for s in by_name["rpc/register"] if s.node != "worker-A"]
+        assert srv and srv[0].parent_id == reg.span_id, \
+            "daemon span must parent under the client RPC span"
+        assert tln.chain_depth(spans) >= 3
+        assert len(tl.nodes) == 2
+
+
+def test_untraced_rpcs_stay_untraced(tmp_path):
+    """No ambient context and no sink => no trace field on the wire and no
+    span allocations (bare library use must stay free)."""
+    from serverless_learn_tpu.control.client import CoordinatorClient
+    from serverless_learn_tpu.control.daemons import start_coordinator
+
+    port = _free_port()
+    coord_log = tmp_path / "coord.jsonl"
+    proc = start_coordinator(port=port, events_log=str(coord_log))
+    try:
+        c = CoordinatorClient(f"127.0.0.1:{port}")
+        rep = c.register("w:1")
+        assert rep.ok and c.heartbeat(rep.worker_id).ok
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+    assert not coord_log.exists() or coord_log.read_text() == ""
+
+
+# -- flight recorder (fast) --------------------------------------------------
+
+def test_flight_ring_is_bounded_and_dump_has_metrics(tmp_path):
+    flight.set_capacity(16)
+    try:
+        for i in range(100):
+            flight.record({"event": "x", "i": i})
+        evs = flight.events()
+        assert len(evs) == 16 and evs[-1]["i"] == 99 and evs[0]["i"] == 84
+        path = flight.dump("unit-test", dir=str(tmp_path))
+        assert path and os.path.exists(path)
+        d = json.loads(open(path).read())
+        assert d["reason"] == "unit-test" and len(d["events"]) == 16
+        assert "metrics" in d  # registry snapshot rides along
+    finally:
+        flight.set_capacity(flight.DEFAULT_CAPACITY)
+
+
+def test_maybe_dump_noop_until_installed(tmp_path):
+    if flight.installed():
+        pytest.skip("flight handlers already installed in this process")
+    assert flight.maybe_dump("lease-expiry") is None
+    assert not any(f.startswith("flight-") for f in os.listdir("."))
+
+
+def test_sigterm_leaves_flight_dump(tmp_path):
+    """Acceptance: killing a traced process with SIGTERM leaves a flight
+    dump containing its last spans, and the exit code stays 143."""
+    script = tmp_path / "victim.py"
+    script.write_text(
+        "import sys, time\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from serverless_learn_tpu.telemetry import init_tracing\n"
+        "from serverless_learn_tpu.telemetry import tracing as ttrace\n"
+        f"init_tracing(node='victim', flight_dir={str(tmp_path)!r})\n"
+        "with ttrace.span('victim/work'):\n"
+        "    pass\n"
+        "print('ready', flush=True)\n"
+        "time.sleep(60)\n")
+    proc = subprocess.Popen([sys.executable, str(script)],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "ready"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == -signal.SIGTERM or rc == 143
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("flight-victim-")]
+    assert dumps, os.listdir(tmp_path)
+    d = json.loads((tmp_path / dumps[0]).read_text())
+    assert d["reason"] == "sigterm"
+    assert any(e.get("span") == "victim/work" for e in d["events"])
+
+
+# -- timeline reconstruction (fast) ------------------------------------------
+
+def _synthetic_two_node_logs(tmp_path, skew_s: float):
+    """Node A (client) + node B (server, clock shifted +skew_s). Returns
+    (paths, client_rpc_span_bounds)."""
+    t0 = 1_700_000_000.0
+    a_recs = [
+        {"event": "span", "span": "round", "node": "A",
+         "trace_id": "t" * 32, "span_id": "a-root", "t0_unix_s": t0,
+         "duration_s": 0.5},
+        {"event": "span", "span": "rpc/put", "node": "A",
+         "trace_id": "t" * 32, "span_id": "a-rpc", "parent_id": "a-root",
+         "t0_unix_s": t0 + 0.10, "duration_s": 0.04},
+    ]
+    b_recs = [
+        {"event": "span", "span": "rpc/put", "node": "B",
+         "trace_id": "t" * 32, "span_id": "b-srv", "parent_id": "a-rpc",
+         # True server time: inside the client's [0.10, 0.14] window;
+         # logged on B's clock which runs ahead by skew_s.
+         "t0_unix_s": t0 + 0.11 + skew_s, "duration_s": 0.02},
+    ]
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    pa.write_text("\n".join(json.dumps(r) for r in a_recs) + "\n")
+    pb.write_text("\n".join(json.dumps(r) for r in b_recs) + "\n")
+    return [str(pa), str(pb)], (t0 + 0.10, t0 + 0.14)
+
+
+def test_skew_correction_recovers_injected_offset(tmp_path):
+    paths, (lo, hi) = _synthetic_two_node_logs(tmp_path, skew_s=5.0)
+    tl = tln.reconstruct(paths, root="A")
+    assert abs(tl.offsets["B"] + 5.0) < 0.05, tl.offsets
+    srv = [s for s in tl.spans if s.span_id == "b-srv"][0]
+    assert lo <= srv.start and srv.end <= hi + 1e-6, (srv.start, lo, hi)
+    # Without correction the server span sits 5 s in the future.
+    raw = tln.reconstruct(paths, skew=False)
+    srv_raw = [s for s in raw.spans if s.span_id == "b-srv"][0]
+    assert srv_raw.start > hi + 4.0
+
+
+def test_critical_path_attributes_self_time(tmp_path):
+    paths, _ = _synthetic_two_node_logs(tmp_path, skew_s=0.0)
+    tl = tln.reconstruct(paths, root="A")
+    rows = tln.critical_path(next(iter(tl.traces().values())))
+    by_span = {r["span_id"]: r for r in rows}
+    # Root: 0.5 total minus the 0.04 covered by its child RPC.
+    assert abs(by_span["a-root"]["self_s"] - 0.46) < 1e-6
+    # Client RPC: 0.04 minus the server's 0.02.
+    assert abs(by_span["a-rpc"]["self_s"] - 0.02) < 1e-6
+    assert rows[0]["span_id"] == "a-root"  # sorted worst-first
+
+
+def test_trace_events_export_is_perfetto_shaped(tmp_path):
+    paths, _ = _synthetic_two_node_logs(tmp_path, skew_s=2.0)
+    out = tln.to_trace_events(tln.reconstruct(paths, root="A"))
+    assert set(out) >= {"traceEvents", "displayTimeUnit"}
+    evs = out["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    metas = [e for e in evs if e["ph"] == "M" and e["name"] == "process_name"]
+    assert len(xs) == 3 and len(metas) == 2
+    assert all(e["ts"] >= 0 and e["dur"] > 0 for e in xs)
+    assert {m["args"]["name"] for m in metas} == {"A", "B"}
+    json.dumps(out)  # must be serializable as-is
+
+
+def test_cli_trace_command_writes_timeline(tmp_path, capsys):
+    from serverless_learn_tpu.cli import main
+
+    paths, _ = _synthetic_two_node_logs(tmp_path, skew_s=1.0)
+    out = tmp_path / "timeline.json"
+    rc = main(["trace", *paths, "--out", str(out), "--root", "A",
+               "--compact"])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["traces"] == 1 and summary["spans"] == 3
+    assert abs(summary["clock_offsets_s"]["B"] + 1.0) < 0.05
+    assert summary["slowest_traces"][0]["chain_depth"] == 3
+    data = json.loads(out.read_text())
+    assert any(e.get("ph") == "X" for e in data["traceEvents"])
+    # Empty input is a loud error, not an empty file.
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert main(["trace", str(empty)]) == 1
+
+
+def test_flight_dump_feeds_timeline(tmp_path):
+    """flight-*.json dumps merge with JSONL logs (node inherited from the
+    dump header when records lack one)."""
+    dump = {"event": "flight_dump", "node": "dead-worker", "reason": "x",
+            "events": [
+                {"event": "span", "span": "train/run",
+                 "trace_id": "u" * 32, "span_id": "w-1",
+                 "t0_unix_s": 1_700_000_000.0, "duration_s": 1.0},
+                {"event": "train_step", "step": 3},
+            ]}
+    p = tmp_path / "flight-dead-worker-1.json"
+    p.write_text(json.dumps(dump))
+    tl = tln.reconstruct([str(tmp_path)])  # directory ingestion
+    assert len(tl.spans) == 1
+    assert tl.spans[0].node == "dead-worker"
+    assert tl.skipped == 0  # non-span records aren't "skipped spans"
+
+
+# -- acceptance (slow): 2-process run, skew injected, CLI end-to-end ---------
+
+@pytest.mark.slow
+def test_two_process_run_produces_skewed_corrected_timeline(tmp_path):
+    """Acceptance: coordinator daemon + worker process, `slt trace --out`
+    over both logs -> Perfetto-loadable trace_event JSON with >= 1
+    cross-process parented chain and skew-corrected timestamps (the
+    worker's log is rewritten with +3 s skew to prove correction)."""
+    from serverless_learn_tpu.cli import main
+    from serverless_learn_tpu.control.client import WorkerAgent
+    from serverless_learn_tpu.control.daemons import start_coordinator
+
+    port = _free_port()
+    coord_log = tmp_path / "coord.jsonl"
+    worker_log = tmp_path / "worker.jsonl"
+    proc = start_coordinator(port=port, lease_ttl_ms=5000, sweep_ms=100,
+                             events_log=str(coord_log))
+    try:
+        ttrace.init_tracing(node="worker-A", events_log=str(worker_log),
+                            install_flight=False)
+        agent = WorkerAgent(f"127.0.0.1:{port}", "w:1", name="w1",
+                            heartbeat_interval_ms=100)
+        agent.start()
+        time.sleep(0.6)  # a few heartbeats
+        agent.stop()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+    if not coord_log.exists():
+        pytest.skip("daemon predates --events_log (native binary without "
+                    "trace support)")
+
+    # Inject +3 s of clock skew into the WORKER's log after the fact.
+    skewed = tmp_path / "worker_skewed.jsonl"
+    with open(worker_log) as src, open(skewed, "w") as dst:
+        for line in src:
+            rec = json.loads(line)
+            rec["t0_unix_s"] = rec["t0_unix_s"] + 3.0
+            dst.write(json.dumps(rec) + "\n")
+
+    out = tmp_path / "timeline.json"
+    rc = main(["trace", str(skewed), str(coord_log), "--out", str(out),
+               "--root", "worker-A", "--compact"])
+    assert rc == 0
+    data = json.loads(out.read_text())
+    xs = [e for e in data["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) >= 2
+    # Cross-process parented chain: a coordinator span whose parent is a
+    # worker client span.
+    tl = tln.reconstruct([str(skewed), str(coord_log)], root="worker-A")
+    by_id = {s.span_id: s for s in tl.spans}
+    cross = [s for s in tl.spans
+             if s.parent_id and s.parent_id in by_id
+             and by_id[s.parent_id].node != s.node]
+    assert cross, "no cross-process parented span chain"
+    # Skew-corrected: the coordinator node's offset ~= +3 s (its clock is
+    # 3 s "behind" the doctored worker log) and each server span lands
+    # inside its client span.
+    coord_node = [n for n in tl.nodes if n != "worker-A"][0]
+    assert abs(tl.offsets[coord_node] - 3.0) < 0.5, tl.offsets
+    for s in cross:
+        p = by_id[s.parent_id]
+        assert p.start - 0.05 <= s.start <= p.end + 0.05
