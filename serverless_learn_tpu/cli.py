@@ -17,6 +17,7 @@ Here one typed CLI fronts everything:
     python -m serverless_learn_tpu stats        # scrape a daemon's load/RPC stats
     python -m serverless_learn_tpu top          # live cluster telemetry view
     python -m serverless_learn_tpu trace        # cross-node timeline from span logs
+    python -m serverless_learn_tpu doctor       # ranked cluster diagnosis
     python -m serverless_learn_tpu models       # list registered model families
 
 Every long-running command takes ``--metrics-port N`` to expose a
@@ -156,6 +157,13 @@ def _add_train_flags(p: argparse.ArgumentParser):
     p.add_argument("--node", default=None,
                    help="node name stamped on span records (default "
                         "<hostname>-<pid>; SLT_NODE env overrides)")
+    p.add_argument("--health", action="store_true",
+                   help="run the cluster-health engine: EWMA/MAD anomaly "
+                        "detectors, config-declared SLO burn-rate alerts "
+                        "(health.slos), and staleness/straggler watchdogs "
+                        "— served at /alerts on the metrics endpoint, "
+                        "flipping /healthz to 503 on critical (config "
+                        "health.enabled=true does the same)")
     p.add_argument("-v", "--verbose", action="store_true")
     # Multi-host: either serverless bootstrap via the native coordinator
     # (--world-size) or explicit topology (--num-processes/--process-id).
@@ -185,6 +193,27 @@ def _start_metrics(args):
     exp = MetricsExporter(port=port).start()
     log_json({"event": "metrics", "addr": exp.addr}, stream=sys.stdout)
     return exp
+
+
+def _start_health(args, cfg, exporter=None, registry=None):
+    """Start the cluster-health engine when --health (or config
+    health.enabled) asks for it; wires it behind the exporter's /alerts
+    and /healthz when one exists. The caller owns stop()."""
+    if not (getattr(args, "health", False) or cfg.health.enabled):
+        return None
+    from serverless_learn_tpu.telemetry.health import HealthEngine
+    from serverless_learn_tpu.utils.metrics import log_json
+
+    flight_dir = getattr(args, "flight_dir", None)
+    engine = HealthEngine(registry=registry, config=cfg.health,
+                          flight_dir=flight_dir).start()
+    if exporter is not None:
+        exporter.attach_health(engine)
+    log_json({"event": "health", "interval_s": engine.interval_s,
+              "slos": [s["name"] for s in engine.slos],
+              **({"alerts_addr": exporter.addr} if exporter else {})},
+             stream=sys.stdout)
+    return engine
 
 
 def _init_tracing_from_args(args):
@@ -252,9 +281,10 @@ def cmd_train(args) -> int:
         initialize(args.jax_coordinator, args.num_processes, args.process_id)
 
     _init_tracing_from_args(args)
+    cfg = _config_from_args(args)
     exporter = _start_metrics(args)
+    health = _start_health(args, cfg, exporter=exporter)
     try:
-        cfg = _config_from_args(args)
         ckpt = _make_checkpointer(args)
         every = cfg.train.checkpoint_every
 
@@ -298,6 +328,8 @@ def cmd_train(args) -> int:
                   **{k: round(v, 3) for k, v in summary.items()},
                   "spans": get_tracer().summary()}, stream=sys.stdout)
     finally:
+        if health is not None:
+            health.stop()
         if exporter is not None:
             exporter.stop()
         if world is not None:
@@ -529,6 +561,8 @@ def cmd_serve(args) -> int:
                               metrics_port=args.metrics_port,
                               event_log_path=args.events_log,
                               profile_dir=args.profile_dir)
+    health = _start_health(args, cfg, exporter=server._exporter,
+                           registry=server.registry)
     log_json({"event": "serving", "addr": server.addr,
               "model": cfg.model,
               **({"metrics_addr": server.metrics_addr}
@@ -538,6 +572,8 @@ def cmd_serve(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if health is not None:
+            health.stop()
         server.stop()
     return 0
 
@@ -596,9 +632,12 @@ def cmd_diloco(args) -> int:
               "worker_id": island.agent.worker_id,
               "inner_steps": island.inner_steps}, stream=sys.stdout)
     exporter = _start_metrics(args)
+    health = _start_health(args, cfg, exporter=exporter)
     try:
         rep = island.run_rounds(args.rounds)
     finally:
+        if health is not None:
+            health.stop()
         if exporter is not None:
             exporter.stop()
     log_json({"event": "diloco_island_done", "rounds": rep.rounds_done,
@@ -639,6 +678,7 @@ def cmd_worker(args) -> int:
         store = ShardServerStore(cfg.control.shard_server_addr)
 
     exporter = _start_metrics(args)
+    health = _start_health(args, cfg, exporter=exporter)
     try:
         if args.multihost:
             from serverless_learn_tpu.training.elastic_multihost import (
@@ -675,6 +715,8 @@ def cmd_worker(args) -> int:
                   "final_loss": losses[-1] if losses else None,
                   "transitions": len(et.transitions)}, stream=sys.stdout)
     finally:
+        if health is not None:
+            health.stop()
         if exporter is not None:
             exporter.stop()
     return 0
@@ -828,6 +870,40 @@ def cmd_trace(args) -> int:
         summary["out"] = args.out
     print(json.dumps(summary, indent=None if args.compact else 2))
     return 0
+
+
+def cmd_doctor(args) -> int:
+    """Ranked cluster diagnosis: merge JSONL event logs, flight-recorder
+    dumps, live /alerts scrapes and bench_history.json into one report —
+    what fired, on which node, with correlated trace ids and cross-run
+    perf regressions. Exit 0 = no critical alert firing, 1 = critical
+    firing (or self-check failure) — scriptable as a gate."""
+    from serverless_learn_tpu.telemetry import doctor
+
+    if args.self_check:
+        health_cfg = None
+        if args.config:
+            # Parse only the health section — doctor must run on nodes
+            # with no devices (and never pay a jax import).
+            from serverless_learn_tpu.config import ExperimentConfig
+
+            with open(args.config) as f:
+                health_cfg = ExperimentConfig.from_dict(
+                    json.load(f)).health
+        rep = doctor.self_check(health_cfg)
+        print(json.dumps(rep, indent=None if args.compact else 2))
+        return 0 if rep["ok"] else 1
+    endpoints = []
+    for chunk in args.endpoints or []:
+        endpoints.extend(e for e in chunk.split(",") if e.strip())
+    if not args.logs and not endpoints:
+        print("doctor needs event logs/flight dumps and/or --endpoints "
+              "(or --self-check)", file=sys.stderr)
+        return 2
+    rep = doctor.diagnose(args.logs, endpoints,
+                          bench_history=args.bench_history, top=args.top)
+    print(json.dumps(rep, indent=None if args.compact else 2))
+    return 1 if rep["summary"]["critical_firing"] else 0
 
 
 def cmd_top(args) -> int:
@@ -1042,6 +1118,35 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--compact", action="store_true",
                     help="single-line JSON summary (for scripts)")
     tr.set_defaults(fn=cmd_trace)
+
+    dr = sub.add_parser("doctor",
+                        help="ranked cluster diagnosis from event logs, "
+                             "flight dumps, live /alerts scrapes and "
+                             "bench history")
+    dr.add_argument("logs", nargs="*", metavar="LOG",
+                    help="JSONL event logs (--events-log), daemon "
+                         "--events_log files, flight-*.json dumps, or "
+                         "directories/globs of them")
+    dr.add_argument("--endpoints", action="append", metavar="HOST:PORT",
+                    default=None,
+                    help="scrape these /alerts endpoints live (comma- or "
+                         "repeat-separated)")
+    dr.add_argument("--bench-history", metavar="FILE", default=None,
+                    help="bench_history.json for cross-run perf "
+                         "regression checks (default: ./bench_history."
+                         "json when present)")
+    dr.add_argument("--config", default=None,
+                    help="config whose health section tunes/declares the "
+                         "rules (used by --self-check)")
+    dr.add_argument("--top", type=int, default=10,
+                    help="ranked alerts to report")
+    dr.add_argument("--compact", action="store_true",
+                    help="single-line JSON report (for scripts)")
+    dr.add_argument("--self-check", action="store_true",
+                    help="smoke-test the health engine: rules parse, a "
+                         "healthy fixture stays quiet, a stalled counter "
+                         "fires the watchdog; exit 0 on success (CI)")
+    dr.set_defaults(fn=cmd_doctor)
 
     tp = sub.add_parser("top", help="live cluster telemetry: poll /metrics "
                                     "endpoints, one-screen view")

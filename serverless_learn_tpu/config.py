@@ -214,6 +214,52 @@ class ControlConfig:
 
 
 @dataclass(frozen=True)
+class HealthConfig:
+    """Cluster-health engine knobs (``telemetry/health.py``).
+
+    ``slos`` declares the SLO objectives the burn-rate alerter tracks
+    (see ``telemetry.health.parse_slos`` for the two spec kinds):
+
+        "health": {"enabled": true, "slos": [
+          {"name": "ttft", "kind": "latency",
+           "metric": "slt_request_ttft_seconds",
+           "threshold_s": 0.5, "objective": 0.95},
+          {"name": "errors", "kind": "ratio",
+           "bad": "slt_server_errors_total",
+           "total": "slt_server_requests_total", "objective": 0.999}]}
+
+    The anomaly/staleness/straggler detectors are always armed while the
+    engine runs; these fields tune their sensitivity.
+    """
+
+    enabled: bool = False           # CLI --health also turns the engine on
+    sample_interval_s: float = 2.0  # registry sampling period
+    # EWMA+MAD anomaly detectors (step time, tokens/sec, heartbeat RTT,
+    # queue wait, remesh time).
+    anomaly_z: float = 6.0          # |modified z| that fires
+    anomaly_min_samples: int = 12   # warmup before any z is produced
+    anomaly_window: int = 240       # bounded per-series sample ring
+    # Staleness watchdogs (no step / round / chunk in factor x the EWMA
+    # inter-event interval).
+    stale_factor: float = 5.0
+    stale_min_interval_s: float = 1.0
+    # DiLoCo straggler scoring (arrival offset vs. round median).
+    straggler_factor: float = 4.0       # MADs above median = late
+    straggler_min_rounds: int = 2
+    straggler_window_rounds: int = 20
+    # Multi-window SLO burn-rate thresholds (the standard fast/slow pair).
+    slo_fast_burn: float = 14.4
+    slo_slow_burn: float = 6.0
+    slo_short_window_s: float = 60.0
+    slo_long_window_s: float = 720.0
+    # Alert lifecycle + forensics.
+    clear_after_ticks: int = 3       # clean ticks before auto-resolve
+    anchor_lag_rounds: float = 2.0   # DiLoCo lag gauge alert threshold
+    dump_cooldown_s: float = 300.0   # min gap between critical flight dumps
+    slos: tuple = ()                 # SLO spec objects (see docstring)
+
+
+@dataclass(frozen=True)
 class ExperimentConfig:
     model: str = "mlp_mnist"
     model_overrides: dict = field(default_factory=dict)
@@ -223,6 +269,7 @@ class ExperimentConfig:
     data: DataConfig = field(default_factory=DataConfig)
     control: ControlConfig = field(default_factory=ControlConfig)
     local_sgd: LocalSGDConfig = field(default_factory=LocalSGDConfig)
+    health: HealthConfig = field(default_factory=HealthConfig)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
@@ -248,6 +295,7 @@ class ExperimentConfig:
             data=build(DataConfig, raw.get("data")),
             control=build(ControlConfig, raw.get("control")),
             local_sgd=build(LocalSGDConfig, raw.get("local_sgd")),
+            health=build(HealthConfig, raw.get("health")),
         )
 
     def override(self, **kwargs: Any) -> "ExperimentConfig":

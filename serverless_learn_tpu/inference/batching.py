@@ -125,6 +125,11 @@ class BatchingEngine:
             buckets=SIZE_BUCKETS, **lbl)
         self._m_tps = reg.histogram(
             "slt_request_tokens_per_sec", buckets=RATE_BUCKETS, **lbl)
+        # Dispatcher liveness stamp (see the continuous engine): the
+        # health engine reads this beside the chunk/batch counters.
+        self._m_activity = reg.gauge(
+            "slt_engine_last_activity_unix_s",
+            "wall time of the dispatcher's last group dispatch", **lbl)
         self._thread = threading.Thread(target=self._dispatch_loop,
                                         daemon=True)
         self._thread.start()
@@ -198,6 +203,7 @@ class BatchingEngine:
             for e in extras:  # mismatched keys go back for the next round
                 self._q.put(e)
             try:
+                self._m_activity.set(time.time())
                 self._run_group(group)
             except Exception as ex:
                 for p in group:

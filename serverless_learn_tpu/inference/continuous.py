@@ -181,6 +181,13 @@ class ContinuousBatchingEngine:
             "slt_request_tokens_per_sec", buckets=RATE_BUCKETS, **lbl)
         self._m_slots = reg.gauge(
             "slt_slots_in_use", "occupied decode slots", **lbl)
+        # Dispatcher liveness stamp for the health engine: a wedged
+        # dispatcher (poisoned device state, hung transfer) stops
+        # advancing this while slots stay occupied — exactly the state
+        # the stale.decode_chunk watchdog pages on.
+        self._m_activity = reg.gauge(
+            "slt_engine_last_activity_unix_s",
+            "wall time of the dispatcher's last admit/chunk", **lbl)
         self._thread = threading.Thread(target=self._dispatch_loop,
                                         daemon=True)
         self._thread.start()
@@ -483,12 +490,14 @@ class ContinuousBatchingEngine:
                     fut = self._admit(staged)
                     if fut is not None:
                         futures.append(fut)
+                        self._m_activity.set(time.time())
                 if any(r is not None and not r.finished
                        for r in self._slots):
                     self._state, toks = self._chunk_jit(self.params,
                                                         self._state)
                     self.chunks_run += 1
                     self._m_chunks.inc()
+                    self._m_activity.set(time.time())
                     # Start the D2H transfer NOW, behind the enqueued
                     # compute: on a tunneled dev chip a device_get costs
                     # ~100 ms of round trip, and serial per-chunk fetches

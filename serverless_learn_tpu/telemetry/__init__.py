@@ -15,6 +15,14 @@ PR 2 adds the distributed-tracing layer: W3C-style context propagation
 (``telemetry/flight.py``), and cross-node timeline reconstruction for
 ``slt trace`` (``telemetry/timeline.py``).
 
+PR 3 adds the interpretation layer: a cluster-health engine
+(``telemetry/health.py``) sampling the registry on a background thread —
+EWMA+MAD anomaly detection, config-declared SLO burn-rate alerting, and
+structural staleness/straggler watchdogs — served from ``/alerts`` (and a
+real ``/healthz``) on :class:`MetricsExporter`, plus ``slt doctor``
+(``telemetry/doctor.py``), which merges event logs, flight dumps, live
+alert scrapes and ``bench_history.json`` into one ranked diagnosis.
+
 See the "Observability" section of ``docs/ARCHITECTURE.md`` for the metric
 naming scheme, endpoint formats, and the tracing data flow.
 """
@@ -23,6 +31,8 @@ import math
 
 from serverless_learn_tpu.telemetry.exporter import (MetricsExporter,
                                                      fetch_text)
+from serverless_learn_tpu.telemetry.health import (Alert, HealthEngine,
+                                                   score_stragglers)
 from serverless_learn_tpu.telemetry.registry import (LATENCY_BUCKETS,
                                                      RATE_BUCKETS,
                                                      SIZE_BUCKETS, Counter,
@@ -37,10 +47,11 @@ from serverless_learn_tpu.telemetry.tracing import (TraceContext,
 
 __all__ = [
     "LATENCY_BUCKETS", "RATE_BUCKETS", "SIZE_BUCKETS",
-    "Counter", "Gauge", "Histogram", "JsonlEventLog", "MetricsRegistry",
-    "MetricsExporter", "Span", "TraceContext", "current_context",
-    "fetch_text", "get_registry", "init_tracing", "parse_traceparent",
-    "publish_rpc_stats",
+    "Alert", "Counter", "Gauge", "HealthEngine", "Histogram",
+    "JsonlEventLog", "MetricsRegistry", "MetricsExporter", "Span",
+    "TraceContext", "current_context", "fetch_text", "get_registry",
+    "init_tracing", "parse_traceparent", "publish_rpc_stats",
+    "score_stragglers",
 ]
 
 

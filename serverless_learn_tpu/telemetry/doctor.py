@@ -1,0 +1,413 @@
+"""`slt doctor`: ranked cluster diagnosis from every telemetry trail.
+
+The health engine (``telemetry/health.py``) fires alerts *live*; this
+module answers the morning-after question — "what went wrong, on which
+node, and what else was happening?" — by merging four sources into one
+report:
+
+* **JSONL event logs** (``--events-log`` files, daemon ``--events_log``):
+  alert fire/resolve records, span records, DiLoCo round records.
+* **Flight-recorder dumps** (``flight-*.json``): a dead node's last
+  events plus its final metrics snapshot — the dump reason itself is a
+  diagnosis input ("sigterm" vs "alert:stale.train_step" vs "lease-expiry").
+* **Live `/alerts` scrapes** (``--endpoints``): what is firing right now.
+* **`bench_history.json`** (``utils/benchlog.py``): cross-run perf
+  regressions — a slow cluster that never fired an alert still shows up
+  as a throughput row below its best comparable historical entry.
+
+Alerts are ranked (critical > warning > info, firing before resolved,
+then by recurrence and recency) and each is **correlated with trace ids**:
+spans on the same node whose corrected window overlaps the alert's firing
+window, longest first — the "start here" pointer into ``slt trace``.
+
+``self_check()`` backs `slt doctor --self-check` (the CI smoke): parse
+the rules, run the engine over a synthetic healthy registry (no alerts
+may fire), then stall the same registry and require the staleness
+watchdog to fire — an engine that can't alarm is as broken as one that
+always does.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from serverless_learn_tpu.telemetry.health import (SEVERITY_RANK,
+                                                   score_stragglers)
+from serverless_learn_tpu.telemetry.timeline import _expand_paths
+
+DEFAULT_BENCH_HISTORY = "bench_history.json"
+TRACE_CORRELATION_WINDOW_S = 30.0
+
+
+# -- source collection -------------------------------------------------------
+
+
+def collect_files(paths: Sequence[str]) -> dict:
+    """Read logs + flight dumps into {"records": [...], "dumps": [...],
+    "files": [...]}. Dump-level metadata (reason, node, metrics snapshot)
+    is kept — `timeline.load_events` flattens it away, and the dump reason
+    is itself diagnostic."""
+    records: List[dict] = []
+    dumps: List[dict] = []
+    files: List[str] = []
+    for path in _expand_paths(list(paths)):
+        try:
+            with open(path) as f:
+                head = f.read(1)
+                f.seek(0)
+                if head == "{":
+                    try:
+                        obj = json.load(f)
+                    except json.JSONDecodeError:
+                        obj = None
+                        f.seek(0)
+                    if isinstance(obj, dict):
+                        files.append(path)
+                        if obj.get("event") == "flight_dump":
+                            node = obj.get("node")
+                            dumps.append({
+                                "path": path, "node": node,
+                                "reason": obj.get("reason"),
+                                "dumped_at_unix_s":
+                                    obj.get("dumped_at_unix_s"),
+                                "n_events": len(obj.get("events", [])),
+                                "has_metrics": "metrics" in obj,
+                                # The health engine's context provider
+                                # stamps firing alerts into every dump.
+                                "firing_alerts": [
+                                    a.get("alert") for a in
+                                    obj.get("alerts") or []]})
+                            for ev in obj.get("events", []):
+                                if node and "node" not in ev:
+                                    ev = dict(ev, node=node)
+                                records.append(ev)
+                        else:
+                            records.append(obj)
+                        continue
+                files.append(path)
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        records.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue  # a crash can tear the final line
+        except OSError:
+            continue
+    return {"records": records, "dumps": dumps, "files": files}
+
+
+def scrape_alerts(endpoints: Sequence[str],
+                  timeout: float = 5.0) -> List[dict]:
+    """Poll each endpoint's /alerts; unreachable nodes are reported, not
+    fatal (a dead node is exactly when you run doctor)."""
+    from serverless_learn_tpu.telemetry.exporter import fetch_text
+
+    out = []
+    for addr in endpoints:
+        addr = addr.strip()
+        if not addr:
+            continue
+        try:
+            payload = json.loads(fetch_text(addr, "/alerts",
+                                            timeout=timeout))
+            out.append({"endpoint": addr, "ok": True, "payload": payload})
+        except Exception as e:
+            out.append({"endpoint": addr, "ok": False,
+                        "error": f"{type(e).__name__}: {e}"})
+    return out
+
+
+# -- alert aggregation -------------------------------------------------------
+
+
+def _alert_key(rec: dict) -> tuple:
+    labels = rec.get("labels") or {}
+    return (rec.get("alert"), rec.get("node", ""),
+            tuple(sorted((str(k), str(v)) for k, v in labels.items())))
+
+
+def aggregate_alerts(records: List[dict],
+                     scrapes: List[dict]) -> List[dict]:
+    """Latest state per (alert, node, labels) across log records and live
+    scrapes; live scrapes win (they ARE the present)."""
+    agg: Dict[tuple, dict] = {}
+
+    def absorb(rec: dict, live: bool):
+        if not rec.get("alert"):
+            return
+        key = _alert_key(rec)
+        cur = agg.get(key)
+        if cur is None:
+            agg[key] = dict(rec, fires=rec.get("count", 1), live=live)
+            return
+        cur["fires"] = max(cur.get("fires", 1), rec.get("count", 1))
+        # Order by last_fired; a live scrape always supersedes the log
+        # trail for current state.
+        if live or not cur.get("live"):
+            if (live and not cur.get("live")) or (
+                    rec.get("last_fired_unix_s", 0)
+                    >= cur.get("last_fired_unix_s", 0)):
+                fires = cur["fires"]
+                cur.update(rec)
+                cur["fires"] = max(fires, rec.get("count", 1))
+                cur["live"] = cur.get("live") or live
+
+    for rec in records:
+        if rec.get("event") == "alert":
+            absorb(rec, live=False)
+    for scrape in scrapes:
+        if not scrape.get("ok"):
+            continue
+        payload = scrape["payload"] or {}
+        for rec in (payload.get("firing") or []) + \
+                (payload.get("resolved") or []):
+            absorb(dict(rec, endpoint=scrape["endpoint"]), live=True)
+    ranked = list(agg.values())
+    ranked.sort(key=lambda a: (
+        -SEVERITY_RANK.get(a.get("severity"), 0),
+        a.get("state") != "firing",
+        -a.get("fires", 1),
+        -a.get("last_fired_unix_s", 0)))
+    return ranked
+
+
+def correlate_traces(alert: dict, records: List[dict],
+                     window_s: float = TRACE_CORRELATION_WINDOW_S,
+                     top: int = 3) -> List[dict]:
+    """Trace ids of spans overlapping the alert's firing window on the
+    same node (any node when the alert is node-less) — the entry points
+    for `slt trace --trace-id`."""
+    t0 = alert.get("first_fired_unix_s")
+    t1 = alert.get("last_fired_unix_s", t0)
+    if t0 is None:
+        return []
+    lo, hi = t0 - window_s, t1 + window_s
+    node = alert.get("node")
+    best: Dict[str, dict] = {}
+    for rec in records:
+        if rec.get("event") != "span" or not rec.get("trace_id"):
+            continue
+        if node and rec.get("node") and rec["node"] != node:
+            continue
+        s0 = rec.get("t0_unix_s")
+        if s0 is None:
+            continue
+        dur = float(rec.get("duration_s") or 0.0)
+        if s0 + dur < lo or s0 > hi:
+            continue
+        tid = rec["trace_id"]
+        cur = best.get(tid)
+        if cur is None or dur > cur["duration_s"]:
+            best[tid] = {"trace_id": tid, "span": rec.get("span"),
+                         "node": rec.get("node"),
+                         "duration_s": round(dur, 6)}
+    rows = sorted(best.values(), key=lambda r: -r["duration_s"])
+    return rows[:top]
+
+
+# -- bench history -----------------------------------------------------------
+
+
+def bench_regressions(history_path: str, rel_threshold: float = 0.05,
+                      key_fields: Sequence[str] = ("metric", "device_kind"),
+                      ) -> List[dict]:
+    """Latest entry per comparable key vs. the best earlier entry — the
+    cross-run "did this cluster get slower" check. Rows flagged by
+    ``benchlog.record`` at write time surface too."""
+    from serverless_learn_tpu.utils.benchlog import load_history
+
+    history = load_history(history_path)
+    latest: Dict[tuple, Tuple[int, dict]] = {}
+    for i, h in enumerate(history):
+        if not isinstance(h.get("value"), (int, float)):
+            continue
+        key = tuple(h.get(k) for k in key_fields)
+        latest[key] = (i, h)
+    out = []
+    for key, (i, entry) in latest.items():
+        earlier = [h["value"] for h in history[:i]
+                   if all(h.get(k) == entry.get(k) for k in key_fields)
+                   and isinstance(h.get("value"), (int, float))]
+        row = None
+        gap = max(rel_threshold,
+                  2.0 * float(entry.get("spread_rel", 0.0) or 0.0))
+        if earlier and entry["value"] < max(earlier) * (1 - gap):
+            row = {"metric": entry.get("metric"),
+                   "value": entry["value"], "best": max(earlier),
+                   "loss_rel": round(1 - entry["value"] / max(earlier), 4)}
+        elif entry.get("regression"):
+            row = {"metric": entry.get("metric"),
+                   "value": entry["value"], "best": entry.get("best"),
+                   "flagged_at_record_time": True}
+        if row is not None:
+            for k, v in zip(key_fields, key):
+                if k != "metric" and v is not None:
+                    row[k] = v
+            if entry.get("time"):
+                row["time"] = entry["time"]
+            out.append(row)
+    out.sort(key=lambda r: -(r.get("loss_rel") or 0.0))
+    return out
+
+
+# -- the report --------------------------------------------------------------
+
+
+def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
+             bench_history: Optional[str] = None, top: int = 10) -> dict:
+    """Merge every source into one ranked diagnosis report (pure data —
+    the CLI prints it; tests assert on it)."""
+    collected = collect_files(paths)
+    records = collected["records"]
+    scrapes = scrape_alerts(endpoints)
+    alerts = aggregate_alerts(records, scrapes)
+
+    ranked = []
+    for a in alerts[:max(top, 1)]:
+        row = {"alert": a.get("alert"), "severity": a.get("severity"),
+               "state": a.get("state"), "node": a.get("node"),
+               "detector": a.get("detector"),
+               "message": a.get("message"),
+               "value": a.get("value"), "threshold": a.get("threshold"),
+               "fires": a.get("fires", 1),
+               "first_fired_unix_s": a.get("first_fired_unix_s"),
+               "last_fired_unix_s": a.get("last_fired_unix_s"),
+               "traces": correlate_traces(a, records)}
+        if a.get("labels"):
+            row["labels"] = a["labels"]
+        if a.get("endpoint"):
+            row["endpoint"] = a["endpoint"]
+        ranked.append(row)
+
+    round_recs = [r for r in records if r.get("event") == "diloco_round"]
+    stragglers = score_stragglers(round_recs) if round_recs else {}
+
+    bench_path = bench_history
+    if bench_path is None and os.path.exists(DEFAULT_BENCH_HISTORY):
+        bench_path = DEFAULT_BENCH_HISTORY
+    bench = None
+    if bench_path and os.path.exists(bench_path):
+        bench = {"history": bench_path,
+                 "regressions": bench_regressions(bench_path)}
+
+    firing = [a for a in alerts if a.get("state") == "firing"]
+    critical = [a for a in firing if a.get("severity") == "critical"]
+    flagged = sorted(w for w, s in stragglers.items() if s["flagged"])
+    verdict_bits = []
+    if critical:
+        worst = critical[0]
+        verdict_bits.append(
+            f"{len(critical)} critical alert(s) firing — worst: "
+            f"{worst.get('alert')} on {worst.get('node') or '?'}")
+    elif firing:
+        verdict_bits.append(f"{len(firing)} non-critical alert(s) firing")
+    if flagged:
+        verdict_bits.append(f"straggler worker(s): {', '.join(flagged)}")
+    if bench and bench["regressions"]:
+        verdict_bits.append(
+            f"{len(bench['regressions'])} bench regression(s) vs history")
+    dead = [s["endpoint"] for s in scrapes if not s["ok"]]
+    if dead:
+        verdict_bits.append(f"unreachable endpoint(s): {', '.join(dead)}")
+    if not verdict_bits:
+        verdict_bits.append("healthy: no firing alerts, no stragglers, "
+                            "no bench regressions")
+
+    return {
+        "generated_unix_s": round(time.time(), 3),
+        "sources": {"files": collected["files"],
+                    "endpoints": [s["endpoint"] for s in scrapes],
+                    "records": len(records)},
+        "summary": {"critical_firing": len(critical),
+                    "warning_firing": len(firing) - len(critical),
+                    "alerts_seen": len(alerts),
+                    "healthy": not critical,
+                    "verdict": "; ".join(verdict_bits)},
+        "alerts": ranked,
+        "stragglers": stragglers,
+        "flight_dumps": collected["dumps"],
+        "bench": bench,
+        "scrapes": [{k: v for k, v in s.items() if k != "payload"}
+                    for s in scrapes],
+    }
+
+
+# -- self-check --------------------------------------------------------------
+
+
+def self_check(config=None) -> dict:
+    """The CI smoke: rules parse, the engine runs clean over a healthy
+    synthetic registry, and the staleness watchdog still fires when the
+    same registry stalls. Returns {"ok": bool, ...}; never raises."""
+    from serverless_learn_tpu.config import HealthConfig
+    from serverless_learn_tpu.telemetry.health import HealthEngine
+    from serverless_learn_tpu.telemetry.registry import MetricsRegistry
+
+    report: dict = {"ok": False, "checks": []}
+
+    def check(name: str, ok: bool, detail: str = ""):
+        report["checks"].append({"check": name, "ok": ok,
+                                 **({"detail": detail} if detail else {})})
+        return ok
+
+    try:
+        if config is None:
+            config = HealthConfig(slos=(
+                {"name": "ttft", "kind": "latency",
+                 "metric": "slt_request_ttft_seconds",
+                 "threshold_s": 0.5, "objective": 0.95},
+                {"name": "errors", "kind": "ratio",
+                 "bad": "slt_server_errors_total",
+                 "total": "slt_server_requests_total",
+                 "objective": 0.999}))
+        elif isinstance(config, dict):
+            config = HealthConfig(**config)
+
+        reg = MetricsRegistry()
+        steps = reg.counter("slt_train_steps_total")
+        step_t = reg.histogram("slt_train_step_seconds")
+        sink: List[dict] = []
+        eng = HealthEngine(registry=reg, config=config,
+                           emit=sink.append, clock=time.time,
+                           dump_on_critical=False)
+        check("rules_parse", True,
+              f"{len(eng.slos)} SLO(s), "
+              f"{len(eng._anomaly)} anomaly series, "
+              f"{len(eng._stale)} staleness watchdogs")
+
+        # Healthy fixture: a steadily stepping trainer, simulated time.
+        t = 1_000_000.0
+        for _ in range(20):
+            steps.inc()
+            step_t.observe(0.1)
+            eng.sample_once(now=t)
+            t += 1.0
+        firing = eng.alerts(firing_only=True)
+        if not check("healthy_fixture_quiet", not firing,
+                     f"firing: {[a['alert'] for a in firing]}" if firing
+                     else "no alerts on a healthy series"):
+            return report
+        check("engine_warm", eng.warm, f"{eng.ticks} samples")
+
+        # Stall the trainer; the watchdog must notice.
+        for _ in range(10):
+            eng.sample_once(now=t)
+            t += 5.0
+        stale = [a for a in eng.alerts(firing_only=True)
+                 if a["alert"] == "stale.train_step"]
+        if not check("stall_detected", bool(stale),
+                     stale[0]["message"] if stale else
+                     "staleness watchdog never fired on a stalled counter"):
+            return report
+        check("alerts_emitted", any(r.get("event") == "alert"
+                                    for r in sink),
+              f"{len(sink)} event(s) emitted")
+        report["ok"] = all(c["ok"] for c in report["checks"])
+    except Exception as e:
+        check("exception", False, f"{type(e).__name__}: {e}")
+    return report

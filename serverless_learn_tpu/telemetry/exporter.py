@@ -2,7 +2,10 @@
 
     GET /metrics                   Prometheus text exposition (0.0.4)
     GET /metrics.json              nested JSON snapshot (same data, typed)
-    GET /healthz                   {"ok": true}
+    GET /healthz                   component readiness (503 when a
+                                   critical health alert is firing)
+    GET /alerts                    the health engine's firing/resolved
+                                   alerts ({"enabled": false} without one)
     GET /debug/profile?seconds=N   capture a jax.profiler device trace
                                    (enabled by `serve --profile-dir DIR`)
 
@@ -47,6 +50,12 @@ class MetricsExporter:
         self.registry = registry or get_registry()
         self.profile_dir = profile_dir
         self._profile_lock = threading.Lock()
+        # Optional cluster-health engine (telemetry/health.py): when
+        # attached, /healthz reports real component readiness (503 while
+        # a critical alert fires — orchestrator-probeable) and /alerts
+        # serves its firing/resolved alert state.
+        self.health = None
+        self._owns_health = False
         exporter = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -75,7 +84,10 @@ class MetricsExporter:
                         body = json.dumps(exporter.registry.snapshot())
                         self._reply(200, "application/json", body.encode())
                     elif path == "/healthz":
-                        self._reply(200, "application/json", b'{"ok": true}')
+                        code, obj = exporter._healthz()
+                        self._reply_json(code, obj)
+                    elif path == "/alerts":
+                        self._reply_json(200, exporter._alerts())
                     elif path == "/debug/profile":
                         code, obj = exporter._profile(
                             parse_qs(url.query),
@@ -90,6 +102,36 @@ class MetricsExporter:
         self._httpd.daemon_threads = True
         self.addr = f"{host}:{self._httpd.server_address[1]}"
         self._thread: Optional[threading.Thread] = None
+
+    # -- health ------------------------------------------------------------
+
+    def attach_health(self, engine, own: bool = False):
+        """Wire a HealthEngine behind /healthz and /alerts. ``own=True``
+        makes stop() stop the engine too (the CLI's single-owner path)."""
+        self.health = engine
+        self._owns_health = own
+        return self
+
+    def _healthz(self):
+        """(code, body): 503 while a critical alert fires, else 200 with
+        component readiness. Without an engine, the legacy liveness probe
+        (the process answers, that is all it claims)."""
+        if self.health is None:
+            return 200, {"ok": True, "engine": None}
+        try:
+            rep = self.health.health()
+        except Exception as e:
+            return 500, {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        return (200 if rep.get("ok") else 503), rep
+
+    def _alerts(self) -> dict:
+        if self.health is None:
+            return {"enabled": False, "firing": [], "resolved": []}
+        try:
+            return dict(self.health.alerts_payload(), enabled=True)
+        except Exception as e:
+            return {"enabled": True, "firing": [], "resolved": [],
+                    "error": f"{type(e).__name__}: {e}"}
 
     # -- on-demand device profiling ---------------------------------------
 
@@ -143,6 +185,8 @@ class MetricsExporter:
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._owns_health and self.health is not None:
+            self.health.stop()
 
 
 def fetch_text(addr: str, path: str = "/metrics",

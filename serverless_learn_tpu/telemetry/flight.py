@@ -36,6 +36,12 @@ _flight_dir: Optional[str] = None
 _prev_sigterm = None
 _prev_excepthook = None
 _prev_thread_hook = None
+# Named payload-section providers: fn() -> JSON-able value, added to
+# every dump under its name. The health engine registers its firing
+# alerts here, so a SIGTERM'd node's dump says WHAT was wrong, not just
+# what it was doing. Keyed (last wins) so a restarted engine replaces
+# its predecessor instead of stacking.
+_providers: dict = {}
 
 
 def record(event: dict):
@@ -50,6 +56,19 @@ def record(event: dict):
 def events() -> List[dict]:
     with _lock:
         return list(_ring)
+
+
+def add_context_provider(name: str, fn):
+    """Attach ``fn() -> JSON-able`` as a dump payload section. Providers
+    are best-effort: a raising provider is skipped, never fatal to the
+    dump (which may be running inside a crash handler)."""
+    with _lock:
+        _providers[name] = fn
+
+
+def remove_context_provider(name: str):
+    with _lock:
+        _providers.pop(name, None)
 
 
 def set_capacity(n: int):
@@ -108,6 +127,15 @@ def dump(reason: str, dir: Optional[str] = None) -> Optional[str]:
             payload["metrics"] = get_registry().snapshot()
         except Exception:
             pass
+        with _lock:
+            providers = list(_providers.items())
+        for pname, fn in providers:
+            try:
+                val = fn()
+                if val is not None and pname not in payload:
+                    payload[pname] = val
+            except Exception:
+                pass
         mem = _device_memory()
         if mem is not None:
             payload["device_memory"] = mem

@@ -422,20 +422,64 @@ class Span:
         return rec
 
 
+DEFAULT_EVENT_LOG_MAX_BYTES = 128 * 1024 * 1024
+
+
 class JsonlEventLog:
     """Append-only JSONL event sink (benchlog-style one-object-per-line),
     for request spans and lifecycle events. Thread-safe; never raises into
-    the serving path (a full disk must not kill a request)."""
+    the serving path (a full disk must not kill a request).
 
-    def __init__(self, path: str):
+    The handle is persistent (the original implementation re-opened the
+    file per event — one ``open`` syscall per request span adds up on a
+    busy server) and the file rotates at ``max_bytes``: the current log
+    moves to ``<path>.1`` (one generation, overwriting the previous) and
+    a fresh file continues. `slt trace`'s directory expansion picks up
+    ``*.jsonl.1`` beside ``*.jsonl``, so a rotated node still merges into
+    one timeline."""
+
+    def __init__(self, path: str,
+                 max_bytes: int = DEFAULT_EVENT_LOG_MAX_BYTES):
         self.path = path
+        self.max_bytes = max(4096, int(max_bytes))
         self._lock = threading.Lock()
+        self._f = None
+        self._size = 0
+
+    def _ensure_open_locked(self):
+        if self._f is None:
+            self._f = open(self.path, "a")
+            self._size = os.fstat(self._f.fileno()).st_size
+
+    def _drop_handle_locked(self):
+        try:
+            if self._f is not None:
+                self._f.close()
+        except (IOError, OSError, ValueError):
+            pass
+        self._f = None
 
     def emit(self, record: dict):
         line = json.dumps(dict(record,
-                               ts=time.strftime("%Y-%m-%dT%H:%M:%S")))
+                               ts=time.strftime("%Y-%m-%dT%H:%M:%S"))) + "\n"
         try:
-            with self._lock, open(self.path, "a") as f:
-                f.write(line + "\n")
-        except (IOError, OSError):
-            pass
+            with self._lock:
+                self._ensure_open_locked()
+                if self._size and self._size + len(line) > self.max_bytes:
+                    # Rotate: close, shift to .1 (previous .1 is replaced),
+                    # reopen fresh. Readers tailing the old inode keep it.
+                    self._drop_handle_locked()
+                    os.replace(self.path, self.path + ".1")
+                    self._ensure_open_locked()
+                self._f.write(line)
+                self._f.flush()
+                self._size += len(line)
+        except (IOError, OSError, ValueError):
+            # Drop the handle so the next emit retries a clean open (the
+            # file may have been deleted or the disk filled and recovered).
+            with self._lock:
+                self._drop_handle_locked()
+
+    def close(self):
+        with self._lock:
+            self._drop_handle_locked()

@@ -86,7 +86,10 @@ def _expand_paths(paths: List[str]) -> List[str]:
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
+            # *.jsonl.1 covers JsonlEventLog's size-based rotation: a
+            # rotated node's older half still merges into the timeline.
             files.extend(sorted(glob.glob(os.path.join(p, "*.jsonl")))
+                         + sorted(glob.glob(os.path.join(p, "*.jsonl.1")))
                          + sorted(glob.glob(os.path.join(p, "*.json"))))
         elif any(c in p for c in "*?["):
             files.extend(sorted(glob.glob(p)))

@@ -7,6 +7,12 @@ the "what is the cluster doing right now?" the reference answered with
 std::cout narration. ``--once`` prints a single snapshot (totals and
 gauges; rates need two polls); live mode recomputes counter rates from
 successive scrapes and redraws in place.
+
+Endpoints running the health engine (``telemetry/health.py``) also feed
+an ALERTS pane from ``/alerts`` — firing alerts render inline under the
+throughput tables (and print in ``--once`` mode, so scripts can grep a
+snapshot for ``critical``). Endpoints without the engine just skip the
+pane; the extra probe is best-effort.
 """
 
 from __future__ import annotations
@@ -109,6 +115,7 @@ class EndpointState:
         self.t: Optional[float] = None
         self.t_prev: Optional[float] = None
         self.error: Optional[str] = None
+        self.alerts: List[dict] = []  # firing alerts from /alerts
 
     def poll(self):
         self.prev, self.t_prev = self.data, self.t
@@ -118,6 +125,18 @@ class EndpointState:
             self.error = None
         except Exception as e:
             self.data, self.error = None, f"{type(e).__name__}: {e}"
+        # Health alerts are a separate, best-effort probe: an endpoint
+        # predating the health engine (or running without one) renders
+        # its metrics as before, with no ALERTS rows.
+        self.alerts = []
+        if self.data is not None:
+            try:
+                import json as _json
+
+                payload = _json.loads(fetch_text(self.addr, "/alerts"))
+                self.alerts = list(payload.get("firing") or [])
+            except Exception:
+                pass
 
     def rate(self, name: str) -> Optional[float]:
         """Counter rate between the last two polls; None on one poll."""
@@ -206,6 +225,27 @@ def render(states: List[EndpointState]) -> str:
         header = ["endpoint", "step", "step p50 ms", "samples/s",
                   "sps/chip", "mfu", "loss", "members", "epoch", "rounds"]
         lines += _table(header, train_rows)
+    alert_rows: List[List[str]] = []
+    for st in states:
+        for a in st.alerts:
+            age = None
+            if isinstance(a.get("last_fired_unix_s"), (int, float)):
+                age = max(0.0, time.time() - a["last_fired_unix_s"])
+            msg = str(a.get("message", ""))
+            alert_rows.append([
+                st.addr,
+                str(a.get("severity", "?")).upper(),
+                str(a.get("alert", "?")),
+                "-" if age is None else f"{age:.0f}s",
+                _num(a.get("value"), 3) if isinstance(
+                    a.get("value"), (int, float)) else "-",
+                msg if len(msg) <= 60 else msg[:57] + "...",
+            ])
+    if alert_rows:
+        lines.append("")
+        lines.append("  ALERTS")
+        lines += _table(["endpoint", "sev", "alert", "age", "value",
+                         "message"], alert_rows)
     if other_rows:
         lines.append("")
         lines += other_rows

@@ -141,6 +141,25 @@ def tracing_enabled() -> bool:
         return _event_log is not None
 
 
+def emit_event(record: dict):
+    """Record a non-span structured event (alert, DiLoCo round, lifecycle
+    marker): node-stamped, appended to the JSONL sink when one is
+    configured, and always pushed into the flight ring. Never raises —
+    the health engine and training loops call this from hot paths."""
+    try:
+        rec = dict(record)
+        rec.setdefault("node", node_name())
+        with _state_lock:
+            log = _event_log
+        if log is not None:
+            log.emit(rec)
+        from serverless_learn_tpu.telemetry import flight
+
+        flight.record(rec)
+    except Exception:
+        pass
+
+
 def emit_span(span: Span):
     """Record a finished span: JSONL sink (when configured) + the flight
     ring (always; bounded and cheap). Never raises into the caller."""

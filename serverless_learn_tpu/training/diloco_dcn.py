@@ -150,6 +150,9 @@ class DilocoIsland:
         self._m_lag = reg.gauge(
             "slt_diloco_anchor_lag_rounds",
             "LATEST round minus this island's round, when last checked")
+        self._m_round_wait = reg.histogram(
+            "slt_diloco_round_wait_seconds",
+            "outer-boundary wait from delta post to anchor availability")
         if self.inner_steps < 1:
             raise ValueError(f"inner_steps must be >= 1, "
                              f"got {self.inner_steps}")
@@ -302,6 +305,11 @@ class DilocoIsland:
         protocol already tolerates (atomic PUT, last wins, both anchors
         valid averages)."""
         next_key = self._k(f"round-{rnd + 1}", "anchor")
+        t_wait0 = time.monotonic()
+        # First-seen offset per worker's delta: the leader's view of who
+        # was prompt and who straggled this round (emitted with the round
+        # record in _lead; scored by telemetry/health.score_stragglers).
+        arrivals: dict = {}
         deadline = time.monotonic() + self.round_timeout_s
         escape_at = (time.monotonic()
                      + self.liveness_factor * self.round_timeout_s)
@@ -330,17 +338,47 @@ class DilocoIsland:
                     challenge = True
             if wid == min(live, default=wid) or challenge:
                 posted = set(self._deltas_for(rnd))
+                now_off = time.monotonic() - t_wait0
+                for p in posted:
+                    arrivals.setdefault(p, now_off)
                 waiting_on = [i for i in live if i not in posted]
                 if challenge or not waiting_on \
                         or time.monotonic() > deadline:
                     self.report.led_rounds += 1
                     self._m_led.inc()
-                    self._lead(rnd, sorted(posted), anchor, trace, template)
+                    self._lead(rnd, sorted(posted), anchor, trace, template,
+                               arrivals=arrivals, live=live,
+                               waited_s=time.monotonic() - t_wait0)
                     return anchor
             time.sleep(self.poll_s)
+        mw = getattr(self, "_m_round_wait", None)
+        if mw is not None:
+            mw.observe(time.monotonic() - t_wait0)
         return anchor
 
-    def _lead(self, rnd: int, posted: List[int], anchor, trace, template):
+    def _lead(self, rnd: int, posted: List[int], anchor, trace, template,
+              arrivals: Optional[dict] = None, live: Optional[List[int]]
+              = None, waited_s: Optional[float] = None):
+        # The leader's round record: who posted, when each delta first
+        # appeared, who was live but missing. Lands in the module straggler
+        # ring (live health engine) AND the JSONL sink/flight ring (`slt
+        # doctor` offline scoring) — one record, both consumers.
+        from serverless_learn_tpu.telemetry import health as _health
+        from serverless_learn_tpu.telemetry import tracing as _ttrace
+
+        rec = {"event": "diloco_round", "run": self.run, "round": rnd,
+               "leader": getattr(self.agent, "worker_id", None),
+               "posted": list(posted),
+               "live": list(live) if live is not None else list(posted),
+               "arrivals_s": {str(k): round(v, 4)
+                              for k, v in (arrivals or {}).items()}}
+        if waited_s is not None:
+            rec["waited_s"] = round(waited_s, 4)
+            mw = getattr(self, "_m_round_wait", None)
+            if mw is not None:
+                mw.observe(waited_s)
+        _health.note_round(rec)
+        _ttrace.emit_event(rec)
         deltas = [_unpack(self.store.get(
             self._k(f"round-{rnd}", f"delta-{i}")), template)
             for i in posted]

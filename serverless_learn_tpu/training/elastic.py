@@ -183,6 +183,15 @@ class ElasticTrainer:
         m_epoch = reg.gauge("slt_membership_epoch")
         m_remesh = reg.counter("slt_remesh_total",
                                "mesh formations (first one included)")
+        # Structural-health inputs (telemetry/health.py): remesh wall time
+        # feeds the anomaly detector (an epoch transition suddenly 10x
+        # slower is a sick store or coordinator), the last-step stamp
+        # feeds the staleness watchdog / /healthz last-step age.
+        m_remesh_t = reg.histogram(
+            "slt_remesh_seconds",
+            "drain -> save -> remesh -> restore wall time per epoch")
+        m_last_step = reg.gauge("slt_train_last_step_unix_s",
+                                "wall time of the latest optimizer step")
         losses: List[float] = []
         state = None
         source = None
@@ -255,6 +264,7 @@ class ElasticTrainer:
                 m_members.set(size)
                 remesh_span.meta.update(n_devices=len(devices), step=step)
                 remesh_cm.__exit__(None, None, None)
+                m_remesh_t.observe(remesh_span.duration_s)
                 flight.record({"event": "mesh_formed", "epoch": epoch,
                                "n_devices": len(devices), "step": step,
                                "stripe": [rank, size]})
@@ -289,6 +299,7 @@ class ElasticTrainer:
                         losses.append(loss)
                         step += 1
                         m_steps.inc()
+                        m_last_step.set(time.time())
                         m_loss.set(loss)
                         if self._agent is not None:
                             self._agent.report(step, loss,

@@ -8,6 +8,7 @@ inside the jitted step.
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional
 
 import jax
@@ -189,6 +190,12 @@ def run_training(
     m_sps = reg.gauge("slt_train_samples_per_sec")
     m_sps_chip = reg.gauge("slt_train_samples_per_sec_per_chip")
     m_loss = reg.gauge("slt_train_loss")
+    # Wall time of the latest optimizer step: the health engine's
+    # staleness watchdog and /healthz "last-step age" read this — a loop
+    # wedged inside one step (device hang, stuck host callback) stops
+    # advancing it even though the process stays alive.
+    m_last_step = reg.gauge("slt_train_last_step_unix_s",
+                            "wall time of the latest optimizer step")
     reg.gauge("slt_train_grad_accum",
               "microbatches per step").set(config.train.grad_accum)
     reg.gauge("slt_train_batch_size").set(config.train.batch_size)
@@ -217,6 +224,7 @@ def run_training(
                            **{k: round(v, 5) for k, v in metrics.items()}})
             m_steps.inc()
             m_step_t.observe(stats.step_time_s)
+            m_last_step.set(time.time())
             m_sps.set(stats.samples_per_sec)
             m_sps_chip.set(stats.samples_per_sec / max(trainer.mesh.size, 1))
             if "loss" in metrics:

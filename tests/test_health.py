@@ -1,0 +1,658 @@
+"""Cluster health engine + `slt doctor` (`telemetry/health.py`, `doctor.py`).
+
+Fast tier: detector math (EWMA/MAD determinism, burn-rate window
+arithmetic at budget boundaries, staleness watchdog), straggler scoring
+from a fabricated 3-worker round log, /healthz state transitions and the
+/alerts endpoint, event-log rotation, the `slt top` ALERTS pane, doctor
+end-to-end over fixture logs, and `doctor --self-check`.
+
+Slow tier: the demo acceptance path — a real training run with an
+injected stall fires a staleness alert on /alerts, flips /healthz to 503,
+triggers a flight dump, and `slt doctor` names the offending node with a
+correlated trace id.
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from serverless_learn_tpu.config import HealthConfig
+from serverless_learn_tpu.telemetry import (HealthEngine, JsonlEventLog,
+                                            MetricsExporter, MetricsRegistry,
+                                            fetch_text)
+from serverless_learn_tpu.telemetry.health import (BurnRate, EwmaMad,
+                                                   StalenessWatch,
+                                                   flatten_snapshot,
+                                                   hist_good_total,
+                                                   parse_slos,
+                                                   score_stragglers)
+
+
+# -- detector math (fast) ----------------------------------------------------
+
+def test_ewma_mad_is_deterministic_and_flags_spikes():
+    det = EwmaMad(alpha=0.3, window=64, min_samples=10, rel_floor=0.05)
+    # Warmup: no z until min_samples history exists.
+    for i in range(10):
+        assert det.update(1.0) is None, i
+    # Steady series: z exactly 0 (ewma == sample, MAD floor positive).
+    assert det.update(1.0) == 0.0
+    # A 10x spike against a constant baseline: z = .6745*(10-1)/(.05*1).
+    z = det.update(10.0)
+    assert z == pytest.approx(0.6745 * 9.0 / 0.05)
+    # Determinism: an identical series yields the identical score.
+    det2 = EwmaMad(alpha=0.3, window=64, min_samples=10, rel_floor=0.05)
+    for _ in range(11):
+        det2.update(1.0)
+    assert det2.update(10.0) == z
+    # The spike was absorbed: the baseline adapts instead of latching.
+    assert det.ewma == pytest.approx(0.3 * 10.0 + 0.7 * 1.0)
+
+
+def test_ewma_mad_low_tail():
+    det = EwmaMad(min_samples=5, rel_floor=0.05)
+    for _ in range(6):
+        det.update(100.0)
+    z = det.update(10.0)  # a throughput collapse is a NEGATIVE z
+    assert z < -6.0
+
+
+def test_burn_rate_window_arithmetic_at_budget_boundaries():
+    # objective 0.99 -> budget 0.01; fast burn 14.4 means a bad fraction
+    # of exactly 0.144 over both windows.
+    br = BurnRate(budget=0.01, short_s=60, long_s=720,
+                  fast_burn=14.4, slow_burn=6.0)
+    assert br.update(0, 0, 0)["severity"] is None  # no history yet
+    r = br.update(30, 144, 1000)
+    assert r["short_burn"] == pytest.approx(14.4)
+    assert r["long_burn"] == pytest.approx(14.4)
+    assert r["severity"] == "critical"
+    # One bad event fewer: 0.1439 -> burn 14.39 < 14.4 but >= 6 -> warning.
+    br2 = BurnRate(budget=0.01, short_s=60, long_s=720)
+    br2.update(0, 0, 0)
+    r2 = br2.update(30, 143, 1000)
+    assert r2["severity"] == "warning"
+    # Under the slow threshold entirely: 59/1000 -> 5.9x.
+    br3 = BurnRate(budget=0.01, short_s=60, long_s=720)
+    br3.update(0, 0, 0)
+    assert br3.update(30, 59, 1000)["severity"] is None
+    # Zero traffic burns nothing.
+    br4 = BurnRate(budget=0.01)
+    br4.update(0, 5, 100)
+    assert br4.update(30, 5, 100)["short_burn"] == 0.0
+    with pytest.raises(ValueError):
+        BurnRate(budget=0.0)
+
+
+def test_burn_rate_needs_both_windows():
+    """A long-ago incident must not page: the short window recovers and
+    the severity drops even while the long window still burns."""
+    br = BurnRate(budget=0.01, short_s=60, long_s=720,
+                  fast_burn=14.4, slow_burn=6.0)
+    br.update(0, 0, 0)
+    assert br.update(60, 200, 1000)["severity"] == "critical"
+    # 10 minutes of clean (light) traffic: the short window is clean even
+    # though the long window still burns hot — no page.
+    br.update(600, 200, 1150)
+    r = br.update(660, 200, 1200)
+    assert r["short_burn"] == 0.0
+    assert r["long_burn"] > 14.4
+    assert r["severity"] is None
+
+
+def test_hist_good_total_threshold_between_edges():
+    reg = MetricsRegistry()
+    h = reg.histogram("slt_t_seconds", buckets=(0.1, 0.25, 0.5))
+    for v in (0.05, 0.2, 0.3, 0.6):
+        h.observe(v)
+    snap = h.snapshot()
+    # Threshold on an edge: observations <= 0.25 are good.
+    assert hist_good_total(snap, 0.25) == (2.0, 4.0)
+    # Between edges: conservative (largest edge <= threshold).
+    assert hist_good_total(snap, 0.4) == (2.0, 4.0)
+    assert hist_good_total(snap, 0.05) == (0.0, 4.0)
+
+
+def test_staleness_watch_learns_interval_and_rearms():
+    w = StalenessWatch(factor=3.0, min_interval_s=0.5)
+    assert w.update(0.0, 10.0) is None   # first observation arms nothing
+    assert w.update(1.0, 11.0) is None   # first increment: interval epoch
+    assert w.update(2.0, 12.0) is None   # ewma interval ~1s
+    assert w.update(4.0, 12.0) is None   # age 2 < 3*1
+    stale = w.update(6.0, 12.0)          # age 4 > 3
+    assert stale is not None
+    age, threshold = stale
+    assert age == pytest.approx(4.0)
+    assert threshold == pytest.approx(3.0)
+    assert w.update(7.0, 13.0) is None   # recovered
+    # Counter restart (process restart) re-arms instead of alarming.
+    assert w.update(8.0, 2.0) is None
+    assert w.update(100.0, 2.0) is None
+
+
+def test_parse_slos_validates_loudly():
+    ok = parse_slos([
+        {"name": "ttft", "kind": "latency",
+         "metric": "slt_request_ttft_seconds", "threshold_s": 0.5,
+         "objective": 0.95},
+        {"name": "err", "kind": "ratio", "bad": "slt_server_errors_total",
+         "total": "slt_server_requests_total", "objective": 0.999}])
+    assert [s["name"] for s in ok] == ["ttft", "err"]
+    for bad in (
+            [{"kind": "latency"}],                      # no name
+            [{"name": "x", "objective": 2.0,            # objective > 1
+              "metric": "m", "threshold_s": 1}],
+            [{"name": "x", "objective": 0.9}],          # latency, no metric
+            [{"name": "x", "kind": "ratio", "objective": 0.9}],  # no bad
+            [{"name": "x", "kind": "nope", "objective": 0.9}],
+            ["not-a-dict"]):
+        with pytest.raises(ValueError):
+            parse_slos(bad)
+
+
+def test_score_stragglers_fabricated_three_worker_rounds():
+    rounds = []
+    for r in range(4):
+        rounds.append({"round": r, "live": [1, 2, 9],
+                       "arrivals_s": {"1": 0.2 + 0.01 * r, "2": 0.25,
+                                      "9": 6.0 + r}})
+    # Worker 9 also misses a round entirely.
+    rounds.append({"round": 4, "live": [1, 2, 9],
+                   "arrivals_s": {"1": 0.2, "2": 0.22}})
+    scores = score_stragglers(rounds, factor=4.0, min_rounds=2)
+    assert scores["9"]["flagged"] is True
+    assert scores["9"]["late"] == 4 and scores["9"]["missing"] == 1
+    assert scores["9"]["mean_lag_s"] > 5.0
+    assert scores["1"]["flagged"] is False
+    assert scores["2"]["flagged"] is False
+    # One slow round out of many is noise, not a straggler.
+    noise = [{"round": r, "live": [1, 2],
+              "arrivals_s": {"1": 0.2, "2": 5.0 if r == 0 else 0.2}}
+             for r in range(6)]
+    assert score_stragglers(noise)["2"]["flagged"] is False
+
+
+# -- engine ticks (fast, fake clock) -----------------------------------------
+
+def _engine(reg, sink, **cfg_kw):
+    cfg = HealthConfig(**{
+        "stale_factor": 3.0, "stale_min_interval_s": 1.0,
+        "clear_after_ticks": 2, **cfg_kw})
+    return HealthEngine(registry=reg, config=cfg, emit=sink.append,
+                        dump_on_critical=False)
+
+
+def test_engine_staleness_fire_and_resolve_cycle():
+    reg = MetricsRegistry()
+    steps = reg.counter("slt_train_steps_total")
+    sink = []
+    eng = _engine(reg, sink)
+    t = 1000.0
+    for _ in range(6):
+        steps.inc()
+        eng.sample_once(now=t)
+        t += 1.0
+    assert eng.alerts(firing_only=True) == []
+    # Stall: interval ~1s, factor 3 -> fires once age > 3s.
+    for _ in range(5):
+        eng.sample_once(now=t)
+        t += 2.0
+    firing = eng.alerts(firing_only=True)
+    assert [a["alert"] for a in firing] == ["stale.train_step"]
+    assert firing[0]["severity"] == "critical"
+    fired_events = [r for r in sink if r.get("event") == "alert"]
+    assert fired_events and fired_events[0]["state"] == "firing"
+    # Recovery + clear_after_ticks clean ticks -> resolved, emitted once.
+    for _ in range(3):
+        steps.inc()
+        eng.sample_once(now=t)
+        t += 1.0
+    assert eng.alerts(firing_only=True) == []
+    resolved = [r for r in sink if r.get("event") == "alert"
+                and r["state"] == "resolved"]
+    assert len(resolved) == 1
+
+
+def test_engine_anomaly_step_time_spike():
+    reg = MetricsRegistry()
+    steps = reg.counter("slt_train_steps_total")
+    h = reg.histogram("slt_train_step_seconds")
+    sink = []
+    eng = _engine(reg, sink, anomaly_min_samples=5, anomaly_z=6.0)
+    t = 0.0
+    for _ in range(8):
+        steps.inc()
+        h.observe(0.1)
+        eng.sample_once(now=t)
+        t += 1.0
+    assert eng.alerts(firing_only=True) == []
+    steps.inc()
+    h.observe(2.0)  # 20x step-time spike in this window
+    eng.sample_once(now=t)
+    firing = [a["alert"] for a in eng.alerts(firing_only=True)]
+    assert "anomaly.step_time" in firing
+
+
+def test_engine_slo_latency_burn():
+    reg = MetricsRegistry()
+    h = reg.histogram("slt_request_ttft_seconds")
+    sink = []
+    eng = _engine(reg, sink, slos=(
+        {"name": "ttft", "kind": "latency",
+         "metric": "slt_request_ttft_seconds", "threshold_s": 0.25,
+         "objective": 0.95},))
+    t = 0.0
+    for _ in range(3):  # healthy: all under target
+        for _ in range(20):
+            h.observe(0.01)
+        eng.sample_once(now=t)
+        t += 10.0
+    assert eng.alerts(firing_only=True) == []
+    for _ in range(12):  # regression: everything lands at 1s
+        for _ in range(20):
+            h.observe(1.0)
+        eng.sample_once(now=t)
+        t += 10.0
+    firing = eng.alerts(firing_only=True)
+    assert [a["alert"] for a in firing] == ["slo.ttft"]
+    # Long enough that the bad fraction dominates both windows: critical.
+    assert firing[0]["severity"] == "critical"
+
+
+def test_engine_event_counter_and_straggler_alerts():
+    from serverless_learn_tpu.telemetry import health as hmod
+
+    reg = MetricsRegistry()
+    lease = reg.counter("slt_lease_expiries_total")
+    sink = []
+    eng = _engine(reg, sink)
+    hmod.clear_rounds()
+    try:
+        eng.sample_once(now=0.0)
+        lease.inc()
+        eng.sample_once(now=1.0)
+        firing = {a["alert"] for a in eng.alerts(firing_only=True)}
+        assert "event.lease_expiry" in firing
+        for r in range(3):
+            hmod.note_round({"round": r, "live": [1, 2, 9],
+                             "arrivals_s": {"1": 0.1, "2": 0.12,
+                                            "9": 8.0}})
+        eng.sample_once(now=2.0)
+        strag = [a for a in eng.alerts(firing_only=True)
+                 if a["alert"] == "straggler.diloco_worker"]
+        assert len(strag) == 1
+        assert strag[0]["labels"] == {"worker_id": "9"}
+    finally:
+        hmod.clear_rounds()
+
+
+def test_flatten_snapshot_sums_series():
+    reg = MetricsRegistry()
+    reg.counter("slt_requests_total", engine="continuous").inc(3)
+    reg.counter("slt_requests_total", engine="static").inc(2)
+    reg.histogram("slt_t_seconds", buckets=(1.0,), engine="a").observe(0.5)
+    reg.histogram("slt_t_seconds", buckets=(1.0,), engine="b").observe(2.0)
+    flat = flatten_snapshot(reg.snapshot())
+    assert flat["values"]["slt_requests_total"] == 5
+    assert flat["hists"]["slt_t_seconds"]["count"] == 2
+    assert flat["hists"]["slt_t_seconds"]["cumulative"] == [1, 2]
+
+
+# -- /healthz + /alerts (fast) -----------------------------------------------
+
+def test_healthz_transitions_and_alerts_endpoint():
+    import urllib.error
+
+    reg = MetricsRegistry()
+    steps = reg.counter("slt_train_steps_total")
+    sink = []
+    eng = _engine(reg, sink)
+    exp = MetricsExporter(reg).start()
+    exp.attach_health(eng)
+    try:
+        t = 1000.0
+        for _ in range(4):
+            steps.inc()
+            eng.sample_once(now=t)
+            t += 1.0
+        # Healthy: 200, real components, no firing criticals.
+        rep = json.loads(fetch_text(exp.addr, "/healthz"))
+        assert rep["ok"] is True
+        assert rep["components"]["engine"]["warm"] is True
+        assert rep["components"]["last_step_age_s"] is not None
+        assert rep["firing_critical"] == []
+        payload = json.loads(fetch_text(exp.addr, "/alerts"))
+        assert payload["enabled"] is True and payload["firing"] == []
+        # Stall -> critical firing -> 503 with the alert named.
+        for _ in range(5):
+            eng.sample_once(now=t)
+            t += 2.0
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            fetch_text(exp.addr, "/healthz")
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read().decode())
+        assert body["ok"] is False
+        assert "stale.train_step" in body["firing_critical"]
+        payload = json.loads(fetch_text(exp.addr, "/alerts"))
+        assert [a["alert"] for a in payload["firing"]] \
+            == ["stale.train_step"]
+        # Recovery: steps resume, clean ticks pass -> 200 again.
+        for _ in range(3):
+            steps.inc()
+            eng.sample_once(now=t)
+            t += 1.0
+        assert json.loads(fetch_text(exp.addr, "/healthz"))["ok"] is True
+    finally:
+        exp.stop()
+
+
+def test_healthz_without_engine_stays_legacy():
+    exp = MetricsExporter(MetricsRegistry()).start()
+    try:
+        assert json.loads(fetch_text(exp.addr, "/healthz"))["ok"] is True
+        payload = json.loads(fetch_text(exp.addr, "/alerts"))
+        assert payload == {"enabled": False, "firing": [], "resolved": []}
+    finally:
+        exp.stop()
+
+
+def test_top_renders_alerts_pane():
+    from serverless_learn_tpu.telemetry.top import EndpointState, render
+
+    reg = MetricsRegistry()
+    steps = reg.counter("slt_train_steps_total")
+    eng = _engine(reg, [])
+    exp = MetricsExporter(reg).start()
+    exp.attach_health(eng)
+    try:
+        t = 0.0
+        for _ in range(4):
+            steps.inc()
+            eng.sample_once(now=t)
+            t += 1.0
+        for _ in range(5):
+            eng.sample_once(now=t)
+            t += 2.0
+        st = EndpointState(exp.addr)
+        st.poll()
+        screen = render([st])
+        assert "ALERTS" in screen
+        assert "stale.train_step" in screen
+        assert "CRITICAL" in screen
+    finally:
+        exp.stop()
+
+
+# -- event-log rotation (fast) -----------------------------------------------
+
+def test_event_log_rotation_and_trace_merge(tmp_path):
+    from serverless_learn_tpu.telemetry import timeline
+
+    path = str(tmp_path / "events.jsonl")
+    log = JsonlEventLog(path, max_bytes=4096)
+    for i in range(100):
+        log.emit({"event": "span", "span": f"s{i}", "trace_id": f"t{i}",
+                  "span_id": f"{i:016x}", "t0_unix_s": 1000.0 + i,
+                  "duration_s": 0.1, "node": "n1",
+                  "pad": "x" * 80})
+    log.close()
+    assert os.path.exists(path + ".1"), "no rotation happened"
+    assert os.path.getsize(path) <= 4096
+    # Every line in both generations is intact JSON.
+    recs = []
+    for p in (path, path + ".1"):
+        with open(p) as f:
+            recs += [json.loads(line) for line in f if line.strip()]
+    assert len(recs) <= 100  # middle generations age out (.1 overwritten)
+    names = {r["span"] for r in recs}
+    assert "s99" in names  # the newest record survives
+    # `slt trace` directory expansion merges both generations.
+    tl = timeline.reconstruct([str(tmp_path)])
+    assert len(tl.spans) == len(recs)
+
+
+def test_event_log_recovers_after_external_delete(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    log = JsonlEventLog(path)
+    log.emit({"event": "a"})
+    os.remove(path)
+    log.emit({"event": "b"})  # must not raise; appends via the old handle
+    log.close()
+    log.emit({"event": "c"})  # reopened handle recreates the file
+    log.close()
+    with open(path) as f:
+        events = [json.loads(line)["event"] for line in f]
+    assert "c" in events
+
+
+# -- doctor (fast) -----------------------------------------------------------
+
+def _write_fixture_logs(tmp_path):
+    """A fabricated incident trail: alerts, spans, rounds, a flight dump,
+    and a bench history with a regression."""
+    events = tmp_path / "events.jsonl"
+    with open(events, "w") as f:
+        base = 1_700_000_000.0
+        f.write(json.dumps({
+            "event": "alert", "alert": "stale.train_step",
+            "severity": "critical", "detector": "structural",
+            "state": "firing", "node": "worker-a",
+            "message": "slt_train_steps_total has not advanced in 42.0s",
+            "value": 42.0, "threshold": 5.0, "count": 1,
+            "first_fired_unix_s": base + 100,
+            "last_fired_unix_s": base + 100}) + "\n")
+        f.write(json.dumps({
+            "event": "alert", "alert": "anomaly.queue_wait",
+            "severity": "warning", "detector": "anomaly",
+            "state": "firing", "node": "serve-b",
+            "message": "queue wait anomalous", "value": 2.0,
+            "threshold": 6.0, "count": 3,
+            "first_fired_unix_s": base + 90,
+            "last_fired_unix_s": base + 120}) + "\n")
+        f.write(json.dumps({
+            "event": "span", "span": "train/run", "node": "worker-a",
+            "trace_id": "aa11", "span_id": "s1",
+            "t0_unix_s": base + 50, "duration_s": 120.0}) + "\n")
+        f.write(json.dumps({
+            "event": "span", "span": "unrelated", "node": "other-c",
+            "trace_id": "zz99", "span_id": "s2",
+            "t0_unix_s": base + 100, "duration_s": 1.0}) + "\n")
+        for r in range(3):
+            f.write(json.dumps({
+                "event": "diloco_round", "round": r, "live": [1, 2, 9],
+                "arrivals_s": {"1": 0.2, "2": 0.3, "9": 9.0}}) + "\n")
+    flight = tmp_path / "flight-worker-a-1700000150.json"
+    with open(flight, "w") as f:
+        json.dump({"event": "flight_dump", "node": "worker-a",
+                   "reason": "alert:stale.train_step", "pid": 1234,
+                   "dumped_at_unix_s": 1_700_000_150.0,
+                   "events": [{"event": "train_step", "step": 7}],
+                   "metrics": {}}, f)
+    bench = tmp_path / "bench_history.json"
+    with open(bench, "w") as f:
+        json.dump([
+            {"metric": "decode_tokens_per_sec", "device_kind": "cpu",
+             "value": 1000.0, "time": "2026-08-01T00:00:00"},
+            {"metric": "decode_tokens_per_sec", "device_kind": "cpu",
+             "value": 600.0, "time": "2026-08-03T00:00:00"},
+        ], f)
+    return str(events), str(flight), str(bench)
+
+
+def test_doctor_end_to_end_over_fixture_logs(tmp_path, capsys):
+    from serverless_learn_tpu.cli import main
+
+    events, flight, bench = _write_fixture_logs(tmp_path)
+    rc = main(["doctor", events, flight, "--bench-history", bench])
+    out = capsys.readouterr().out
+    assert rc == 1  # critical alert firing -> nonzero for scripting
+    rep = json.loads(out)
+    # Ranked: critical staleness first, named node, correlated trace.
+    top_alert = rep["alerts"][0]
+    assert top_alert["alert"] == "stale.train_step"
+    assert top_alert["node"] == "worker-a"
+    assert top_alert["traces"][0]["trace_id"] == "aa11"
+    assert all(t["trace_id"] != "zz99" for t in top_alert["traces"])
+    assert rep["alerts"][1]["alert"] == "anomaly.queue_wait"
+    # Straggler scoring from the round records in the log.
+    assert rep["stragglers"]["9"]["flagged"] is True
+    # The flight dump (with its reason) is surfaced.
+    assert rep["flight_dumps"][0]["node"] == "worker-a"
+    assert rep["flight_dumps"][0]["reason"] == "alert:stale.train_step"
+    # Cross-run bench regression vs history.
+    regs = rep["bench"]["regressions"]
+    assert regs and regs[0]["metric"] == "decode_tokens_per_sec"
+    assert regs[0]["value"] == 600.0 and regs[0]["best"] == 1000.0
+    # Verdict names the worst problem.
+    assert "stale.train_step" in rep["summary"]["verdict"]
+    assert rep["summary"]["healthy"] is False
+
+
+def test_doctor_healthy_logs_exit_zero(tmp_path, capsys):
+    from serverless_learn_tpu.cli import main
+
+    events = tmp_path / "events.jsonl"
+    with open(events, "w") as f:
+        f.write(json.dumps({"event": "span", "span": "train/run",
+                            "trace_id": "ab", "span_id": "cd",
+                            "t0_unix_s": 1.0, "duration_s": 1.0}) + "\n")
+    # Point --bench-history away from any repo-root bench_history.json so
+    # the verdict reflects only this fixture.
+    rc = main(["doctor", str(events),
+               "--bench-history", str(tmp_path / "none.json")])
+    rep = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert rep["summary"]["healthy"] is True
+    assert "healthy" in rep["summary"]["verdict"]
+
+
+def test_doctor_self_check_cli(capsys):
+    from serverless_learn_tpu.cli import main
+
+    assert main(["doctor", "--self-check"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ok"] is True
+    assert {c["check"] for c in rep["checks"]} >= {
+        "rules_parse", "healthy_fixture_quiet", "stall_detected"}
+
+
+def test_doctor_scrapes_live_alerts_endpoint():
+    from serverless_learn_tpu.telemetry import doctor
+
+    reg = MetricsRegistry()
+    steps = reg.counter("slt_train_steps_total")
+    eng = _engine(reg, [])
+    exp = MetricsExporter(reg).start()
+    exp.attach_health(eng)
+    try:
+        t = 0.0
+        for _ in range(4):
+            steps.inc()
+            eng.sample_once(now=t)
+            t += 1.0
+        for _ in range(5):
+            eng.sample_once(now=t)
+            t += 2.0
+        rep = doctor.diagnose(endpoints=[exp.addr])
+        assert rep["summary"]["critical_firing"] == 1
+        assert rep["alerts"][0]["alert"] == "stale.train_step"
+        # A dead endpoint is reported, not fatal.
+        rep2 = doctor.diagnose(endpoints=["127.0.0.1:1"])
+        assert rep2["scrapes"][0]["ok"] is False
+        assert "unreachable" in rep2["summary"]["verdict"]
+    finally:
+        exp.stop()
+
+
+# -- demo acceptance (slow): stall -> alert -> dump -> doctor ----------------
+
+@pytest.mark.slow
+def test_stalled_training_fires_alert_dump_and_doctor(tmp_path, capsys):
+    """A training run with an injected stall produces a firing staleness
+    alert on /alerts, a 503 /healthz, a flight dump, and an `slt doctor`
+    report naming the offending node with a correlated trace id."""
+    from serverless_learn_tpu.cli import main
+    from serverless_learn_tpu.config import (DataConfig, ExperimentConfig,
+                                             MeshConfig, OptimizerConfig,
+                                             TrainConfig)
+    from serverless_learn_tpu.telemetry import get_registry, init_tracing
+    from serverless_learn_tpu.training.loop import run_training
+
+    events = str(tmp_path / "events.jsonl")
+    init_tracing(node="stall-node", events_log=events,
+                 flight_dir=str(tmp_path))
+    reg = get_registry()  # run_training publishes here
+    eng = HealthEngine(
+        registry=reg,
+        config=HealthConfig(sample_interval_s=0.05, stale_factor=3.0,
+                            stale_min_interval_s=0.25,
+                            clear_after_ticks=3),
+        flight_dir=str(tmp_path)).start()
+    exp = MetricsExporter(reg).start()
+    exp.attach_health(eng)
+
+    def stall(step, state, stats):
+        if step == 4:
+            time.sleep(4.0)  # the injected stall
+
+    cfg = ExperimentConfig(
+        model="mlp_mnist", mesh=MeshConfig(dp=8),
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.1),
+        train=TrainConfig(batch_size=16, num_steps=8, dtype="float32",
+                          param_dtype="float32"),
+        data=DataConfig())
+    t = threading.Thread(target=run_training, args=(cfg,),
+                         kwargs={"step_callback": stall})
+    t.start()
+    try:
+        deadline = time.time() + 120
+        firing = []
+        while time.time() < deadline:
+            try:
+                payload = json.loads(fetch_text(exp.addr, "/alerts"))
+                firing = [a for a in payload["firing"]
+                          if a["alert"] == "stale.train_step"]
+                if firing:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.05)
+        assert firing, "staleness alert never fired during the stall"
+        assert firing[0]["severity"] == "critical"
+        assert firing[0]["node"] == "stall-node"
+        # /healthz is an orchestrator-probeable 503 while critical fires.
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            fetch_text(exp.addr, "/healthz")
+        assert ei.value.code == 503
+    finally:
+        t.join(timeout=300)
+        eng.stop()
+        exp.stop()
+    # The critical alert triggered a flight dump into our dir.
+    dumps = [p for p in glob.glob(str(tmp_path / "flight-*.json"))]
+    assert dumps, "critical alert produced no flight dump"
+    with open(dumps[0]) as f:
+        dump = json.load(f)
+    assert dump["reason"].startswith("alert:stale.train_step")
+    # The dump itself names what was wrong (flight context provider).
+    assert "stale.train_step" in [a["alert"] for a in dump["alerts"]]
+    # Doctor over the persisted trail: names the node, links a trace.
+    rc = main(["doctor", events] + dumps)
+    rep = json.loads(capsys.readouterr().out)
+    assert rc in (0, 1)  # resolved after recovery (0) or still firing (1)
+    stale = [a for a in rep["alerts"] if a["alert"] == "stale.train_step"]
+    assert stale, rep["alerts"]
+    assert stale[0]["node"] == "stall-node"
+    trace_ids = [tr["trace_id"] for tr in stale[0]["traces"]]
+    assert trace_ids, "no correlated trace ids in the doctor report"
+    # The correlated trace is the training run's own span.
+    with open(events) as f:
+        run_spans = [json.loads(line) for line in f if line.strip()]
+    run_trace = [r["trace_id"] for r in run_spans
+                 if r.get("event") == "span" and r.get("span") == "train/run"]
+    assert run_trace and run_trace[0] in trace_ids
