@@ -38,15 +38,21 @@ HISTORY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 def measure() -> dict:
     """One headline measurement: ResNet-18/CIFAR train throughput on the
     local chip(s). Pure measurement — no history side effects (the ladder
-    reuses it)."""
+    reuses it). A fresh goodput ledger brackets the run, so every history
+    row carries its own goodput/badput breakdown (compile vs timed steps)
+    — schema-tolerant consumers (`benchgate.py`, `doctor.py`) read only
+    the fields they know, so old rows stay readable."""
     import jax
 
     from serverless_learn_tpu.config import (
         DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig, TrainConfig)
     from serverless_learn_tpu.data.datasets import SyntheticSource
+    from serverless_learn_tpu.telemetry.goodput import PhaseLedger
     from serverless_learn_tpu.training.train_step import build_trainer
     from serverless_learn_tpu.utils.flops import compiled_step_flops, mfu
 
+    ledger = PhaseLedger(emit=False)  # bench rows, not JSONL traffic
+    ledger.ensure_started()
     n_dev = len(jax.devices())
     cfg = ExperimentConfig(
         model="resnet18_cifar",
@@ -60,16 +66,18 @@ def measure() -> dict:
     src = iter(SyntheticSource(trainer.bundle.make_batch, cfg.data,
                                cfg.train.batch_size, seed=0))
     batch = trainer.shard_batch(next(src))
-    for _ in range(WARMUP):
-        state, metrics = trainer.step(state, batch)
-    # device_get (not block_until_ready): the axon remote platform has been
-    # observed to return from block_until_ready before execution finishes;
-    # fetching the scalar is a reliable sync point.
-    float(jax.device_get(metrics["loss"]))
+    with ledger.phase("compile"):  # warmup = trace+compile badput
+        for _ in range(WARMUP):
+            state, metrics = trainer.step(state, batch)
+        # device_get (not block_until_ready): the axon remote platform has
+        # been observed to return from block_until_ready before execution
+        # finishes; fetching the scalar is a reliable sync point.
+        float(jax.device_get(metrics["loss"]))
     t0 = time.perf_counter()
-    for _ in range(STEPS):
-        state, metrics = trainer.step(state, batch)
-    float(jax.device_get(metrics["loss"]))
+    with ledger.phase("step"):
+        for _ in range(STEPS):
+            state, metrics = trainer.step(state, batch)
+        float(jax.device_get(metrics["loss"]))
     dt = time.perf_counter() - t0
     step_s = dt / STEPS
     sps_chip = cfg.train.batch_size / step_s / n_dev
@@ -87,6 +95,9 @@ def measure() -> dict:
     }
     if utilization is not None:
         record["mfu"] = round(utilization, 4)
+    grep = ledger.report(mfu=utilization)
+    record["goodput"] = grep["goodput"]
+    record["badput_breakdown"] = grep["badput_breakdown"]
     return record
 
 

@@ -18,6 +18,9 @@ Here one typed CLI fronts everything:
     python -m serverless_learn_tpu top          # live cluster telemetry view
     python -m serverless_learn_tpu trace        # cross-node timeline from span logs
     python -m serverless_learn_tpu doctor       # ranked cluster diagnosis
+    python -m serverless_learn_tpu goodput      # goodput/badput accounting report
+    python -m serverless_learn_tpu profile      # trigger a device-trace capture
+    python -m serverless_learn_tpu bench        # perf regression gate (--gate)
     python -m serverless_learn_tpu models       # list registered model families
 
 Every long-running command takes ``--metrics-port N`` to expose a
@@ -139,9 +142,13 @@ def _add_train_flags(p: argparse.ArgumentParser):
     p.add_argument("--checkpoint-name", default="ckpt",
                    help="checkpoint namespace inside the store (an elastic "
                         "worker saves under its --name)")
-    p.add_argument("--profile-dir", help="capture a jax.profiler trace here "
-                        "(train: brackets the run; serve: arms the "
-                        "on-demand /debug/profile?seconds=N endpoint)")
+    p.add_argument("--profile-dir", help="arm the shared profiler service "
+                        "on this role: /debug/profile?seconds=N on the "
+                        "metrics endpoint (see `slt profile`), plus "
+                        "alert-triggered captures with --health (config "
+                        "health.profile_on_critical_s). train without "
+                        "--metrics-port keeps the classic behavior: one "
+                        "capture bracketing the whole run")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="serve /metrics (Prometheus text) + /metrics.json "
                         "from this port (0 = auto; scraped by `top`)")
@@ -183,15 +190,24 @@ def _add_train_flags(p: argparse.ArgumentParser):
 def _start_metrics(args):
     """Start the /metrics exporter when --metrics-port is given; the
     caller owns stop(). Logs the bound address so `top` users can copy it
-    (port 0 auto-assigns)."""
+    (port 0 auto-assigns). --profile-dir arms the SHARED profiler service
+    on every role: /debug/profile on this endpoint, `slt profile`
+    remotely, and (with the health engine) alert-triggered captures."""
+    profile_dir = getattr(args, "profile_dir", None)
+    if profile_dir:
+        from serverless_learn_tpu.telemetry import profiler
+
+        profiler.arm(profile_dir)
     port = getattr(args, "metrics_port", None)
     if port is None:
         return None
     from serverless_learn_tpu.telemetry import MetricsExporter
     from serverless_learn_tpu.utils.metrics import log_json
 
-    exp = MetricsExporter(port=port).start()
-    log_json({"event": "metrics", "addr": exp.addr}, stream=sys.stdout)
+    exp = MetricsExporter(port=port, profile_dir=profile_dir).start()
+    log_json({"event": "metrics", "addr": exp.addr,
+              **({"profile_armed": True} if profile_dir else {})},
+             stream=sys.stdout)
     return exp
 
 
@@ -209,8 +225,23 @@ def _start_health(args, cfg, exporter=None, registry=None):
                           flight_dir=flight_dir).start()
     if exporter is not None:
         exporter.attach_health(engine)
+    # Alert-triggered profiling: with --profile-dir armed and a positive
+    # health.profile_on_critical_s, a critical fire captures a device
+    # trace (rate-limited) — the incident's profile exists before anyone
+    # looks at the alert.
+    from serverless_learn_tpu.telemetry import profiler
+
+    profile_armed = (profiler.armed()
+                     and cfg.health.profile_on_critical_s > 0)
+    if profile_armed:
+        profiler.on_alert(engine,
+                          seconds=cfg.health.profile_on_critical_s,
+                          cooldown_s=cfg.health.profile_cooldown_s)
     log_json({"event": "health", "interval_s": engine.interval_s,
               "slos": [s["name"] for s in engine.slos],
+              **({"profile_on_critical_s":
+                  cfg.health.profile_on_critical_s}
+                 if profile_armed else {}),
               **({"alerts_addr": exporter.addr} if exporter else {})},
              stream=sys.stdout)
     return engine
@@ -258,7 +289,7 @@ def cmd_train(args) -> int:
 
     from serverless_learn_tpu.training.loop import run_training
     from serverless_learn_tpu.utils.metrics import log_json
-    from serverless_learn_tpu.utils.tracing import capture, get_tracer
+    from serverless_learn_tpu.utils.tracing import get_tracer
 
     # Form the multi-host process group BEFORE reading the config: the
     # default mesh spans all *global* devices.
@@ -284,6 +315,21 @@ def cmd_train(args) -> int:
     cfg = _config_from_args(args)
     exporter = _start_metrics(args)
     health = _start_health(args, cfg, exporter=exporter)
+
+    def _bracket_ctx():
+        # --profile-dir semantics on train: with a metrics endpoint the
+        # shared on-demand /debug/profile (+ alert-triggered captures)
+        # is the tool — bracketing a long run in one device trace would
+        # produce an unloadable capture. Without one (the classic local
+        # workflow) bracket the whole run, through the shared profiler
+        # lock so an on-demand request can never nest a start_trace.
+        if args.profile_dir and exporter is None:
+            from serverless_learn_tpu.telemetry.profiler import (
+                capture_session)
+
+            return capture_session(args.profile_dir)
+        return contextlib.nullcontext()
+
     try:
         ckpt = _make_checkpointer(args)
         every = cfg.train.checkpoint_every
@@ -295,8 +341,7 @@ def cmd_train(args) -> int:
                                  "the dp mesh axis)")
             from serverless_learn_tpu.training.local_sgd import run_local_sgd
 
-            with (capture(args.profile_dir) if args.profile_dir
-                  else contextlib.nullcontext()):
+            with _bracket_ctx():
                 state, meter = run_local_sgd(cfg, checkpointer=ckpt,
                                              verbose=args.verbose)
             summary = meter.steady_state()
@@ -312,20 +357,23 @@ def cmd_train(args) -> int:
                 if step % every == 0:
                     ckpt.save(state)
 
-        trace_ctx = (capture(args.profile_dir) if args.profile_dir
-                     else contextlib.nullcontext())
-        with trace_ctx:
+        with _bracket_ctx():
             state, meter = run_training(cfg, step_callback=callback,
                                         verbose=args.verbose)
         if ckpt is not None:
             ckpt.save(state)
             ckpt.wait()
         summary = meter.steady_state()
+        from serverless_learn_tpu.telemetry import goodput as _goodput
+
+        grep = _goodput.get_ledger().report(mfu=summary.get("mfu"))
         log_json({"event": "done",
                   "final_step": int(jax.device_get(state.step)),
                   **({"rank": world.rank, "world": world.num_processes}
                      if world else {}),
                   **{k: round(v, 3) for k, v in summary.items()},
+                  "goodput": grep["goodput"],
+                  "badput_breakdown": grep["badput_breakdown"],
                   "spans": get_tracer().summary()}, stream=sys.stdout)
     finally:
         if health is not None:
@@ -906,6 +954,110 @@ def cmd_doctor(args) -> int:
     return 1 if rep["summary"]["critical_firing"] else 0
 
 
+def cmd_goodput(args) -> int:
+    """Goodput/badput accounting report: per-phase wall-clock breakdown,
+    productive fraction, MFU-weighted goodput. Live (`--endpoints` scrape
+    of /goodput) or offline (`--from-events` / positional JSONL logs,
+    aggregating the phase records every traced run emits). The phases —
+    `unattributed` included — sum to the total run time by construction."""
+    from serverless_learn_tpu.telemetry import goodput
+
+    if args.self_check:
+        rep = goodput.self_check()
+        print(json.dumps(rep, indent=None if args.compact else 2))
+        return 0 if rep["ok"] else 1
+    endpoints = []
+    for chunk in args.endpoints or []:
+        endpoints.extend(e for e in chunk.split(",") if e.strip())
+    logs = list(args.logs or []) + list(args.from_events or [])
+    if not logs and not endpoints:
+        print("goodput needs JSONL event logs (--from-events / positional) "
+              "and/or --endpoints (or --self-check)", file=sys.stderr)
+        return 2
+    out: dict = {}
+    if endpoints:
+        from serverless_learn_tpu.telemetry.exporter import fetch_text
+
+        scraped = {}
+        for addr in endpoints:
+            try:
+                scraped[addr] = json.loads(fetch_text(addr, "/goodput"))
+            except Exception as e:
+                scraped[addr] = {"error": f"{type(e).__name__}: {e}"}
+        out["endpoints"] = scraped
+    if logs:
+        from serverless_learn_tpu.telemetry import timeline
+
+        records = timeline.load_events(logs)
+        out["nodes"] = goodput.aggregate_events(records)
+        if not out["nodes"]:
+            out["warning"] = ("no phase records found — was the run "
+                              "started with --events-log?")
+    print(json.dumps(out, indent=None if args.compact else 2))
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Trigger an on-demand device-trace capture on a live node through
+    its metrics endpoint (/debug/profile — armed by --profile-dir on any
+    role). Prints the capture reply (output directory, seconds)."""
+    from serverless_learn_tpu.telemetry.exporter import fetch_text
+
+    try:
+        rep = json.loads(fetch_text(
+            args.endpoint, f"/debug/profile?seconds={args.seconds:g}",
+            timeout=args.seconds + 30.0))
+    except Exception as e:
+        print(json.dumps({"ok": False,
+                          "error": f"{type(e).__name__}: {e}",
+                          "endpoint": args.endpoint}), file=sys.stderr)
+        return 1
+    print(json.dumps(rep, indent=2))
+    return 0 if rep.get("ok") else 1
+
+
+def cmd_bench(args) -> int:
+    """Headline benchmark + the perf regression gate. `--gate` compares
+    against bench_history.json with the noise-aware threshold
+    (telemetry/benchgate.py) and exits 1 on regression — the CI loop
+    from measurement to enforcement. `--dry-run` skips the measurement
+    and gates the committed history's latest entries (no device needed)."""
+    from serverless_learn_tpu.telemetry import benchgate
+
+    history = args.history or "bench_history.json"
+    entry = None
+    if not args.dry_run:
+        # A real measurement: reuse bench.py's headline measure() (the
+        # repo-root module — run from a checkout) and record through the
+        # shared history guard, then gate the fresh entry against
+        # everything before it.
+        try:
+            import bench as bench_mod
+        except ImportError:
+            print("bench.py not importable (run from the repo root), or "
+                  "use --dry-run to gate the committed history",
+                  file=sys.stderr)
+            return 2
+        from serverless_learn_tpu.utils.benchlog import record
+
+        entry = bench_mod.measure()
+        record(entry, history, better="max", rel_threshold=args.threshold,
+               key_fields=("metric", "device_kind", "batch_per_chip"))
+    # Default scope: the headline series (bench.py's own guard keys).
+    # The ladder's multi-mode rows carry record-time flags and documented
+    # shared-chip variance; gate them deliberately via --metric, or
+    # sweep everything report-style via --all.
+    metric = None if args.all else (args.metric
+                                    or benchgate.HEADLINE_METRIC)
+    rep = benchgate.run_gate(history, entry=entry,
+                             rel_threshold=args.threshold,
+                             metric=metric)
+    print(json.dumps(rep, indent=None if args.compact else 2))
+    if not args.gate:
+        return 0
+    return 0 if rep.get("ok") else 1
+
+
 def cmd_top(args) -> int:
     """Live cluster telemetry: poll /metrics endpoints, render one screen
     (per-worker throughput, inference latency percentiles, membership)."""
@@ -1147,6 +1299,68 @@ def build_parser() -> argparse.ArgumentParser:
                          "healthy fixture stays quiet, a stalled counter "
                          "fires the watchdog; exit 0 on success (CI)")
     dr.set_defaults(fn=cmd_doctor)
+
+    gp = sub.add_parser("goodput",
+                        help="goodput/badput accounting: per-phase "
+                             "wall-clock breakdown from live /goodput "
+                             "scrapes or JSONL event logs")
+    gp.add_argument("logs", nargs="*", metavar="LOG",
+                    help="JSONL event logs / flight dumps / directories "
+                         "containing phase records (offline mode)")
+    gp.add_argument("--from-events", action="append", metavar="LOG",
+                    default=None,
+                    help="same as the positional logs (explicit offline "
+                         "mode)")
+    gp.add_argument("--endpoints", action="append", metavar="HOST:PORT",
+                    default=None,
+                    help="scrape these /goodput endpoints live (comma- or "
+                         "repeat-separated)")
+    gp.add_argument("--compact", action="store_true",
+                    help="single-line JSON (for scripts)")
+    gp.add_argument("--self-check", action="store_true",
+                    help="smoke-test the ledger math on a fabricated "
+                         "timeline: exclusivity exact, phases sum to the "
+                         "total, offline aggregation agrees; exit 0 on "
+                         "success (CI)")
+    gp.set_defaults(fn=cmd_goodput)
+
+    pf = sub.add_parser("profile",
+                        help="capture an on-demand jax.profiler device "
+                             "trace on a live node (needs --profile-dir "
+                             "+ --metrics-port on the target)")
+    pf.add_argument("endpoint", metavar="HOST:PORT",
+                    help="the target's metrics endpoint")
+    pf.add_argument("--seconds", type=float, default=3.0,
+                    help="capture window length")
+    pf.set_defaults(fn=cmd_profile)
+
+    bn = sub.add_parser("bench",
+                        help="headline benchmark + perf regression gate "
+                             "over bench_history.json")
+    bn.add_argument("--gate", action="store_true",
+                    help="exit 1 when a series regresses past the "
+                         "noise-aware threshold (CI gate)")
+    bn.add_argument("--dry-run", action="store_true",
+                    help="skip the measurement; gate the committed "
+                         "history's latest entries (no device needed)")
+    bn.add_argument("--history", metavar="FILE", default=None,
+                    help="bench history file (default: "
+                         "./bench_history.json)")
+    bn.add_argument("--threshold", type=float, default=0.05,
+                    help="relative regression threshold (widened by "
+                         "2x a row's recorded spread_rel)")
+    bn.add_argument("--metric", default=None,
+                    help="gate series whose metric name contains this "
+                         "substring (default: the headline "
+                         "resnet18_cifar series; *_ms series gate with "
+                         "better=min)")
+    bn.add_argument("--all", action="store_true",
+                    help="sweep every series in the history (report "
+                         "mode — the ladder's multi-mode rows carry "
+                         "documented shared-chip variance)")
+    bn.add_argument("--compact", action="store_true",
+                    help="single-line JSON report (for scripts)")
+    bn.set_defaults(fn=cmd_bench)
 
     tp = sub.add_parser("top", help="live cluster telemetry: poll /metrics "
                                     "endpoints, one-screen view")

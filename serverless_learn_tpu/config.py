@@ -256,6 +256,12 @@ class HealthConfig:
     clear_after_ticks: int = 3       # clean ticks before auto-resolve
     anchor_lag_rounds: float = 2.0   # DiLoCo lag gauge alert threshold
     dump_cooldown_s: float = 300.0   # min gap between critical flight dumps
+    # Alert-triggered device profiling (telemetry/profiler.py): when the
+    # process was started with --profile-dir, a CRITICAL alert captures a
+    # jax.profiler window of this many seconds (0 disables), rate-limited
+    # to one capture per profile_cooldown_s.
+    profile_on_critical_s: float = 3.0
+    profile_cooldown_s: float = 600.0
     slos: tuple = ()                 # SLO spec objects (see docstring)
 
 

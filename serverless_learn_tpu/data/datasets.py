@@ -68,15 +68,23 @@ class Prefetcher:
         return self
 
     def __next__(self):
-        while True:
-            try:
-                return self.q.get(timeout=1.0)
-            except queue.Empty:
-                if not self.thread.is_alive():
-                    if self._error is not None:
-                        raise self._error
-                    raise StopIteration
-                continue
+        # Consumer-side wait = the run's data-stall badput: when the
+        # queue has a batch ready this returns in microseconds and the
+        # phase records ~0; when the producer lags, the block lands in
+        # the goodput ledger as "data_wait" instead of vanishing into
+        # unattributed time (telemetry/goodput.py).
+        from serverless_learn_tpu.telemetry import goodput
+
+        with goodput.phase("data_wait"):
+            while True:
+                try:
+                    return self.q.get(timeout=1.0)
+                except queue.Empty:
+                    if not self.thread.is_alive():
+                        if self._error is not None:
+                            raise self._error
+                        raise StopIteration
+                    continue
 
     def depth(self) -> int:
         """Batches currently ready — the worker's flow/backpressure signal

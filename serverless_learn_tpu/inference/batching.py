@@ -49,7 +49,7 @@ import numpy as np
 
 from serverless_learn_tpu.inference.generate import generate
 from serverless_learn_tpu.telemetry import (RATE_BUCKETS, SIZE_BUCKETS,
-                                            Span, get_registry)
+                                            Span, get_registry, goodput)
 
 
 def _bucket(n: int, floor: int = 8) -> int:
@@ -130,6 +130,9 @@ class BatchingEngine:
         self._m_activity = reg.gauge(
             "slt_engine_last_activity_unix_s",
             "wall time of the dispatcher's last group dispatch", **lbl)
+        # Goodput: group shapes seen before — a fresh one pays the XLA
+        # compile, charged to "compile" rather than "decode".
+        self._compiled_groups: set = set()
         self._thread = threading.Thread(target=self._dispatch_loop,
                                         daemon=True)
         self._thread.start()
@@ -179,7 +182,8 @@ class BatchingEngine:
     def _dispatch_loop(self):
         while not self._stop.is_set():
             try:
-                first = self._q.get(timeout=0.1)
+                with goodput.phase("idle"):
+                    first = self._q.get(timeout=0.1)
             except queue.Empty:
                 continue
             group = [first]
@@ -187,19 +191,21 @@ class BatchingEngine:
             deadline = time.perf_counter() + self.batch_wait_s
             # Admission window: wait briefly for co-batchable requests —
             # the latency cost is bounded by batch_wait_ms; the win is the
-            # whole point of a server.
-            while len(group) < self.max_batch:
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0:
-                    break
-                try:
-                    nxt = self._q.get(timeout=remaining)
-                except queue.Empty:
-                    break
-                if nxt.group_key == first.group_key:
-                    group.append(nxt)
-                else:
-                    extras.append(nxt)
+            # whole point of a server. On the ledger it is "admit_wait"
+            # badput (deliberate, bounded — but accounted).
+            with goodput.phase("admit_wait"):
+                while len(group) < self.max_batch:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    try:
+                        nxt = self._q.get(timeout=remaining)
+                    except queue.Empty:
+                        break
+                    if nxt.group_key == first.group_key:
+                        group.append(nxt)
+                    else:
+                        extras.append(nxt)
             for e in extras:  # mismatched keys go back for the next round
                 self._q.put(e)
             try:
@@ -237,12 +243,18 @@ class BatchingEngine:
             prompts[i] = prompts[0]
             lengths[i] = lengths[0]
 
-        tokens = generate(
-            self.module, self.params, jnp.asarray(prompts), new_bucket,
-            temperature=first.temperature, top_k=first.top_k,
-            eos_id=first.eos_id, rng=jax.random.PRNGKey(first.seed),
-            prompt_lengths=jnp.asarray(lengths))
-        new = np.asarray(jax.device_get(tokens))[:, prompt_bucket:]
+        shape_key = (batch_bucket, prompt_bucket, new_bucket,
+                     first.temperature > 0, first.top_k > 0,
+                     first.eos_id is not None)
+        new_shape = shape_key not in self._compiled_groups
+        self._compiled_groups.add(shape_key)
+        with goodput.phase("compile" if new_shape else "decode"):
+            tokens = generate(
+                self.module, self.params, jnp.asarray(prompts), new_bucket,
+                temperature=first.temperature, top_k=first.top_k,
+                eos_id=first.eos_id, rng=jax.random.PRNGKey(first.seed),
+                prompt_lengths=jnp.asarray(lengths))
+            new = np.asarray(jax.device_get(tokens))[:, prompt_bucket:]
         self.batches_run += 1
         self.requests_batched += n
         for i, p in enumerate(group):

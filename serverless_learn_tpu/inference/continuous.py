@@ -68,7 +68,7 @@ from serverless_learn_tpu.inference.batching import _bucket
 from serverless_learn_tpu.inference.generate import init_cache
 from serverless_learn_tpu.telemetry import (RATE_BUCKETS, SIZE_BUCKETS,
                                             Span, TraceContext, get_registry)
-from serverless_learn_tpu.telemetry import flight
+from serverless_learn_tpu.telemetry import flight, goodput
 from serverless_learn_tpu.telemetry.tracing import node_name
 
 
@@ -399,12 +399,17 @@ class ContinuousBatchingEngine:
         for r in self._slots:
             if r is not None:
                 r.peak_batch = max(r.peak_batch, live)
+        # Goodput: a first-seen (nb, pb) bucket pays an XLA compile here
+        # — that wall-clock is "compile" badput, not admission work.
+        new_bucket = (nb, pb) not in self._admit_jits
         fn = self._admit_jit(nb, pb)
-        self._state, tok0 = fn(self.params, self._state,
-                               jnp.asarray(prompts), jnp.asarray(lengths),
-                               jnp.asarray(slot_ids), jnp.asarray(temp),
-                               jnp.asarray(topk), jnp.asarray(eos),
-                               jnp.asarray(seed))
+        with goodput.phase("compile" if new_bucket else "admit"):
+            self._state, tok0 = fn(self.params, self._state,
+                                   jnp.asarray(prompts),
+                                   jnp.asarray(lengths),
+                                   jnp.asarray(slot_ids), jnp.asarray(temp),
+                                   jnp.asarray(topk), jnp.asarray(eos),
+                                   jnp.asarray(seed))
         try:
             tok0.copy_to_host_async()  # overlap the tunnel RTT (see chunk)
         except (AttributeError, RuntimeError):
@@ -480,7 +485,14 @@ class ContinuousBatchingEngine:
             idle = (not futures and not staged
                     and all(r is None for r in self._slots))
             try:
-                staged.append(self._q.get(timeout=0.05 if idle else 0.0))
+                if idle:
+                    # A fully idle engine's blocking wait is "idle" on
+                    # the goodput ledger — the busy/admit/compile split
+                    # below is what the badput breakdown reports.
+                    with goodput.phase("idle"):
+                        staged.append(self._q.get(timeout=0.05))
+                else:
+                    staged.append(self._q.get(timeout=0.0))
                 while True:
                     staged.append(self._q.get_nowait())
             except queue.Empty:
@@ -493,8 +505,10 @@ class ContinuousBatchingEngine:
                         self._m_activity.set(time.time())
                 if any(r is not None and not r.finished
                        for r in self._slots):
-                    self._state, toks = self._chunk_jit(self.params,
-                                                        self._state)
+                    with goodput.phase("compile" if self.chunks_run == 0
+                                       else "decode"):
+                        self._state, toks = self._chunk_jit(self.params,
+                                                            self._state)
                     self.chunks_run += 1
                     self._m_chunks.inc()
                     self._m_activity.set(time.time())
@@ -519,7 +533,10 @@ class ContinuousBatchingEngine:
                 while futures and (len(futures) > self.pipeline_depth
                                    or not any(r is not None
                                               for r in self._slots)):
-                    self._harvest(futures.popleft())
+                    # The harvest's device_get is where dispatched decode
+                    # work actually drains: productive "decode" time.
+                    with goodput.phase("decode"):
+                        self._harvest(futures.popleft())
             except Exception as ex:
                 # Fail every in-flight and staged request; a poisoned
                 # device state must not wedge the dispatcher silently.

@@ -79,6 +79,13 @@ class GenerationServer:
         # 0 = auto-assign (the addr rides in self.metrics_addr).
         self._exporter = None
         self.metrics_addr: Optional[str] = None
+        if profile_dir:
+            # Arm the SHARED profiler service (telemetry/profiler.py):
+            # /debug/profile on the exporter below, `slt profile`, and
+            # alert-triggered captures all go through the same owner.
+            from serverless_learn_tpu.telemetry import profiler
+
+            profiler.arm(profile_dir)
         if metrics_port is not None:
             from serverless_learn_tpu.telemetry import MetricsExporter
 

@@ -23,6 +23,16 @@ real ``/healthz``) on :class:`MetricsExporter`, plus ``slt doctor``
 (``telemetry/doctor.py``), which merges event logs, flight dumps, live
 alert scrapes and ``bench_history.json`` into one ranked diagnosis.
 
+PR 4 adds the accounting layer: the goodput/badput run ledger
+(``telemetry/goodput.py`` — nestable :class:`PhaseLedger` phase timers
+wired through training, elastic, DiLoCo, checkpointing, the data plane
+and both inference engines; served at ``/goodput``, rendered by ``slt
+top``/``slt goodput``), the shared on-device profiler service
+(``telemetry/profiler.py`` — ``/debug/profile`` on every role,
+alert-triggered rate-limited captures stamped with the ledger snapshot),
+and the perf regression gate (``telemetry/benchgate.py``, ``slt bench
+--gate``) over ``bench_history.json``.
+
 See the "Observability" section of ``docs/ARCHITECTURE.md`` for the metric
 naming scheme, endpoint formats, and the tracing data flow.
 """
@@ -31,6 +41,8 @@ import math
 
 from serverless_learn_tpu.telemetry.exporter import (MetricsExporter,
                                                      fetch_text)
+from serverless_learn_tpu.telemetry.goodput import (PhaseLedger, get_ledger,
+                                                    phase)
 from serverless_learn_tpu.telemetry.health import (Alert, HealthEngine,
                                                    score_stragglers)
 from serverless_learn_tpu.telemetry.registry import (LATENCY_BUCKETS,
@@ -48,10 +60,10 @@ from serverless_learn_tpu.telemetry.tracing import (TraceContext,
 __all__ = [
     "LATENCY_BUCKETS", "RATE_BUCKETS", "SIZE_BUCKETS",
     "Alert", "Counter", "Gauge", "HealthEngine", "Histogram",
-    "JsonlEventLog", "MetricsRegistry", "MetricsExporter", "Span",
-    "TraceContext", "current_context", "fetch_text", "get_registry",
-    "init_tracing", "parse_traceparent", "publish_rpc_stats",
-    "score_stragglers",
+    "JsonlEventLog", "MetricsRegistry", "MetricsExporter", "PhaseLedger",
+    "Span", "TraceContext", "current_context", "fetch_text", "get_ledger",
+    "get_registry", "init_tracing", "parse_traceparent", "phase",
+    "publish_rpc_stats", "score_stragglers",
 ]
 
 

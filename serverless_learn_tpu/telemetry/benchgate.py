@@ -1,0 +1,144 @@
+"""`slt bench --gate`: the perf regression gate over bench history.
+
+``utils/benchlog.record`` has flagged regressions at *write* time since
+round 2 — but a flag in a JSON file fails no build. This module closes
+the measurement -> enforcement loop: evaluate the latest entry of every
+comparable series in ``bench_history.json`` against the best earlier
+entry and **exit non-zero on regression**, so CI (and operators) get a
+hard gate instead of a stderr warning nobody reads.
+
+Noise-awareness reuses the benchlog recipe: the effective threshold is
+``max(rel_threshold, 2 x spread_rel)`` per entry (timing rows that
+recorded a repeat spread widen their own gate), and comparability is
+keyed on ``(metric, device_kind, batch_per_chip)`` — a batch sweep or a
+different chip neither flags nor masks a phantom regression.
+
+Schema tolerance is deliberate: history rows have grown fields over the
+rounds (``mfu``, ``spread_rel``, ``retried_after_transient``, and now
+``goodput`` / ``badput_breakdown``); the gate reads only what it needs
+and skips rows without a numeric ``value``, so old and new rows coexist
+in one file forever.
+
+Scope: the default gate covers the **headline series**
+(:data:`HEADLINE_METRIC` — the one ``bench.py`` measures, retries on
+transients, and guards with the right comparability keys). The ladder's
+other rows are multi-mode measurements under documented shared-chip
+variance (README: interleaved-arm ratios, day-to-day r50 swings); their
+record-time flags live in-row, and blindly re-deriving them here would
+make the gate permanently red on honest noise. ``--metric`` gates any
+one of them deliberately (latency-style ``*_ms`` series gate with
+better=min automatically); ``--all`` sweeps everything for a report.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+DEFAULT_KEY_FIELDS = ("metric", "device_kind", "batch_per_chip")
+DEFAULT_REL_THRESHOLD = 0.05
+# bench.py's headline series: the default gate scope.
+HEADLINE_METRIC = "resnet18_cifar_train_samples_per_sec_per_chip"
+
+
+def _better_for(metric) -> str:
+    """Direction of goodness from the metric name: latency/step-time
+    series (``*_ms``) regress UP; throughput series regress down."""
+    return "min" if str(metric or "").endswith("_ms") else "max"
+
+
+def _comparable(history: List[dict], entry: dict,
+                key_fields: Sequence[str]) -> List[dict]:
+    return [h for h in history
+            if isinstance(h, dict)
+            and all(h.get(k) == entry.get(k) for k in key_fields)
+            and isinstance(h.get("value"), (int, float))]
+
+
+def gate_entry(entry: dict, history: List[dict],
+               key_fields: Sequence[str] = DEFAULT_KEY_FIELDS,
+               rel_threshold: float = DEFAULT_REL_THRESHOLD,
+               better: str = "max") -> dict:
+    """One series check: ``entry`` vs the best comparable row in
+    ``history`` (which must NOT contain the entry itself). Returns
+    {"metric", "ok", "value", "best", "gap", ...}; a series with no
+    earlier comparable rows passes vacuously (first run of a new
+    benchmark must not fail CI)."""
+    earlier = _comparable(history, entry, key_fields)
+    gap = max(rel_threshold, 2.0 * float(entry.get("spread_rel") or 0.0))
+    row = {"metric": entry.get("metric"), "value": entry.get("value"),
+           "threshold_rel": round(gap, 4), "n_baseline": len(earlier)}
+    for k in key_fields:
+        if k != "metric" and entry.get(k) is not None:
+            row[k] = entry.get(k)
+    if not earlier or not isinstance(entry.get("value"), (int, float)):
+        row["ok"] = True
+        row["reason"] = "no comparable baseline" if not earlier \
+            else "no numeric value"
+        return row
+    vals = [h["value"] for h in earlier]
+    best = max(vals) if better == "max" else min(vals)
+    worse = (entry["value"] < best * (1 - gap) if better == "max"
+             else entry["value"] > best * (1 + gap))
+    row["best"] = best
+    row["loss_rel"] = round(1 - entry["value"] / best, 4) if better == "max" \
+        else round(entry["value"] / best - 1, 4)
+    row["ok"] = not worse
+    return row
+
+
+def gate_history(history: List[dict],
+                 key_fields: Sequence[str] = DEFAULT_KEY_FIELDS,
+                 rel_threshold: float = DEFAULT_REL_THRESHOLD,
+                 metric: Optional[str] = HEADLINE_METRIC) -> dict:
+    """The ``--dry-run`` mode: gate each matching series' LATEST entry
+    against the best of its earlier entries. ``metric`` is a substring
+    filter (default: the headline series — see the module docstring for
+    why the full ladder is report-only); ``metric=None`` sweeps every
+    series. Returns {"ok", "checks": [...], "series": N}."""
+    latest: dict = {}
+    for i, h in enumerate(history):
+        if not isinstance(h, dict) \
+                or not isinstance(h.get("value"), (int, float)):
+            continue
+        if metric and metric not in str(h.get("metric", "")):
+            continue
+        latest[tuple(h.get(k) for k in key_fields)] = i
+    checks = []
+    for key, i in sorted(latest.items(), key=lambda kv: str(kv[0])):
+        entry = history[i]
+        checks.append(gate_entry(entry, history[:i], key_fields,
+                                 rel_threshold,
+                                 better=_better_for(entry.get("metric"))))
+    return {"ok": all(c["ok"] for c in checks),
+            "series": len(checks),
+            "scope": metric or "all",
+            "regressions": [c for c in checks if not c["ok"]],
+            "checks": checks}
+
+
+def run_gate(history_path: str, entry: Optional[dict] = None,
+             rel_threshold: float = DEFAULT_REL_THRESHOLD,
+             key_fields: Sequence[str] = DEFAULT_KEY_FIELDS,
+             metric: Optional[str] = HEADLINE_METRIC) -> dict:
+    """The CLI body. With ``entry`` (a fresh measurement): gate it
+    against the whole history. Without: dry-run over the committed
+    history (``metric=None`` sweeps all series). Returns a report with
+    "ok"; missing/empty history is ``{"ok": False, "error": ...}`` so a
+    gate pointed at the wrong path fails loudly instead of passing
+    vacuously."""
+    from serverless_learn_tpu.utils.benchlog import load_history
+
+    if not os.path.exists(history_path):
+        return {"ok": False, "error": f"no history at {history_path}"}
+    history = load_history(history_path)
+    if not history:
+        return {"ok": False, "error": f"history {history_path} is empty "
+                                      f"or unreadable"}
+    if entry is not None:
+        check = gate_entry(entry, history, key_fields, rel_threshold,
+                           better=_better_for(entry.get("metric")))
+        return {"ok": check["ok"], "series": 1,
+                "regressions": [] if check["ok"] else [check],
+                "checks": [check]}
+    return gate_history(history, key_fields, rel_threshold, metric=metric)

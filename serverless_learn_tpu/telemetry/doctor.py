@@ -6,7 +6,9 @@ node, and what else was happening?" — by merging four sources into one
 report:
 
 * **JSONL event logs** (``--events-log`` files, daemon ``--events_log``):
-  alert fire/resolve records, span records, DiLoCo round records.
+  alert fire/resolve records, span records, DiLoCo round records, and
+  goodput ``phase`` records (aggregated into a per-node goodput/badput
+  breakdown — a run can be alert-free and still 60% badput).
 * **Flight-recorder dumps** (``flight-*.json``): a dead node's last
   events plus its final metrics snapshot — the dump reason itself is a
   diagnosis input ("sigterm" vs "alert:stale.train_step" vs "lease-expiry").
@@ -287,6 +289,14 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
     round_recs = [r for r in records if r.get("event") == "diloco_round"]
     stragglers = score_stragglers(round_recs) if round_recs else {}
 
+    # Goodput/badput accounting from the same JSONL trail: phase records
+    # (telemetry/goodput.py) aggregate into a per-node breakdown, so the
+    # diagnosis says not just WHAT fired but where the run's wall-clock
+    # went. Only nodes with a meaningful window rank in the verdict.
+    from serverless_learn_tpu.telemetry import goodput as _goodput
+
+    goodput_by_node = _goodput.aggregate_events(records)
+
     bench_path = bench_history
     if bench_path is None and os.path.exists(DEFAULT_BENCH_HISTORY):
         bench_path = DEFAULT_BENCH_HISTORY
@@ -311,6 +321,16 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
     if bench and bench["regressions"]:
         verdict_bits.append(
             f"{len(bench['regressions'])} bench regression(s) vs history")
+    low_goodput = sorted(
+        (node, rep) for node, rep in goodput_by_node.items()
+        if rep["total_s"] >= 5.0 and rep["goodput"] < 0.5)
+    for node, rep in low_goodput[:2]:
+        worst = max(rep["badput_breakdown"].items(),
+                    key=lambda kv: kv[1], default=(None, 0.0))
+        verdict_bits.append(
+            f"low goodput on {node}: {rep['goodput'] * 100:.0f}%"
+            + (f" (worst badput: {worst[0]} "
+               f"{worst[1] * 100:.0f}%)" if worst[0] else ""))
     dead = [s["endpoint"] for s in scrapes if not s["ok"]]
     if dead:
         verdict_bits.append(f"unreachable endpoint(s): {', '.join(dead)}")
@@ -330,6 +350,7 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
                     "verdict": "; ".join(verdict_bits)},
         "alerts": ranked,
         "stragglers": stragglers,
+        "goodput": goodput_by_node,
         "flight_dumps": collected["dumps"],
         "bench": bench,
         "scrapes": [{k: v for k, v in s.items() if k != "payload"}

@@ -6,8 +6,11 @@
                                    critical health alert is firing)
     GET /alerts                    the health engine's firing/resolved
                                    alerts ({"enabled": false} without one)
+    GET /goodput                   the run ledger's goodput/badput report
+                                   (telemetry/goodput.py; MFU-weighted
+                                   when the trainer publishes an MFU gauge)
     GET /debug/profile?seconds=N   capture a jax.profiler device trace
-                                   (enabled by `serve --profile-dir DIR`)
+                                   (armed by --profile-dir on ANY role)
 
 One ThreadingHTTPServer on a daemon thread — zero dependencies, safe to
 embed in a serving process (scrapes read a consistent snapshot under the
@@ -17,11 +20,13 @@ serve/train/worker/diloco commands).
 
 ``/debug/profile`` makes ``--profile-dir`` useful on a LIVE node: instead
 of restarting the server to bracket a run with ``jax.profiler.trace``, an
-operator curls the endpoint and gets an on-demand N-second device trace
-written under the configured directory (TensorBoard/Perfetto loadable).
-One capture at a time (the profiler is process-global); an ``X-SLT-Trace``
-traceparent header on the request records the capture as a span in the
-caller's distributed trace.
+operator curls the endpoint (or runs ``slt profile host:port --seconds N``)
+and gets an on-demand N-second device trace written under the configured
+directory (TensorBoard/Perfetto loadable). The capture itself lives in the
+shared ``telemetry/profiler.py`` service — one profiler owner per process,
+shared with alert-triggered captures; concurrent requests get a 409. An
+``X-SLT-Trace`` traceparent header on the request records the capture as a
+span in the caller's distributed trace.
 """
 
 from __future__ import annotations
@@ -38,7 +43,10 @@ from serverless_learn_tpu.telemetry.registry import (MetricsRegistry,
                                                      get_registry)
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
-MAX_PROFILE_SECONDS = 60.0
+# Kept as the endpoint's documented bound; the value lives with the
+# shared profiler service now.
+from serverless_learn_tpu.telemetry.profiler import (  # noqa: E402
+    MAX_PROFILE_SECONDS)
 
 
 class MetricsExporter:
@@ -49,7 +57,6 @@ class MetricsExporter:
                  profile_dir: Optional[str] = None):
         self.registry = registry or get_registry()
         self.profile_dir = profile_dir
-        self._profile_lock = threading.Lock()
         # Optional cluster-health engine (telemetry/health.py): when
         # attached, /healthz reports real component readiness (503 while
         # a critical alert fires — orchestrator-probeable) and /alerts
@@ -88,6 +95,8 @@ class MetricsExporter:
                         self._reply_json(code, obj)
                     elif path == "/alerts":
                         self._reply_json(200, exporter._alerts())
+                    elif path == "/goodput":
+                        self._reply_json(200, exporter._goodput())
                     elif path == "/debug/profile":
                         code, obj = exporter._profile(
                             parse_qs(url.query),
@@ -133,11 +142,36 @@ class MetricsExporter:
             return {"enabled": True, "firing": [], "resolved": [],
                     "error": f"{type(e).__name__}: {e}"}
 
+    # -- goodput -----------------------------------------------------------
+
+    def _goodput(self) -> dict:
+        """The /goodput body: the process ledger's report, MFU-weighted
+        when the trainer has published ``slt_train_mfu``."""
+        from serverless_learn_tpu.telemetry import goodput
+
+        try:
+            mfu = None
+            fam = self.registry.snapshot().get("slt_train_mfu")
+            if fam:
+                vals = [s.get("value") for s in fam.get("series", [])
+                        if isinstance(s.get("value"), (int, float))]
+                if vals:
+                    mfu = max(vals)
+            return dict(goodput.get_ledger().report(mfu=mfu), enabled=True)
+        except Exception as e:
+            return {"enabled": True,
+                    "error": f"{type(e).__name__}: {e}"}
+
     # -- on-demand device profiling ---------------------------------------
 
     def _profile(self, query: dict, trace_header: Optional[str]):
-        """Handle /debug/profile: returns (http_code, reply_json)."""
-        if not self.profile_dir:
+        """Handle /debug/profile: returns (http_code, reply_json). The
+        capture itself is the shared profiler service's — this exporter's
+        ``profile_dir`` (when set) overrides the process-armed one."""
+        from serverless_learn_tpu.telemetry import profiler
+
+        base = self.profile_dir or profiler.profile_dir()
+        if not base:
             return 404, {"ok": False,
                          "error": "profiling disabled; start this process "
                                   "with --profile-dir DIR to enable"}
@@ -149,30 +183,20 @@ class MetricsExporter:
             return 400, {"ok": False,
                          "error": f"seconds must be in (0, "
                                   f"{MAX_PROFILE_SECONDS:g}]"}
-        if not self._profile_lock.acquire(blocking=False):
-            return 409, {"ok": False,
-                         "error": "a profile capture is already running"}
         try:
             from serverless_learn_tpu.telemetry import tracing as ttrace
 
             parent = ttrace.parse_traceparent(trace_header)
-            out_dir = os.path.join(self.profile_dir,
-                                   f"profile-{int(time.time())}")
+            out_dir = os.path.join(base, f"profile-{int(time.time())}")
             with ttrace.span("debug/profile", parent=parent,
                              emit=parent is not None, dir=out_dir,
                              seconds=seconds):
-                import jax.profiler
-
-                jax.profiler.start_trace(out_dir)
-                try:
-                    time.sleep(seconds)
-                finally:
-                    jax.profiler.stop_trace()
-            return 200, {"ok": True, "dir": out_dir, "seconds": seconds}
+                rep = profiler.capture(seconds, out_dir=out_dir)
+            return 200, rep
+        except profiler.ProfilerBusy as e:
+            return 409, {"ok": False, "error": str(e)}
         except Exception as e:
             return 500, {"ok": False, "error": f"{type(e).__name__}: {e}"}
-        finally:
-            self._profile_lock.release()
 
     def start(self) -> "MetricsExporter":
         self._thread = threading.Thread(target=self._httpd.serve_forever,

@@ -1,0 +1,375 @@
+"""Goodput/badput accounting: the run ledger.
+
+PR 1-3 made the cluster observable (metrics, traces, alerts) but never
+answered the operator's first question: *what fraction of this run was
+productive step time, and where did the rest go?* The reference is the
+cautionary tale — its master spends most of each round on blind 100 MB
+re-pushes and per-round channel churn (SURVEY §2.2), pure badput it had
+no way to even see. This module is the accounting layer:
+
+* :class:`PhaseLedger` — thread-safe, contextvar-scoped, *nestable* phase
+  timers. ``with ledger.phase("step"): ...`` attributes wall-clock to the
+  innermost open phase per context: entering a child pauses the parent
+  (exclusive/self-time semantics), so ``checkpoint`` inside ``remesh``
+  never double-counts, and the per-phase totals partition attributed
+  time exactly.
+* **Phase taxonomy** (shared, so reports compose across roles):
+  training — ``compile`` / ``step`` / ``data_wait`` / ``checkpoint`` /
+  ``remesh`` / ``eval`` / ``diloco_round_wait``; serving — ``decode`` /
+  ``admit`` / ``admit_wait`` / ``idle``. ``step`` and ``decode`` are the
+  *productive* phases; everything else is badput with a name.
+* **Reports** — :meth:`PhaseLedger.report` returns per-phase wall-clock
+  seconds, counts and fractions plus ``goodput`` (productive fraction of
+  total run time) and, when an MFU gauge is live, MFU-weighted goodput
+  (fraction of total wall-clock spent at the measured FLOP rate). Open
+  phases contribute their elapsed-so-far, and the remainder lands under
+  ``unattributed`` — the breakdown always sums to the total.
+* **Emission** — phase exits longer than ``emit_min_s`` emit
+  ``{"event": "phase", ...}`` records through ``tracing.emit_event``
+  when tracing is initialized, so `slt trace` renders phase bands on the
+  Perfetto timeline and `slt doctor` / ``slt goodput --from-events``
+  reconstruct the breakdown offline from the same JSONL trail.
+
+Served live from ``/goodput`` on :class:`MetricsExporter`; rendered by
+`slt top`'s GOODPUT pane and the ``slt goodput`` CLI. `bench.py` stamps
+``goodput`` / ``badput_breakdown`` into its history rows, which
+``telemetry/benchgate.py`` (`slt bench --gate`) reads schema-tolerantly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional
+
+# The canonical taxonomy. Ledgers accept any name (forward compatibility
+# beats a registry), but these are the ones the framework itself emits.
+TRAIN_PHASES = ("compile", "step", "data_wait", "checkpoint", "remesh",
+                "eval", "diloco_round_wait")
+SERVE_PHASES = ("compile", "decode", "admit", "admit_wait", "idle")
+
+# Phases that count as goodput. Everything else — including
+# "unattributed" — is badput with a name.
+PRODUCTIVE_PHASES = frozenset({"step", "decode"})
+
+# Default floor below which a phase exit is not emitted as a JSONL event
+# (the ledger totals still include it). Keeps tight decode loops from
+# writing an event per chunk while steps/remeshes/checkpoints all emit.
+DEFAULT_EMIT_MIN_S = 0.05
+
+_stack_var: contextvars.ContextVar = contextvars.ContextVar(
+    "slt_phase_stack", default=None)
+
+
+class _Frame:
+    """One open phase: name, entry clocks, child coverage (seconds of
+    nested-phase time to subtract from this phase's exclusive total)."""
+
+    __slots__ = ("name", "t0", "t0_unix", "child_s", "ledger")
+
+    def __init__(self, name: str, t0: float, t0_unix: float, ledger):
+        self.name = name
+        self.t0 = t0
+        self.t0_unix = t0_unix
+        self.child_s = 0.0
+        self.ledger = ledger
+
+
+class PhaseLedger:
+    """Exclusive per-phase wall-clock accounting for one run.
+
+    ``clock`` is injectable (tests drive fabricated timelines and assert
+    the math is exact); production uses ``time.monotonic``. One ledger
+    per process is the normal shape (:func:`get_ledger`); subsystems
+    accept an explicit ledger the way they accept a registry.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 emit_min_s: float = DEFAULT_EMIT_MIN_S,
+                 emit: Optional[bool] = None):
+        self._clock = clock
+        self.emit_min_s = emit_min_s
+        # None = emit phase events iff tracing has a JSONL sink (the same
+        # gate client_span uses); True/False force it.
+        self._emit = emit
+        self._lock = threading.Lock()
+        self._totals: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+        self._open: List[_Frame] = []  # live frames, all contexts
+        self._t_start: Optional[float] = None
+
+    # -- recording ---------------------------------------------------------
+
+    def ensure_started(self, now: Optional[float] = None):
+        """Pin the run's t0 (total-time denominator). Idempotent; the
+        first phase entry does this implicitly."""
+        with self._lock:
+            if self._t_start is None:
+                self._t_start = self._clock() if now is None else now
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        """Attribute the block's wall-clock to ``name``, exclusively:
+        nested phases subtract from this one. Contextvar-scoped, so each
+        thread/task keeps its own stack."""
+        t0 = self._clock()
+        frame = _Frame(name, t0, time.time(), self)
+        stack = _stack_var.get()
+        # Guard against a frame captured from a DIFFERENT ledger leaking
+        # through a context copy (a thread spawned mid-phase): only treat
+        # the parent as ours if it belongs to this ledger.
+        parent = stack[-1] if stack and stack[-1].ledger is self else None
+        token = _stack_var.set((stack or ()) + (frame,))
+        with self._lock:
+            if self._t_start is None:
+                self._t_start = t0
+            self._open.append(frame)
+        try:
+            yield
+        finally:
+            _stack_var.reset(token)
+            dt = self._clock() - t0
+            self_s = max(0.0, dt - frame.child_s)
+            with self._lock:
+                try:
+                    self._open.remove(frame)
+                except ValueError:
+                    pass
+                self._totals[name] = self._totals.get(name, 0.0) + self_s
+                self._counts[name] = self._counts.get(name, 0) + 1
+            if parent is not None:
+                parent.child_s += dt
+            self._maybe_emit(name, frame.t0_unix, dt, self_s)
+
+    def add(self, name: str, seconds: float, count: int = 1):
+        """Directly credit ``seconds`` of exclusive time to a phase —
+        for callers that measured a wait themselves and can't hold a
+        scope open (e.g. offline replay)."""
+        if seconds < 0:
+            return
+        with self._lock:
+            if self._t_start is None:
+                self._t_start = self._clock()
+            self._totals[name] = self._totals.get(name, 0.0) + seconds
+            self._counts[name] = self._counts.get(name, 0) + count
+
+    def _maybe_emit(self, name: str, t0_unix: float, dt: float,
+                    self_s: float):
+        if dt < self.emit_min_s:
+            return
+        emit = self._emit
+        if emit is None:
+            from serverless_learn_tpu.telemetry import tracing
+
+            emit = tracing.tracing_enabled()
+        if not emit:
+            return
+        from serverless_learn_tpu.telemetry import tracing
+
+        tracing.emit_event({"event": "phase", "phase": name,
+                            "t0_unix_s": round(t0_unix, 6),
+                            "duration_s": round(dt, 6),
+                            "self_s": round(self_s, 6)})
+
+    def reset(self):
+        with self._lock:
+            self._totals.clear()
+            self._counts.clear()
+            self._t_start = None
+            # Open frames keep running; they re-total on exit.
+
+    # -- reading -----------------------------------------------------------
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        """{"phases": {name: {"seconds", "count"}}, "total_s": ...} with
+        open phases credited their elapsed-so-far (a live scrape during a
+        10-minute step must not report the step as unattributed)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            totals = dict(self._totals)
+            counts = dict(self._counts)
+            t0 = self._t_start
+            open_frames = [(f.name, f.t0, f.child_s) for f in self._open]
+        for name, f_t0, child_s in open_frames:
+            live = max(0.0, (now - f_t0) - child_s)
+            totals[name] = totals.get(name, 0.0) + live
+            counts.setdefault(name, 0)
+        total = max(0.0, now - t0) if t0 is not None else 0.0
+        return {"phases": {n: {"seconds": totals[n],
+                               "count": counts.get(n, 0)}
+                           for n in totals},
+                "total_s": total}
+
+    def report(self, mfu: Optional[float] = None,
+               now: Optional[float] = None) -> dict:
+        """The `/goodput` payload (and ``slt goodput`` print shape)."""
+        snap = self.snapshot(now=now)
+        return build_report(snap["phases"], snap["total_s"], mfu=mfu)
+
+
+def build_report(phases: Dict[str, dict], total_s: float,
+                 mfu: Optional[float] = None) -> dict:
+    """Phase totals + a total-time denominator -> the goodput report.
+    Shared by live ledgers and the offline ``--from-events`` path, so
+    both print the identical shape and obey the same invariant: the
+    per-phase seconds (``unattributed`` included) sum to ``total_s``."""
+    attributed = sum(float(p["seconds"]) for p in phases.values())
+    total = max(float(total_s), attributed)
+    out_phases = {}
+    for name in sorted(phases, key=lambda n: -float(phases[n]["seconds"])):
+        sec = float(phases[name]["seconds"])
+        out_phases[name] = {
+            "seconds": round(sec, 6),
+            "count": int(phases[name].get("count", 0)),
+            "fraction": round(sec / total, 6) if total > 0 else 0.0}
+    unattributed = max(0.0, total - attributed)
+    if total > 0:
+        out_phases["unattributed"] = {
+            "seconds": round(unattributed, 6), "count": 0,
+            "fraction": round(unattributed / total, 6)}
+    productive = sum(float(phases[n]["seconds"])
+                     for n in phases if n in PRODUCTIVE_PHASES)
+    goodput = productive / total if total > 0 else 0.0
+    badput = {n: v["fraction"] for n, v in out_phases.items()
+              if n not in PRODUCTIVE_PHASES and v["seconds"] > 0}
+    rep = {"total_s": round(total, 6),
+           "productive_s": round(productive, 6),
+           "goodput": round(goodput, 6),
+           "badput_breakdown": badput,
+           "phases": out_phases}
+    if mfu is not None and mfu > 0:
+        # Fraction of the whole run's wall-clock spent at the measured
+        # FLOP rate: productive time at `mfu` utilization, badput at 0.
+        rep["mfu"] = round(float(mfu), 6)
+        rep["mfu_weighted_goodput"] = round(goodput * float(mfu), 6)
+    return rep
+
+
+# -- offline aggregation -----------------------------------------------------
+
+
+def aggregate_events(records: List[dict]) -> Dict[str, dict]:
+    """Per-node goodput reports from JSONL ``phase`` records (the
+    ``slt goodput --from-events`` / `slt doctor` path). The total-time
+    denominator per node is the span of its phase records — first entry
+    to last exit — so the breakdown sums to the observed run window."""
+    per_node: Dict[str, dict] = {}
+    for rec in records:
+        if rec.get("event") != "phase":
+            continue
+        t0 = rec.get("t0_unix_s")
+        if not isinstance(t0, (int, float)):
+            continue
+        dur = float(rec.get("duration_s") or 0.0)
+        self_s = rec.get("self_s")
+        self_s = dur if not isinstance(self_s, (int, float)) else float(self_s)
+        node = str(rec.get("node", "?"))
+        name = str(rec.get("phase", "?"))
+        st = per_node.setdefault(node, {"phases": {}, "t_min": float(t0),
+                                        "t_max": float(t0) + dur})
+        st["t_min"] = min(st["t_min"], float(t0))
+        st["t_max"] = max(st["t_max"], float(t0) + dur)
+        ph = st["phases"].setdefault(name, {"seconds": 0.0, "count": 0})
+        ph["seconds"] += max(0.0, self_s)
+        ph["count"] += 1
+    return {node: build_report(st["phases"], st["t_max"] - st["t_min"])
+            for node, st in per_node.items()}
+
+
+# -- process-wide default ----------------------------------------------------
+
+_default_lock = threading.Lock()
+_default_ledger: Optional[PhaseLedger] = None
+
+
+def get_ledger() -> PhaseLedger:
+    """The process-wide ledger every subsystem defaults to (mirrors
+    ``registry.get_registry``)."""
+    global _default_ledger
+    with _default_lock:
+        if _default_ledger is None:
+            _default_ledger = PhaseLedger()
+        return _default_ledger
+
+
+def set_ledger(ledger: Optional[PhaseLedger]) -> Optional[PhaseLedger]:
+    """Swap the process ledger (tests, multi-tenant embedding); returns
+    the previous one so callers can restore it."""
+    global _default_ledger
+    with _default_lock:
+        prev = _default_ledger
+        _default_ledger = ledger
+        return prev
+
+
+def phase(name: str):
+    """``with goodput.phase("step"):`` against the process ledger."""
+    return get_ledger().phase(name)
+
+
+# -- self-check --------------------------------------------------------------
+
+
+def self_check() -> dict:
+    """CI smoke (mirrors ``doctor.self_check``): the exclusivity math is
+    exact on a fabricated timeline, the report sums to the total, and
+    the offline aggregation agrees with the live ledger. Never raises."""
+    report: dict = {"ok": False, "checks": []}
+
+    def check(name: str, ok: bool, detail: str = ""):
+        report["checks"].append({"check": name, "ok": bool(ok),
+                                 **({"detail": detail} if detail else {})})
+        return ok
+
+    try:
+        t = [0.0]
+        led = PhaseLedger(clock=lambda: t[0], emit=False)
+        led.ensure_started()
+        # 10s of steps, one containing a 2s checkpoint; 3s data wait.
+        with led.phase("step"):
+            t[0] += 4.0
+        with led.phase("data_wait"):
+            t[0] += 3.0
+        with led.phase("step"):
+            t[0] += 4.0
+            with led.phase("checkpoint"):
+                t[0] += 2.0
+        t[0] += 1.0  # trailing idle -> unattributed
+        rep = led.report()
+        ph = rep["phases"]
+        check("exclusivity_exact",
+              ph["step"]["seconds"] == 8.0
+              and ph["checkpoint"]["seconds"] == 2.0
+              and ph["data_wait"]["seconds"] == 3.0,
+              f"step={ph['step']['seconds']} "
+              f"ckpt={ph['checkpoint']['seconds']} "
+              f"wait={ph['data_wait']['seconds']}")
+        total = rep["total_s"]
+        summed = sum(p["seconds"] for p in ph.values())
+        check("phases_sum_to_total",
+              total > 0 and abs(summed - total) / total < 0.01,
+              f"sum={summed} total={total}")
+        check("goodput_fraction", abs(rep["goodput"] - 8.0 / 14.0) < 1e-6,
+              f"goodput={rep['goodput']}")  # report rounds to 6 places
+        # Offline agreement: replay the same phases as event records.
+        events = [
+            {"event": "phase", "phase": "step", "t0_unix_s": 0.0,
+             "duration_s": 4.0, "self_s": 4.0, "node": "n"},
+            {"event": "phase", "phase": "data_wait", "t0_unix_s": 4.0,
+             "duration_s": 3.0, "self_s": 3.0, "node": "n"},
+            {"event": "phase", "phase": "checkpoint", "t0_unix_s": 11.0,
+             "duration_s": 2.0, "self_s": 2.0, "node": "n"},
+            {"event": "phase", "phase": "step", "t0_unix_s": 7.0,
+             "duration_s": 6.0, "self_s": 4.0, "node": "n"},
+        ]
+        off = aggregate_events(events)["n"]
+        check("offline_agrees",
+              off["phases"]["step"]["seconds"] == 8.0
+              and off["phases"]["checkpoint"]["seconds"] == 2.0,
+              f"offline step={off['phases']['step']['seconds']}")
+        report["ok"] = all(c["ok"] for c in report["checks"])
+    except Exception as e:
+        check("exception", False, f"{type(e).__name__}: {e}")
+    return report

@@ -501,6 +501,10 @@ class HealthEngine:
         self.ticks = 0
         self._last_dump_t: Optional[float] = None
         self.last_dump_path: Optional[str] = None
+        # Alert hooks: fn(Alert) called on every NEW or escalated fire
+        # (after the event emission). The profiler service registers its
+        # rate-limited capture-on-critical here (telemetry/profiler.py).
+        self._alert_hooks: List[Callable] = []
         # RLock: a critical fire inside a tick (under the lock) triggers
         # a flight dump whose "alerts" context provider re-enters
         # alerts() on the same thread.
@@ -594,6 +598,11 @@ class HealthEngine:
             self._emit_event(a.to_event())
             if a.severity == "critical" and self.dump_on_critical:
                 self._maybe_dump(now, a)
+            for hook in list(self._alert_hooks):
+                try:
+                    hook(a)
+                except Exception:
+                    pass  # forensics hooks must never break a tick
 
     def _calm(self, now: float, name: str, labels: Optional[dict] = None):
         """Condition is clean this tick; resolve after ``clear_after``
@@ -606,6 +615,19 @@ class HealthEngine:
             a.state = "resolved"
             a.resolved_unix_s = now
             self._emit_event(a.to_event())
+
+    def add_alert_hook(self, fn: Callable):
+        """``fn(alert)`` on every new/escalated fire. Hooks run inside
+        the tick (keep them quick or hand off to a thread) and their
+        exceptions are swallowed."""
+        self._alert_hooks.append(fn)
+        return fn
+
+    def remove_alert_hook(self, fn: Callable):
+        try:
+            self._alert_hooks.remove(fn)
+        except ValueError:
+            pass
 
     def _maybe_dump(self, now: float, alert: Alert):
         """Critical alert → flight-recorder dump, rate-limited so a

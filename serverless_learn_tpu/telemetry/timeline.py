@@ -136,13 +136,39 @@ def load_events(paths: List[str]) -> List[dict]:
     return records
 
 
+PHASE_TRACE_PREFIX = "phase-"
+
+
 def normalize(records: List[dict]) -> Tuple[List[TSpan], int]:
     """Span-shaped records -> TSpans; returns (spans, skipped). Records
     without cross-node identity (pre-PR2 spans, lifecycle events) are
-    counted, not fatal."""
+    counted, not fatal.
+
+    Goodput ``phase`` records (``telemetry/goodput.py``) normalize too:
+    each becomes a ``phase/<name>`` span on a synthetic per-node
+    ``phase-<node>`` trace, so the Perfetto export shows goodput/badput
+    bands in one lane per node alongside the causal spans. Phase lanes
+    carry no cross-node identity and are excluded from the slowest-trace
+    ranking (a run-length band is not a slow request)."""
     spans: List[TSpan] = []
     skipped = 0
+    n_phase = 0
     for rec in records:
+        if rec.get("event") == "phase":
+            t0, dur = rec.get("t0_unix_s"), rec.get("duration_s")
+            if not isinstance(t0, (int, float)):
+                skipped += 1
+                continue
+            node = str(rec.get("node", "?"))
+            n_phase += 1
+            spans.append(TSpan(
+                name=f"phase/{rec.get('phase', '?')}",
+                node=node,
+                trace_id=f"{PHASE_TRACE_PREFIX}{node}",
+                span_id=f"phase{n_phase}", parent_id=None,
+                start=float(t0), duration=max(0.0, float(dur or 0.0)),
+                meta={"self_s": rec["self_s"]} if "self_s" in rec else {}))
+            continue
         if rec.get("event") != "span":
             continue
         trace_id, span_id = rec.get("trace_id"), rec.get("span_id")
@@ -316,6 +342,8 @@ def summarize(tl: Timeline, top: int = 5) -> dict:
     traces = tl.traces()
     rows = []
     for trace_id, spans in traces.items():
+        if trace_id.startswith(PHASE_TRACE_PREFIX):
+            continue  # goodput bands; whole-run length is not a slow trace
         start = min(s.start for s in spans)
         end = max(s.end for s in spans)
         rows.append({"trace_id": trace_id,
@@ -325,10 +353,13 @@ def summarize(tl: Timeline, top: int = 5) -> dict:
                      "duration_s": round(end - start, 6),
                      "critical_path": critical_path(spans)[:top]})
     rows.sort(key=lambda r: -r["duration_s"])
+    phase_lanes = sum(1 for t in traces
+                      if t.startswith(PHASE_TRACE_PREFIX))
     return {"spans": len(tl.spans),
             "skipped_records": tl.skipped,
             "nodes": tl.nodes,
-            "traces": len(traces),
+            "traces": len(traces) - phase_lanes,
+            "phase_lanes": phase_lanes,
             "root_node": tl.root_node,
             "clock_offsets_s": {n: round(o, 6)
                                 for n, o in tl.offsets.items()},

@@ -11,8 +11,11 @@ successive scrapes and redraws in place.
 Endpoints running the health engine (``telemetry/health.py``) also feed
 an ALERTS pane from ``/alerts`` — firing alerts render inline under the
 throughput tables (and print in ``--once`` mode, so scripts can grep a
-snapshot for ``critical``). Endpoints without the engine just skip the
-pane; the extra probe is best-effort.
+snapshot for ``critical``). Endpoints with an active goodput ledger
+(``telemetry/goodput.py``) feed a GOODPUT pane from ``/goodput`` —
+productive fraction, MFU-weighted goodput and the top badput phases per
+node. Endpoints without either just skip the pane; the extra probes are
+best-effort.
 """
 
 from __future__ import annotations
@@ -116,6 +119,7 @@ class EndpointState:
         self.t_prev: Optional[float] = None
         self.error: Optional[str] = None
         self.alerts: List[dict] = []  # firing alerts from /alerts
+        self.goodput: Optional[dict] = None  # /goodput report, if served
 
     def poll(self):
         self.prev, self.t_prev = self.data, self.t
@@ -129,12 +133,23 @@ class EndpointState:
         # predating the health engine (or running without one) renders
         # its metrics as before, with no ALERTS rows.
         self.alerts = []
+        self.goodput = None
         if self.data is not None:
             try:
                 import json as _json
 
                 payload = _json.loads(fetch_text(self.addr, "/alerts"))
                 self.alerts = list(payload.get("firing") or [])
+            except Exception:
+                pass
+            # Goodput is the same best-effort deal: endpoints predating
+            # the ledger (or with an empty one) just skip the pane.
+            try:
+                import json as _json
+
+                gp = _json.loads(fetch_text(self.addr, "/goodput"))
+                if gp.get("total_s"):
+                    self.goodput = gp
             except Exception:
                 pass
 
@@ -241,6 +256,28 @@ def render(states: List[EndpointState]) -> str:
                     a.get("value"), (int, float)) else "-",
                 msg if len(msg) <= 60 else msg[:57] + "...",
             ])
+    goodput_rows: List[List[str]] = []
+    for st in states:
+        gp = st.goodput
+        if not gp:
+            continue
+        bad = sorted((gp.get("badput_breakdown") or {}).items(),
+                     key=lambda kv: -kv[1])
+        top_bad = " ".join(f"{n}={f * 100:.1f}%" for n, f in bad[:3]
+                           if f > 0) or "-"
+        mfu_g = gp.get("mfu_weighted_goodput")
+        goodput_rows.append([
+            st.addr,
+            f"{gp.get('goodput', 0.0) * 100:.1f}%",
+            "-" if mfu_g is None else f"{mfu_g * 100:.1f}%",
+            _num(gp.get("total_s"), 1),
+            top_bad,
+        ])
+    if goodput_rows:
+        lines.append("")
+        lines.append("  GOODPUT")
+        lines += _table(["endpoint", "goodput", "mfu-wtd", "total s",
+                         "top badput"], goodput_rows)
     if alert_rows:
         lines.append("")
         lines.append("  ALERTS")

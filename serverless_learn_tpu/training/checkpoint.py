@@ -177,10 +177,17 @@ class Checkpointer:
     def save(self, state: TrainState, step: Optional[int] = None) -> int:
         if self.sharded:
             return self.save_sharded(state, step)
-        step = int(jax.device_get(state.step)) if step is None else int(step)
-        host_state = jax.device_get(state)  # gather before returning
-        blob = serialization.to_bytes(host_state)
-        self.wait()  # at most one upload in flight
+        # The synchronous cost (device gather + serialize + the wait on a
+        # previous upload) is checkpoint badput on the training thread;
+        # the async upload itself overlaps training and is not charged.
+        from serverless_learn_tpu.telemetry import goodput
+
+        with goodput.phase("checkpoint"):
+            step = (int(jax.device_get(state.step)) if step is None
+                    else int(step))
+            host_state = jax.device_get(state)  # gather before returning
+            blob = serialization.to_bytes(host_state)
+            self.wait()  # at most one upload in flight
 
         def upload():
             self.store.put(self._key(step), blob)
@@ -208,6 +215,13 @@ class Checkpointer:
         to ``multihost_utils.sync_global_devices`` when there is more than
         one process, and to a no-op single-process.
         """
+        from serverless_learn_tpu.telemetry import goodput
+
+        with goodput.phase("checkpoint"):
+            return self._save_sharded(state, step, barrier)
+
+    def _save_sharded(self, state: TrainState, step: Optional[int],
+                      barrier: Optional[Callable[[str], None]]) -> int:
         step = int(jax.device_get(state.step)) if step is None else int(step)
         proc, n_procs = jax.process_index(), jax.process_count()
         leaves_meta = []
@@ -368,17 +382,21 @@ class Checkpointer:
         ``shardings``, leaves are placed directly into their mesh layout;
         a sharded checkpoint then only fetches the byte ranges this
         process's shards need (restore-time resharding)."""
-        if step is None:
-            step = self.latest_step()
+        from serverless_learn_tpu.telemetry import goodput
+
+        with goodput.phase("checkpoint"):
             if step is None:
-                raise FileNotFoundError(f"no checkpoint under {self.name!r}")
-        if shardings is not None and self._is_sharded(step):
-            return self._restore_resharded(template, shardings, step)
-        restored = self.restore_host(template, step)
-        if shardings is not None:
-            return jax.tree_util.tree_map(
-                lambda x, s: jax.device_put(x, s), restored, shardings)
-        return jax.tree_util.tree_map(jax.numpy.asarray, restored)
+                step = self.latest_step()
+                if step is None:
+                    raise FileNotFoundError(
+                        f"no checkpoint under {self.name!r}")
+            if shardings is not None and self._is_sharded(step):
+                return self._restore_resharded(template, shardings, step)
+            restored = self.restore_host(template, step)
+            if shardings is not None:
+                return jax.tree_util.tree_map(
+                    lambda x, s: jax.device_put(x, s), restored, shardings)
+            return jax.tree_util.tree_map(jax.numpy.asarray, restored)
 
     def _restore_resharded(self, template, shardings, step: int):
         reader = _ShardedReader(self.store, self._key(step))

@@ -247,15 +247,26 @@ class DilocoIsland:
         state = self._adopt(state, anchor)
 
         src = self.source_factory(self.agent.worker_id)
+        from serverless_learn_tpu.telemetry import goodput
+
+        ledger = goodput.get_ledger()
+        ledger.ensure_started()
+        first_inner_step = True
         while self.report.rounds_done < num_rounds:
             if self._aborted():
                 return self.report
             # ---- inner phase: ZERO bytes on the store -------------------
             for _ in range(self.inner_steps):
                 batch = tr.shard_batch(next(src))
-                state, metrics = tr.step(state, batch)
+                with ledger.phase("compile" if first_inner_step
+                                  else "step"):
+                    state, metrics = tr.step(state, batch)
+                first_inner_step = False
                 self.report.steps_done += 1
-            loss = float(jax.device_get(metrics["loss"]))
+            with ledger.phase("step"):
+                # The inner steps dispatch asynchronously; the device
+                # work drains at this fetch — productive time.
+                loss = float(jax.device_get(metrics["loss"]))
             self.report.losses.append(loss)
             self.agent.report(step=self.report.steps_done, metric=loss)
             if self._aborted():  # crash BEFORE posting: verdict churn case
@@ -266,7 +277,8 @@ class DilocoIsland:
             # exactly where a slow round went — serialization, the store
             # RPCs, or waiting out a straggler/leader.
             with ttrace.span("diloco/round", round=rnd,
-                             worker_id=self.agent.worker_id) as rspan:
+                             worker_id=self.agent.worker_id) as rspan, \
+                    ledger.phase("diloco_round_wait"):
                 delta = jax.tree_util.tree_map(
                     lambda a, p: a - p, anchor, _to_f32_host(state.params))
                 self.store.put(

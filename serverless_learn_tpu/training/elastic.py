@@ -39,7 +39,7 @@ from serverless_learn_tpu.config import (ExperimentConfig,
 from serverless_learn_tpu.control.client import WorkerAgent
 from serverless_learn_tpu.data.datasets import Prefetcher
 from serverless_learn_tpu.parallel.mesh import make_mesh
-from serverless_learn_tpu.telemetry import flight, get_registry
+from serverless_learn_tpu.telemetry import flight, get_registry, goodput
 from serverless_learn_tpu.telemetry import tracing as ttrace
 from serverless_learn_tpu.training.checkpoint import Checkpointer
 from serverless_learn_tpu.training.loop import make_source
@@ -204,6 +204,11 @@ class ElasticTrainer:
                 # Each mesh formation is a span: `slt trace` shows how long
                 # drain -> save -> remesh -> restore took per epoch, and
                 # the flight ring keeps the transition in a crash dump.
+                # The same window is "remesh" badput on the goodput ledger
+                # (the nested checkpoint restore subtracts into its own
+                # "checkpoint" phase — exclusive attribution).
+                remesh_phase = goodput.get_ledger().phase("remesh")
+                remesh_phase.__enter__()
                 remesh_cm = ttrace.span("elastic/remesh", epoch=epoch)
                 remesh_span = remesh_cm.__enter__()
                 # Largest prefix of the world's devices the policy can host:
@@ -264,6 +269,7 @@ class ElasticTrainer:
                 m_members.set(size)
                 remesh_span.meta.update(n_devices=len(devices), step=step)
                 remesh_cm.__exit__(None, None, None)
+                remesh_phase.__exit__(None, None, None)
                 m_remesh_t.observe(remesh_span.duration_s)
                 flight.record({"event": "mesh_formed", "epoch": epoch,
                                "n_devices": len(devices), "step": step,
@@ -282,6 +288,9 @@ class ElasticTrainer:
                 # shard_batch's placement is mesh-specific.
                 prefetch = Prefetcher(source_iter, trainer.shard_batch,
                                       depth=cfg.data.prefetch)
+                # First step on a fresh mesh pays the XLA retrace/compile;
+                # charge it to "compile", not "step", like the plain loop.
+                first_step_on_mesh = True
                 try:
                     while (step < num_steps and not self._remesh.is_set()
                            and not self._stop.is_set()):
@@ -294,8 +303,12 @@ class ElasticTrainer:
                             raise RuntimeError(
                                 f"worker fenced out: {self._agent.fatal}")
                         batch = next(prefetch)
-                        state, metrics = trainer.step(state, batch)
-                        loss = float(jax.device_get(metrics["loss"]))
+                        with goodput.get_ledger().phase(
+                                "compile" if first_step_on_mesh
+                                else "step"):
+                            state, metrics = trainer.step(state, batch)
+                            loss = float(jax.device_get(metrics["loss"]))
+                        first_step_on_mesh = False
                         losses.append(loss)
                         step += 1
                         m_steps.inc()

@@ -16,7 +16,7 @@ import numpy as np
 
 from serverless_learn_tpu.config import ExperimentConfig
 from serverless_learn_tpu.data.datasets import Prefetcher, SyntheticSource
-from serverless_learn_tpu.telemetry import flight, get_registry
+from serverless_learn_tpu.telemetry import flight, get_registry, goodput
 from serverless_learn_tpu.telemetry import tracing as ttrace
 from serverless_learn_tpu.training.train_step import Trainer, build_trainer
 from serverless_learn_tpu.utils.metrics import ThroughputMeter, log_json
@@ -201,6 +201,12 @@ def run_training(
     reg.gauge("slt_train_batch_size").set(config.train.batch_size)
     reg.gauge("slt_train_n_chips").set(trainer.mesh.size)
     last_batch = None
+    # Goodput accounting: the run ledger's t0 pins the total-time
+    # denominator; every wait below lands in a named phase ("step" is the
+    # only productive one — compile, data_wait, eval, checkpoint are
+    # badput with a name, scraped at /goodput and by `slt goodput`).
+    ledger = goodput.get_ledger()
+    ledger.ensure_started()
     # One run-level trace span brackets the whole loop (children: every
     # RPC a shard-streaming source issues inherits it via the ambient
     # context) and per-step records feed the flight ring, so a dying
@@ -211,8 +217,13 @@ def run_training(
     try:
         for i, batch in zip(range(start_step, config.train.num_steps), prefetch):
             last_batch = batch
+            # The first step pays the XLA trace+compile; attributing it
+            # to "step" would poison both the goodput number and the
+            # step-time anomaly baseline's warmup.
+            phase_name = "compile" if i == start_step else "step"
             with step_annotation(i + 1), tracer.span("train/step",
-                                                     annotate_device=False):
+                                                     annotate_device=False), \
+                    ledger.phase(phase_name):
                 state, metrics = trainer.step(state, batch)
                 # Block on the metrics (small) so step timing is honest;
                 # params stay on device.
@@ -240,7 +251,8 @@ def run_training(
                 # the run (a reused source would advance between passes).
                 # Cost: one connect per eval pass, amortized over
                 # eval_every training steps.
-                eval_metrics = run_eval(config, trainer, state)
+                with ledger.phase("eval"):
+                    eval_metrics = run_eval(config, trainer, state)
                 if verbose:
                     log_json({"step": i + 1,
                               **{k: round(v, 5)

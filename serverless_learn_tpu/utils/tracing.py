@@ -27,10 +27,40 @@ that registry.
 from __future__ import annotations
 
 import contextlib
+import os
+import sys
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
+
+# Per-span narration — the descendant of the reference's unconditional
+# ``std::cout`` line on every RPC (its in-source perf TODO,
+# ``src/master.cc:257``). Narrating a tight loop costs real wall-clock:
+# a flushed stdout write is tens of microseconds, which SKEWS the
+# goodput ledger's phase timings and the step-time anomaly baseline for
+# sub-millisecond spans. It is therefore OFF by default and gated twice:
+# the ``SLT_TRACE_NARRATE`` env var (or ``Tracer(narrate=True)``) must
+# opt in, and output goes to stderr, unbuffered by line — never stdout,
+# which the CLI reserves for machine-readable JSON.
+NARRATE_ENV = "SLT_TRACE_NARRATE"
+
+
+def _narrate_enabled() -> bool:
+    return os.environ.get(NARRATE_ENV, "").strip().lower() \
+        not in ("", "0", "false", "no")
+
+
+def narrate(message: str, force: bool = False):
+    """Verbosity-gated debug narration; a no-op unless SLT_TRACE_NARRATE
+    is set (or ``force``). Never raises (a closed stderr must not kill a
+    span)."""
+    if not (force or _narrate_enabled()):
+        return
+    try:
+        sys.stderr.write(message + "\n")
+    except (IOError, OSError, ValueError):
+        pass
 
 # framing.h MsgType tag -> human name, for daemon-scraped reports.
 # Mirrors native/rpc_stats.h: kMaxMsgType (32) is the overflow slot where
@@ -63,10 +93,15 @@ class SpanStat:
 
 @dataclass
 class Tracer:
-    """Accumulates named host-side span timings; thread-safe."""
+    """Accumulates named host-side span timings; thread-safe.
+
+    ``narrate=True`` (or the SLT_TRACE_NARRATE env var) prints one
+    stderr line per finished span — debugging only; silent by default so
+    per-RPC spans in tight loops cost aggregation, not I/O flushes."""
 
     stats: Dict[str, SpanStat] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock)
+    narrate: bool = False
 
     @contextlib.contextmanager
     def span(self, name: str, annotate_device: bool = True):
@@ -78,6 +113,8 @@ class Tracer:
         dt = time.perf_counter() - t0
         with self._lock:
             self.stats.setdefault(name, SpanStat()).add(dt)
+        if self.narrate or _narrate_enabled():
+            narrate(f"[span] {name} {dt * 1e3:.3f} ms", force=self.narrate)
 
     def record(self, name: str, dt: float):
         with self._lock:

@@ -176,6 +176,8 @@ SLOW_TESTS = {
     "tests/test_qlora.py::test_int8_frozen_base_trains_lora",
     "tests/test_qlora.py::test_qlora_lora_grads_track_bf16_base_grads",
     "tests/test_quantize.py::test_quant_moe_experts",
+    # round 9 (goodput acceptance: a real train run through the ledger)
+    "tests/test_goodput.py::test_train_run_records_goodput",
     # round 6 (telemetry integration; registry/endpoint/top units stay fast)
     "tests/test_telemetry.py::test_server_metrics_endpoint_scrape",
     "tests/test_telemetry.py::test_continuous_cancellation_retires_slot",

@@ -1,0 +1,404 @@
+"""Goodput/badput accounting, shared profiler, and `slt bench --gate`
+(`telemetry/goodput.py`, `telemetry/profiler.py`, `telemetry/benchgate.py`).
+
+Fast tier: PhaseLedger nesting/exclusivity math on fabricated timelines
+(injected clock — the arithmetic is asserted exact), /goodput endpoint
+round-trip, phase records merging into `slt trace` output, the bench
+gate passing flat history and failing an injected 20% regression,
+alert-triggered capture rate-limiting, `slt goodput --self-check`, and
+the tracing narration gate (silent by default).
+
+Slow tier: a tiny real train run asserts goodput in (0, 1] with compile
+badput recorded on the first step and the breakdown summing to the run's
+wall-clock within 1%.
+"""
+
+import json
+import threading
+
+import pytest
+
+from serverless_learn_tpu.telemetry import benchgate, goodput, profiler
+from serverless_learn_tpu.telemetry.exporter import MetricsExporter, fetch_text
+from serverless_learn_tpu.telemetry.goodput import (PhaseLedger,
+                                                    aggregate_events,
+                                                    build_report)
+from serverless_learn_tpu.telemetry.registry import MetricsRegistry
+
+
+# -- ledger math (fast) ------------------------------------------------------
+
+def _fake_clock():
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    return t, clock
+
+
+def test_ledger_nesting_exclusivity_exact():
+    """Entering a child pauses the parent: exclusive attribution is
+    exact on a fabricated timeline."""
+    t, clock = _fake_clock()
+    led = PhaseLedger(clock=clock, emit=False)
+    led.ensure_started()
+    with led.phase("step"):
+        t[0] += 4.0
+        with led.phase("checkpoint"):
+            t[0] += 2.0
+            with led.phase("data_wait"):  # double nesting
+                t[0] += 1.0
+        t[0] += 3.0
+    snap = led.snapshot()
+    ph = snap["phases"]
+    assert ph["step"]["seconds"] == 7.0       # 10 total - 3 child
+    assert ph["checkpoint"]["seconds"] == 2.0  # 3 total - 1 child
+    assert ph["data_wait"]["seconds"] == 1.0
+    assert ph["step"]["count"] == 1
+    assert snap["total_s"] == 10.0
+    # Sibling phases and direct credit.
+    with led.phase("idle"):
+        t[0] += 5.0
+    led.add("remesh", 0.5)
+    snap = led.snapshot()
+    assert snap["phases"]["idle"]["seconds"] == 5.0
+    assert snap["phases"]["remesh"]["seconds"] == 0.5
+
+
+def test_ledger_open_phase_counts_in_snapshot():
+    """A live scrape mid-phase credits the open phase its elapsed time —
+    a 10-minute step must not read as unattributed."""
+    t, clock = _fake_clock()
+    led = PhaseLedger(clock=clock, emit=False)
+    cm = led.phase("step")
+    cm.__enter__()
+    t[0] += 6.0
+    snap = led.snapshot()
+    assert snap["phases"]["step"]["seconds"] == 6.0
+    assert snap["total_s"] == 6.0
+    t[0] += 1.0
+    cm.__exit__(None, None, None)
+    assert led.snapshot()["phases"]["step"]["seconds"] == 7.0
+
+
+def test_report_sums_to_total_and_weights_mfu():
+    rep = build_report(
+        {"step": {"seconds": 6.0, "count": 3},
+         "compile": {"seconds": 2.0, "count": 1},
+         "data_wait": {"seconds": 1.0, "count": 4}},
+        total_s=10.0, mfu=0.5)
+    assert rep["goodput"] == pytest.approx(0.6)
+    assert rep["mfu_weighted_goodput"] == pytest.approx(0.3)
+    summed = sum(p["seconds"] for p in rep["phases"].values())
+    assert summed == pytest.approx(rep["total_s"])  # incl. unattributed
+    assert rep["phases"]["unattributed"]["seconds"] == pytest.approx(1.0)
+    assert "compile" in rep["badput_breakdown"]
+    assert "step" not in rep["badput_breakdown"]
+
+
+def test_ledger_threads_keep_separate_stacks():
+    """Contextvar scoping: a phase opened in one thread is never the
+    parent of a phase in another; both threads' totals accumulate."""
+    led = PhaseLedger(emit=False)
+    errs = []
+
+    def worker(name):
+        try:
+            with led.phase(name):
+                pass
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    with led.phase("step"):
+        ts = [threading.Thread(target=worker, args=("idle",))
+              for _ in range(4)]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join()
+    assert not errs
+    snap = led.snapshot()
+    assert snap["phases"]["idle"]["count"] == 4
+    assert snap["phases"]["step"]["count"] == 1
+
+
+# -- /goodput endpoint (fast) ------------------------------------------------
+
+def test_goodput_endpoint_roundtrip():
+    """A live /goodput scrape returns the ledger report, MFU-weighted
+    when the registry publishes slt_train_mfu."""
+    t, clock = _fake_clock()
+    led = PhaseLedger(clock=clock, emit=False)
+    led.ensure_started()
+    with led.phase("step"):
+        t[0] += 8.0
+    with led.phase("data_wait"):
+        t[0] += 2.0
+    reg = MetricsRegistry()
+    reg.gauge("slt_train_mfu").set(0.5)
+    prev = goodput.set_ledger(led)
+    exp = MetricsExporter(reg).start()
+    try:
+        rep = json.loads(fetch_text(exp.addr, "/goodput"))
+    finally:
+        exp.stop()
+        goodput.set_ledger(prev)
+    assert rep["enabled"] is True
+    assert rep["goodput"] == pytest.approx(0.8)
+    assert rep["mfu_weighted_goodput"] == pytest.approx(0.4)
+    summed = sum(p["seconds"] for p in rep["phases"].values())
+    assert abs(summed - rep["total_s"]) <= 0.01 * rep["total_s"]
+
+
+# -- phase records -> slt trace (fast) ---------------------------------------
+
+def test_phase_events_merge_into_trace_output(tmp_path):
+    from serverless_learn_tpu.telemetry import timeline
+
+    log = tmp_path / "node-a.jsonl"
+    recs = [
+        {"event": "phase", "phase": "compile", "node": "a",
+         "t0_unix_s": 100.0, "duration_s": 3.0, "self_s": 3.0},
+        {"event": "phase", "phase": "step", "node": "a",
+         "t0_unix_s": 103.0, "duration_s": 7.0, "self_s": 7.0},
+        {"event": "span", "span": "train/run", "node": "a",
+         "trace_id": "a" * 32, "span_id": "b" * 16,
+         "t0_unix_s": 100.0, "duration_s": 10.0, "marks_s": {"done": 10.0}},
+    ]
+    with open(log, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    tl = timeline.reconstruct([str(log)])
+    names = sorted(s.name for s in tl.spans)
+    assert "phase/compile" in names and "phase/step" in names
+    events = timeline.to_trace_events(tl)["traceEvents"]
+    bands = [e for e in events if e.get("name", "").startswith("phase/")]
+    assert len(bands) == 2
+    # The synthetic phase lane never ranks as a "slowest trace".
+    summary = timeline.summarize(tl)
+    assert summary["phase_lanes"] == 1
+    assert summary["traces"] == 1
+    assert all(not r["trace_id"].startswith("phase-")
+               for r in summary["slowest_traces"])
+
+
+def test_aggregate_events_per_node_breakdown():
+    recs = [
+        {"event": "phase", "phase": "step", "node": "a",
+         "t0_unix_s": 0.0, "duration_s": 8.0, "self_s": 8.0},
+        {"event": "phase", "phase": "checkpoint", "node": "a",
+         "t0_unix_s": 8.0, "duration_s": 2.0, "self_s": 2.0},
+        {"event": "phase", "phase": "decode", "node": "b",
+         "t0_unix_s": 50.0, "duration_s": 5.0, "self_s": 5.0},
+        {"event": "other", "node": "a"},
+    ]
+    by_node = aggregate_events(recs)
+    assert by_node["a"]["goodput"] == pytest.approx(0.8)
+    assert by_node["a"]["total_s"] == pytest.approx(10.0)
+    assert by_node["b"]["goodput"] == pytest.approx(1.0)
+
+
+# -- CLI: goodput (fast) -----------------------------------------------------
+
+def test_goodput_cli_from_events(tmp_path, capsys):
+    from serverless_learn_tpu.cli import main
+
+    log = tmp_path / "run.jsonl"
+    with open(log, "w") as f:
+        for rec in (
+            {"event": "phase", "phase": "compile", "node": "n",
+             "t0_unix_s": 0.0, "duration_s": 2.0, "self_s": 2.0},
+            {"event": "phase", "phase": "step", "node": "n",
+             "t0_unix_s": 2.0, "duration_s": 8.0, "self_s": 8.0},
+        ):
+            f.write(json.dumps(rec) + "\n")
+    assert main(["goodput", "--from-events", str(log)]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    node = rep["nodes"]["n"]
+    assert node["goodput"] == pytest.approx(0.8)
+    # Acceptance: the printed per-phase breakdown sums to the total run
+    # time within 1%.
+    summed = sum(p["seconds"] for p in node["phases"].values())
+    assert abs(summed - node["total_s"]) <= 0.01 * node["total_s"]
+
+
+def test_goodput_cli_self_check(capsys):
+    from serverless_learn_tpu.cli import main
+
+    assert main(["goodput", "--self-check", "--compact"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ok"] is True
+
+
+def test_goodput_cli_needs_input(capsys):
+    from serverless_learn_tpu.cli import main
+
+    assert main(["goodput"]) == 2
+
+
+# -- bench gate (fast) -------------------------------------------------------
+
+def _hist_row(value, **extra):
+    return {"metric": "resnet18_cifar_train_samples_per_sec_per_chip",
+            "value": value, "unit": "samples/sec/chip",
+            "device_kind": "TPU v5 lite", "batch_per_chip": 4096, **extra}
+
+
+def test_bench_gate_passes_flat_history(tmp_path, capsys):
+    from serverless_learn_tpu.cli import main
+
+    hist = tmp_path / "hist.json"
+    hist.write_text(json.dumps(
+        [_hist_row(100.0), _hist_row(101.0),
+         _hist_row(100.0, goodput=0.97,
+                   badput_breakdown={"compile": 0.03}),
+         {"metric": "corrupt", "value": "n/a"}]))
+    assert main(["bench", "--gate", "--dry-run",
+                 "--history", str(hist)]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ok"] is True and rep["series"] >= 1
+
+
+def test_bench_gate_fails_injected_regression(tmp_path, capsys):
+    from serverless_learn_tpu.cli import main
+
+    hist = tmp_path / "hist.json"
+    hist.write_text(json.dumps(
+        [_hist_row(100.0), _hist_row(101.0), _hist_row(80.0)]))  # -20%
+    assert main(["bench", "--gate", "--dry-run",
+                 "--history", str(hist)]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ok"] is False
+    assert rep["regressions"][0]["loss_rel"] == pytest.approx(0.208, abs=1e-3)
+    # Without --gate the same report is informational: exit 0.
+    assert main(["bench", "--dry-run", "--history", str(hist)]) == 0
+
+
+def test_bench_gate_noise_widening_and_missing_history(tmp_path, capsys):
+    from serverless_learn_tpu.cli import main
+
+    # A 10% drop with a recorded 6% spread widens the gate to 12%: pass.
+    hist = tmp_path / "hist.json"
+    hist.write_text(json.dumps(
+        [_hist_row(100.0), _hist_row(90.0, spread_rel=0.06)]))
+    assert main(["bench", "--gate", "--dry-run",
+                 "--history", str(hist)]) == 0
+    capsys.readouterr()
+    # A gate pointed at a missing file fails loudly, not vacuously.
+    assert main(["bench", "--gate", "--dry-run",
+                 "--history", str(tmp_path / "nope.json")]) == 1
+
+
+def test_gate_entry_first_run_passes_vacuously():
+    check = benchgate.gate_entry(_hist_row(50.0), [])
+    assert check["ok"] is True and check["n_baseline"] == 0
+
+
+# -- committed history stays gate-clean (fast) -------------------------------
+
+def test_committed_bench_history_passes_gate():
+    """CI acceptance: the repo's own bench_history.json must pass the
+    dry-run gate (regressed entries were retried/explained at record
+    time; the latest comparable entries are within threshold)."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench_history.json")
+    rep = benchgate.run_gate(path)
+    assert rep["ok"] is True, rep["regressions"]
+
+
+# -- alert-triggered capture (fast) ------------------------------------------
+
+def test_alert_triggered_capture_is_rate_limited():
+    from serverless_learn_tpu.telemetry.health import HealthEngine
+
+    captured = []
+
+    def fake_capture(seconds, reason=""):
+        captured.append((seconds, reason))
+        return {"ok": True}
+
+    eng = HealthEngine(registry=MetricsRegistry(), emit=lambda r: None,
+                       dump_on_critical=False)
+    profiler.on_alert(eng, seconds=1.5, cooldown_s=3600.0,
+                      capture_fn=fake_capture, in_thread=False)
+    # A warning never captures; the first critical does; the second
+    # critical inside the cooldown is suppressed.
+    eng._fire(1.0, "w", "warning", "structural", "m", 1.0, 0.0)
+    assert captured == []
+    eng._fire(2.0, "stale.train_step", "critical", "structural",
+              "m", 1.0, 0.0)
+    eng._fire(3.0, "stale.decode_chunk", "critical", "structural",
+              "m", 1.0, 0.0)
+    assert len(captured) == 1
+    assert captured[0] == (1.5, "alert:stale.train_step")
+
+
+def test_profiler_capture_stamps_meta_and_rejects_nested(tmp_path):
+    out = tmp_path / "cap"
+    rep = profiler.capture(0.05, out_dir=str(out))
+    assert rep["ok"] is True
+    meta = json.loads((out / "capture-meta.json").read_text())
+    assert meta["reason"] == "on-demand"
+    assert "ledger_at_trigger" in meta
+    with pytest.raises(RuntimeError):
+        profiler.capture(0.05)  # nothing armed, no out_dir
+    with profiler.capture_session(str(tmp_path / "sess")):
+        with pytest.raises(profiler.ProfilerBusy):
+            profiler.capture(0.05, out_dir=str(tmp_path / "x"))
+
+
+# -- narration gate (fast) ---------------------------------------------------
+
+def test_tracer_narration_silent_by_default(capsys, monkeypatch):
+    from serverless_learn_tpu.utils.tracing import NARRATE_ENV, Tracer
+
+    monkeypatch.delenv(NARRATE_ENV, raising=False)
+    tr = Tracer()
+    with tr.span("rpc/fetch", annotate_device=False):
+        pass
+    out = capsys.readouterr()
+    assert out.out == "" and out.err == ""  # no per-RPC narration
+    tr2 = Tracer(narrate=True)
+    with tr2.span("rpc/fetch", annotate_device=False):
+        pass
+    out = capsys.readouterr()
+    assert out.out == ""            # stdout stays machine-readable
+    assert "rpc/fetch" in out.err   # opt-in narration goes to stderr
+    monkeypatch.setenv(NARRATE_ENV, "1")
+    with tr.span("rpc/env", annotate_device=False):
+        pass
+    assert "rpc/env" in capsys.readouterr().err
+
+
+# -- the real thing (slow) ---------------------------------------------------
+
+def test_train_run_records_goodput():
+    """Acceptance: a tiny real training run books compile badput on the
+    first step, lands goodput in (0, 1], and its breakdown sums to the
+    run's wall-clock within 1%."""
+    from serverless_learn_tpu.config import (DataConfig, ExperimentConfig,
+                                             MeshConfig, TrainConfig)
+    from serverless_learn_tpu.training.loop import run_training
+
+    led = PhaseLedger(emit=False)
+    prev = goodput.set_ledger(led)
+    try:
+        cfg = ExperimentConfig(
+            model="mlp_mnist", mesh=MeshConfig(dp=8),
+            train=TrainConfig(batch_size=16, num_steps=4),
+            data=DataConfig())
+        run_training(cfg)
+        rep = led.report()
+    finally:
+        goodput.set_ledger(prev)
+    assert 0.0 < rep["goodput"] <= 1.0
+    ph = rep["phases"]
+    assert ph["compile"]["count"] == 1          # first step only
+    assert ph["compile"]["seconds"] > 0.0
+    assert ph["step"]["count"] == 3
+    assert "data_wait" in ph                    # Prefetcher consumer wait
+    summed = sum(p["seconds"] for p in ph.values())
+    assert abs(summed - rep["total_s"]) <= 0.01 * rep["total_s"]
