@@ -1,18 +1,26 @@
 # Developer entry points. The Python package needs no build; `native/` holds
 # the C++ control/data-plane daemons.
 
-.PHONY: test test-all lint native tsan bench lm-bench data-bench gen-bench dryrun clean
+.PHONY: test test-all lint check lockcheck native tsan bench lm-bench data-bench gen-bench dryrun clean
 
 test:  ## fast tier (<2 min on CPU); compile-heavy tests are marked slow
 	python -m pytest tests/ -q -m "not slow"
 
-lint:  ## ruff (when installed) + bytecode-compile every tree we ship
+lint:  ## ruff (when installed) + bytecode-compile + project-aware `slt check`
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check serverless_learn_tpu tests benchmarks; \
 	else \
 		echo "ruff not installed; skipping style pass"; \
 	fi
 	python -m compileall -q serverless_learn_tpu tests benchmarks bench.py
+	python -m serverless_learn_tpu check
+
+check:  ## project-aware static analysis alone (SLT001-SLT006)
+	python -m serverless_learn_tpu check
+
+lockcheck:  ## fast telemetry/health/goodput tier under the runtime lock-order detector
+	SLT_LOCKCHECK=1 python -m pytest tests/test_analysis.py tests/test_telemetry.py \
+		tests/test_health.py tests/test_goodput.py -q -m "not slow"
 
 test-all:  ## the full suite (~13 min on CPU)
 	python -m pytest tests/ -q

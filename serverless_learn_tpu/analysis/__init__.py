@@ -1,0 +1,30 @@
+"""Project-aware static analysis + runtime race detection (`slt check`).
+
+Generic linters (ruff, compileall) catch undefined names and syntax rot,
+but the invariants that actually break this system live above that level:
+lock acquisition order across the telemetry/elastic/inference threads,
+metric names that `slt doctor`/`top`/the health engine consume vs. what
+the registry actually emits, Python side effects inside jitted functions,
+wire-format compatibility of ``native/proto/slt.proto``, and config keys
+that silently no-op because no dataclass declares them. Framework-specific
+invariants need framework-specific checkers (TensorFlow's graph checks,
+DrJAX's purity discipline) — this package is that pass for
+serverless-learn-tpu.
+
+Layout:
+
+* ``engine.py`` — file discovery, the :class:`Finding` model, the
+  committed baseline-suppression file, text/JSON reporting.
+* ``rules/`` — one module per SLT rule (SLT001..SLT006); see
+  ``rules/__init__.py`` for the registry and README for how to add one.
+* ``lockcheck.py`` — the RUNTIME half of SLT001: an opt-in
+  (``SLT_LOCKCHECK=1``) instrumented lock wrapper that records real
+  acquisition orderings during the test suite and fails on cycles.
+
+Run it: ``slt check [--rule SLTxxx] [--json] [--update-baseline]``.
+"""
+
+from serverless_learn_tpu.analysis.engine import (Finding, Project,
+                                                  load_baseline, run_check)
+
+__all__ = ["Finding", "Project", "load_baseline", "run_check"]

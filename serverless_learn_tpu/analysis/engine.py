@@ -1,0 +1,238 @@
+"""Shared checker engine: discovery, findings, baseline, reporting.
+
+Every rule gets the same deal: a parsed :class:`Project` in, a list of
+:class:`Finding` out. The engine owns everything rules should not
+reimplement — which files are in scope, how a finding is fingerprinted,
+how the committed baseline suppresses pre-existing findings without
+hiding new ones, and the `slt check` text/JSON output contract.
+
+Baseline discipline: a finding's fingerprint hashes (rule, path,
+message) — deliberately NOT the line number, so unrelated edits above a
+baselined finding don't resurrect it. ``--update-baseline`` rewrites the
+file from the current findings; every entry carries a ``justification``
+string (hand-edited after the update) so the suppression is a reviewed
+decision, not a dumping ground.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+# Directories/files scanned for Python rules, relative to the repo root.
+DEFAULT_PY_ROOTS = ("serverless_learn_tpu", "benchmarks", "bench.py")
+EXCLUDE_DIRS = {"__pycache__", "fixtures"}
+EXCLUDE_PATHS = {"native/gen"}
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclass
+class Finding:
+    """One rule violation at one site."""
+
+    rule: str          # "SLT001".."SLT006"
+    path: str          # repo-relative path
+    line: int          # 1-based; 0 = whole-file/project finding
+    message: str
+    severity: str = "error"
+
+    @property
+    def fingerprint(self) -> str:
+        h = hashlib.sha1(
+            f"{self.rule}|{self.path}|{self.message}".encode()).hexdigest()
+        return h[:16]
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "severity": self.severity, "message": self.message,
+                "fingerprint": self.fingerprint}
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: {self.rule} [{self.severity}] {self.message}"
+
+
+@dataclass
+class SourceFile:
+    path: str          # repo-relative, forward slashes
+    source: str
+    tree: Optional[ast.AST]   # None when the file does not parse
+    parse_error: Optional[str] = None
+
+
+@dataclass
+class Project:
+    """Parsed view of the repo handed to every rule.
+
+    ``files`` covers the Python trees under :data:`DEFAULT_PY_ROOTS`;
+    rules that read non-Python inputs (the proto, native headers, config
+    JSON) resolve them from ``root`` directly.
+    """
+
+    root: str
+    files: List[SourceFile] = field(default_factory=list)
+
+    def by_path(self, relpath: str) -> Optional[SourceFile]:
+        for f in self.files:
+            if f.path == relpath:
+                return f
+        return None
+
+    def read(self, relpath: str) -> Optional[str]:
+        """Raw text of any repo file (None when absent)."""
+        p = os.path.join(self.root, relpath)
+        try:
+            with open(p) as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+
+def discover(root: str,
+             py_roots: Sequence[str] = DEFAULT_PY_ROOTS) -> Project:
+    proj = Project(root=root)
+    for entry in py_roots:
+        top = os.path.join(root, entry)
+        if os.path.isfile(top) and entry.endswith(".py"):
+            _add_file(proj, root, top)
+            continue
+        if not os.path.isdir(top):
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in EXCLUDE_DIRS)
+            rel_dir = os.path.relpath(dirpath, root).replace(os.sep, "/")
+            if any(rel_dir == e or rel_dir.startswith(e + "/")
+                   for e in EXCLUDE_PATHS):
+                continue
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    _add_file(proj, root, os.path.join(dirpath, fn))
+    return proj
+
+
+def _add_file(proj: Project, root: str, path: str):
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    try:
+        with open(path) as fh:
+            src = fh.read()
+    except OSError as e:
+        proj.files.append(SourceFile(rel, "", None, parse_error=str(e)))
+        return
+    try:
+        tree = ast.parse(src, filename=rel)
+        err = None
+    except SyntaxError as e:
+        tree, err = None, f"{type(e).__name__}: {e}"
+    proj.files.append(SourceFile(rel, src, tree, parse_error=err))
+
+
+# -- baseline ----------------------------------------------------------------
+
+DEFAULT_BASELINE = "serverless_learn_tpu/analysis/baseline.json"
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """fingerprint -> entry. Missing file = empty baseline."""
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    out = {}
+    for entry in data.get("suppressions", []):
+        fp = entry.get("fingerprint")
+        if fp:
+            out[str(fp)] = entry
+    return out
+
+
+def save_baseline(path: str, findings: List[Finding],
+                  previous: Optional[Dict[str, dict]] = None):
+    """Write the baseline from the current findings, preserving the
+    hand-written justification of any entry that survives the update."""
+    previous = previous or {}
+    entries = []
+    seen = set()
+    for f in sorted(findings, key=lambda f: (f.rule, f.path, f.line)):
+        if f.fingerprint in seen:
+            continue
+        seen.add(f.fingerprint)
+        old = previous.get(f.fingerprint, {})
+        entries.append({
+            "fingerprint": f.fingerprint,
+            "rule": f.rule,
+            "path": f.path,
+            "message": f.message,
+            "justification": old.get("justification",
+                                     "TODO: justify or fix"),
+        })
+    payload = {
+        "_comment": ("Baseline suppressions for `slt check`. Every entry "
+                     "needs a one-line justification explaining why the "
+                     "finding is a false positive or accepted behavior; "
+                     "new findings never auto-enter this file."),
+        "suppressions": entries,
+    }
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+
+
+# -- the run -----------------------------------------------------------------
+
+def run_check(root: str, rule_ids: Optional[Sequence[str]] = None,
+              baseline_path: Optional[str] = None,
+              update_baseline: bool = False) -> dict:
+    """Run the selected rules; returns the report dict the CLI prints.
+
+    ``ok`` is True when no un-baselined finding remains (warnings
+    included: an undocumented metric is a docs bug, not noise).
+    """
+    from serverless_learn_tpu.analysis.rules import RULES
+
+    if rule_ids:
+        unknown = [r for r in rule_ids if r not in RULES]
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) {unknown}; have {sorted(RULES)}")
+        selected = {r: RULES[r] for r in rule_ids}
+    else:
+        selected = dict(RULES)
+
+    proj = discover(root)
+    findings: List[Finding] = []
+    for f in proj.files:
+        if f.parse_error is not None:
+            findings.append(Finding("SLT000", f.path, 0,
+                                    f"file does not parse: {f.parse_error}"))
+    for rid in sorted(selected):
+        findings.extend(selected[rid].run(proj))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+
+    bpath = os.path.join(root, baseline_path or DEFAULT_BASELINE)
+    baseline = load_baseline(bpath)
+    if update_baseline:
+        save_baseline(bpath, findings, previous=baseline)
+        baseline = load_baseline(bpath)
+
+    new = [f for f in findings if f.fingerprint not in baseline]
+    suppressed = [f for f in findings if f.fingerprint in baseline]
+    current = {f.fingerprint for f in findings}
+    stale = [fp for fp, entry in baseline.items()
+             if entry.get("rule") in selected and fp not in current]
+    return {
+        "ok": not new,
+        "rules": sorted(selected),
+        "files_scanned": len(proj.files),
+        "counts": {"new": len(new), "baselined": len(suppressed),
+                   "stale_baseline_entries": len(stale)},
+        "findings": [f.to_dict() for f in new],
+        "baselined": [f.to_dict() for f in suppressed],
+        "stale_baseline": stale,
+    }

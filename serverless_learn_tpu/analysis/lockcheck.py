@@ -1,0 +1,287 @@
+"""Runtime lock-order detection: SLT001's dynamic validator.
+
+The static rule reasons about ``with self._lock:`` nesting it can see;
+this module records the orderings that actually HAPPEN. Opt-in via
+``SLT_LOCKCHECK=1``: ``install()`` (called from ``tests/conftest.py``)
+replaces ``threading.Lock``/``RLock`` with factories producing
+instrumented wrappers, so every lock the package creates afterwards
+reports its acquisitions to a process-global :class:`LockOrderMonitor`.
+
+The monitor keys locks by their **creation site** (``file:line``), not
+object identity: two instances of ``Counter._lock`` are the same node,
+which is exactly the class-level ordering discipline SLT001's static
+graph models — and what makes a recorded ``A → B`` edge from one test
+meaningfully conflict with a ``B → A`` edge from another, even though no
+single run deadlocked. At every acquisition the monitor adds edges from
+all currently-held locks and checks the growing graph for cycles;
+``assert_clean()`` (the session-finish hook) raises with the offending
+cycle and one recorded stack per edge.
+
+Overhead is a dict update per acquisition — cheap enough to leave on for
+the whole fast tier in CI. The wrapper forwards everything else to the
+real primitive, so ``Condition``/``Event`` built on wrapped locks keep
+working.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_allocate = getattr(threading, "_allocate_lock", None) or (lambda: _REAL_LOCK())
+
+ENV_VAR = "SLT_LOCKCHECK"
+_STACK_DEPTH = 8
+# Frames from this module to drop when stamping creation/acquire sites.
+_SELF = os.path.abspath(__file__)
+
+
+class LockOrderViolation(AssertionError):
+    """A cycle exists in the observed lock-acquisition graph."""
+
+
+def _site(skip_internal: bool = True) -> str:
+    for frame in reversed(traceback.extract_stack()[:-1]):
+        if skip_internal and os.path.abspath(frame.filename) == _SELF:
+            continue
+        if "threading.py" in frame.filename:
+            continue
+        return f"{frame.filename}:{frame.lineno}"
+    return "<unknown>"
+
+
+def _stack() -> List[str]:
+    out = []
+    for frame in traceback.extract_stack()[:-2]:
+        if os.path.abspath(frame.filename) == _SELF:
+            continue
+        out.append(f"{frame.filename}:{frame.lineno} in {frame.name}")
+    return out[-_STACK_DEPTH:]
+
+
+class LockOrderMonitor:
+    """Observed acquisition graph + violations. Internal state is guarded
+    by a RAW interpreter lock (never an instrumented one)."""
+
+    def __init__(self, name: str = "default"):
+        self.name = name
+        self._mu = _allocate()
+        self._edges: Dict[Tuple[str, str], dict] = {}
+        self._violations: List[dict] = []
+        self._tls = threading.local()
+
+    # -- wrapper API -------------------------------------------------------
+
+    def wrap(self, lock=None, site: Optional[str] = None):
+        """Instrument an existing lock (or a fresh ``Lock()``)."""
+        return _InstrumentedLock(self, lock if lock is not None
+                                 else _REAL_LOCK(),
+                                 site or _site())
+
+    def _held(self) -> List[tuple]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _on_acquired(self, lk: "_InstrumentedLock"):
+        held = self._held()
+        if any(h is lk for h in held):
+            held.append(lk)   # reentrant RLock acquire: no new edges
+            return
+        new_edges = []
+        for h in held:
+            if h.site != lk.site:
+                new_edges.append((h.site, lk.site))
+        held.append(lk)
+        if not new_edges:
+            return
+        stack = _stack()
+        with self._mu:
+            for a, b in new_edges:
+                if (a, b) not in self._edges:
+                    self._edges[(a, b)] = {"stack": stack,
+                                           "thread":
+                                           threading.current_thread().name}
+                    cyc = self._find_cycle(b, a)
+                    if cyc is not None:
+                        # cyc runs b -> … -> a; with the new edge a -> b
+                        # that closes the loop. Store each node once.
+                        self._violations.append({
+                            "cycle": [a] + cyc[:-1],
+                            "edge": (a, b),
+                            "stack": stack,
+                        })
+
+    def _on_released(self, lk: "_InstrumentedLock"):
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lk:
+                del held[i]
+                return
+
+    def _find_cycle(self, start: str, target: str) -> Optional[List[str]]:
+        """Path start -> … -> target through the edge set (the new edge
+        target -> start closes the cycle)."""
+        seen: Set[str] = {start}
+        stack = [(start, [start])]
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in self._edges:
+            adj.setdefault(a, []).append(b)
+        while stack:
+            node, path = stack.pop()
+            if node == target:
+                return path
+            for nxt in adj.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # -- read side ---------------------------------------------------------
+
+    def edges(self) -> Dict[Tuple[str, str], dict]:
+        with self._mu:
+            return dict(self._edges)
+
+    def violations(self) -> List[dict]:
+        with self._mu:
+            return list(self._violations)
+
+    def reset(self):
+        with self._mu:
+            self._edges.clear()
+            self._violations.clear()
+
+    def report(self) -> str:
+        vio = self.violations()
+        lines = [f"lockcheck[{self.name}]: {len(self.edges())} ordered "
+                 f"pairs observed, {len(vio)} cycle(s)"]
+        for v in vio:
+            lines.append("  cycle: " + " -> ".join(v["cycle"])
+                         + f" -> {v['cycle'][0]}")
+            lines.append(f"  closing edge {v['edge'][0]} -> {v['edge'][1]} "
+                         f"on thread {self._edges.get(tuple(v['edge']), {}).get('thread', '?')}, acquired at:")
+            for fr in v["stack"]:
+                lines.append(f"    {fr}")
+        return "\n".join(lines)
+
+    def assert_clean(self):
+        if self.violations():
+            raise LockOrderViolation(self.report())
+
+
+class _InstrumentedLock:
+    """Duck-typed stand-in for Lock/RLock reporting to a monitor."""
+
+    def __init__(self, monitor: LockOrderMonitor, inner, site: str):
+        self._mon = monitor
+        self._inner = inner
+        self.site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._mon._on_acquired(self)
+        return got
+
+    # Condition() binds these at construction; mirror Condition's own
+    # fallbacks when the inner primitive (a plain Lock) lacks them.
+    def _acquire_restore(self, state):
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+            self._mon._on_acquired(self)
+        else:
+            self.acquire()
+
+    def _release_save(self):
+        if hasattr(self._inner, "_release_save"):
+            state = self._inner._release_save()
+            self._mon._on_released(self)
+            return state
+        self.release()
+        return None
+
+    def _is_owned(self):
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def release(self):
+        self._inner.release()
+        self._mon._on_released(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def _at_fork_reinit(self):
+        self._inner._at_fork_reinit()
+
+    def __repr__(self):
+        return f"<instrumented {self._inner!r} from {self.site}>"
+
+
+# -- global install ----------------------------------------------------------
+
+_default_monitor = LockOrderMonitor()
+_installed = False
+# Only locks CREATED from files whose path contains one of these are
+# instrumented: the invariant under test is this package's ordering
+# discipline, and wrapping jax/stdlib-internal locks would add overhead
+# plus third-party orderings we neither own nor can fix.
+DEFAULT_SCOPE = ("serverless_learn_tpu", "tests")
+
+
+def monitor() -> LockOrderMonitor:
+    return _default_monitor
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get(ENV_VAR, "") == "1"
+
+
+def install(scope=DEFAULT_SCOPE) -> LockOrderMonitor:
+    """Patch threading.Lock/RLock so every in-scope lock created AFTER
+    this call is instrumented. Idempotent."""
+    global _installed
+    if _installed:
+        return _default_monitor
+
+    def _make(real):
+        def factory():
+            site = _site()
+            if scope and not any(s in site for s in scope):
+                return real()
+            return _InstrumentedLock(_default_monitor, real(), site)
+        return factory
+
+    threading.Lock = _make(_REAL_LOCK)
+    threading.RLock = _make(_REAL_RLOCK)
+    _installed = True
+    return _default_monitor
+
+
+def uninstall():
+    global _installed
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed

@@ -1,0 +1,25 @@
+"""SLT rule registry.
+
+Adding a rule: create ``slt0NN_short_name.py`` exposing ``RULE_ID``,
+``TITLE`` and ``run(project) -> list[Finding]``, then list it in
+:data:`RULES` below. Keep rules pure functions of the :class:`Project`
+(no filesystem writes, no imports of heavyweight deps — `slt check`
+must run on toolchain-less CI nodes and inside ``native/Makefile``'s
+``check-proto`` without paying a jax import).
+"""
+
+from serverless_learn_tpu.analysis.rules import (slt001_lock_order,
+                                                 slt002_metric_drift,
+                                                 slt003_jit_purity,
+                                                 slt004_thread_lifecycle,
+                                                 slt005_proto_compat,
+                                                 slt006_config_drift)
+
+RULES = {
+    mod.RULE_ID: mod
+    for mod in (slt001_lock_order, slt002_metric_drift, slt003_jit_purity,
+                slt004_thread_lifecycle, slt005_proto_compat,
+                slt006_config_drift)
+}
+
+TITLES = {rid: mod.TITLE for rid, mod in RULES.items()}

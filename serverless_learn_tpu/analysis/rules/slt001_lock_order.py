@@ -1,0 +1,445 @@
+"""SLT001: lock-order / deadlock analysis for the threaded planes.
+
+Builds the static lock-acquisition graph of the package: every
+``threading.Lock()``/``RLock()`` bound at module level or as an instance
+attribute is a node; nesting ``with lockB:`` (or ``lockB.acquire()``)
+inside ``with lockA:`` adds the edge A → B, including edges discovered
+one-to-four calls deep through resolvable intra-package calls
+(``self.method()``, same-module functions, ``module.func`` for package
+imports). Two finding kinds:
+
+* **cycle** — a cycle in the acquisition graph is a potential deadlock
+  the moment two threads walk it from different entry points.
+* **blocking-under-lock** — a call that can block on the outside world
+  (sleep, socket/HTTP, file write, thread join/event wait, subprocess)
+  made while holding a lock. Registry/engine locks guard in-memory
+  state shared with scrape endpoints and dispatcher hot paths; blocking
+  under them turns a slow disk into a stalled /metrics scrape or a
+  wedged dispatcher.
+
+The static graph is deliberately conservative (unresolvable receivers —
+``obj.anything()`` on a non-self object — are skipped, not guessed);
+``analysis/lockcheck.py`` validates the same invariant dynamically from
+real acquisition orderings under ``SLT_LOCKCHECK=1``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from serverless_learn_tpu.analysis.engine import Finding, Project
+
+RULE_ID = "SLT001"
+TITLE = "lock-order / blocking-call-under-lock analysis"
+
+_LOCKISH_ATTR = re.compile(r"(^|_)(lock|locks|mu|mutex)$")
+_PKG_PREFIX = "serverless_learn_tpu"
+
+# Direct calls considered blocking while a lock is held: (reason, match).
+_BLOCKING_ATTRS = {
+    "sleep": "sleep",
+    "sendall": "socket send",
+    "recv": "socket recv",
+    "accept": "socket accept",
+    "connect": "socket connect",
+    "urlopen": "HTTP request",
+    "replace": "file I/O",       # os.replace (receiver-checked below)
+    "fsync": "file I/O",
+    "wait": "blocking wait",
+    "join": "thread join",       # receiver-checked below
+}
+_BLOCKING_NAMES = {
+    "open": "file open",
+    "urlopen": "HTTP request",
+    "fetch_text": "HTTP request",
+    "create_connection": "socket connect",
+}
+_FILEY = {"_f", "f", "fh", "file", "sock", "conn", "s"}
+_MAX_CHAIN = 5
+
+
+def _call_name(func: ast.AST) -> Tuple[Optional[str], Optional[str]]:
+    """(receiver_dotted, attr) for Attribute calls; (None, name) for Name."""
+    if isinstance(func, ast.Name):
+        return None, func.id
+    if isinstance(func, ast.Attribute):
+        parts = []
+        node = func.value
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts)), func.attr
+        return "?", func.attr
+    return None, None
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    recv, attr = _call_name(node.func)
+    name = attr or ""
+    if name in ("Lock", "RLock") and (recv in (None, "threading")
+                                      or recv is None):
+        return True
+    # field(default_factory=threading.Lock) — dataclass lock attribute.
+    if name == "field":
+        for kw in node.keywords:
+            if kw.arg == "default_factory":
+                _, a2 = _call_name(kw.value)
+                if a2 in ("Lock", "RLock"):
+                    return True
+    return False
+
+
+@dataclass
+class _Fn:
+    qual: str                    # "path::Class.method" / "path::func"
+    path: str
+    cls: Optional[str]
+    node: ast.AST
+    acquires: set = field(default_factory=set)       # lock ids
+    acquire_sites: dict = field(default_factory=dict)  # lock -> line
+    # (held tuple, callee key or None, line, blocking reason or None)
+    calls: List[tuple] = field(default_factory=list)
+    blocking: List[tuple] = field(default_factory=list)  # (reason, line)
+    nested: List[tuple] = field(default_factory=list)    # held-edge pairs
+
+
+class _Module:
+    def __init__(self, sf):
+        self.sf = sf
+        self.path = sf.path
+        self.imports: Dict[str, str] = {}     # local name -> module relpath
+        self.from_funcs: Dict[str, tuple] = {}  # name -> (relpath, name)
+        self.locks: Dict[str, str] = {}       # module-global name -> lock id
+        self.class_locks: Dict[str, Dict[str, str]] = {}
+        self.functions: Dict[str, _Fn] = {}
+
+
+def _mod_to_path(modname: str, proj: Project) -> Optional[str]:
+    if not modname.startswith(_PKG_PREFIX):
+        return None
+    rel = modname.replace(".", "/")
+    if proj.by_path(rel + ".py") is not None:
+        return rel + ".py"
+    if proj.by_path(rel + "/__init__.py") is not None:
+        return rel + "/__init__.py"
+    return None
+
+
+def _collect_module(sf, proj: Project) -> _Module:
+    m = _Module(sf)
+    tree = sf.tree
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                p = _mod_to_path(alias.name, proj)
+                if p:
+                    m.imports[alias.asname or alias.name.split(".")[0]] = p
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            base = node.module
+            for alias in node.names:
+                local = alias.asname or alias.name
+                sub = _mod_to_path(f"{base}.{alias.name}", proj)
+                if sub:
+                    m.imports[local] = sub
+                    continue
+                p = _mod_to_path(base, proj)
+                if p:
+                    m.from_funcs[local] = (p, alias.name)
+    # Module-global locks + top-level functions.
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    m.locks[tgt.id] = f"{m.path}::{tgt.id}"
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            m.functions[node.name] = _Fn(f"{m.path}::{node.name}",
+                                         m.path, None, node)
+        elif isinstance(node, ast.ClassDef):
+            attrs: Dict[str, str] = {}
+            for sub in node.body:
+                if (isinstance(sub, ast.AnnAssign)
+                        and isinstance(sub.target, ast.Name)
+                        and sub.value is not None
+                        and _is_lock_ctor(sub.value)):
+                    attrs[sub.target.id] = \
+                        f"{m.path}::{node.name}.{sub.target.id}"
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and _is_lock_ctor(sub.value):
+                    for tgt in sub.targets:
+                        if (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            attrs[tgt.attr] = \
+                                f"{m.path}::{node.name}.{tgt.attr}"
+            m.class_locks[node.name] = attrs
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    m.functions[f"{node.name}.{sub.name}"] = _Fn(
+                        f"{m.path}::{node.name}.{sub.name}",
+                        m.path, node.name, sub)
+    return m
+
+
+class _FnVisitor:
+    """Statement walk of ONE function body with a held-lock stack.
+
+    Nested function/lambda bodies are skipped: they execute later, on
+    some other thread's schedule, not under the current holds.
+    """
+
+    def __init__(self, mod: _Module, fn: _Fn):
+        self.m = mod
+        self.fn = fn
+        self.held: List[str] = []
+
+    # -- resolution --------------------------------------------------------
+
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return self.m.locks.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            recv, attr = _call_name(expr)
+            if recv == "self" and self.fn.cls:
+                known = self.m.class_locks.get(self.fn.cls, {})
+                if attr in known:
+                    return known[attr]
+                if _LOCKISH_ATTR.search(attr or ""):
+                    return f"{self.m.path}::{self.fn.cls}.{attr}"
+            elif recv in self.m.imports:
+                # module._lock style cross-module reference
+                if _LOCKISH_ATTR.search(attr or ""):
+                    return f"{self.m.imports[recv]}::{attr}"
+        return None
+
+    def _callee_key(self, func: ast.AST) -> Optional[str]:
+        recv, attr = _call_name(func)
+        if recv is None and attr:
+            if attr in self.m.functions:
+                return f"{self.m.path}::{attr}"
+            if attr in self.m.from_funcs:
+                p, name = self.m.from_funcs[attr]
+                return f"{p}::{name}"
+            return None
+        if recv == "self" and self.fn.cls and attr:
+            if f"{self.fn.cls}.{attr}" in self.m.functions:
+                return f"{self.m.path}::{self.fn.cls}.{attr}"
+            return None
+        if recv in self.m.imports and attr:
+            return f"{self.m.imports[recv]}::{attr}"
+        return None
+
+    def _blocking_reason(self, node: ast.Call) -> Optional[str]:
+        recv, attr = _call_name(node.func)
+        if recv is None and attr in _BLOCKING_NAMES:
+            return _BLOCKING_NAMES[attr]
+        if attr in ("urlopen", "create_connection"):
+            return _BLOCKING_ATTRS.get(attr) or _BLOCKING_NAMES.get(attr)
+        if attr in _BLOCKING_ATTRS and recv is not None:
+            last = recv.split(".")[-1]
+            if attr == "join":
+                return ("thread join"
+                        if "thread" in last.lower() else None)
+            if attr == "replace" or attr == "fsync":
+                return _BLOCKING_ATTRS[attr] if last == "os" else None
+            if attr == "sleep":
+                return "sleep"
+            if attr == "wait":
+                # Event/condition waits: self._stop.wait, r.done.wait.
+                return "blocking wait"
+            return _BLOCKING_ATTRS[attr]
+        if attr in ("write", "flush") and recv is not None:
+            if recv.split(".")[-1] in _FILEY:
+                return "file write"
+        if recv == "subprocess" or (recv or "").startswith("subprocess."):
+            return "subprocess"
+        if recv == "json" and attr == "dump":
+            return "file write"
+        return None
+
+    # -- the walk ----------------------------------------------------------
+
+    def visit(self, stmts) -> None:
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _acquire(self, lock: str, line: int):
+        for h in self.held:
+            if h != lock:
+                self.fn.nested.append((h, lock, line))
+        self.fn.acquires.add(lock)
+        self.fn.acquire_sites.setdefault(lock, line)
+
+    def _stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            pushed = []
+            for item in stmt.items:
+                ctx = item.context_expr
+                lock = self._lock_of(ctx) if not isinstance(ctx, ast.Call) \
+                    else None
+                if lock is None and isinstance(ctx, ast.Call):
+                    # with lock.acquire()-style or plain `with x():` — no.
+                    self._expr(ctx)
+                    continue
+                if lock is not None:
+                    self._acquire(lock, stmt.lineno)
+                    self.held.append(lock)
+                    pushed.append(lock)
+                else:
+                    self._expr(ctx)
+            self.visit(stmt.body)
+            for _ in pushed:
+                self.held.pop()
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._stmt(child)
+            elif isinstance(child, ast.expr):
+                self._expr(child)
+            elif isinstance(child, (ast.excepthandler,)):
+                self.visit(child.body)
+            elif hasattr(child, "body") and isinstance(
+                    getattr(child, "body", None), list):
+                self.visit(child.body)
+
+    def _expr(self, expr: ast.expr):
+        skip = set()  # node ids inside lambdas: they run later, elsewhere
+        for node in ast.walk(expr):
+            if id(node) in skip:
+                continue
+            if isinstance(node, ast.Lambda):
+                for sub in ast.walk(node):
+                    if sub is not node:
+                        skip.add(id(sub))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            recv, attr = _call_name(node.func)
+            # lock.acquire() as a point acquisition event
+            if attr == "acquire":
+                lk = self._lock_of(node.func.value) if isinstance(
+                    node.func, ast.Attribute) else None
+                if lk is not None:
+                    self._acquire(lk, node.lineno)
+                    continue
+            reason = self._blocking_reason(node)
+            callee = self._callee_key(node.func)
+            self.fn.calls.append(
+                (tuple(self.held), callee, node.lineno, reason))
+            if reason is not None:
+                self.fn.blocking.append((reason, node.lineno))
+
+
+def run(proj: Project) -> List[Finding]:
+    mods = [
+        _collect_module(sf, proj) for sf in proj.files if sf.tree is not None
+    ]
+    fns: Dict[str, _Fn] = {}
+    for m in mods:
+        for fn in m.functions.values():
+            body = getattr(fn.node, "body", [])
+            _FnVisitor(m, fn).visit(body)
+            fns[fn.qual] = fn
+
+    # Transitive acquisition closure + may-block chains (bounded fixpoint).
+    closure: Dict[str, set] = {q: set(f.acquires) for q, f in fns.items()}
+    blocks: Dict[str, Optional[tuple]] = {
+        q: ((f.blocking[0][0], ()) if f.blocking else None)
+        for q, f in fns.items()}
+    for _ in range(_MAX_CHAIN):
+        changed = False
+        for q, f in fns.items():
+            for _, callee, _, _ in f.calls:
+                if callee is None or callee not in fns:
+                    continue
+                add = closure[callee] - closure[q]
+                if add:
+                    closure[q] |= add
+                    changed = True
+                if blocks[q] is None and blocks[callee] is not None:
+                    reason, chain = blocks[callee]
+                    short = callee.split("::")[-1]
+                    blocks[q] = (reason, (short,) + chain)
+                    changed = True
+        if not changed:
+            break
+
+    findings: List[Finding] = []
+    edges: Dict[tuple, tuple] = {}  # (a, b) -> (path, line)
+
+    for q, f in fns.items():
+        for a, b, line in f.nested:
+            edges.setdefault((a, b), (f.path, line))
+        for held, callee, line, reason in f.calls:
+            if not held:
+                continue
+            # interprocedural lock edges
+            if callee in fns:
+                for b in closure[callee]:
+                    for a in held:
+                        if a != b:
+                            edges.setdefault((a, b), (f.path, line))
+            # blocking under lock
+            chain = None
+            if reason is not None:
+                chain = (reason, ())
+            elif callee in fns and blocks.get(callee) is not None:
+                r, c = blocks[callee]
+                chain = (r, (callee.split("::")[-1],) + c)
+            if chain is not None:
+                r, c = chain
+                via = f" (via {' -> '.join(c)})" if c else ""
+                lockname = held[-1].split("::")[-1]
+                findings.append(Finding(
+                    RULE_ID, f.path, line,
+                    f"{f.qual.split('::')[-1]} performs {r}{via} while "
+                    f"holding {lockname}"))
+
+    # Cycle detection over the acquisition graph.
+    graph: Dict[str, set] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    for cyc in _cycles(graph):
+        first = min(cyc)
+        i = cyc.index(first)
+        ordered = cyc[i:] + cyc[:i]
+        path, line = edges.get((ordered[0], ordered[1 % len(ordered)]),
+                               ("", 0))
+        pretty = " -> ".join(x.split("::")[-1] for x in ordered
+                             ) + f" -> {ordered[0].split('::')[-1]}"
+        findings.append(Finding(
+            RULE_ID, path or ordered[0].split("::")[0], line,
+            f"lock-order cycle (potential deadlock): {pretty}"))
+    return findings
+
+
+def _cycles(graph: Dict[str, set]) -> List[List[str]]:
+    """Simple cycles via DFS, deduped by node set (enough for lock graphs,
+    which stay tiny)."""
+    out, seen_sets = [], set()
+    nodes = sorted(set(graph) | {b for bs in graph.values() for b in bs})
+
+    def dfs(start, node, path, visited):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                key = frozenset(path)
+                if key not in seen_sets:
+                    seen_sets.add(key)
+                    out.append(list(path))
+            elif nxt not in visited and len(path) < 8:
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for n in nodes:
+        dfs(n, n, [n], {n})
+    return out

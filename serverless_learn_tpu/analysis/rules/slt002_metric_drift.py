@@ -1,0 +1,126 @@
+"""SLT002: metric-name drift between emitters and consumers.
+
+The registry is stringly-typed on purpose (Prometheus names), which means
+a renamed emission silently blinds every consumer: `slt top` renders
+dashes, the health engine's staleness watchdog never arms, `slt doctor`
+ranks nothing. This rule extracts:
+
+* **emitted** — every literal first argument of a
+  ``.counter("…")`` / ``.gauge("…")`` / ``.histogram("…")`` call anywhere
+  in the package;
+* **consumed** — every ``slt_*`` string literal in the consumer modules
+  (``telemetry/top.py``, ``doctor.py``, ``health.py`` rule tables,
+  ``exporter.py``, ``benchgate.py``) that is not itself an emission call
+  in that file;
+
+and flags (a) names consumed but never emitted anywhere (error — the
+consumer is reading a metric that cannot exist) and (b) names emitted
+but missing from the metric catalog in ``docs/ARCHITECTURE.md`` (warning
+— operators grep that list to know what to scrape).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from serverless_learn_tpu.analysis.engine import Finding, Project
+
+RULE_ID = "SLT002"
+TITLE = "metric-name drift (emitted vs consumed vs documented)"
+
+_EMIT_METHODS = {"counter", "gauge", "histogram"}
+_NAME_RE = re.compile(r"^slt_[a-z0-9_]+$")
+CONSUMER_BASENAMES = {"top.py", "doctor.py", "health.py", "exporter.py",
+                      "benchgate.py"}
+DOC_PATH = "docs/ARCHITECTURE.md"
+# Doc shorthand like `slt_train_samples_per_sec[_per_chip]` expands to
+# both names; `slt_rpc_{calls,time_seconds,max_seconds}` to all three.
+_DOC_TOKEN_RE = re.compile(r"slt_[a-z0-9_\[\]{},]+")
+
+
+def _emissions(tree: ast.AST) -> List[Tuple[str, int]]:
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _EMIT_METHODS and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            name = node.args[0].value
+            if _NAME_RE.match(name):
+                out.append((name, node.lineno))
+    return out
+
+
+def _string_literals(tree: ast.AST) -> List[Tuple[str, int]]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if _NAME_RE.match(node.value):
+                out.append((node.value, node.lineno))
+    return out
+
+
+def doc_names(doc_text: str) -> Set[str]:
+    names: Set[str] = set()
+    for tok in _DOC_TOKEN_RE.findall(doc_text):
+        for expanded in _expand(tok):
+            if _NAME_RE.match(expanded):
+                names.add(expanded)
+    return names
+
+
+def _expand(tok: str) -> List[str]:
+    m = re.search(r"\{([^}]*)\}", tok)
+    if m:
+        out = []
+        for part in m.group(1).split(","):
+            out.extend(_expand(tok[:m.start()] + part + tok[m.end():]))
+        return out
+    m = re.search(r"\[([^\]]*)\]", tok)
+    if m:
+        without = tok[:m.start()] + tok[m.end():]
+        with_ = tok[:m.start()] + m.group(1) + tok[m.end():]
+        return _expand(without) + _expand(with_)
+    return [tok.rstrip("_")]
+
+
+def run(proj: Project) -> List[Finding]:
+    emitted: Dict[str, Tuple[str, int]] = {}
+    consumed: Dict[str, Tuple[str, int]] = {}
+    for sf in proj.files:
+        if sf.tree is None:
+            continue
+        emits_here = _emissions(sf.tree)
+        for name, line in emits_here:
+            emitted.setdefault(name, (sf.path, line))
+        base = sf.path.rsplit("/", 1)[-1]
+        if base in CONSUMER_BASENAMES:
+            emit_names = {n for n, _ in emits_here}
+            for name, line in _string_literals(sf.tree):
+                if name not in emit_names:
+                    consumed.setdefault(name, (sf.path, line))
+
+    findings: List[Finding] = []
+    for name in sorted(consumed):
+        if name not in emitted:
+            path, line = consumed[name]
+            findings.append(Finding(
+                RULE_ID, path, line,
+                f"metric {name!r} is consumed here but never emitted by "
+                f"any registry.counter/gauge/histogram call"))
+
+    doc = proj.read(DOC_PATH)
+    if doc is not None:
+        documented = doc_names(doc)
+        for name in sorted(emitted):
+            if name not in documented:
+                path, line = emitted[name]
+                findings.append(Finding(
+                    RULE_ID, path, line,
+                    f"metric {name!r} is emitted but undocumented in "
+                    f"{DOC_PATH} (add it to the metric catalog)",
+                    severity="warning"))
+    return findings

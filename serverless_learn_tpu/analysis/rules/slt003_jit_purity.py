@@ -1,0 +1,146 @@
+"""SLT003: Python side effects inside jit/pjit-traced functions.
+
+A ``jax.jit``-traced function runs its Python body ONCE per compile
+cache entry; side effects inside it (clock reads, metric emission,
+prints, host syncs) execute at trace time, not step time — a
+``time.time()`` inside ``train_step`` measures compilation, a counter
+``.inc()`` fires once per bucket shape and then never again, and an
+``.item()``/``device_get`` forces a host sync that serializes async
+dispatch. DrJAX-style purity discipline, mechanized: this rule finds
+functions that are jitted (``@jax.jit``, ``@partial(jax.jit, …)``,
+``fn = jax.jit(local_def)``) and flags known-impure calls anywhere in
+their bodies, including nested defs (a ``lax.scan`` body traces too).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from serverless_learn_tpu.analysis.engine import Finding, Project
+
+RULE_ID = "SLT003"
+TITLE = "Python side effects inside jitted functions"
+
+# (dotted-receiver or None, attr/name) -> description
+_IMPURE_ATTRS = {
+    ("time", "time"): "reads the wall clock at trace time",
+    ("time", "perf_counter"): "reads the clock at trace time",
+    ("time", "monotonic"): "reads the clock at trace time",
+    ("time", "sleep"): "sleeps at trace time",
+    ("jax", "device_get"): "forces a host sync inside the traced body",
+    ("os", "urandom"): "draws host randomness at trace time",
+    ("random", "random"): "draws host randomness at trace time",
+    ("np", "asarray"): "materializes a traced value on host",
+    ("numpy", "asarray"): "materializes a traced value on host",
+}
+_IMPURE_NAMES = {
+    "print": "prints at trace time, silent afterwards",
+    "log_json": "emits a log record at trace time only",
+    "emit_event": "emits a telemetry event at trace time only",
+}
+_IMPURE_BARE_ATTRS = {
+    "item": "forces a host sync inside the traced body",
+    "inc": "metric emission fires at trace time only",
+    "observe": "metric emission fires at trace time only",
+    "block_until_ready": "forces a host sync inside the traced body",
+}
+
+
+def _call_parts(func: ast.AST):
+    if isinstance(func, ast.Name):
+        return None, func.id
+    if isinstance(func, ast.Attribute):
+        node, parts = func.value, []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts)), func.attr
+        return "?", func.attr
+    return None, None
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    """jax.jit / pjit / partial(jax.jit, ...) as a decorator or call."""
+    if isinstance(node, ast.Call):
+        recv, attr = _call_parts(node.func)
+        if attr in ("jit", "pjit"):
+            return True
+        if attr == "partial" and node.args:
+            return _is_jit_call(node.args[0])
+        return False
+    recv, attr = _call_parts(node) if isinstance(
+        node, (ast.Attribute, ast.Name)) else (None, None)
+    return attr in ("jit", "pjit")
+
+
+def _jitted_functions(tree: ast.AST) -> List[ast.AST]:
+    """Function nodes whose bodies trace: decorated defs, local defs
+    passed to jax.jit(...), and lambdas jitted inline."""
+    jitted: List[ast.AST] = []
+    local_defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local_defs.setdefault(node.name, node)
+            for dec in node.decorator_list:
+                if _is_jit_call(dec):
+                    jitted.append(node)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_jit_call(node)):
+            continue
+        recv, attr = _call_parts(node.func)
+        args = node.args
+        if attr == "partial":
+            continue  # the decorator form, handled above
+        if args:
+            target = args[0]
+            if isinstance(target, ast.Name) and target.id in local_defs:
+                jitted.append(local_defs[target.id])
+            elif isinstance(target, ast.Lambda):
+                jitted.append(target)
+    seen: Set[int] = set()
+    out = []
+    for n in jitted:
+        if id(n) not in seen:
+            seen.add(id(n))
+            out.append(n)
+    return out
+
+
+def _impurities(fn: ast.AST) -> List[tuple]:
+    out = []
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for node in ast.walk(ast.Module(body=list(body), type_ignores=[])):
+        if not isinstance(node, ast.Call):
+            continue
+        recv, attr = _call_parts(node.func)
+        why = None
+        if recv is None and attr in _IMPURE_NAMES:
+            why = _IMPURE_NAMES[attr]
+        elif (recv, attr) in _IMPURE_ATTRS:
+            why = _IMPURE_ATTRS[(recv, attr)]
+        elif (recv is not None and attr in _IMPURE_BARE_ATTRS
+                and not node.args and not node.keywords):
+            why = _IMPURE_BARE_ATTRS[attr]
+        elif recv is not None and attr in ("inc", "observe"):
+            why = _IMPURE_BARE_ATTRS[attr]
+        if why is not None:
+            what = f"{recv}.{attr}" if recv else attr
+            out.append((node.lineno, what, why))
+    return out
+
+
+def run(proj: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in proj.files:
+        if sf.tree is None:
+            continue
+        for fn in _jitted_functions(sf.tree):
+            name = getattr(fn, "name", "<lambda>")
+            for line, what, why in _impurities(fn):
+                findings.append(Finding(
+                    RULE_ID, sf.path, line,
+                    f"{what}() inside jitted {name}: {why}"))
+    return findings

@@ -1,0 +1,158 @@
+"""SLT004: thread lifecycle — threads that can outlive their owner.
+
+A ``threading.Thread`` started without ``daemon=True`` and without any
+reachable ``join()`` keeps the interpreter alive after main exits (a
+"done" CLI run that never returns its shell prompt), and a thread with
+neither a stop signal nor a join is unkillable state the next re-mesh or
+shutdown path has to race against. The rule flags every
+``threading.Thread(...)`` construction that is neither
+
+* daemonized (``daemon=True`` at construction, or ``<target>.daemon =
+  True`` before start), nor
+* joined — a ``.join(`` on the variable/attribute the thread was bound
+  to (same function for locals, anywhere in the class for ``self.X``),
+  or any ``.join(`` in the same function for threads managed through
+  collections (the ``threads = […]; for t in threads: t.join()`` idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from serverless_learn_tpu.analysis.engine import Finding, Project
+
+RULE_ID = "SLT004"
+TITLE = "thread lifecycle (daemon or join path required)"
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id == "Thread"
+    if isinstance(f, ast.Attribute):
+        return (f.attr == "Thread"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "threading")
+    return False
+
+
+def _daemon_kwarg(node: ast.Call) -> Optional[bool]:
+    for kw in node.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return None
+
+
+def _target_name(assign_parent) -> Optional[str]:
+    """'x' for `x = Thread(...)`, 'self.x' for `self.x = Thread(...)`."""
+    if not isinstance(assign_parent, ast.Assign):
+        return None
+    if len(assign_parent.targets) != 1:
+        return None
+    t = assign_parent.targets[0]
+    if isinstance(t, ast.Name):
+        return t.id
+    if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+            and t.value.id == "self"):
+        return f"self.{t.attr}"
+    return None
+
+
+def _has_join(tree: ast.AST, bound: Optional[str]) -> bool:
+    """Any `.join(` call on the bound name (or on anything, when the
+    thread went into a collection — bound None)."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            continue
+        recv = node.func.value
+        if isinstance(recv, ast.Constant):
+            continue  # ", ".join(...) — a str, not a thread
+        if bound is None:
+            return True
+        if isinstance(recv, ast.Name) and bound == recv.id:
+            return True
+        if (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"
+                and bound == f"self.{recv.attr}"):
+            return True
+    return False
+
+
+def _sets_daemon(tree: ast.AST, bound: Optional[str]) -> bool:
+    """`<bound>.daemon = True` after construction."""
+    if bound is None:
+        return False
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, ast.Attribute) and t.attr == "daemon"):
+            continue
+        if not (isinstance(node.value, ast.Constant) and node.value.value):
+            continue
+        recv = t.value
+        if isinstance(recv, ast.Name) and bound == recv.id:
+            return True
+        if (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"
+                and bound == f"self.{recv.attr}"):
+            return True
+    return False
+
+
+def run(proj: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in proj.files:
+        if sf.tree is None:
+            continue
+        # function scopes + enclosing class (for self.X joins in stop()).
+        scopes = []  # (function node, class node or None)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        scopes.append((sub, node))
+        in_class = {id(fn) for fn, _ in scopes}
+        for node in ast.walk(sf.tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and id(node) not in in_class):
+                scopes.append((node, None))
+
+        for fn, cls in scopes:
+            for stmt in ast.walk(fn):
+                if not (isinstance(stmt, ast.Call)
+                        and _is_thread_ctor(stmt)):
+                    continue
+                d = _daemon_kwarg(stmt)
+                if d is True:
+                    continue
+                parent = _enclosing_assign(fn, stmt)
+                bound = _target_name(parent)
+                if _sets_daemon(fn, bound):
+                    continue
+                if bound and bound.startswith("self."):
+                    search: ast.AST = cls if cls is not None else fn
+                    if _has_join(search, bound):
+                        continue
+                elif _has_join(fn, bound):
+                    continue
+                tname = bound or "<unbound>"
+                findings.append(Finding(
+                    RULE_ID, sf.path, stmt.lineno,
+                    f"thread {tname} in {fn.name} is neither daemonized "
+                    f"nor joined: it can outlive its owner and block "
+                    f"interpreter exit"))
+    return findings
+
+
+def _enclosing_assign(fn: ast.AST, call: ast.Call):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and node.value is call:
+            return node
+    return None

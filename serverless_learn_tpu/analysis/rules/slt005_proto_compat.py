@@ -1,0 +1,253 @@
+"""SLT005: wire-protocol compatibility for ``native/proto/slt.proto``.
+
+The native daemons, the committed generated code, and the Python twins
+all speak the same length-prefixed protobuf frames; a field-number edit
+that would be a one-line diff anywhere else is a silent wire break here
+(deployed binaries parse the old layout forever). Checks:
+
+* **field-number reuse** — no duplicate field numbers inside a message;
+* **field 15 is TraceContext** — every use of field number 15 must be
+  ``TraceContext trace`` (docs/WIRE_PROTOCOL.md: the uniform 0x7A tag is
+  what lets old daemons wire-scan the context), and every non-empty
+  ``*Request`` message must carry it;
+* **generated-code drift** — message/field names+numbers in
+  ``native/gen/slt_pb2.py`` must match the .proto (a .proto edit without
+  regeneration ships two protocols);
+* **tag bounds** — ``framing.h``'s ``MsgType`` values must be unique and
+  stay inside ``rpc_stats.h``'s ``kMaxMsgType`` (the overflow slot at
+  ``kMaxMsgType`` is reserved for unknown tags).
+
+Pure-text parsing on purpose: this must run in trees without protoc or
+even without the protobuf runtime (the ``native/Makefile check-proto``
+target gates C++-side edits with it).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+from serverless_learn_tpu.analysis.engine import Finding, Project
+
+RULE_ID = "SLT005"
+TITLE = "wire-protocol compatibility (slt.proto / gen / native headers)"
+
+PROTO_PATH = "native/proto/slt.proto"
+GEN_PATH = "native/gen/slt_pb2.py"
+FRAMING_PATH = "native/framing.h"
+RPC_STATS_PATH = "native/rpc_stats.h"
+
+TRACE_FIELD_NUMBER = 15
+
+_MSG_RE = re.compile(r"^\s*message\s+(\w+)\s*\{", re.M)
+_FIELD_RE = re.compile(
+    r"^\s*(?:repeated\s+|optional\s+)?([\w.]+)\s+(\w+)\s*=\s*(\d+)\s*;",
+    re.M)
+_ENUM_VAL_RE = re.compile(r"^\s*(MSG_\w+)\s*=\s*(\d+)", re.M)
+_KMAX_RE = re.compile(r"kMaxMsgType\s*=\s*(\d+)")
+
+
+def parse_proto(text: str) -> Dict[str, List[Tuple[str, str, int, int]]]:
+    """message -> [(type, name, number, lineno)], brace-matched per
+    message body (nested messages are not used in slt.proto)."""
+    out: Dict[str, List[Tuple[str, str, int, int]]] = {}
+    # Strip comments but keep line structure for line numbers.
+    stripped = re.sub(r"//[^\n]*", "", text)
+    for m in _MSG_RE.finditer(stripped):
+        name = m.group(1)
+        depth, i = 1, m.end()
+        while i < len(stripped) and depth:
+            if stripped[i] == "{":
+                depth += 1
+            elif stripped[i] == "}":
+                depth -= 1
+            i += 1
+        body = stripped[m.end():i - 1]
+        base_line = stripped.count("\n", 0, m.end())
+        fields = []
+        for fm in _FIELD_RE.finditer(body):
+            line = base_line + body.count("\n", 0, fm.start()) + 1
+            fields.append((fm.group(1), fm.group(2), int(fm.group(3)),
+                           line))
+        out[name] = fields
+    return out
+
+
+def parse_gen(text: str) -> Dict[str, Dict[str, int]]:
+    """message -> {field name: number} from the generated module's
+    serialized descriptor, without importing protobuf."""
+    out: Dict[str, Dict[str, int]] = {}
+    m = re.search(
+        r"AddSerializedFile\(\s*(b(?:'''|\"\"\"|'|\")[\s\S]*?)\)\s*$",
+        text, re.M)
+    if not m:
+        return out
+    try:
+        import ast as _ast
+
+        blob = _ast.literal_eval(m.group(1).strip())
+    except (ValueError, SyntaxError):
+        return out
+    return _parse_descriptor_blob(blob)
+
+
+def _parse_descriptor_blob(blob: bytes) -> Dict[str, Dict[str, int]]:
+    """Minimal FileDescriptorProto wire-format walk: message_type (tag 4)
+    holds DescriptorProto { name=1, field(2): FieldDescriptorProto
+    { name=1, number=3 } }."""
+    out: Dict[str, Dict[str, int]] = {}
+    for f_num, wire, val in _iter_fields(blob):
+        if f_num == 4 and wire == 2:  # message_type
+            name, fields = None, {}
+            for d_num, d_wire, d_val in _iter_fields(val):
+                if d_num == 1 and d_wire == 2:
+                    name = d_val.decode("utf-8", "replace")
+                elif d_num == 2 and d_wire == 2:  # field
+                    fname, fnum = None, None
+                    for p_num, p_wire, p_val in _iter_fields(d_val):
+                        if p_num == 1 and p_wire == 2:
+                            fname = p_val.decode("utf-8", "replace")
+                        elif p_num == 3 and p_wire == 0:
+                            fnum = p_val
+                    if fname is not None and fnum is not None:
+                        fields[fname] = fnum
+            if name:
+                out[name] = fields
+    return out
+
+
+def _iter_fields(buf: bytes):
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        if key is None:
+            return
+        f_num, wire = key >> 3, key & 7
+        if wire == 0:
+            val, i = _varint(buf, i)
+            if val is None:
+                return
+            yield f_num, wire, val
+        elif wire == 2:
+            ln, i = _varint(buf, i)
+            if ln is None or i + ln > n:
+                return
+            yield f_num, wire, buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            yield f_num, wire, buf[i:i + 4]
+            i += 4
+        elif wire == 1:
+            yield f_num, wire, buf[i:i + 8]
+            i += 8
+        else:
+            return
+
+
+def _varint(buf: bytes, i: int):
+    shift, val = 0, 0
+    while i < len(buf):
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+        if shift > 63:
+            break
+    return None, i
+
+
+def run(proj: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    proto = proj.read(PROTO_PATH)
+    if proto is None:
+        return [Finding(RULE_ID, PROTO_PATH, 0,
+                        "proto file missing", severity="warning")]
+    messages = parse_proto(proto)
+
+    for msg, fields in sorted(messages.items()):
+        seen: Dict[int, str] = {}
+        for ftype, fname, fnum, line in fields:
+            if fnum in seen:
+                findings.append(Finding(
+                    RULE_ID, PROTO_PATH, line,
+                    f"field number {fnum} reused in message {msg}: "
+                    f"{fname!r} clashes with {seen[fnum]!r} (numbers are "
+                    f"the wire identity; renumber the NEW field)"))
+            else:
+                seen[fnum] = fname
+            if fnum == TRACE_FIELD_NUMBER and (
+                    ftype != "TraceContext" or fname != "trace"):
+                findings.append(Finding(
+                    RULE_ID, PROTO_PATH, line,
+                    f"message {msg} uses reserved field 15 for "
+                    f"{ftype} {fname!r}; field 15 must stay "
+                    f"'TraceContext trace' on every message "
+                    f"(docs/WIRE_PROTOCOL.md tracing compat rules)"))
+        if (msg.endswith("Request") and fields
+                and not any(n == TRACE_FIELD_NUMBER
+                            for _, _, n, _ in fields)):
+            findings.append(Finding(
+                RULE_ID, PROTO_PATH, fields[0][3],
+                f"request message {msg} lacks the optional "
+                f"'TraceContext trace = 15' carrier every non-empty "
+                f"request message declares", severity="warning"))
+
+    gen = proj.read(GEN_PATH)
+    if gen is not None:
+        gen_msgs = parse_gen(gen)
+        if gen_msgs:
+            for msg, fields in sorted(messages.items()):
+                gfields = gen_msgs.get(msg)
+                if gfields is None:
+                    findings.append(Finding(
+                        RULE_ID, GEN_PATH, 0,
+                        f"message {msg} exists in slt.proto but not in "
+                        f"the committed generated code — regenerate "
+                        f"native/gen (make -C native)"))
+                    continue
+                want = {fname: fnum for _, fname, fnum, _ in fields}
+                for fname, fnum in sorted(want.items()):
+                    if gfields.get(fname) != fnum:
+                        got = gfields.get(fname)
+                        findings.append(Finding(
+                            RULE_ID, GEN_PATH, 0,
+                            f"{msg}.{fname}: slt.proto says field "
+                            f"{fnum}, generated code has "
+                            f"{'no such field' if got is None else got}"
+                            f" — regenerate native/gen"))
+            for msg in sorted(set(gen_msgs) - set(messages)):
+                findings.append(Finding(
+                    RULE_ID, GEN_PATH, 0,
+                    f"generated code has message {msg} that slt.proto "
+                    f"no longer declares — regenerate native/gen"))
+        else:
+            findings.append(Finding(
+                RULE_ID, GEN_PATH, 0,
+                "could not parse the generated descriptor (format "
+                "changed?); SLT005 gen-drift check skipped",
+                severity="warning"))
+
+    framing = proj.read(FRAMING_PATH)
+    rpc_stats = proj.read(RPC_STATS_PATH)
+    if framing is not None and rpc_stats is not None:
+        kmax_m = _KMAX_RE.search(rpc_stats)
+        kmax = int(kmax_m.group(1)) if kmax_m else None
+        tags: Dict[int, str] = {}
+        for m in _ENUM_VAL_RE.finditer(framing):
+            name, val = m.group(1), int(m.group(2))
+            line = framing.count("\n", 0, m.start()) + 1
+            if val in tags:
+                findings.append(Finding(
+                    RULE_ID, FRAMING_PATH, line,
+                    f"MsgType tag {val} reused: {name} clashes with "
+                    f"{tags[val]}"))
+            tags[val] = name
+            if kmax is not None and not (0 < val < kmax):
+                findings.append(Finding(
+                    RULE_ID, FRAMING_PATH, line,
+                    f"MsgType {name} = {val} outside (0, kMaxMsgType="
+                    f"{kmax}): tag {kmax} is the rpc_stats.h overflow "
+                    f"slot and larger tags lose latency accounting"))
+    return findings

@@ -1,0 +1,181 @@
+"""SLT006: config-schema drift — keys nobody declares, fields nobody reads.
+
+Every knob flows through the frozen dataclasses in ``config.py``; a
+config key that no dataclass declares raises at ``from_dict`` time *if*
+it is spelled at the right level, but an attribute read of a field that
+does not exist (``cfg.train.nmu_steps``) only explodes on the code path
+that reaches it — which for failure-handling knobs is the outage. Three
+checks:
+
+* attribute chains ``<cfg>.<section>.<field>`` (receiver named
+  ``cfg``/``config``, section one of the ExperimentConfig fields) where
+  ``field`` is not declared by that section's dataclass;
+* single-hop reads ``<cfg>.<name>`` where ``name`` exists on no config
+  dataclass at all (one-hop receivers can be any section object, so the
+  check is the union — it still catches typos that exist nowhere);
+* keys in the committed ``configs/*.json`` files that the dataclasses
+  do not declare (these would make ``ExperimentConfig.from_dict`` raise
+  at load time — a broken example config is a broken README).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Set
+
+from serverless_learn_tpu.analysis.engine import Finding, Project
+
+RULE_ID = "SLT006"
+TITLE = "config-schema drift (reads vs declared dataclass fields)"
+
+CONFIG_MODULE = "serverless_learn_tpu/config.py"
+CONFIGS_DIR = "configs"
+_CFG_NAMES = {"cfg", "config", "_cfg", "experiment_config"}
+# Sections whose values are free-form by design.
+_FREEFORM_SECTIONS = {"model_overrides"}
+_FREEFORM_FIELDS = {"slos"}
+
+
+def _dataclass_schema(tree: ast.AST) -> Dict[str, Set[str]]:
+    """class name -> declared fields + methods + properties + class vars."""
+    out: Dict[str, Set[str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        names: Set[str] = set()
+        for sub in node.body:
+            if isinstance(sub, ast.AnnAssign) and isinstance(
+                    sub.target, ast.Name):
+                names.add(sub.target.id)
+            elif isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.add(sub.name)
+        out[node.name] = names
+    return out
+
+
+def _experiment_sections(tree: ast.AST,
+                         schema: Dict[str, Set[str]]) -> Dict[str, str]:
+    """ExperimentConfig field name -> dataclass name (when annotated with
+    one of the config classes)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "ExperimentConfig":
+            for sub in node.body:
+                if isinstance(sub, ast.AnnAssign) and isinstance(
+                        sub.target, ast.Name):
+                    ann = sub.annotation
+                    cls = None
+                    if isinstance(ann, ast.Name) and ann.id in schema:
+                        cls = ann.id
+                    out[sub.target.id] = cls or ""
+    return out
+
+
+def _recv_name(node: ast.AST) -> Optional[str]:
+    """'cfg' for Name cfg; 'cfg' for self.cfg / self.config."""
+    if isinstance(node, ast.Name):
+        return node.id if node.id in _CFG_NAMES else None
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in _CFG_NAMES):
+        return node.attr
+    return None
+
+
+def run(proj: Project) -> List[Finding]:
+    cfg_sf = proj.by_path(CONFIG_MODULE)
+    if cfg_sf is None or cfg_sf.tree is None:
+        return []
+    schema = _dataclass_schema(cfg_sf.tree)
+    sections = _experiment_sections(cfg_sf.tree, schema)
+    exp_fields = schema.get("ExperimentConfig", set())
+    union_fields: Set[str] = set(exp_fields)
+    for names in schema.values():
+        union_fields |= names
+    # A bare `cfg.X` receiver can be ANY config object — model configs
+    # (TransformerConfig & co.) live outside config.py. The one-hop check
+    # is therefore the union over every *Config class in the project: it
+    # still catches names declared nowhere.
+    for sf in proj.files:
+        if sf.tree is None:
+            continue
+        for cls, names in _dataclass_schema(sf.tree).items():
+            if cls.endswith("Config"):
+                union_fields |= names
+
+    findings: List[Finding] = []
+    for sf in proj.files:
+        if sf.tree is None or sf.path == CONFIG_MODULE:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            # two-hop: <cfg>.<section>.<field>
+            if (isinstance(node.value, ast.Attribute)
+                    and _recv_name(node.value.value) is not None):
+                section = node.value.attr
+                if section in _FREEFORM_SECTIONS:
+                    continue
+                cls = sections.get(section)
+                if cls:
+                    allowed = schema[cls] | {"__class__"}
+                    if (node.attr not in allowed
+                            and node.attr not in _FREEFORM_FIELDS):
+                        findings.append(Finding(
+                            RULE_ID, sf.path, node.lineno,
+                            f"cfg.{section}.{node.attr} is read here but "
+                            f"{cls} declares no field {node.attr!r}"))
+                continue
+            # one-hop: <cfg>.<field> — union check (receiver could be any
+            # section object named `config`, e.g. HealthEngine.config).
+            if _recv_name(node.value) is not None:
+                if node.attr not in union_fields:
+                    findings.append(Finding(
+                        RULE_ID, sf.path, node.lineno,
+                        f"cfg.{node.attr} is read here but no config "
+                        f"dataclass declares a field or method "
+                        f"{node.attr!r}"))
+
+    # Committed example configs must load.
+    cfg_dir = os.path.join(proj.root, CONFIGS_DIR)
+    if os.path.isdir(cfg_dir):
+        for fn in sorted(os.listdir(cfg_dir)):
+            if not fn.endswith(".json"):
+                continue
+            rel = f"{CONFIGS_DIR}/{fn}"
+            try:
+                with open(os.path.join(cfg_dir, fn)) as fh:
+                    raw = json.load(fh)
+            except (OSError, json.JSONDecodeError) as e:
+                findings.append(Finding(RULE_ID, rel, 0,
+                                        f"config does not parse: {e}"))
+                continue
+            if not isinstance(raw, dict):
+                findings.append(Finding(RULE_ID, rel, 0,
+                                        "config root must be an object"))
+                continue
+            for key, val in raw.items():
+                if key not in exp_fields:
+                    findings.append(Finding(
+                        RULE_ID, rel, 0,
+                        f"unknown top-level config key {key!r} "
+                        f"(ExperimentConfig declares no such field)"))
+                    continue
+                cls = sections.get(key)
+                if (cls and isinstance(val, dict)
+                        and key not in _FREEFORM_SECTIONS):
+                    for sub in val:
+                        if sub not in schema[cls]:
+                            findings.append(Finding(
+                                RULE_ID, rel, 0,
+                                f"unknown config key {key}.{sub!r} "
+                                f"({cls} declares no such field; "
+                                f"from_dict would raise)"))
+    return findings

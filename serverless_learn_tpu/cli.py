@@ -21,6 +21,7 @@ Here one typed CLI fronts everything:
     python -m serverless_learn_tpu goodput      # goodput/badput accounting report
     python -m serverless_learn_tpu profile      # trigger a device-trace capture
     python -m serverless_learn_tpu bench        # perf regression gate (--gate)
+    python -m serverless_learn_tpu check        # project-aware static analysis
     python -m serverless_learn_tpu models       # list registered model families
 
 Every long-running command takes ``--metrics-port N`` to expose a
@@ -1058,6 +1059,49 @@ def cmd_bench(args) -> int:
     return 0 if rep.get("ok") else 1
 
 
+def cmd_check(args) -> int:
+    """Project-aware static analysis (serverless_learn_tpu/analysis/):
+    lock-order + blocking-under-lock (SLT001), metric-name drift (SLT002),
+    jit purity (SLT003), thread lifecycle (SLT004), wire-protocol compat
+    (SLT005), config-schema drift (SLT006). Exit 0 = no finding beyond
+    the committed baseline; `--update-baseline` rewrites it (every entry
+    then needs a reviewed justification). Deliberately jax-free so it
+    runs on toolchain-less CI nodes and from native/Makefile."""
+    from serverless_learn_tpu.analysis import run_check
+    from serverless_learn_tpu.analysis.rules import TITLES
+
+    if args.list_rules:
+        for rid in sorted(TITLES):
+            print(f"{rid}  {TITLES[rid]}")
+        return 0
+    root = args.root
+    if root is None:
+        # Default to the checkout containing this package, so `slt check`
+        # works from any cwd.
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        rep = run_check(root, rule_ids=args.rule or None,
+                        baseline_path=args.baseline,
+                        update_baseline=args.update_baseline)
+    except ValueError as e:
+        raise SystemExit(str(e))
+    if args.json:
+        print(json.dumps(rep, indent=None if args.compact else 2))
+    else:
+        for f in rep["findings"]:
+            loc = f"{f['path']}:{f['line']}" if f["line"] else f["path"]
+            print(f"{loc}: {f['rule']} [{f['severity']}] {f['message']}")
+        c = rep["counts"]
+        print(f"slt check: {c['new']} finding(s), {c['baselined']} "
+              f"baselined, {rep['files_scanned']} files "
+              f"({', '.join(rep['rules'])})")
+        if c["stale_baseline_entries"]:
+            print(f"note: {c['stale_baseline_entries']} stale baseline "
+                  f"entr{'y' if c['stale_baseline_entries'] == 1 else 'ies'}"
+                  f" no longer match any finding (run --update-baseline)")
+    return 0 if rep["ok"] else 1
+
+
 def cmd_top(args) -> int:
     """Live cluster telemetry: poll /metrics endpoints, render one screen
     (per-worker throughput, inference latency percentiles, membership)."""
@@ -1361,6 +1405,30 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--compact", action="store_true",
                     help="single-line JSON report (for scripts)")
     bn.set_defaults(fn=cmd_bench)
+
+    ck = sub.add_parser("check",
+                        help="project-aware static analysis: lock order, "
+                             "metric drift, jit purity, thread lifecycle, "
+                             "proto compat, config drift (SLT001-SLT006)")
+    ck.add_argument("--rule", action="append", metavar="SLTxxx",
+                    help="run only this rule (repeatable)")
+    ck.add_argument("--list-rules", action="store_true",
+                    help="print the rule registry and exit")
+    ck.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ck.add_argument("--compact", action="store_true",
+                    help="single-line JSON (with --json)")
+    ck.add_argument("--root", default=None,
+                    help="repo root to scan (default: the checkout "
+                         "containing this package)")
+    ck.add_argument("--baseline", default=None, metavar="FILE",
+                    help="baseline-suppression file, relative to the "
+                         "root (default: serverless_learn_tpu/analysis/"
+                         "baseline.json)")
+    ck.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "(then hand-edit each justification)")
+    ck.set_defaults(fn=cmd_check)
 
     tp = sub.add_parser("top", help="live cluster telemetry: poll /metrics "
                                     "endpoints, one-screen view")

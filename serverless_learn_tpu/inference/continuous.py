@@ -581,7 +581,10 @@ class ContinuousBatchingEngine:
 
             self._min_admit = min(n, self.max_slots)
             try:
-                threads = [threading.Thread(target=_one, args=(i,))
+                # daemon: the join below is bounded, and a straggler warm
+                # submit must not block interpreter exit (SLT004).
+                threads = [threading.Thread(target=_one, args=(i,),
+                                            daemon=True)
                            for i in range(n)]
                 for t in threads:
                     t.start()

@@ -221,22 +221,27 @@ class GenerationServer:
             # Per-connection thread: a slow or idle keepalive client blocks
             # only its own thread; concurrent generation requests coalesce
             # in the BatchingEngine's admission queue.
+            t = None
             with self._conns_lock:
-                if len(self._conns) >= self.max_connections:
-                    # At the cap the total buffer memory bound
-                    # (max_connections * MAX_LINE) would break; refuse
-                    # rather than queue without bound.
-                    try:
-                        conn.sendall(json.dumps(
-                            {"error": "server at connection capacity"}
-                        ).encode() + b"\n")
-                        conn.close()
-                    except OSError:
-                        pass
-                    continue
-                t = threading.Thread(
-                    target=self._serve_conn_safe, args=(conn,), daemon=True)
-                self._conns[t] = conn
+                if len(self._conns) < self.max_connections:
+                    t = threading.Thread(
+                        target=self._serve_conn_safe, args=(conn,),
+                        daemon=True)
+                    self._conns[t] = conn
+            if t is None:
+                # At the cap the total buffer memory bound
+                # (max_connections * MAX_LINE) would break; refuse rather
+                # than queue without bound. The refusal write happens with
+                # NO lock held — a client with a full receive buffer must
+                # not stall every other accept (SLT001).
+                try:
+                    conn.sendall(json.dumps(
+                        {"error": "server at connection capacity"}
+                    ).encode() + b"\n")
+                    conn.close()
+                except OSError:
+                    pass
+                continue
             t.start()
 
     def _serve_conn_safe(self, conn: socket.socket):

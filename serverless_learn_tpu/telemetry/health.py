@@ -505,9 +505,16 @@ class HealthEngine:
         # (after the event emission). The profiler service registers its
         # rate-limited capture-on-critical here (telemetry/profiler.py).
         self._alert_hooks: List[Callable] = []
-        # RLock: a critical fire inside a tick (under the lock) triggers
-        # a flight dump whose "alerts" context provider re-enters
-        # alerts() on the same thread.
+        # I/O staged by the locked tick (event emission, flight dumps,
+        # alert hooks) and flushed by sample_once AFTER the lock drops:
+        # /alerts and /healthz scrapes share this lock, and a slow disk
+        # inside a tick must not stall them (SLT001). Outside a tick
+        # (tests driving _fire/_calm directly) the staging flushes
+        # immediately, preserving the synchronous unit contract.
+        self._pending_actions: List[tuple] = []
+        self._in_tick = False
+        # RLock: defensive — an alert hook or flight context provider
+        # that re-enters alerts() on the engine thread must not deadlock.
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -595,14 +602,12 @@ class HealthEngine:
         a.clean_ticks = 0
         a.resolved_unix_s = None
         if new or escalated:
-            self._emit_event(a.to_event())
+            # Stage the I/O; sample_once flushes after the lock drops.
+            self._pending_actions.append(("event", a.to_event()))
             if a.severity == "critical" and self.dump_on_critical:
-                self._maybe_dump(now, a)
-            for hook in list(self._alert_hooks):
-                try:
-                    hook(a)
-                except Exception:
-                    pass  # forensics hooks must never break a tick
+                self._pending_actions.append(("dump", a))
+            self._pending_actions.append(("hooks", a))
+        self._flush_if_outside_tick(now)
 
     def _calm(self, now: float, name: str, labels: Optional[dict] = None):
         """Condition is clean this tick; resolve after ``clear_after``
@@ -614,7 +619,8 @@ class HealthEngine:
         if a.clean_ticks >= self.config.clear_after_ticks:
             a.state = "resolved"
             a.resolved_unix_s = now
-            self._emit_event(a.to_event())
+            self._pending_actions.append(("event", a.to_event()))
+        self._flush_if_outside_tick(now)
 
     def add_alert_hook(self, fn: Callable):
         """``fn(alert)`` on every new/escalated fire. Hooks run inside
@@ -655,7 +661,38 @@ class HealthEngine:
         now = self.clock() if now is None else now
         sample = flatten_snapshot(self.registry.snapshot())
         with self._lock:
-            self._tick_locked(now, sample)
+            self._in_tick = True
+            try:
+                self._tick_locked(now, sample)
+            finally:
+                self._in_tick = False
+                actions, self._pending_actions = self._pending_actions, []
+        self._flush_actions(now, actions)
+
+    def _flush_if_outside_tick(self, now: float):
+        """Direct _fire/_calm callers (tests, future manual injectors) get
+        synchronous emission; inside a tick the flush waits for the lock
+        to drop."""
+        if self._in_tick or not self._pending_actions:
+            return
+        actions, self._pending_actions = self._pending_actions, []
+        self._flush_actions(now, actions)
+
+    def _flush_actions(self, now: float, actions: List[tuple]):
+        """Run the tick's staged I/O (JSONL emission, flight dumps, alert
+        hooks) with NO lock held: scrapes and the engine's own context
+        provider stay responsive however slow the disk is."""
+        for kind, payload in actions:
+            if kind == "event":
+                self._emit_event(payload)
+            elif kind == "dump":
+                self._maybe_dump(now, payload)
+            elif kind == "hooks":
+                for hook in list(self._alert_hooks):
+                    try:
+                        hook(payload)
+                    except Exception:
+                        pass  # forensics hooks must never break a tick
 
     def _tick_locked(self, now: float, sample: dict):
         values, hists = sample["values"], sample["hists"]

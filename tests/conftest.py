@@ -27,6 +27,34 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# -- runtime lock-order detection (SLT_LOCKCHECK=1) --------------------------
+#
+# The dynamic half of `slt check`'s SLT001: instrument every lock the
+# package creates, record real acquisition orderings across the whole
+# suite, and fail the session on cycles (analysis/lockcheck.py). Installed
+# HERE — before any serverless_learn_tpu module runs its module-level
+# `threading.Lock()` — and scoped to locks created from this repo's files.
+
+_LOCKCHECK = os.environ.get("SLT_LOCKCHECK", "") == "1"
+if _LOCKCHECK:
+    from serverless_learn_tpu.analysis import lockcheck as _lockcheck
+
+    _lockcheck.install()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _LOCKCHECK:
+        return
+    mon = _lockcheck.monitor()
+    rep = mon.report()
+    print(f"\n{rep}")
+    if mon.violations():
+        # pytest.exit with a returncode is the one channel wrap_session
+        # honors from inside this hook (assigning session.exitstatus here
+        # is discarded).
+        pytest.exit("lockcheck: lock-order cycle observed (see report "
+                    "above)", returncode=3)
+
 
 @pytest.fixture(scope="session")
 def devices():

@@ -634,7 +634,7 @@ def test_stalled_training_fires_alert_dump_and_doctor(tmp_path, capsys):
         eng.stop()
         exp.stop()
     # The critical alert triggered a flight dump into our dir.
-    dumps = [p for p in glob.glob(str(tmp_path / "flight-*.json"))]
+    dumps = list(glob.glob(str(tmp_path / "flight-*.json")))
     assert dumps, "critical alert produced no flight dump"
     with open(dumps[0]) as f:
         dump = json.load(f)
