@@ -1,0 +1,22 @@
+"""Fault-injection chaos harness (round 11).
+
+Three layers:
+
+* :mod:`chaos.plan` — the FaultPlan DSL: a JSON list of timed fault ops
+  (kill / restart / partition / heal / drop / delay / pause / skew)
+  validated up front so a typo'd plan fails before anything runs.
+* :mod:`chaos.sim` — a deterministic in-process simulator: hundreds of
+  SWIM gossip members (``control/gossip.py``) on virtual time with a
+  seeded RNG, a quorum-gated DiLoCo-style training-progress model, fault
+  application from a plan, convergence/progress invariants, and JSONL
+  telemetry that ``slt doctor`` can diagnose.
+* :mod:`chaos.shim` — a TCP chaos proxy for REAL transports: park it in
+  front of a (py-)daemon and inject blackholes, mid-stream stalls and
+  resets into live control/data-plane connections — the harness for the
+  client hardening regression tests.
+
+Exposed as ``slt chaos run --plan plan.json --nodes N --seed S`` and
+``slt chaos soak`` (a seeded random schedule) from the CLI.
+"""
+
+from serverless_learn_tpu.chaos.plan import Fault, FaultPlan  # noqa: F401

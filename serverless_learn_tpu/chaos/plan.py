@@ -1,0 +1,205 @@
+"""FaultPlan DSL: declarative, timed fault injection.
+
+A plan is a JSON object::
+
+    {"faults": [
+      {"at": 2.0,  "op": "kill",      "frac": 0.3},
+      {"at": 2.0,  "op": "partition", "split": 0.5, "for": 10.0},
+      {"at": 20.0, "op": "restart",   "node": "node-3"},
+      {"at": 25.0, "op": "pause",     "node": "node-5", "for": 3.0},
+      {"at": 30.0, "op": "delay",     "s": 0.05, "jitter": 0.02},
+      {"at": 30.0, "op": "drop",      "rate": 0.2},
+      {"at": 35.0, "op": "skew",      "node": "node-1", "offset_s": 1.5},
+      {"at": 40.0, "op": "heal"}
+    ]}
+
+Times are VIRTUAL seconds from simulation start. Node selectors: an
+explicit ``"node"`` id, a ``"frac"`` of the currently-alive population, or
+a ``"count"``; fraction/count picks are resolved by the simulator's seeded
+RNG, so the same (plan, seed) always injects the same faults. ``"for"``
+auto-schedules the inverse op (heal / restart / resume) after the window.
+
+Validation is strict and up-front — ``FaultPlan.from_json`` raises
+``ValueError`` with the offending entry, so `slt chaos run` refuses a
+typo'd plan before simulating anything.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+OPS = ("kill", "restart", "partition", "heal", "drop", "delay", "pause",
+       "skew")
+
+_SELECTOR_OPS = ("kill", "restart", "pause", "skew")
+
+
+@dataclass(frozen=True)
+class Fault:
+    at: float
+    op: str
+    node: Optional[str] = None
+    frac: Optional[float] = None
+    count: Optional[int] = None
+    duration: Optional[float] = None  # JSON key "for"
+    split: Optional[float] = None     # partition: fraction in group A
+    groups: Optional[tuple] = None    # partition: explicit id groups
+    rate: Optional[float] = None      # drop probability
+    s: Optional[float] = None         # added one-way delay
+    jitter: Optional[float] = None
+    offset_s: Optional[float] = None  # clock skew
+
+    def describe(self) -> str:
+        sel = (self.node or
+               (f"{self.frac:.0%} of nodes" if self.frac is not None else
+                (f"{self.count} nodes" if self.count is not None else "")))
+        extra = f" for {self.duration}s" if self.duration else ""
+        return f"{self.op} {sel}".strip() + extra
+
+
+@dataclass
+class FaultPlan:
+    faults: List[Fault] = field(default_factory=list)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        try:
+            obj = json.loads(text)
+        except ValueError as e:
+            raise ValueError(f"fault plan is not valid JSON: {e}")
+        return cls.from_obj(obj)
+
+    @classmethod
+    def from_obj(cls, obj) -> "FaultPlan":
+        if isinstance(obj, list):
+            obj = {"faults": obj}
+        if not isinstance(obj, dict) or not isinstance(
+                obj.get("faults"), list):
+            raise ValueError('fault plan must be {"faults": [...]} '
+                             "or a bare list of fault objects")
+        out = []
+        for i, f in enumerate(obj["faults"]):
+            out.append(cls._parse_one(i, f))
+        out.sort(key=lambda f: f.at)
+        return cls(out)
+
+    @staticmethod
+    def _parse_one(i: int, f) -> Fault:
+        def bad(msg):
+            raise ValueError(f"faults[{i}]: {msg} ({f!r})")
+
+        if not isinstance(f, dict):
+            bad("must be an object")
+        op = f.get("op")
+        if op not in OPS:
+            bad(f"unknown op {op!r}; expected one of {OPS}")
+        at = f.get("at")
+        if not isinstance(at, (int, float)) or isinstance(at, bool) or at < 0:
+            bad("'at' must be a non-negative number of virtual seconds")
+        known = {"at", "op", "node", "frac", "count", "for", "split",
+                 "groups", "rate", "s", "jitter", "offset_s"}
+        unknown = set(f) - known
+        if unknown:
+            bad(f"unknown keys {sorted(unknown)}")
+
+        node, frac, count = f.get("node"), f.get("frac"), f.get("count")
+        if node is not None and not isinstance(node, str):
+            bad("'node' must be a node-id string")
+        if frac is not None and not (isinstance(frac, (int, float))
+                                     and 0 < frac <= 1):
+            bad("'frac' must be in (0, 1]")
+        if count is not None and not (isinstance(count, int)
+                                      and not isinstance(count, bool)
+                                      and count > 0):
+            bad("'count' must be a positive integer")
+        if op in _SELECTOR_OPS and not any(
+                x is not None for x in (node, frac, count)):
+            bad(f"'{op}' needs a selector: 'node', 'frac' or 'count'")
+        if sum(x is not None for x in (node, frac, count)) > 1:
+            bad("give exactly one of 'node', 'frac', 'count'")
+
+        dur = f.get("for")
+        if dur is not None and not (isinstance(dur, (int, float))
+                                    and dur > 0):
+            bad("'for' must be a positive duration in virtual seconds")
+
+        split, groups = f.get("split"), f.get("groups")
+        if op == "partition":
+            if groups is not None:
+                if (not isinstance(groups, list) or len(groups) < 2
+                        or not all(isinstance(g, list) and g
+                                   and all(isinstance(n, str) for n in g)
+                                   for g in groups)):
+                    bad("'groups' must be >= 2 non-empty lists of node ids")
+                groups = tuple(tuple(g) for g in groups)
+            elif split is None:
+                split = 0.5
+            if split is not None and not (isinstance(split, (int, float))
+                                          and 0 < split < 1):
+                bad("'split' must be in (0, 1)")
+        elif split is not None or groups is not None:
+            bad("'split'/'groups' only apply to op 'partition'")
+
+        rate = f.get("rate")
+        if op == "drop":
+            if not (isinstance(rate, (int, float)) and 0 <= rate <= 1):
+                bad("'drop' needs 'rate' in [0, 1]")
+        s, jitter = f.get("s"), f.get("jitter")
+        if op == "delay":
+            if not (isinstance(s, (int, float)) and s >= 0):
+                bad("'delay' needs 's' >= 0")
+            if jitter is not None and not (isinstance(jitter, (int, float))
+                                           and jitter >= 0):
+                bad("'jitter' must be >= 0")
+        off = f.get("offset_s")
+        if op == "skew" and not isinstance(off, (int, float)):
+            bad("'skew' needs 'offset_s'")
+        if op == "pause" and dur is None:
+            bad("'pause' needs 'for' (how long the process stalls)")
+
+        return Fault(at=float(at), op=op, node=node,
+                     frac=None if frac is None else float(frac),
+                     count=count,
+                     duration=None if dur is None else float(dur),
+                     split=None if split is None else float(split),
+                     groups=groups, rate=None if rate is None else float(rate),
+                     s=None if s is None else float(s),
+                     jitter=None if jitter is None else float(jitter),
+                     offset_s=None if off is None else float(off))
+
+    def end_time(self) -> float:
+        """When the last fault (including its 'for' window) is over."""
+        t = 0.0
+        for f in self.faults:
+            t = max(t, f.at + (f.duration or 0.0))
+        return t
+
+    @classmethod
+    def random_soak(cls, n_nodes: int, duration_s: float,
+                    rng) -> "FaultPlan":
+        """A seeded random schedule for `slt chaos soak`: kills with later
+        restarts, short partitions, straggler pauses — paced so the
+        membership has room to reconverge between injections."""
+        faults: List[dict] = []
+        t = rng.uniform(2.0, 4.0)
+        while t < duration_s * 0.7:
+            roll = rng.random()
+            if roll < 0.4:
+                faults.append({"at": round(t, 3), "op": "kill",
+                               "count": max(1, int(n_nodes * 0.1))})
+                faults.append({"at": round(t + rng.uniform(
+                    duration_s * 0.1, duration_s * 0.2), 3),
+                    "op": "restart", "count": max(1, int(n_nodes * 0.1))})
+            elif roll < 0.7:
+                faults.append({"at": round(t, 3), "op": "partition",
+                               "split": rng.uniform(0.2, 0.5),
+                               "for": round(rng.uniform(
+                                   2.0, duration_s * 0.15), 3)})
+            else:
+                faults.append({"at": round(t, 3), "op": "pause",
+                               "count": 1,
+                               "for": round(rng.uniform(1.0, 4.0), 3)})
+            t += rng.uniform(duration_s * 0.15, duration_s * 0.3)
+        return cls.from_obj({"faults": faults})

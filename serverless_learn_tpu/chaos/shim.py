@@ -1,0 +1,172 @@
+"""TCP chaos proxy: fault injection for REAL control/data-plane sockets.
+
+The simulator (``chaos/sim.py``) exercises the gossip protocol at scale;
+this shim exercises the *transport hardening* (``control/client.py``
+backoff, deadlines, circuit breaker, reconnect-on-timeout) against live
+daemons. Park a :class:`TcpChaosProxy` between a client and a
+(py-)daemon and flip fault modes at runtime:
+
+    proxy = TcpChaosProxy(upstream=coordinator.addr).start()
+    client = CoordinatorClient(proxy.addr)
+    ...
+    proxy.set_fault("blackhole")       # packets vanish both ways
+    proxy.set_fault("stall")           # connections freeze mid-stream
+    proxy.set_fault("reset")           # every connection RSTs
+    proxy.set_fault(None)              # heal
+    proxy.set_fault("stall", direction="up")    # asymmetric: requests
+                                                # stall, replies flow
+
+Modes apply to NEW and IN-FLIGHT connections (a stall freezes currently
+open streams too — exactly the mid-stream timeout the round-11 satellite
+regression-tests). ``delay_s`` adds per-chunk latency while healthy.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import List, Optional
+
+_MODES = (None, "blackhole", "stall", "reset")
+
+
+class TcpChaosProxy:
+    """One listening socket forwarding to ``upstream``; per-direction
+    fault modes."""
+
+    def __init__(self, upstream: str, listen_host: str = "127.0.0.1",
+                 listen_port: int = 0, delay_s: float = 0.0):
+        self.upstream = upstream
+        self.delay_s = delay_s
+        self._mode: Optional[str] = None
+        self._direction = "both"  # "up" (client->daemon) | "down" | "both"
+        self._mode_lock = threading.Lock()
+        self._conns: List[socket.socket] = []
+        self._conns_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((listen_host, listen_port))
+        self._sock.listen(64)
+        self.addr = "%s:%d" % self._sock.getsockname()[:2]
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {"connections": 0, "bytes_up": 0, "bytes_down": 0,
+                      "reset": 0, "blackholed": 0}
+
+    # -- control -------------------------------------------------------------
+
+    def set_fault(self, mode: Optional[str], direction: str = "both"):
+        if mode not in _MODES:
+            raise ValueError(f"unknown fault mode {mode!r}; one of {_MODES}")
+        if direction not in ("up", "down", "both"):
+            raise ValueError(f"bad direction {direction!r}")
+        with self._mode_lock:
+            self._mode = mode
+            self._direction = direction
+        if mode == "reset":
+            self._kill_conns()
+
+    def _faulted(self, direction: str) -> Optional[str]:
+        with self._mode_lock:
+            if self._mode is None:
+                return None
+            if self._direction in ("both", direction):
+                return self._mode
+            return None
+
+    def _kill_conns(self):
+        with self._conns_lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             b"\x01\x00\x00\x00\x00\x00\x00\x00")
+                c.close()
+            except OSError:
+                pass
+            self.stats["reset"] += 1
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "TcpChaosProxy":
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="chaos-proxy")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._kill_conns()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _accept_loop(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                client, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            self.stats["connections"] += 1
+            if self._faulted("up") == "reset":
+                client.close()
+                continue
+            try:
+                host, port = self.upstream.rsplit(":", 1)
+                server = socket.create_connection((host, int(port)),
+                                                  timeout=5)
+            except OSError:
+                client.close()
+                continue
+            with self._conns_lock:
+                self._conns += [client, server]
+            threading.Thread(target=self._pump, daemon=True,
+                             args=(client, server, "up")).start()
+            threading.Thread(target=self._pump, daemon=True,
+                             args=(server, client, "down")).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket, direction: str):
+        key = f"bytes_{direction}"
+        try:
+            src.settimeout(0.2)
+            while not self._stop.is_set():
+                mode = self._faulted(direction)
+                if mode == "stall":
+                    time.sleep(0.05)  # freeze the stream, keep it open
+                    continue
+                try:
+                    data = src.recv(64 * 1024)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                if not data:
+                    break
+                mode = self._faulted(direction)
+                if mode == "blackhole":
+                    self.stats["blackholed"] += len(data)
+                    continue  # swallow silently, connection stays up
+                if mode == "stall":
+                    # arrived exactly as the stall landed: hold it
+                    while (self._faulted(direction) == "stall"
+                           and not self._stop.is_set()):
+                        time.sleep(0.05)
+                if self.delay_s:
+                    time.sleep(self.delay_s)
+                self.stats[key] += len(data)
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.close()
+                except OSError:
+                    pass

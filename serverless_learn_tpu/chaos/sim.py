@@ -1,0 +1,548 @@
+"""Deterministic chaos simulator: hundreds of gossip members, virtual time.
+
+We cannot rent a 1000-chip pod to kill 30% of it, so this runs the REAL
+membership protocol (``control/gossip.GossipNode`` — the same code a live
+cluster runs, not a model of it) over a simulated network:
+
+* **virtual time** — a single event heap; no sleeps, no wall-clock reads,
+  so a 2-minute soak of 100 nodes takes ~a second of CPU and two runs with
+  the same (plan, seed) are byte-identical;
+* **seeded faults** — a :class:`~serverless_learn_tpu.chaos.plan.FaultPlan`
+  applied at virtual times: kills, restarts, partitions, link drop/delay,
+  pause-the-process stragglers, clock skew;
+* **a training-progress model** — a quorum-gated DiLoCo-style outer loop
+  (leader = min live id in the leader's own gossip view; a round commits
+  ``inner_steps`` when a quorum of the leader's view is reachable, else the
+  safe-pause policy skips it). The committed step is asserted MONOTONE —
+  the "no lost training progress" invariant;
+* **telemetry out** — JSONL event records in the exact shape the health
+  engine emits (``{"event": "alert", ...}``), so ``slt doctor`` can name
+  every injected incident from telemetry alone, plus ``fault_injected``
+  ground-truth records for the harness itself.
+
+Convergence invariants checked by :meth:`ChaosSim.run`:
+
+* after the last fault heals, every live member's view agrees with the
+  true live set within ``convergence_bound_periods()`` protocol periods;
+* a killed node is detected (suspected, then declared dead cluster-wide)
+  in O(log N) periods;
+* committed training progress never moves backwards and resumes after
+  quorum returns.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import math
+import random
+import time as _walltime
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from serverless_learn_tpu.chaos.plan import Fault, FaultPlan
+from serverless_learn_tpu.control.gossip import GossipConfig, GossipNode
+
+# Deterministic base for "unix" timestamps in emitted telemetry: virtual
+# second v maps to SIM_EPOCH + v. Doctor only needs self-consistent times.
+SIM_EPOCH = 1_700_000_000.0
+
+
+@dataclass
+class _SimHost:
+    node: GossipNode
+    alive: bool = True
+    paused_until: float = -1.0
+    skew_s: float = 0.0
+    mailbox: List[bytes] = field(default_factory=list)  # queued while paused
+
+
+class ChaosSim:
+    """One seeded simulation run. ``node-0`` seeds the cluster (every
+    joiner's first ping goes there), mirroring the coordinator-as-seed
+    bootstrap of the live plane."""
+
+    def __init__(self, n_nodes: int, seed: int = 0,
+                 plan: Optional[FaultPlan] = None,
+                 gossip: Optional[GossipConfig] = None,
+                 events_log: Optional[str] = None,
+                 base_delay_s: float = 0.01,
+                 round_s: float = 2.0, inner_steps: int = 8,
+                 quorum_fraction: float = 0.5):
+        self.n = n_nodes
+        self.seed = seed
+        self.plan = plan or FaultPlan()
+        self.cfg = gossip or GossipConfig(
+            protocol_period_s=0.5, ping_timeout_s=0.15)
+        self.events_log = events_log
+        # String seeds hash deterministically (sha512 path) across
+        # processes; tuple seeds would fall back to randomized hash().
+        self.rng = random.Random(f"chaos-{seed}")
+        self.base_delay_s = base_delay_s
+        self.round_s = round_s
+        self.inner_steps = inner_steps
+        self.quorum_fraction = quorum_fraction
+
+        self.now = 0.0
+        self._heap: list = []
+        self._heap_seq = 0
+        self.hosts: Dict[str, _SimHost] = {}
+        self._groups: Optional[List[Set[str]]] = None  # active partition
+        self._drop_rate = 0.0
+        self._extra_delay = 0.0
+        self._extra_jitter = 0.0
+        self._events_buf: List[dict] = []
+        self._alert_state: Dict[tuple, dict] = {}
+        self.injected: List[dict] = []
+        self.violations: List[str] = []
+        self.last_fault_t = 0.0
+        self.detection: Dict[str, dict] = {}  # killed id -> times
+        # training model
+        self.committed_step = 0
+        self.paused_rounds = 0
+        self.completed_rounds = 0
+        self._step_history: List[tuple] = []
+
+        for i in range(n_nodes):
+            nid = self._nid(i)
+            node = GossipNode(
+                nid, f"sim://{nid}", self.cfg,
+                rng=random.Random(f"node-{seed}-{nid}"),
+                meta={"worker_id": i, "n_chips": 1},
+                on_change=self._make_observer(nid))
+            self.hosts[nid] = _SimHost(node)
+
+    @staticmethod
+    def _nid(i: int) -> str:
+        return f"node-{i}"
+
+    # -- event loop ----------------------------------------------------------
+
+    def _push(self, t: float, fn, *args):
+        self._heap_seq += 1
+        heapq.heappush(self._heap, (t, self._heap_seq, fn, args))
+
+    def _local_now(self, host: _SimHost) -> float:
+        return self.now + host.skew_s
+
+    def _send_all(self, outs):
+        for addr, payload in outs:
+            self._route(addr, payload)
+
+    def _route(self, addr: str, payload: bytes):
+        dst = addr[len("sim://"):] if addr.startswith("sim://") else addr
+        host = self.hosts.get(dst)
+        if host is None:
+            return
+        delay = self.base_delay_s + self._extra_delay
+        if self._extra_jitter:
+            delay += self.rng.uniform(0, self._extra_jitter)
+        self._push(self.now + delay, self._deliver, dst, payload)
+
+    def _reachable(self, a: str, b: str) -> bool:
+        if self._groups is None:
+            return True
+        ga = next((i for i, g in enumerate(self._groups) if a in g), None)
+        gb = next((i for i, g in enumerate(self._groups) if b in g), None)
+        return ga == gb  # unlisted nodes (None) only reach each other
+
+    def _deliver(self, dst: str, payload: bytes):
+        host = self.hosts[dst]
+        if not host.alive:
+            return
+        src = self._peek_sender(payload)
+        if src is not None and not self._reachable(src, dst):
+            return
+        if self._drop_rate and self.rng.random() < self._drop_rate:
+            return
+        if host.paused_until > self.now:
+            # a paused process's kernel still queues datagrams (bounded)
+            if len(host.mailbox) < 256:
+                host.mailbox.append(payload)
+            return
+        self._send_all(host.node.on_message(payload,
+                                            self._local_now(host)))
+
+    @staticmethod
+    def _peek_sender(payload: bytes) -> Optional[str]:
+        # Partition semantics need the SENDER; decode minimally.
+        try:
+            return json.loads(payload.decode())["from"]
+        except Exception:
+            return None
+
+    def _tick(self, nid: str):
+        host = self.hosts[nid]
+        if not host.alive:
+            return
+        if host.paused_until > self.now:
+            self._push(host.paused_until, self._tick, nid)
+            return
+        if host.mailbox:  # drain messages queued during a pause
+            queued, host.mailbox = host.mailbox, []
+            for payload in queued:
+                self._send_all(host.node.on_message(
+                    payload, self._local_now(host)))
+        self._send_all(host.node.tick(self._local_now(host)))
+        self._push(self.now + self.cfg.ping_timeout_s / 2.0,
+                   self._tick, nid)
+
+    # -- faults --------------------------------------------------------------
+
+    def _select(self, f: Fault, pool: List[str]) -> List[str]:
+        if f.node is not None:
+            return [f.node] if f.node in pool else []
+        pool = sorted(pool)
+        k = (f.count if f.count is not None
+             else max(1, round((f.frac or 0.0) * len(pool))))
+        k = min(k, len(pool))
+        return self.rng.sample(pool, k) if k else []
+
+    def _apply_fault(self, f: Fault):
+        alive = [nid for nid, h in self.hosts.items() if h.alive]
+        dead = [nid for nid, h in self.hosts.items() if not h.alive]
+        targets: List[str] = []
+        if f.op == "kill":
+            targets = self._select(f, alive)
+            for nid in targets:
+                self.hosts[nid].alive = False
+                self.detection[nid] = {"killed_at": self.now,
+                                       "detected_at": None}
+            if f.duration:
+                self._push(self.now + f.duration, self._apply_fault,
+                           Fault(at=self.now + f.duration, op="restart",
+                                 groups=tuple(targets) or None,
+                                 node=None if len(targets) != 1
+                                 else targets[0]))
+        elif f.op == "restart":
+            pool = list(f.groups) if f.groups else dead
+            targets = (self._select(f, pool) if (f.node or f.frac or f.count)
+                       else pool)
+            for nid in targets:
+                self._restart(nid)
+        elif f.op == "partition":
+            if f.groups:
+                self._groups = [set(g) for g in f.groups]
+                targets = [n for g in f.groups for n in g]
+            else:
+                pool = sorted(alive)
+                self.rng.shuffle(pool)
+                cut = max(1, min(len(pool) - 1,
+                                 round((f.split or 0.5) * len(pool))))
+                self._groups = [set(pool[:cut]), set(pool[cut:])]
+                targets = pool
+            if f.duration:
+                self._push(self.now + f.duration, self._apply_fault,
+                           Fault(at=self.now + f.duration, op="heal"))
+        elif f.op == "heal":
+            self._groups = None
+            self._drop_rate = 0.0
+            self._extra_delay = 0.0
+            self._extra_jitter = 0.0
+        elif f.op == "drop":
+            self._drop_rate = f.rate or 0.0
+            if f.duration:
+                self._push(self.now + f.duration, self._apply_fault,
+                           Fault(at=self.now + f.duration, op="drop",
+                                 rate=0.0))
+        elif f.op == "delay":
+            self._extra_delay = f.s or 0.0
+            self._extra_jitter = f.jitter or 0.0
+        elif f.op == "pause":
+            targets = self._select(f, alive)
+            for nid in targets:
+                self.hosts[nid].paused_until = self.now + (f.duration or 0)
+        elif f.op == "skew":
+            targets = self._select(f, alive)
+            for nid in targets:
+                self.hosts[nid].skew_s = f.offset_s or 0.0
+        self.last_fault_t = max(self.last_fault_t,
+                                self.now + (0.0 if f.op == "heal"
+                                            else (f.duration or 0.0)))
+        rec = {"event": "fault_injected", "op": f.op,
+               "t_virtual_s": round(self.now, 3),
+               "t_unix_s": round(SIM_EPOCH + self.now, 3)}
+        if targets:
+            rec["nodes"] = sorted(targets)
+        if f.op == "partition" and self._groups is not None:
+            rec["group_sizes"] = [len(g) for g in self._groups]
+        self.injected.append(rec)
+        self._emit(rec)
+
+    def _restart(self, nid: str):
+        host = self.hosts[nid]
+        i = int(nid.split("-")[1])
+        host.node = GossipNode(
+            nid, f"sim://{nid}", self.cfg,
+            rng=random.Random(f"node-{self.seed}-{nid}-r{self.now:.3f}"),
+            meta={"worker_id": i, "n_chips": 1},
+            on_change=self._make_observer(nid))
+        host.alive = True
+        host.paused_until = -1.0
+        host.mailbox = []
+        seeds = [f"sim://{s}" for s, h in sorted(self.hosts.items())
+                 if h.alive and s != nid][:2]
+        self._send_all(host.node.join(seeds, self._local_now(host)))
+        self._push(self.now + self.cfg.ping_timeout_s / 2.0,
+                   self._tick, nid)
+        self.detection.pop(nid, None)
+
+    # -- telemetry -----------------------------------------------------------
+
+    def _make_observer(self, observer: str):
+        def on_change(state: str, member):
+            self._observe(observer, state, member)
+        return on_change
+
+    def _observe(self, observer: str, state: str, member):
+        subject = member.node_id
+        if state == "dead":
+            det = self.detection.get(subject)
+            if det is not None and det["detected_at"] is None:
+                det["detected_at"] = self.now
+                det["suspected_first"] = det.get("suspected_first")
+            self._alert(("dead", subject), firing=True, severity="critical",
+                        alert="gossip_member_dead", node=subject,
+                        message=f"{subject} declared dead by gossip "
+                                f"(inc {member.incarnation}, "
+                                f"first observer {observer})")
+        elif state == "suspect":
+            det = self.detection.get(subject)
+            if det is not None and det.get("suspected_first") is None:
+                det["suspected_first"] = self.now
+            self._alert(("suspect", subject), firing=True,
+                        severity="warning", alert="gossip_member_suspect",
+                        node=subject,
+                        message=f"{subject} suspected by {observer} "
+                                f"(awaiting refutation)")
+            self._maybe_partition_alert(observer)
+        elif state == "refute":
+            self._alert(("suspect", subject), firing=False,
+                        severity="warning", alert="gossip_member_suspect",
+                        node=subject,
+                        message=f"{subject} refuted suspicion "
+                                f"(inc {member.incarnation})")
+            self._maybe_partition_resolve(observer)
+        elif state == "alive":
+            self._alert(("dead", subject), firing=False,
+                        severity="critical", alert="gossip_member_dead",
+                        node=subject,
+                        message=f"{subject} rejoined "
+                                f"(inc {member.incarnation})")
+            self._maybe_partition_resolve(observer)
+
+    def _maybe_partition_alert(self, observer: str):
+        host = self.hosts.get(observer)
+        if host is None or not host.alive:
+            return
+        node = host.node
+        n_suspect = len(node.suspect_ids())
+        n_live = len(node.alive_ids())
+        if n_live >= 4 and n_suspect >= max(2, 0.25 * n_live):
+            self._alert(("partition", observer), firing=True,
+                        severity="critical",
+                        alert="gossip_partition_suspected", node=observer,
+                        message=f"{observer} suspects {n_suspect} of "
+                                f"{n_live} members at once — likely a "
+                                f"network partition, not {n_suspect} "
+                                f"simultaneous crashes")
+
+    def _maybe_partition_resolve(self, observer: str):
+        host = self.hosts.get(observer)
+        if host is None:
+            return
+        node = host.node
+        n_suspect = len(node.suspect_ids())
+        n_live = len(node.alive_ids())
+        if n_live == 0 or n_suspect < max(2, 0.25 * n_live):
+            self._alert(("partition", observer), firing=False,
+                        severity="critical",
+                        alert="gossip_partition_suspected", node=observer,
+                        message=f"{observer}'s mass suspicion cleared")
+
+    def _alert(self, key: tuple, firing: bool, **fields):
+        """Health-engine-shaped alert lifecycle records, deduped by key."""
+        cur = self._alert_state.get(key)
+        t = round(SIM_EPOCH + self.now, 3)
+        if firing:
+            if cur is not None and cur["state"] == "firing":
+                cur["count"] += 1
+                cur["last_fired_unix_s"] = t
+                return  # refires are folded; doctor reads the final record
+            rec = {"event": "alert", "state": "firing", "detector": "gossip",
+                   "count": 1, "first_fired_unix_s": t,
+                   "last_fired_unix_s": t, "value": 1.0, "threshold": 0.0,
+                   **fields}
+            self._alert_state[key] = rec
+            self._emit(dict(rec))
+        else:
+            if cur is None or cur["state"] == "resolved":
+                return
+            cur["state"] = "resolved"
+            cur["resolved_unix_s"] = t
+            cur.update({k: v for k, v in fields.items() if k == "message"})
+            self._emit(dict(cur))
+
+    def _emit(self, rec: dict):
+        if self.events_log:
+            self._events_buf.append(rec)
+
+    def _flush_events(self):
+        if not self.events_log or not self._events_buf:
+            return
+        with open(self.events_log, "a") as f:
+            for rec in self._events_buf:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._events_buf = []
+
+    # -- training-progress model ---------------------------------------------
+
+    def _training_round(self):
+        live = {nid for nid, h in self.hosts.items()
+                if h.alive and h.paused_until <= self.now}
+        if live:
+            leader = min(live)
+            view = set(self.hosts[leader].node.alive_ids()) & live
+            participants = {nid for nid in view
+                            if self._reachable(leader, nid)}
+            need = max(1, math.ceil(self.quorum_fraction * len(view)))
+            if len(participants) >= need:
+                self.committed_step += self.inner_steps
+                self.completed_rounds += 1
+            else:
+                self.paused_rounds += 1
+                self._emit({"event": "training_safe_pause",
+                            "leader": leader,
+                            "participants": len(participants),
+                            "needed": need,
+                            "t_unix_s": round(SIM_EPOCH + self.now, 3)})
+        self._step_history.append((self.now, self.committed_step))
+        self._push(self.now + self.round_s, self._training_round)
+
+    # -- invariants ----------------------------------------------------------
+
+    def _true_live(self) -> List[str]:
+        return sorted(nid for nid, h in self.hosts.items() if h.alive)
+
+    def membership_converged(self) -> bool:
+        want = self._true_live()
+        for nid in want:
+            h = self.hosts[nid]
+            if h.paused_until > self.now:
+                return False
+            if h.node.alive_ids() != want:
+                return False
+        return True
+
+    def convergence_bound_periods(self) -> float:
+        """Budget for full re-agreement after the last fault: detection
+        (probe selection + the suspicion timeout), O(log N) dissemination,
+        and — after a partition that produced false deaths on both sides —
+        the dead-reclaim probe + refutation + re-spread cycle. Every term
+        is O(log N) protocol periods."""
+        log_n = math.ceil(math.log2(self.n + 1))
+        return (6 + (self.cfg.suspicion_mult + 5.0) * log_n)
+
+    # -- run -----------------------------------------------------------------
+
+    def run(self, duration_s: Optional[float] = None) -> dict:
+        wall0 = _walltime.perf_counter()
+        bound_s = self.convergence_bound_periods() * self.cfg.protocol_period_s
+        duration = duration_s or (self.plan.end_time() + 2 * bound_s
+                                  + 5 * self.round_s)
+        # bootstrap: everyone joins via node-0 at t in [0, one period)
+        for i, (nid, host) in enumerate(sorted(self.hosts.items())):
+            jitter = self.rng.uniform(0, self.cfg.protocol_period_s)
+            if i > 0:
+                self._push(jitter, self._join_initial, nid)
+            self._push(jitter + 0.001, self._tick, nid)
+        self._push(self.round_s, self._training_round)
+        for f in self.plan.faults:
+            self._push(f.at, self._apply_fault, f)
+
+        self._converged_at: Optional[float] = None
+        self._prev_committed = 0
+        # Convergence sampled once per protocol period (a per-event check
+        # would be O(N^2) per message at 100 nodes).
+        self._push(self.cfg.protocol_period_s, self._check_invariants)
+        while self._heap and self.now <= duration:
+            t, _, fn, args = heapq.heappop(self._heap)
+            self.now = t
+            fn(*args)
+        self._flush_events()
+
+        report = self._report(self._converged_at, duration)
+        report["wall_time_s"] = round(_walltime.perf_counter() - wall0, 3)
+        return report
+
+    def _check_invariants(self):
+        if self.committed_step < self._prev_committed:
+            self.violations.append(
+                f"training progress moved backwards at t={self.now:.2f}")
+        self._prev_committed = self.committed_step
+        if self.now <= self.last_fault_t:
+            self._converged_at = None  # a later fault invalidated it
+        elif self._converged_at is None and self.membership_converged():
+            self._converged_at = self.now
+        self._push(self.now + self.cfg.protocol_period_s,
+                   self._check_invariants)
+
+    def _join_initial(self, nid: str):
+        host = self.hosts[nid]
+        if host.alive:
+            self._send_all(host.node.join(["sim://node-0"],
+                                          self._local_now(host)))
+
+    def _report(self, converged_at: Optional[float],
+                duration: float) -> dict:
+        period = self.cfg.protocol_period_s
+        bound = self.convergence_bound_periods()
+        if converged_at is None and not self.membership_converged():
+            self.violations.append(
+                f"membership did not re-converge within {duration:.1f}s "
+                f"of virtual time (last fault at {self.last_fault_t:.1f}s)")
+        diss_periods = (None if converged_at is None
+                        else (converged_at - self.last_fault_t) / period)
+        if diss_periods is not None and diss_periods > bound:
+            self.violations.append(
+                f"re-convergence took {diss_periods:.1f} periods "
+                f"(bound {bound:.1f})")
+        detection = {}
+        for nid, det in self.detection.items():
+            if self.hosts[nid].alive:
+                continue
+            if det["detected_at"] is None:
+                self.violations.append(f"killed {nid} never declared dead")
+                detection[nid] = None
+            else:
+                detection[nid] = round(
+                    (det["detected_at"] - det["killed_at"]) / period, 2)
+        # training progress must resume after the last fault window
+        post_fault = [s for t, s in self._step_history
+                      if t > self.last_fault_t]
+        if (self._step_history and post_fault
+                and self.last_fault_t > 0
+                and max(post_fault) <= min(post_fault)
+                and len(post_fault) >= 3):
+            self.violations.append(
+                "training made no progress after the final fault healed")
+        return {
+            "nodes": self.n, "seed": self.seed,
+            "duration_virtual_s": round(min(self.now, duration), 2),
+            "protocol_period_s": period,
+            "faults_injected": self.injected,
+            "killed_live": sorted(nid for nid, h in self.hosts.items()
+                                  if not h.alive),
+            "converged": not any("converge" in v for v in self.violations),
+            "converged_at_virtual_s": (None if converged_at is None
+                                       else round(converged_at, 2)),
+            "dissemination_periods": (None if diss_periods is None
+                                      else round(diss_periods, 1)),
+            "convergence_bound_periods": round(bound, 1),
+            "detection_periods": detection,
+            "training": {"committed_step": self.committed_step,
+                         "completed_rounds": self.completed_rounds,
+                         "safe_paused_rounds": self.paused_rounds},
+            "violations": list(self.violations),
+            "ok": not self.violations,
+        }

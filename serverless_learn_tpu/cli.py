@@ -22,6 +22,7 @@ Here one typed CLI fronts everything:
     python -m serverless_learn_tpu profile      # trigger a device-trace capture
     python -m serverless_learn_tpu bench        # perf regression gate (--gate)
     python -m serverless_learn_tpu check        # project-aware static analysis
+    python -m serverless_learn_tpu chaos        # fault-injection chaos harness
     python -m serverless_learn_tpu models       # list registered model families
 
 Every long-running command takes ``--metrics-port N`` to expose a
@@ -788,6 +789,15 @@ def cmd_coordinator(args) -> int:
         argv += ["--state_file", args.state_file]
     if args.events_log:
         argv += ["--events_log", args.events_log]
+    if args.gossip or args.gossip_port is not None:
+        # SWIM gossip seed (round 11): python-daemon only — the native
+        # coordinator predates the gossip plane.
+        argv += ["--gossip_port", str(args.gossip_port
+                                      if args.gossip_port is not None
+                                      else args.port + 1)]
+        from serverless_learn_tpu.control.py_daemons import main_coordinator
+
+        return main_coordinator(argv)
     if native_daemon_usable("coordinator"):
         return _exec_daemon("coordinator", argv)
     # Committed binaries can't run in this image (glibc/libprotobuf
@@ -1102,6 +1112,52 @@ def cmd_check(args) -> int:
     return 0 if rep["ok"] else 1
 
 
+def cmd_chaos(args) -> int:
+    """Deterministic chaos harness over the SWIM gossip membership
+    (chaos/sim.py): `run` executes a FaultPlan (kills, restarts,
+    partitions, stragglers, skew) against N simulated members on virtual
+    time; `soak` generates a seeded random schedule. Exit 0 iff every
+    convergence/progress invariant held. Deliberately jax-free — a
+    2-minute 50-node soak runs in seconds on a CPU-only CI node."""
+    from serverless_learn_tpu.chaos.plan import FaultPlan
+    from serverless_learn_tpu.chaos.sim import ChaosSim
+    from serverless_learn_tpu.control.gossip import GossipConfig
+
+    gossip = GossipConfig(
+        protocol_period_s=args.period_ms / 1000.0,
+        ping_timeout_s=args.period_ms / 1000.0 * 0.3)
+    if args.mode == "run":
+        if not args.plan:
+            print("chaos run needs --plan FILE.json (see chaos/plan.py "
+                  "for the DSL)", file=sys.stderr)
+            return 2
+        try:
+            with open(args.plan) as f:
+                plan = FaultPlan.from_json(f.read())
+        except (OSError, ValueError) as e:
+            print(f"bad fault plan: {e}", file=sys.stderr)
+            return 2
+    else:  # soak
+        import random as random_mod
+
+        plan = FaultPlan.random_soak(
+            args.nodes, args.duration or 120.0,
+            random_mod.Random(f"soak-{args.seed}"))
+    sim = ChaosSim(args.nodes, seed=args.seed, plan=plan,
+                   gossip=gossip, events_log=args.events_log)
+    rep = sim.run(args.duration)
+    if not args.full:
+        rep = dict(rep)
+        rep["faults_injected"] = len(rep["faults_injected"])
+        det = [v for v in rep["detection_periods"].values()
+               if v is not None]
+        rep["detection_periods"] = {
+            "n": len(rep["detection_periods"]),
+            "max": max(det) if det else None}
+    print(json.dumps(rep, indent=None if args.compact else 2))
+    return 0 if rep["ok"] else 1
+
+
 def cmd_top(args) -> int:
     """Live cluster telemetry: poll /metrics endpoints, render one screen
     (per-worker throughput, inference latency percentiles, membership)."""
@@ -1220,6 +1276,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="append a JSONL server-side span per traced RPC "
                         "(requests carrying TraceContext) — one input of "
                         "`slt trace`")
+    c.add_argument("--gossip", action="store_true",
+                   help="run a SWIM gossip seed beside the RPC port "
+                        "(UDP, port+1 by default): liveness comes from "
+                        "gossip probes instead of O(N) lease heartbeats; "
+                        "workers opt in with membership.mode=gossip")
+    c.add_argument("--gossip-port", type=int, default=None,
+                   help="UDP port for the gossip seed (default: RPC "
+                        "port + 1; implies --gossip)")
     c.set_defaults(fn=cmd_coordinator)
 
     s = sub.add_parser("shard-server", help="run the data-plane daemon")
@@ -1429,6 +1493,35 @@ def build_parser() -> argparse.ArgumentParser:
                     help="rewrite the baseline from the current findings "
                          "(then hand-edit each justification)")
     ck.set_defaults(fn=cmd_check)
+
+    ch = sub.add_parser("chaos",
+                        help="fault-injection chaos harness: run a "
+                             "FaultPlan (or a seeded random soak) against "
+                             "N simulated gossip members on virtual time")
+    ch.add_argument("mode", choices=["run", "soak"],
+                    help="run: execute --plan; soak: seeded random "
+                         "schedule of kills/partitions/stragglers")
+    ch.add_argument("--plan", metavar="FILE.json",
+                    help="FaultPlan (chaos/plan.py DSL); required for run")
+    ch.add_argument("--nodes", type=int, default=50,
+                    help="simulated cluster size")
+    ch.add_argument("--seed", type=int, default=0,
+                    help="fault-resolution + protocol RNG seed; same "
+                         "(plan, seed) => identical run")
+    ch.add_argument("--duration", type=float, default=None,
+                    help="virtual seconds to simulate (default: plan end "
+                         "+ convergence budget; soak defaults to 120)")
+    ch.add_argument("--period-ms", type=float, default=500.0,
+                    help="gossip protocol period (virtual ms)")
+    ch.add_argument("--events-log", metavar="PATH", default=None,
+                    help="write health-engine-shaped alert + fault JSONL "
+                         "here — feed it to `slt doctor` to check the "
+                         "telemetry names every injected incident")
+    ch.add_argument("--full", action="store_true",
+                    help="full report (per-fault and per-node detail)")
+    ch.add_argument("--compact", action="store_true",
+                    help="single-line JSON (for scripts)")
+    ch.set_defaults(fn=cmd_chaos)
 
     tp = sub.add_parser("top", help="live cluster telemetry: poll /metrics "
                                     "endpoints, one-screen view")

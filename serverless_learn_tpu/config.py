@@ -214,6 +214,66 @@ class ControlConfig:
 
 
 @dataclass(frozen=True)
+class MembershipConfig:
+    """Membership plane selection + SWIM gossip tuning + degradation policy
+    (``control/gossip.py``, consumed by ``training/elastic.py`` and
+    ``training/elastic_multihost.py``).
+
+    ``mode`` selects how liveness is established:
+
+    * ``"master"`` — the classic path: every worker heartbeats the
+      coordinator on a timer and the coordinator sweeps lapsed leases.
+      O(N) fan-out from one process; fine at 16 nodes.
+    * ``"gossip"`` — SWIM-style probabilistic probing: each member pings
+      one random peer per protocol period, falls back to ``indirect_probes``
+      ping-req relays on timeout, and spreads state changes by piggybacking
+      them on the probe traffic. Failure detection is O(1) messages per
+      member per period and dissemination converges in O(log N) periods.
+      The coordinator stays as the registration/bootstrap directory and
+      lease heartbeats slow down to a fallback channel.
+
+    The degradation-policy fields apply in BOTH modes (elastic reads them):
+    they turn the implicit "any membership twitch → remesh" behavior into
+    explicit policy.
+    """
+
+    mode: str = "master"  # "master" | "gossip"
+    # Gossip wire plane. seed "" derives the coordinator's gossip address
+    # as <coordinator_host>:<coordinator_port + 1> (the py-coordinator's
+    # default when started with gossip enabled).
+    seed: str = ""
+    gossip_bind_host: str = "127.0.0.1"
+    gossip_port: int = 0                 # 0 = ephemeral
+    protocol_period_ms: int = 250        # one probe round per member
+    ping_timeout_ms: int = 80            # direct-ack wait before ping-req
+    indirect_probes: int = 3             # ping-req relays per failed probe
+    # A SUSPECT member is declared dead after
+    # suspicion_mult * ceil(log2(N + 1)) protocol periods without a
+    # refutation (incarnation bump from the accused).
+    suspicion_mult: float = 2.0
+    # Each membership update piggybacks on probe traffic until it has been
+    # sent retransmit_mult * ceil(log2(N + 1)) times.
+    retransmit_mult: float = 3.0
+    max_piggyback: int = 12              # updates per packet
+    # ---- graceful-degradation policy (elastic / DiLoCo) ----
+    # SUSPECT alone never triggers a remesh: keep training until the
+    # suspicion either refutes (no churn at all) or confirms dead.
+    train_through_suspicion: bool = True
+    # Membership changes must hold still this long before elastic acts on
+    # them — anti-flap hysteresis for asymmetric partitions where a member
+    # bounces (evict + instant re-register would otherwise remesh twice).
+    remesh_debounce_s: float = 0.0
+    # Safe-pause: when the live view drops below quorum_fraction of the
+    # largest world seen, stop stepping (and do NOT remesh down onto a
+    # minority island) until quorum returns or the run is stopped.
+    safe_pause: bool = False
+    quorum_fraction: float = 0.5
+    # DiLoCo: allow non-leaders to re-challenge a hung leader (the
+    # liveness escape); False pins leadership strictly to min-id.
+    leader_rechallenge: bool = True
+
+
+@dataclass(frozen=True)
 class HealthConfig:
     """Cluster-health engine knobs (``telemetry/health.py``).
 
@@ -276,6 +336,7 @@ class ExperimentConfig:
     control: ControlConfig = field(default_factory=ControlConfig)
     local_sgd: LocalSGDConfig = field(default_factory=LocalSGDConfig)
     health: HealthConfig = field(default_factory=HealthConfig)
+    membership: MembershipConfig = field(default_factory=MembershipConfig)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
@@ -302,6 +363,7 @@ class ExperimentConfig:
             control=build(ControlConfig, raw.get("control")),
             local_sgd=build(LocalSGDConfig, raw.get("local_sgd")),
             health=build(HealthConfig, raw.get("health")),
+            membership=build(MembershipConfig, raw.get("membership")),
         )
 
     def override(self, **kwargs: Any) -> "ExperimentConfig":

@@ -1,0 +1,788 @@
+"""SWIM-style gossip membership (the source paper's gossip plane, scaled).
+
+The reference gossiped *model weights* pairwise on a timer; its membership
+was a master that heartbeated every worker — O(N) fan-out from one process
+(``src/master.cc:43-60``), the ROADMAP's next scaling wall. This module
+reproduces the reference capability we had not yet rebuilt at scale:
+per-member O(1) probabilistic failure detection with O(log N) dissemination
+(SWIM: Das/Gupta/Motivala 2002, plus the standard Lifeguard-ish
+refinements), selected per run via ``config.MembershipConfig``:
+
+* **probe**: each protocol period a member pings ONE peer (round-robin over
+  a shuffled ring, so every peer is probed within N periods); on a missed
+  ack it asks ``indirect_probes`` random peers to ping-req the target —
+  distinguishing "target died" from "my link to the target died".
+* **suspicion + refutation**: a failed probe marks the target SUSPECT, not
+  dead. Suspicion carries the accused's *incarnation number*; the accused —
+  hearing its own suspicion piggybacked back to it — refutes by bumping its
+  incarnation and gossiping ALIVE. Only an unrefuted suspicion (after
+  ``suspicion_mult * ceil(log2(N+1))`` periods) becomes DEAD. This is what
+  keeps one slow link from evicting a healthy node (no remesh flapping).
+* **piggybacked dissemination**: membership updates ride on the ping/ack
+  traffic itself (no broadcast storms); each update retransmits
+  ``retransmit_mult * ceil(log2(N+1))`` times, preferring the
+  least-transmitted updates — epidemic spread reaches every member in
+  O(log N) periods with high probability.
+
+The core (:class:`GossipNode`) is **deterministic and transport-free**: it
+never reads a clock, opens a socket, or sleeps. Every entry point takes
+``now`` and *returns* the datagrams to send — so the chaos simulator
+(``chaos/sim.py``) can run hundreds of nodes on virtual time with a seeded
+RNG, byte-identical across runs, while :class:`UdpGossipRuntime` drives the
+same code over real UDP sockets for live clusters. Wire payloads are
+versioned JSON; anything malformed is counted and dropped, never raised
+(``slt_gossip_bad_payloads_total`` — a gossip daemon must survive any
+datagram the network hands it).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+import select
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+WIRE_VERSION = 1
+MAX_PACKET_BYTES = 60 * 1024  # stay under a UDP datagram
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+DEAD = "dead"
+LEFT = "left"
+
+# Update precedence (SWIM §4.2): for equal incarnations suspicion beats
+# alive, death beats both; higher incarnations beat lower ones entirely.
+_STATE_RANK = {ALIVE: 0, SUSPECT: 1, DEAD: 2, LEFT: 2}
+
+
+@dataclass
+class GossipConfig:
+    """Tuning knobs, pre-converted to seconds (``config.MembershipConfig``
+    carries the ms-based user-facing fields)."""
+
+    protocol_period_s: float = 0.25
+    ping_timeout_s: float = 0.08
+    indirect_probes: int = 3
+    suspicion_mult: float = 2.0
+    retransmit_mult: float = 3.0
+    max_piggyback: int = 12
+
+    @classmethod
+    def from_membership(cls, m) -> "GossipConfig":
+        return cls(protocol_period_s=m.protocol_period_ms / 1000.0,
+                   ping_timeout_s=m.ping_timeout_ms / 1000.0,
+                   indirect_probes=m.indirect_probes,
+                   suspicion_mult=m.suspicion_mult,
+                   retransmit_mult=m.retransmit_mult,
+                   max_piggyback=m.max_piggyback)
+
+
+@dataclass
+class Member:
+    """One peer as this node believes it to be."""
+
+    node_id: str
+    addr: str
+    incarnation: int = 0
+    state: str = ALIVE
+    since: float = 0.0           # when the current state was adopted
+    deadline: float = 0.0        # SUSPECT only: when it becomes DEAD
+    meta: dict = field(default_factory=dict)
+
+
+def _metrics():
+    """(bad_payloads, stale_updates, suspicions, refutations) counters —
+    resolved lazily so importing this module costs nothing."""
+    from serverless_learn_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    return (reg.counter("slt_gossip_bad_payloads_total",
+                        "malformed/oversized gossip datagrams dropped"),
+            reg.counter("slt_gossip_stale_updates_total",
+                        "piggybacked updates ignored as stale "
+                        "(old incarnation replays included)"),
+            reg.counter("slt_gossip_suspicions_total",
+                        "members this node marked SUSPECT"),
+            reg.counter("slt_gossip_refutations_total",
+                        "suspicions dropped because the accused refuted"))
+
+
+def decode_payload(data: bytes) -> Optional[dict]:
+    """Parse one gossip datagram; None for anything malformed. This is the
+    fuzz boundary: arbitrary bytes must never raise past here."""
+    if not isinstance(data, (bytes, bytearray)) or len(data) > MAX_PACKET_BYTES:
+        return None
+    try:
+        msg = json.loads(data.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError):
+        return None
+    if not isinstance(msg, dict) or msg.get("v") != WIRE_VERSION:
+        return None
+    if not isinstance(msg.get("t"), str) or not isinstance(
+            msg.get("from"), str):
+        return None
+    if not isinstance(msg.get("fa"), str):
+        return None
+    seq = msg.get("seq", 0)
+    if not isinstance(seq, int) or isinstance(seq, bool):
+        return None
+    g = msg.get("g", [])
+    if not isinstance(g, list):
+        return None
+    updates = []
+    for u in g[:64]:
+        if not isinstance(u, dict):
+            continue
+        nid, addr = u.get("id"), u.get("a")
+        inc, state = u.get("i"), u.get("s")
+        meta = u.get("m", {})
+        if (isinstance(nid, str) and nid and isinstance(addr, str)
+                and isinstance(inc, int) and not isinstance(inc, bool)
+                and 0 <= inc < 2 ** 53
+                and state in _STATE_RANK and isinstance(meta, dict)):
+            updates.append({"id": nid, "a": addr, "i": inc, "s": state,
+                            "m": meta})
+        # silently skip malformed entries; the datagram-level counter
+        # below covers the fully-bogus case
+    msg["g"] = updates
+    return msg
+
+
+class GossipNode:
+    """One SWIM member. Deterministic: inject ``rng``; pass ``now`` to every
+    call; sends come back as ``[(addr, payload_bytes), ...]``.
+
+    Thread-safety: all public methods take an internal lock; the
+    ``on_change`` callback fires AFTER the lock is released (callbacks may
+    re-enter reads).
+    """
+
+    def __init__(self, node_id: str, addr: str, cfg: GossipConfig,
+                 rng: Optional[random.Random] = None,
+                 meta: Optional[dict] = None,
+                 on_change: Optional[Callable[[str, Member], None]] = None):
+        self.node_id = node_id
+        self.addr = addr
+        self.cfg = cfg
+        self.rng = rng or random.Random()
+        self.meta = dict(meta or {})
+        self.on_change = on_change
+        self.incarnation = 0
+        self.epoch = 0  # bumps on every confirmed membership change
+        self._lock = threading.Lock()
+        self._members: Dict[str, Member] = {}
+        self._next_period_at: Optional[float] = None
+        self._probe_ring: List[str] = []
+        self._seq = 0
+        # seq -> (target_id, direct_deadline, period_deadline, indirect_sent)
+        self._probes: Dict[int, list] = {}
+        # relayed ping-req acks: our seq -> (origin_addr, origin_seq)
+        self._relays: Dict[int, Tuple[str, int]] = {}
+        # update_key -> [update_dict, sends_remaining]; update_key is the
+        # subject node id (one in-flight update per subject — newest wins).
+        self._gossip_q: Dict[str, list] = {}
+        self._left = False
+        (self._m_bad, self._m_stale,
+         self._m_susp, self._m_refute) = _metrics()
+
+    # -- read API ------------------------------------------------------------
+
+    def members(self) -> Dict[str, Member]:
+        with self._lock:
+            return {k: Member(m.node_id, m.addr, m.incarnation, m.state,
+                              m.since, m.deadline, dict(m.meta))
+                    for k, m in self._members.items()}
+
+    def alive_ids(self, include_suspect: bool = True) -> List[str]:
+        """Live view (self included). SUSPECT members count as alive by
+        default — train-through-suspicion is the policy default."""
+        ok = (ALIVE, SUSPECT) if include_suspect else (ALIVE,)
+        with self._lock:
+            out = [self.node_id] if not self._left else []
+            out += [m.node_id for m in self._members.values()
+                    if m.state in ok]
+            return sorted(out)
+
+    def suspect_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(m.node_id for m in self._members.values()
+                          if m.state == SUSPECT)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def join(self, seed_addrs: List[str], now: float) -> List[Tuple[str, bytes]]:
+        """Announce ourselves to seed addresses (any alive member works)."""
+        with self._lock:
+            self._enqueue_update_locked(self._self_update_locked())
+            out = [(a, self._packet_locked("ping", self._next_seq_locked()))
+                   for a in seed_addrs if a != self.addr]
+        return out
+
+    def leave(self, now: float) -> List[Tuple[str, bytes]]:
+        """Graceful departure: gossip LEFT so peers skip the suspicion
+        dance entirely."""
+        with self._lock:
+            self._left = True
+            self.incarnation += 1
+            upd = {"id": self.node_id, "a": self.addr, "i": self.incarnation,
+                   "s": LEFT, "m": self.meta}
+            self._enqueue_update_locked(upd)
+            targets = [m.addr for m in self._members.values()
+                       if m.state in (ALIVE, SUSPECT)]
+            self.rng.shuffle(targets)
+            out = [(a, self._packet_locked("ping", self._next_seq_locked()))
+                   for a in targets[:max(3, self.cfg.indirect_probes)]]
+        return out
+
+    # -- wire in -------------------------------------------------------------
+
+    def on_message(self, data: bytes, now: float) -> List[Tuple[str, bytes]]:
+        msg = decode_payload(data)
+        if msg is None:
+            self._m_bad.inc()
+            return []
+        events: List[Tuple[str, Member]] = []
+        out: List[Tuple[str, bytes]] = []
+        with self._lock:
+            if self._left:
+                return []
+            # Piggybacked updates FIRST (the sender's own full update —
+            # incarnation + meta — always rides in g), then the implicit
+            # bare-identity join as a fallback for senders whose g was
+            # truncated. The other order would seed a meta-less member
+            # record that blocks the equal-incarnation real update.
+            for upd in msg["g"]:
+                self._absorb_locked(upd, now, events)
+            self._absorb_locked(
+                {"id": msg["from"], "a": msg["fa"],
+                 "i": 0, "s": ALIVE, "m": {}},
+                now, events, implicit=True)
+            # A message FROM a member we believe dead: a false death (the
+            # other side of a healed partition) or a restart. Its own
+            # alive(inc) loses to the obituary by precedence, so nudge it:
+            # re-enqueue the obituary — it rides our reply's piggyback,
+            # the accused sees it and refutes with a bumped incarnation.
+            # Without this, a falsely-dead member whose obituary exhausted
+            # its retransmit budget could stay dead forever.
+            ghost = self._members.get(msg["from"])
+            if ghost is not None and ghost.state in (DEAD, LEFT):
+                self._enqueue_update_locked(self._update_of_locked(ghost))
+            t = msg["t"]
+            if t == "ping":
+                fwd = msg.get("fwd")  # ping-req relay: reply routes back
+                out.append((msg["fa"],
+                            self._packet_locked("ack", msg["seq"],
+                                                fwd=fwd)))
+            elif t == "ack":
+                self._on_ack_locked(msg, now, out)
+            elif t == "ping-req":
+                tgt_addr = msg.get("ta")
+                tgt_id = msg.get("tid")
+                if isinstance(tgt_addr, str) and isinstance(tgt_id, str):
+                    seq = self._next_seq_locked()
+                    self._relays[seq] = (msg["fa"], msg["seq"])
+                    out.append((tgt_addr,
+                                self._packet_locked("ping", seq, fwd=True)))
+            # unknown message types: already counted structure-valid;
+            # ignore (forward-compat)
+        self._fire(events)
+        return out
+
+    def _on_ack_locked(self, msg: dict, now: float, out: list):
+        seq = msg["seq"]
+        if seq in self._relays:
+            # We were the ping-req mediator: relay the good news.
+            origin_addr, origin_seq = self._relays.pop(seq)
+            out.append((origin_addr,
+                        self._packet_locked("ack", origin_seq)))
+            return
+        probe = self._probes.pop(seq, None)
+        if probe is not None:
+            # Target answered (directly or via a relay): cancel suspicion
+            # for this probe cycle.
+            pass
+
+    # -- timers --------------------------------------------------------------
+
+    def tick(self, now: float) -> List[Tuple[str, bytes]]:
+        """Advance timers: start protocol periods, escalate failed probes
+        to ping-req, expire probe cycles into SUSPECT, expire suspicions
+        into DEAD. Returns datagrams to send."""
+        events: List[Tuple[str, Member]] = []
+        out: List[Tuple[str, bytes]] = []
+        with self._lock:
+            if self._left:
+                return []
+            if self._next_period_at is None:
+                self._next_period_at = now
+            # 1) escalate / expire in-flight probes
+            for seq in list(self._probes):
+                target_id, direct_dl, period_dl, indirect = self._probes[seq]
+                m = self._members.get(target_id)
+                if m is None or m.state != ALIVE:
+                    self._probes.pop(seq)
+                    continue
+                if not indirect and now >= direct_dl:
+                    self._probes[seq][3] = True
+                    helpers = [p for p in self._members.values()
+                               if p.state == ALIVE
+                               and p.node_id != target_id]
+                    self.rng.shuffle(helpers)
+                    for h in helpers[:self.cfg.indirect_probes]:
+                        out.append((h.addr, self._packet_locked(
+                            "ping-req", seq, ta=m.addr, tid=target_id)))
+                if now >= period_dl:
+                    self._probes.pop(seq)
+                    self._suspect_locked(m, now, events)
+            # 2) expire suspicions
+            for m in list(self._members.values()):
+                if m.state == SUSPECT and now >= m.deadline:
+                    self._transition_locked(m, DEAD, m.incarnation, now,
+                                            events)
+                    self._enqueue_update_locked(self._update_of_locked(m))
+            # 3) start a new protocol period
+            if now >= self._next_period_at:
+                self._next_period_at = now + self.cfg.protocol_period_s
+                target = self._next_probe_target_locked()
+                if target is not None:
+                    seq = self._next_seq_locked()
+                    self._probes[seq] = [
+                        target.node_id, now + self.cfg.ping_timeout_s,
+                        now + self.cfg.protocol_period_s, False]
+                    out.append((target.addr,
+                                self._packet_locked("ping", seq)))
+                # Dead-member reclaim probe: occasionally ping a member we
+                # believe dead, with its obituary attached. A false death
+                # (healed partition) refutes on the spot — without this,
+                # two sides that each declared the other dead would never
+                # probe across again and could stay split forever.
+                dead = [m for m in self._members.values()
+                        if m.state == DEAD]
+                if dead:
+                    # Reclaim rate scales with the dead fraction: after a
+                    # healed partition most of the "dead" are false, and a
+                    # fixed low rate would make recovery a slow coupon
+                    # collection over every obituary.
+                    p = min(0.5, max(0.15, len(dead) / self._n_locked()))
+                    if self.rng.random() < p:
+                        m = dead[int(self.rng.random() * len(dead))]
+                        out.append((m.addr, self._packet_locked(
+                            "ping", self._next_seq_locked(),
+                            gx=[self._update_of_locked(m)])))
+        self._fire(events)
+        return out
+
+    def next_due(self, now: float) -> float:
+        """Earliest time tick() has work — the runtime's sleep bound."""
+        with self._lock:
+            due = self._next_period_at if self._next_period_at is not None \
+                else now
+            for _, direct_dl, period_dl, indirect in self._probes.values():
+                due = min(due, period_dl if indirect else direct_dl)
+            for m in self._members.values():
+                if m.state == SUSPECT:
+                    due = min(due, m.deadline)
+            return due
+
+    # -- internals -----------------------------------------------------------
+
+    def _n_locked(self) -> int:
+        return 1 + sum(1 for m in self._members.values()
+                       if m.state in (ALIVE, SUSPECT))
+
+    def _log_n_locked(self) -> float:
+        return math.ceil(math.log2(self._n_locked() + 1))
+
+    def _suspicion_timeout_locked(self) -> float:
+        return (self.cfg.suspicion_mult * self._log_n_locked()
+                * self.cfg.protocol_period_s)
+
+    def _next_seq_locked(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def _next_probe_target_locked(self) -> Optional[Member]:
+        alive = [m for m in self._members.values() if m.state in
+                 (ALIVE, SUSPECT)]
+        if not alive:
+            return None
+        while True:
+            if not self._probe_ring:
+                ids = [m.node_id for m in alive]
+                self.rng.shuffle(ids)
+                self._probe_ring = ids
+            nid = self._probe_ring.pop()
+            m = self._members.get(nid)
+            if m is not None and m.state in (ALIVE, SUSPECT):
+                return m
+
+    def _self_update_locked(self) -> dict:
+        return {"id": self.node_id, "a": self.addr, "i": self.incarnation,
+                "s": ALIVE, "m": self.meta}
+
+    def _update_of_locked(self, m: Member) -> dict:
+        return {"id": m.node_id, "a": m.addr, "i": m.incarnation,
+                "s": m.state, "m": m.meta}
+
+    def _enqueue_update_locked(self, upd: dict):
+        sends = max(1, math.ceil(self.cfg.retransmit_mult
+                                 * self._log_n_locked()))
+        self._gossip_q[upd["id"]] = [dict(upd), sends]
+
+    def _piggyback_locked(self) -> List[dict]:
+        # Least-remaining-first would starve fresh updates; SWIM prefers
+        # least-TRANSMITTED, i.e. most-sends-remaining first.
+        items = sorted(self._gossip_q.items(), key=lambda kv: -kv[1][1])
+        picked = []
+        for key, slot in items[:self.cfg.max_piggyback]:
+            picked.append(slot[0])
+            slot[1] -= 1
+            if slot[1] <= 0:
+                self._gossip_q.pop(key, None)
+        return picked
+
+    def _packet_locked(self, mtype: str, seq: int, gx: Optional[list] = None,
+                       **extra) -> bytes:
+        msg = {"v": WIRE_VERSION, "t": mtype, "from": self.node_id,
+               "fa": self.addr, "seq": seq,
+               "g": (self._piggyback_locked() + (gx or [])
+                     + [self._self_update_locked()])}
+        msg.update({k: v for k, v in extra.items() if v is not None})
+        return json.dumps(msg, separators=(",", ":")).encode()
+
+    def _transition_locked(self, m: Member, state: str, inc: int,
+                           now: float, events: list):
+        if m.state == state and m.incarnation == inc:
+            return
+        prev = m.state
+        m.state = state
+        m.incarnation = inc
+        m.since = now
+        if state == SUSPECT:
+            m.deadline = now + self._suspicion_timeout_locked()
+            self._m_susp.inc()
+        if state == ALIVE and prev == SUSPECT:
+            self._m_refute.inc()
+        # Confirmed membership changes bump the epoch; suspicion (and its
+        # refutation) deliberately does not — that is the
+        # train-through-suspicion contract elastic relies on.
+        if (prev in (ALIVE, SUSPECT)) != (state in (ALIVE, SUSPECT)):
+            self.epoch += 1
+        events.append((state if state != ALIVE or prev not in
+                       (SUSPECT,) else "refute", m))
+
+    def _absorb_locked(self, upd: dict, now: float, events: list,
+                       implicit: bool = False):
+        nid, state, inc = upd["id"], upd["s"], upd["i"]
+        if nid == self.node_id:
+            # About us. Refute any suspicion/death rumor at our incarnation
+            # or newer by outbidding it.
+            if state in (SUSPECT, DEAD) and inc >= self.incarnation:
+                self.incarnation = inc + 1
+                self._enqueue_update_locked(self._self_update_locked())
+            return
+        m = self._members.get(nid)
+        if m is None:
+            if state in (DEAD, LEFT):
+                if not implicit:
+                    # remember the obituary so late gossip can't resurrect
+                    m = Member(nid, upd["a"], inc, state, now,
+                               meta=dict(upd["m"]))
+                    self._members[nid] = m
+                    self._enqueue_update_locked(self._update_of_locked(m))
+                return
+            m = Member(nid, upd["a"], inc, ALIVE, now, meta=dict(upd["m"]))
+            self._members[nid] = m
+            self.epoch += 1
+            events.append((ALIVE, m))
+            self._enqueue_update_locked(self._update_of_locked(m))
+            return
+        # Precedence: higher incarnation wins; same incarnation ->
+        # dead/left > suspect > alive. Everything else is a stale replay.
+        rank_new = (inc, _STATE_RANK[state])
+        rank_cur = (m.incarnation, _STATE_RANK[m.state])
+        if rank_new <= rank_cur:
+            if not implicit and rank_new < rank_cur:
+                self._m_stale.inc()
+            return
+        if m.state in (DEAD, LEFT) and state == ALIVE and inc > m.incarnation:
+            # resurrection: a restarted/refuting node outbid its obituary
+            pass
+        m.addr = upd["a"] or m.addr
+        if upd["m"]:
+            m.meta = dict(upd["m"])
+        self._transition_locked(m, state, inc, now, events)
+        self._enqueue_update_locked(self._update_of_locked(m))
+
+    def _suspect_locked(self, m: Member, now: float, events: list):
+        if m.state != ALIVE:
+            return
+        self._transition_locked(m, SUSPECT, m.incarnation, now, events)
+        self._enqueue_update_locked(self._update_of_locked(m))
+
+    def _fire(self, events: list):
+        if self.on_change is None:
+            return
+        for state, m in events:
+            try:
+                self.on_change(state, m)
+            except Exception:
+                pass  # a bad observer must never kill the protocol
+
+
+def bind_gossip_socket(bind_host: str = "127.0.0.1",
+                       port: int = 0) -> socket.socket:
+    """Bound UDP socket for a gossip plane — bound BEFORE the node is
+    constructed so the node can advertise its real (ephemeral) address."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind((bind_host, port))
+    return sock
+
+
+class UdpGossipRuntime:
+    """Drives one GossipNode over a real UDP socket on a daemon thread.
+
+    All sends happen OUTSIDE the node's lock (the node returns datagrams;
+    we transmit them) — no socket I/O under a protocol lock."""
+
+    def __init__(self, node: GossipNode, bind_host: str = "127.0.0.1",
+                 port: int = 0, sock: Optional[socket.socket] = None):
+        self.node = node
+        self.sock = sock if sock is not None else bind_gossip_socket(
+            bind_host, port)
+        self.addr = "%s:%d" % self.sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "UdpGossipRuntime":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"gossip-{self.node.node_id}")
+        self._thread.start()
+        return self
+
+    def send_all(self, outs: List[Tuple[str, bytes]]):
+        for addr, payload in outs:
+            try:
+                host, port = addr.rsplit(":", 1)
+                self.sock.sendto(payload, (host, int(port)))
+            except (OSError, ValueError):
+                pass  # unreachable peer: the failure detector's job
+
+    def _run(self):
+        while not self._stop.is_set():
+            now = time.monotonic()
+            self.send_all(self.node.tick(now))
+            wait = max(0.005, min(self.node.next_due(now) - now, 0.05))
+            try:
+                r, _, _ = select.select([self.sock], [], [], wait)
+            except OSError:
+                break
+            if r:
+                try:
+                    data, _ = self.sock.recvfrom(MAX_PACKET_BYTES + 1)
+                except OSError:
+                    continue
+                self.send_all(self.node.on_message(data, time.monotonic()))
+
+    def stop(self, leave: bool = True):
+        if leave:
+            self.send_all(self.node.leave(time.monotonic()))
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Worker-facing membership agent (WorkerAgent-compatible)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PeerInfo:
+    """Duck-type of the coordinator's protobuf PeerInfo — what elastic's
+    device/stripe policies actually read."""
+
+    worker_id: int
+    addr: str
+    name: str = ""
+    n_chips: int = 1
+
+
+def default_gossip_seed(coordinator_addr: str) -> str:
+    """The py-coordinator's gossip listener defaults to its RPC port + 1."""
+    host, port = coordinator_addr.rsplit(":", 1)
+    return f"{host}:{int(port) + 1}"
+
+
+class GossipAgent:
+    """Membership agent backed by SWIM gossip; drop-in for
+    ``control.client.WorkerAgent`` (same surface: ``start/stop/snapshot/
+    report/worker_id/fatal/interval/on_epoch_change``).
+
+    Division of labor: the coordinator stays the *registration directory*
+    (worker ids, exclusive names, checkpoint-namespace fencing) and a slow
+    lease-fallback channel; *liveness and the membership view* come from
+    gossip. Heartbeats run at ~1/3 of the lease TTL instead of the
+    configured fast interval — the O(N)-every-second fan-out is gone, and
+    a gossip-mode coordinator (``py_daemons.PyCoordinator`` with
+    ``gossip_port``) additionally refuses to lease-evict members its own
+    gossip node still sees alive.
+    """
+
+    def __init__(self, coordinator_addr: str, advertise_addr: str,
+                 name: str = "", n_chips: int = 1,
+                 heartbeat_interval_ms: int = 1000,
+                 on_epoch_change: Optional[Callable[[int, list], None]] = None,
+                 prefer_native: bool = True, exclusive_name: bool = False,
+                 membership=None):
+        from serverless_learn_tpu.config import MembershipConfig
+        from serverless_learn_tpu.control.client import WorkerAgent
+
+        self.membership = membership or MembershipConfig(mode="gossip")
+        self.on_epoch_change = on_epoch_change
+        self._seed = (self.membership.seed
+                      or default_gossip_seed(coordinator_addr))
+        # Reuse WorkerAgent for registration + slow lease renewal, but
+        # intercept its epoch callback: in gossip mode the authoritative
+        # view is ours.
+        self._inner = WorkerAgent(
+            coordinator_addr, advertise_addr, name=name, n_chips=n_chips,
+            heartbeat_interval_ms=heartbeat_interval_ms,
+            on_epoch_change=None, prefer_native=prefer_native,
+            exclusive_name=exclusive_name)
+        self.advertise_addr = advertise_addr
+        self.name = name
+        self.n_chips = n_chips
+        self.interval = self._inner.interval
+        self._node: Optional[GossipNode] = None
+        self._runtime: Optional[UdpGossipRuntime] = None
+        self._lock = threading.Lock()
+        self._max_alive_seen = 1
+
+    # -- WorkerAgent surface -------------------------------------------------
+
+    @property
+    def worker_id(self):
+        return self._inner.worker_id
+
+    @property
+    def fatal(self):
+        return self._inner.fatal
+
+    @property
+    def lease_ttl_ms(self):
+        return self._inner.lease_ttl_ms
+
+    def start(self) -> "GossipAgent":
+        self._inner.start()
+        # Slow the lease channel down now that gossip owns liveness: renew
+        # at a third of the TTL (never faster than the configured interval).
+        ttl_s = (self._inner.lease_ttl_ms or 5000) / 1000.0
+        self._inner.interval = max(self._inner.interval, ttl_s / 3.0)
+        self.interval = self._inner.interval
+        cfg = GossipConfig.from_membership(self.membership)
+        sock_host = self.membership.gossip_bind_host
+        node_id = f"w{self._inner.worker_id}"
+        meta = {"worker_id": int(self._inner.worker_id),
+                "name": self.name, "addr": self.advertise_addr,
+                "n_chips": int(self.n_chips)}
+        # Bind first so the node can advertise its real address.
+        sock = bind_gossip_socket(sock_host, self.membership.gossip_port)
+        addr = "%s:%d" % sock.getsockname()[:2]
+        self._node = GossipNode(node_id, addr, cfg,
+                                rng=random.Random(),
+                                meta=meta, on_change=self._on_change)
+        self._runtime = UdpGossipRuntime(self._node, sock=sock)
+        self._runtime.send_all(self._node.join([self._seed],
+                                               time.monotonic()))
+        self._runtime.start()
+        return self
+
+    def _on_change(self, state: str, member: Member):
+        # Suspicion does not change the epoch (GossipNode contract); only
+        # confirmed joins/deaths/leaves land here with a bumped epoch.
+        if state in (ALIVE, DEAD, LEFT):
+            epoch, peers = self.snapshot()
+            if self.on_epoch_change is not None:
+                self.on_epoch_change(epoch, peers)
+
+    def snapshot(self) -> Tuple[int, List[PeerInfo]]:
+        """(epoch, live peers incl. self) from gossip state. Peers without
+        a registered worker_id (e.g. the coordinator's own gossip node)
+        are not training members and are excluded."""
+        node = self._node
+        if node is None:
+            return self._inner.snapshot()
+        peers: Dict[int, PeerInfo] = {}
+        me = self._inner.worker_id
+        if me is not None:
+            peers[me] = PeerInfo(me, self.advertise_addr, self.name,
+                                 self.n_chips)
+        members = node.members()
+        with self._lock:
+            for m in members.values():
+                if m.state not in (ALIVE, SUSPECT):
+                    continue
+                wid = m.meta.get("worker_id")
+                if not isinstance(wid, int):
+                    continue
+                peers[wid] = PeerInfo(wid, m.meta.get("addr", m.addr),
+                                      m.meta.get("name", ""),
+                                      int(m.meta.get("n_chips", 1) or 1))
+            alive_now = len(peers)
+            self._max_alive_seen = max(self._max_alive_seen, alive_now)
+        return node.epoch, [peers[k] for k in sorted(peers)]
+
+    def quorum_lost(self) -> bool:
+        """True when the live view fell below ``quorum_fraction`` of the
+        largest world this agent has seen — the safe-pause trigger."""
+        if self._node is None:
+            return False
+        _, peers = self.snapshot()
+        with self._lock:
+            hwm = self._max_alive_seen
+        return len(peers) < self.membership.quorum_fraction * hwm
+
+    def suspects(self) -> List[str]:
+        return [] if self._node is None else self._node.suspect_ids()
+
+    def report(self, step: int, metric: float, flow=None):
+        self._inner.report(step, metric, flow)
+
+    def stop(self, deregister: bool = True):
+        if self._runtime is not None:
+            self._runtime.stop(leave=True)
+        self._inner.stop(deregister=deregister)
+
+
+def make_membership_agent(config, coordinator_addr: str, advertise_addr: str,
+                          name: str = "", n_chips: int = 1,
+                          on_epoch_change=None, prefer_native: bool = True,
+                          exclusive_name: bool = False):
+    """WorkerAgent or GossipAgent per ``config.membership.mode`` — the one
+    switch elastic/elastic_multihost flip (master fan-out stays the
+    config-selectable fallback)."""
+    from serverless_learn_tpu.control.client import WorkerAgent
+
+    hb_ms = config.control.heartbeat_interval_ms
+    if getattr(config, "membership", None) and config.membership.mode == "gossip":
+        return GossipAgent(coordinator_addr, advertise_addr, name=name,
+                           n_chips=n_chips, heartbeat_interval_ms=hb_ms,
+                           on_epoch_change=on_epoch_change,
+                           prefer_native=prefer_native,
+                           exclusive_name=exclusive_name,
+                           membership=config.membership)
+    return WorkerAgent(coordinator_addr, advertise_addr, name=name,
+                       n_chips=n_chips, heartbeat_interval_ms=hb_ms,
+                       on_epoch_change=on_epoch_change,
+                       prefer_native=prefer_native,
+                       exclusive_name=exclusive_name)

@@ -209,7 +209,9 @@ class PyCoordinator(_FramedServer):
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  lease_ttl_ms: int = 5000, sweep_ms: int = 500,
                  state_file: Optional[str] = None,
-                 events_log: Optional[str] = None):
+                 events_log: Optional[str] = None,
+                 gossip_port: Optional[int] = None,
+                 membership=None):
         super().__init__(host, port)
         self.lease_ttl_ms = lease_ttl_ms
         self.sweep_ms = sweep_ms
@@ -220,8 +222,55 @@ class PyCoordinator(_FramedServer):
         self._next_id = 1
         self._epoch = 0
         self._load_state()
+        # SWIM gossip plane (round 11): with a gossip port, the
+        # coordinator runs its own gossip member as the cluster's seed.
+        # Liveness then comes from gossip — a member gossip declares dead
+        # is evicted IMMEDIATELY (no lease wait), and a member gossip
+        # still sees alive is never lease-evicted, so workers can slow
+        # their heartbeats from the O(N)-per-second fan-out to a lazy
+        # lease-renewal fallback (control/gossip.GossipAgent does).
+        self.gossip_runtime = None
+        self._gossip_node = None
+        if gossip_port is not None:
+            from serverless_learn_tpu.config import MembershipConfig
+            from serverless_learn_tpu.control import gossip as g
+
+            m = membership or MembershipConfig(mode="gossip")
+            sock = g.bind_gossip_socket(host if host != "0.0.0.0"
+                                        else "0.0.0.0", gossip_port)
+            addr = "%s:%d" % sock.getsockname()[:2]
+            self._gossip_node = g.GossipNode(
+                "coordinator", addr, g.GossipConfig.from_membership(m),
+                meta={"role": "coordinator"},
+                on_change=self._on_gossip_change)
+            self.gossip_runtime = g.UdpGossipRuntime(
+                self._gossip_node, sock=sock).start()
         self._sweeper = threading.Thread(target=self._sweep_loop, daemon=True)
         self._sweeper.start()
+
+    # -- gossip-driven liveness ----
+    def _on_gossip_change(self, state: str, member):
+        wid = member.meta.get("worker_id")
+        if state not in ("dead", "left") or not isinstance(wid, int):
+            return
+        with self._mu:
+            if wid in self._workers:
+                del self._workers[wid]
+                self._epoch += 1
+                self._save_state_locked()
+
+    def _gossip_alive_worker_ids(self):
+        """Registered worker ids gossip currently believes live (SUSPECT
+        counts as live: train-through-suspicion)."""
+        if self._gossip_node is None:
+            return None
+        out = set()
+        for m in self._gossip_node.members().values():
+            if m.state in ("alive", "suspect"):
+                wid = m.meta.get("worker_id")
+                if isinstance(wid, int):
+                    out.add(wid)
+        return out
 
     # -- durability ----
     def _save_state_locked(self):
@@ -273,14 +322,22 @@ class PyCoordinator(_FramedServer):
     def _sweep_loop(self):
         while not self._stop.wait(self.sweep_ms / 1000.0):
             cutoff = _now_ms() - self.lease_ttl_ms
+            gossip_alive = self._gossip_alive_worker_ids()
             with self._mu:
                 dead = [wid for wid, rec in self._workers.items()
-                        if rec["last_seen"] < cutoff]
+                        if rec["last_seen"] < cutoff
+                        and (gossip_alive is None
+                             or wid not in gossip_alive)]
                 for wid in dead:
                     del self._workers[wid]
                 if dead:
                     self._epoch += 1
                     self._save_state_locked()
+
+    def stop(self):
+        if self.gossip_runtime is not None:
+            self.gossip_runtime.stop(leave=True)
+        super().stop()
 
     # -- RPC dispatch ----
     def handle(self, conn, mtype: int, payload: bytes):
@@ -698,13 +755,20 @@ def main_coordinator(argv) -> int:
     p.add_argument("--sweep_ms", type=int, default=500)
     p.add_argument("--state_file", default=None)
     p.add_argument("--events_log", default=None)
+    p.add_argument("--gossip_port", type=int, default=None,
+                   help="run a SWIM gossip seed on this UDP port "
+                        "(convention: RPC port + 1); liveness then comes "
+                        "from gossip instead of lease sweeps")
     args = p.parse_args(argv)
     srv = PyCoordinator(host="0.0.0.0", port=args.port,
                         lease_ttl_ms=args.lease_ttl_ms,
                         sweep_ms=args.sweep_ms, state_file=args.state_file,
-                        events_log=args.events_log)
-    print(json.dumps({"event": "py_coordinator_up", "addr": srv.addr}),
-          flush=True)
+                        events_log=args.events_log,
+                        gossip_port=args.gossip_port)
+    up = {"event": "py_coordinator_up", "addr": srv.addr}
+    if srv.gossip_runtime is not None:
+        up["gossip_addr"] = srv.gossip_runtime.addr
+    print(json.dumps(up), flush=True)
     return _run_until_sigterm(srv)
 
 

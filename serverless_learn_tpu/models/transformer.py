@@ -491,17 +491,25 @@ class PipelinedBlocks(nn.Module):
                 jnp.arange(x.shape[1], dtype=jnp.int32)[None, :],
                 (x.shape[0], x.shape[1]))
 
+        # Params don't depend on the attention impl; pinning "xla" keeps
+        # init's trace free of the auto dispatcher (which on an sp mesh
+        # would wrap a shard_map around init's tiny dummy input).
+        # Constructed HERE — at __call__'s trace level, not inside
+        # init_stack or the vmap: flax >= 0.10 checks the trace level at
+        # Module construction, and flax may invoke the initializer from a
+        # transformed apply (e.g. under jax.grad), where construction
+        # inside the initializer raises JaxTransformError. Calling .init
+        # on an outside-built module inside the vmap is the supported
+        # pattern.
+        init_block = Block(dataclasses.replace(cfg, attention_impl="xla"))
+
         def init_stack(rng):
             dummy = jnp.zeros((1, 4, cfg.d_model), cfg.dtype)
             dpos = jnp.zeros((1, 4), jnp.int32)
-            # Params don't depend on the attention impl; pinning "xla"
-            # keeps init's trace free of the auto dispatcher (which on an
-            # sp mesh would wrap a shard_map around this [1, 4, D] dummy).
-            init_cfg = dataclasses.replace(cfg, attention_impl="xla")
 
             def one(r):
-                return Block(init_cfg).init(r, dummy, mask=None,
-                                            positions=dpos)["params"]
+                return init_block.init(r, dummy, mask=None,
+                                       positions=dpos)["params"]
 
             return jax.vmap(one)(jax.random.split(rng, cfg.n_layers))
 
@@ -581,6 +589,13 @@ class PipelinedBlocks(nn.Module):
 
         moe_aux = cfg.n_experts > 0
 
+        # Construct the Block once, OUTSIDE the pipeline's scan/shard_map:
+        # flax >= 0.10 checks the trace level at Module construction, so
+        # building it inside the transformed region raises
+        # JaxTransformError; the functional .apply on an outside-built
+        # module is the supported pattern.
+        pipe_block = Block(block_cfg)
+
         def block_apply(p, h, pos, m):
             if moe_aux:
                 # Thread the MoE router loss out of the nested apply: the
@@ -588,7 +603,7 @@ class PipelinedBlocks(nn.Module):
                 # each block returns its summed sown losses explicitly and
                 # the pipeline/sequential scan accumulates them.
                 def fn(pp_, h_, pos_, m_):
-                    out, mut = Block(block_cfg).apply(
+                    out, mut = pipe_block.apply(
                         {"params": pp_}, h_, mask=m_, positions=pos_,
                         mutable=["losses"])
                     leaves = jax.tree_util.tree_leaves(
@@ -597,7 +612,7 @@ class PipelinedBlocks(nn.Module):
                            else jnp.float32(0.0))
                     return out, aux
             else:
-                fn = lambda pp_, h_, pos_, m_: Block(block_cfg).apply(
+                fn = lambda pp_, h_, pos_, m_: pipe_block.apply(
                     {"params": pp_}, h_, mask=m_, positions=pos_)
             if cfg.remat:
                 fn = jax.checkpoint(fn)

@@ -21,6 +21,18 @@ except ImportError:  # pragma: no cover
 shard_map = _shard_map
 
 
+def axis_size(axis_name: str):
+    """``jax.lax.axis_size`` on JAX versions that have it; the classic
+    ``psum(1, axis)`` identity (constant-folded under jit) elsewhere —
+    0.4.x has ``axis_index`` but not ``axis_size``."""
+    import jax
+
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 def shard_map_no_check(fn, *, mesh, in_specs, out_specs):
     """shard_map with replication checking off, on any supported JAX."""
     return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,

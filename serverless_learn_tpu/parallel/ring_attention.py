@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from serverless_learn_tpu.parallel import compat
 from serverless_learn_tpu.parallel.compat import (
     shard_map_no_check as _shard_map)
 
@@ -161,7 +162,7 @@ def _ring_attention_local(q, k, v, kv_lengths, *, axis_name: str,
     unexpanded. ``kv_lengths`` [B] are GLOBAL suffix lengths; each hop
     slices them to its resident block. Causal hidden hops still compute
     (gated in the merge) — the zigzag layout removes that waste."""
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     T_loc = q.shape[1]
@@ -251,7 +252,7 @@ def _ring_attention_zigzag(q, k, v, kv_lengths, *, axis_name: str, hop_fn):
     layout: the relayout (two half-block ppermutes in, two out) is
     amortized against (n-1) hops of halved compute.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     B, T_loc = q.shape[:2]
@@ -392,7 +393,7 @@ def ring_attention_manual(q, k, v, *, axis_name: str = "sp",
     GLOBAL suffix lengths (each hop slices its resident block's span).
     Same math and hop kernels as the public ``ring_attention``; only the
     shard_map wrapper is omitted."""
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     local = _local_ring_fn(q.shape[1], n, causal, layout,
                            q.shape[-1] ** -0.5)
     lens = None if kv_lengths is None else kv_lengths.astype(jnp.int32)

@@ -445,6 +445,12 @@ _EVENT_RULES = (
     ("lease_expiry", "slt_lease_expiries_total", "warning"),
     ("diloco_liveness_escape", "slt_diloco_liveness_escapes_total",
      "warning"),
+    # Round 11: gossip failure-detector suspicions (a peer stopped
+    # acking probes — link or process trouble even when the master is
+    # reachable) and circuit-breaker trips (a peer failed enough RPCs
+    # in a row that the client is now failing fast).
+    ("gossip_suspicion", "slt_gossip_suspicions_total", "warning"),
+    ("rpc_breaker_open", "slt_rpc_breaker_opens_total", "warning"),
 )
 
 

@@ -115,6 +115,11 @@ class DilocoIsland:
     lets each island stream distinct data keyed by its worker id.
     """
 
+    # Class-level default so harness-style construction (``__new__`` +
+    # manual attributes, as the liveness tests do) keeps the historic
+    # challenge-enabled behavior.
+    leader_rechallenge = True
+
     def __init__(self, config: ExperimentConfig, store, coordinator_addr:
                  str, run_name: str, mesh=None,
                  inner_steps: Optional[int] = None,
@@ -123,7 +128,8 @@ class DilocoIsland:
                  round_timeout_s: float = 20.0, poll_s: float = 0.05,
                  source_factory: Optional[Callable] = None,
                  init_timeout_s: float = 30.0,
-                 liveness_factor: float = 3.0, registry=None):
+                 liveness_factor: float = 3.0, registry=None,
+                 leader_rechallenge: Optional[bool] = None):
         lcfg = config.local_sgd
         self.config = config
         self.store = store
@@ -140,6 +146,15 @@ class DilocoIsland:
         # lease expiry detects crashed processes, not processes whose
         # heartbeat thread outlives a wedged training thread.
         self.liveness_factor = liveness_factor
+        # Explicit degradation policy (round 11): leader re-challenge is
+        # on by default but config-selectable (membership.leader_
+        # rechallenge=false pins leadership strictly to min-id — islands
+        # then WAIT on a wedged leader instead of racing past it).
+        if leader_rechallenge is None:
+            leader_rechallenge = getattr(
+                config, "membership", None) is None or \
+                config.membership.leader_rechallenge
+        self.leader_rechallenge = bool(leader_rechallenge)
         reg = registry or get_registry()
         self._m_rounds = reg.counter("slt_diloco_rounds_total")
         self._m_led = reg.counter("slt_diloco_led_rounds_total")
@@ -334,7 +349,8 @@ class DilocoIsland:
             # would compare a dead id against live membership forever.
             wid = self.agent.worker_id
             challenge = False
-            if wid != min(live, default=wid) and \
+            if self.leader_rechallenge and \
+                    wid != min(live, default=wid) and \
                     time.monotonic() > escape_at:
                 latest = self._latest_round()
                 self._m_lag.set(max(0, (latest or rnd) - rnd))

@@ -36,7 +36,7 @@ import jax
 
 from serverless_learn_tpu.config import (ExperimentConfig,
                                           UnsatisfiableMeshError, scale_mesh)
-from serverless_learn_tpu.control.client import WorkerAgent
+from serverless_learn_tpu.control.gossip import make_membership_agent
 from serverless_learn_tpu.data.datasets import Prefetcher
 from serverless_learn_tpu.parallel.mesh import make_mesh
 from serverless_learn_tpu.telemetry import flight, get_registry, goodput
@@ -108,19 +108,59 @@ class ElasticTrainer:
         self.transitions: List[EpochTransition] = []
         self._remesh = threading.Event()
         self._stop = threading.Event()
-        self._agent: Optional[WorkerAgent] = None
+        self._last_epoch_change_t = 0.0
+        self._agent = None
         if coordinator_addr is not None:
-            self._agent = WorkerAgent(
-                coordinator_addr, advertise_addr, name=name,
+            # Membership plane per config.membership.mode: the classic
+            # master-heartbeat WorkerAgent, or the SWIM GossipAgent whose
+            # epochs come from gossip state (round 11).
+            self._agent = make_membership_agent(
+                config, coordinator_addr, advertise_addr, name=name,
                 n_chips=n_chips if n_chips is not None else len(jax.devices()),
-                heartbeat_interval_ms=config.control.heartbeat_interval_ms,
                 on_epoch_change=self._on_epoch_change,
                 exclusive_name=True)
 
     # -- membership hook ---------------------------------------------------
 
     def _on_epoch_change(self, epoch: int, peers):
+        self._last_epoch_change_t = time.time()
         self._remesh.set()
+
+    def _remesh_due(self) -> bool:
+        """Anti-flap hysteresis (membership.remesh_debounce_s): a pending
+        epoch change only triggers the drain→save→remesh cycle once the
+        view has held still for the debounce window. A member that bounces
+        (lease blip: evict + instant re-register, or a suspicion that
+        refutes) keeps pushing the window out and ends up causing ZERO
+        remeshes when the final view equals the formed one."""
+        if not self._remesh.is_set():
+            return False
+        debounce = self.config.membership.remesh_debounce_s
+        if debounce <= 0:
+            return True
+        if time.time() - self._last_epoch_change_t < debounce:
+            return False
+        # Debounced long enough — but if the settled view is exactly the
+        # world we already formed, skip the remesh entirely.
+        epoch, devices = self._current_world()
+        if (self.transitions
+                and len(devices) == self.transitions[-1].n_devices
+                and self._stripe() == self.transitions[-1].stripe):
+            self._remesh.clear()
+            self.transitions[-1].epoch = epoch
+            return False
+        return True
+
+    def _safe_paused(self) -> bool:
+        """Quorum-loss safe-pause (membership.safe_pause): when the live
+        view drops below quorum, stop stepping instead of re-meshing down
+        onto a minority island — a partitioned minority training on would
+        fork the checkpoint namespace from the majority."""
+        if not (self.config.membership.safe_pause
+                and self._agent is not None
+                and hasattr(self._agent, "quorum_lost")):
+            return False
+        return bool(self._agent.quorum_lost())
 
     def request_stop(self):
         """Graceful shutdown: finish the in-flight step, checkpoint, return."""
@@ -192,6 +232,12 @@ class ElasticTrainer:
             "drain -> save -> remesh -> restore wall time per epoch")
         m_last_step = reg.gauge("slt_train_last_step_unix_s",
                                 "wall time of the latest optimizer step")
+        m_safe_paused = reg.gauge(
+            "slt_safe_paused",
+            "1 while quorum-loss safe-pause is holding training")
+        m_safe_pauses = reg.counter(
+            "slt_safe_pause_ticks_total",
+            "step-loop ticks skipped under quorum-loss safe-pause")
         losses: List[float] = []
         state = None
         source = None
@@ -292,7 +338,7 @@ class ElasticTrainer:
                 # charge it to "compile", not "step", like the plain loop.
                 first_step_on_mesh = True
                 try:
-                    while (step < num_steps and not self._remesh.is_set()
+                    while (step < num_steps and not self._remesh_due()
                            and not self._stop.is_set()):
                         if (self._agent is not None
                                 and self._agent.fatal is not None):
@@ -302,6 +348,12 @@ class ElasticTrainer:
                             # clobber its checkpoints.
                             raise RuntimeError(
                                 f"worker fenced out: {self._agent.fatal}")
+                        if self._safe_paused():
+                            m_safe_paused.set(1)
+                            m_safe_pauses.inc()
+                            time.sleep(0.05)
+                            continue
+                        m_safe_paused.set(0)
                         batch = next(prefetch)
                         with goodput.get_ledger().phase(
                                 "compile" if first_step_on_mesh

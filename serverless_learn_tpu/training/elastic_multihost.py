@@ -74,7 +74,7 @@ from typing import List, Optional
 
 from serverless_learn_tpu.config import (ExperimentConfig,
                                           UnsatisfiableMeshError, scale_mesh)
-from serverless_learn_tpu.control.client import WorkerAgent
+from serverless_learn_tpu.control.gossip import make_membership_agent
 from serverless_learn_tpu.training.checkpoint import (
     Checkpointer, LocalStore, ShardServerStore)
 from serverless_learn_tpu.utils.metrics import log_json
@@ -160,11 +160,12 @@ class ElasticHostSupervisor:
         self._membership_changed = threading.Event()
         label = label or f"{socket.gethostname()}-{os.getpid()}"
         self._tag = f"{EMH_TAG}{run_name}/"
-        self.agent = WorkerAgent(
-            coordinator_addr, f"{advertise_host}:0",
+        # Membership plane per config.membership.mode (SWIM gossip or the
+        # classic master-heartbeat fallback) — round 11.
+        self.agent = make_membership_agent(
+            config, coordinator_addr, f"{advertise_host}:0",
             name=self._tag + label,
             n_chips=n_chips if n_chips is not None else 1,
-            heartbeat_interval_ms=config.control.heartbeat_interval_ms,
             on_epoch_change=lambda e, p: self._membership_changed.set())
         self._last_gen = 0
 

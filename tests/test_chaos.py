@@ -1,0 +1,219 @@
+"""Chaos harness (round 11): FaultPlan DSL, the 100-node acceptance
+scenario (kill 30% + 10s partition → bounded re-convergence, zero
+training-progress loss, doctor names every incident), determinism, and
+the soak CLI."""
+
+import json
+import math
+
+import pytest
+
+from serverless_learn_tpu.chaos.plan import FaultPlan
+from serverless_learn_tpu.chaos.sim import ChaosSim
+from serverless_learn_tpu.control.gossip import GossipConfig
+
+ACCEPTANCE_PLAN = {"faults": [
+    {"at": 3.0, "op": "kill", "frac": 0.3},
+    {"at": 3.0, "op": "partition", "split": 0.5, "for": 10.0},
+]}
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan DSL
+# ---------------------------------------------------------------------------
+
+
+def test_plan_parses_and_sorts():
+    plan = FaultPlan.from_json(json.dumps({"faults": [
+        {"at": 5.0, "op": "heal"},
+        {"at": 1.0, "op": "kill", "node": "node-3"},
+        {"at": 2.0, "op": "partition", "groups": [["node-0"], ["node-1"]]},
+        {"at": 2.5, "op": "pause", "count": 2, "for": 3.0},
+        {"at": 3.0, "op": "drop", "rate": 0.5},
+        {"at": 3.0, "op": "delay", "s": 0.02, "jitter": 0.01},
+        {"at": 4.0, "op": "skew", "node": "node-1", "offset_s": 2.0},
+    ]}))
+    assert [f.at for f in plan.faults] == sorted(f.at for f in plan.faults)
+    assert plan.end_time() == 5.5
+    # bare-list form accepted too
+    assert len(FaultPlan.from_obj(
+        [{"at": 0, "op": "kill", "frac": 0.1}]).faults) == 1
+
+
+@pytest.mark.parametrize("bad", [
+    "not json",
+    json.dumps({"faults": [{"at": 1.0, "op": "explode"}]}),
+    json.dumps({"faults": [{"at": -1, "op": "heal"}]}),
+    json.dumps({"faults": [{"at": 1, "op": "kill"}]}),          # no selector
+    json.dumps({"faults": [{"at": 1, "op": "kill", "frac": 2}]}),
+    json.dumps({"faults": [{"at": 1, "op": "kill", "node": "x",
+                            "frac": 0.5}]}),                     # two selectors
+    json.dumps({"faults": [{"at": 1, "op": "drop"}]}),           # no rate
+    json.dumps({"faults": [{"at": 1, "op": "pause", "node": "x"}]}),  # no for
+    json.dumps({"faults": [{"at": 1, "op": "kill", "node": "x",
+                            "typo_key": 1}]}),
+    json.dumps({"faults": [{"at": 1, "op": "partition",
+                            "groups": [["a"]]}]}),               # 1 group
+])
+def test_plan_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        FaultPlan.from_json(bad)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario
+# ---------------------------------------------------------------------------
+
+
+def test_kill_30pct_plus_partition_converges_and_doctor_names_it(tmp_path):
+    """ISSUE 6 acceptance: 100 nodes, 30% killed, the rest partitioned
+    for 10 virtual seconds. Asserts (1) membership agreement restored
+    within the O(log N) dissemination bound, (2) zero training-progress
+    loss (monotone committed step, progress resumes post-heal), and
+    (3) `slt doctor` names every killed node and the partition from the
+    emitted telemetry alone."""
+    from serverless_learn_tpu.telemetry import doctor
+
+    events = str(tmp_path / "chaos-events.jsonl")
+    sim = ChaosSim(100, seed=7, plan=FaultPlan.from_obj(ACCEPTANCE_PLAN),
+                   events_log=events)
+    rep = sim.run()
+    assert rep["ok"], rep["violations"]
+    assert len(rep["killed_live"]) == 30
+    assert rep["converged"]
+    assert rep["dissemination_periods"] is not None
+    assert (rep["dissemination_periods"]
+            <= rep["convergence_bound_periods"])
+    # every killed node individually detected in O(log N) periods of its
+    # death becoming observable (partition end for cross-side observers)
+    for nid, periods in rep["detection_periods"].items():
+        assert periods is not None, f"{nid} never detected"
+    # training: monotone (asserted inside run) and it kept moving
+    assert rep["training"]["committed_step"] > 0
+    assert not any("backwards" in v for v in rep["violations"])
+
+    # doctor, fed ONLY the telemetry log, names each incident
+    d = doctor.diagnose([events], top=400)
+    named_dead = {a.get("node") for a in d["alerts"]
+                  if a.get("alert") == "gossip_member_dead"
+                  and a.get("state") == "firing"}
+    assert set(rep["killed_live"]) <= named_dead
+    partition = [a for a in d["alerts"]
+                 if a.get("alert") == "gossip_partition_suspected"]
+    assert partition, "partition never surfaced as an alert"
+    # and the partition alerts RESOLVED after the heal (no stuck pages)
+    assert all(a["state"] == "resolved" for a in partition)
+
+
+def test_same_seed_same_report():
+    """Determinism: identical (plan, seed) ⇒ byte-identical reports
+    (wall_time aside). This is what makes chaos failures debuggable."""
+    def run():
+        rep = ChaosSim(60, seed=13,
+                       plan=FaultPlan.from_obj(ACCEPTANCE_PLAN)).run()
+        rep.pop("wall_time_s")
+        return rep
+
+    assert run() == run()
+
+
+def test_different_seed_different_faults():
+    def faults(seed):
+        sim = ChaosSim(60, seed=seed,
+                       plan=FaultPlan.from_obj(ACCEPTANCE_PLAN))
+        sim.run(duration_s=5.0)
+        return json.dumps(sim.injected)
+
+    assert faults(1) != faults(2)
+
+
+def test_killed_node_detection_is_log_n_at_scale():
+    """ISSUE 6 acceptance: a killed node in a 120-node cluster is
+    detected (suspected → declared dead cluster-wide) in O(log N)
+    protocol periods — no partition in the way."""
+    plan = FaultPlan.from_obj([{"at": 4.0, "op": "kill", "count": 1}])
+    sim = ChaosSim(120, seed=3, plan=plan)
+    rep = sim.run()
+    assert rep["ok"], rep["violations"]
+    (periods,) = rep["detection_periods"].values()
+    cfg = sim.cfg
+    log_n = math.ceil(math.log2(120 + 1))
+    assert periods <= 4 + (cfg.suspicion_mult + 3) * log_n, periods
+
+
+def test_straggler_pause_refutes_no_flap():
+    """A paused (straggling) process gets suspected but — resuming before
+    the suspicion times out everywhere — refutes and is never declared
+    dead: total membership churn (epoch delta) stays zero."""
+    sim = ChaosSim(20, seed=5, plan=FaultPlan.from_obj(
+        [{"at": 6.0, "op": "pause", "node": "node-7", "for": 1.2}]))
+    # capture epochs after bootstrap converges, before the pause
+    epochs_at = {}
+    orig_apply = sim._apply_fault
+
+    def capture_then_apply(f):
+        if not epochs_at:
+            epochs_at.update({nid: h.node.epoch
+                              for nid, h in sim.hosts.items()})
+        orig_apply(f)
+
+    sim._apply_fault = capture_then_apply
+    rep = sim.run(duration_s=25.0)
+    assert rep["ok"], rep["violations"]
+    assert rep["killed_live"] == []
+    for nid, h in sim.hosts.items():
+        members = h.node.members()
+        if "node-7" in members:
+            assert members["node-7"].state != "dead", nid
+        # zero membership churn: suspicion + refutation bumps no epochs
+        assert h.node.epoch == epochs_at[nid], nid
+
+
+def test_quorum_loss_safe_pauses_training():
+    """Partition the leader into a minority: the training model must
+    SKIP rounds (safe-pause policy) rather than commit minority progress,
+    then resume after the heal."""
+    sim = ChaosSim(12, seed=2, plan=FaultPlan.from_obj([
+        {"at": 5.0, "op": "partition",
+         "groups": [["node-0", "node-1"],
+                    ["node-%d" % i for i in range(2, 12)]],
+         "for": 8.0}]))
+    rep = sim.run()
+    assert rep["training"]["safe_paused_rounds"] >= 1
+    assert rep["ok"], rep["violations"]
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_cli_run_and_soak(tmp_path, capsys):
+    from serverless_learn_tpu.cli import main
+
+    plan_file = tmp_path / "plan.json"
+    plan_file.write_text(json.dumps({"faults": [
+        {"at": 2.0, "op": "kill", "count": 2}]}))
+    rc = main(["chaos", "run", "--plan", str(plan_file), "--nodes", "20",
+               "--seed", "1", "--compact"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["ok"]
+    assert out["detection_periods"]["n"] == 2
+
+    rc = main(["chaos", "soak", "--nodes", "20", "--duration", "40",
+               "--seed", "2", "--compact"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["ok"]
+
+    rc = main(["chaos", "run", "--plan", "/nonexistent.json"])
+    assert rc == 2
+
+
+def test_chaos_cli_rejects_bad_plan(tmp_path, capsys):
+    from serverless_learn_tpu.cli import main
+
+    plan_file = tmp_path / "bad.json"
+    plan_file.write_text(json.dumps({"faults": [{"at": 1, "op": "nope"}]}))
+    rc = main(["chaos", "run", "--plan", str(plan_file)])
+    assert rc == 2
+    assert "bad fault plan" in capsys.readouterr().err

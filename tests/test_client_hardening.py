@@ -1,0 +1,396 @@
+"""Control-plane client hardening (round 11): backoff with full jitter,
+per-RPC deadlines with reconnect-on-timeout (the poisoned-socket fix),
+the per-peer circuit breaker, lease expiry under asymmetric partition,
+and elastic's remesh-debounce hysteresis."""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from serverless_learn_tpu.chaos.shim import TcpChaosProxy
+from serverless_learn_tpu.control.client import (
+    MSG_MEMBERSHIP_REQ, MSG_STATS_REQ, CircuitBreaker, Transport,
+    full_jitter_backoff)
+from serverless_learn_tpu.control.py_daemons import (PyCoordinator,
+                                                     PyShardServer)
+
+
+def _counter_value(name):
+    from serverless_learn_tpu.telemetry import get_registry
+
+    fam = get_registry().snapshot().get(name)
+    if not fam:
+        return 0.0
+    return sum(s.get("value", 0.0) for s in fam.get("series", []))
+
+
+# ---------------------------------------------------------------------------
+# backoff + breaker units
+# ---------------------------------------------------------------------------
+
+
+def test_full_jitter_backoff_bounds():
+    rng = random.Random(42)
+    seen = set()
+    for attempt in range(6):
+        for _ in range(50):
+            s = full_jitter_backoff(attempt, rng, base_s=0.05, cap_s=2.0)
+            assert 0.0 <= s <= min(2.0, 0.05 * 2 ** attempt)
+            seen.add(round(s, 6))
+    assert len(seen) > 100  # actually jittered, not a fixed ladder
+
+
+def test_circuit_breaker_state_machine():
+    b = CircuitBreaker("unit-test-peer-1", fail_threshold=3, open_s=0.15)
+    assert b.allow() and b.state == CircuitBreaker.CLOSED
+    b.record_failure()
+    b.record_failure()
+    assert b.allow()  # under threshold
+    b.record_failure()
+    assert b.state == CircuitBreaker.OPEN
+    assert not b.allow()
+    time.sleep(0.2)
+    assert b.allow()          # half-open probe
+    assert not b.allow()      # only ONE probe
+    b.record_failure()        # probe failed -> straight back to open
+    assert b.state == CircuitBreaker.OPEN
+    time.sleep(0.2)
+    assert b.allow()
+    b.record_success()        # probe succeeded -> closed
+    assert b.state == CircuitBreaker.CLOSED
+    assert b.allow() and b.allow()
+
+
+def test_breaker_trips_and_fails_fast(tmp_path):
+    """After fail_threshold consecutive transport failures the breaker
+    opens: further calls raise 'circuit open' WITHOUT touching the
+    network, until the open window lapses (half-open probe heals it)."""
+    srv = PyShardServer(port=0, root=str(tmp_path / "b"))
+    srv.start()
+    proxy = TcpChaosProxy(upstream=srv.addr).start()
+    try:
+        breaker = CircuitBreaker(proxy.addr, fail_threshold=2, open_s=0.5)
+        t = Transport(proxy.addr, prefer_native=False, rpc_timeout_s=0.3,
+                      max_attempts=1, breaker=breaker)
+        t.call(MSG_STATS_REQ, b"")
+        opens_before = _counter_value("slt_rpc_breaker_opens_total")
+        proxy.set_fault("blackhole")
+        for _ in range(2):
+            with pytest.raises(OSError):
+                t.call(MSG_STATS_REQ, b"")
+        assert breaker.state == CircuitBreaker.OPEN
+        assert _counter_value("slt_rpc_breaker_opens_total") > opens_before
+        proxy.set_fault(None)  # upstream healthy again, but breaker open
+        conns_before = proxy.stats["connections"]
+        with pytest.raises(ConnectionError, match="circuit open"):
+            t.call(MSG_STATS_REQ, b"")
+        assert proxy.stats["connections"] == conns_before  # failed FAST
+        time.sleep(0.6)
+        t.call(MSG_STATS_REQ, b"")  # half-open probe succeeds -> closed
+        assert breaker.state == CircuitBreaker.CLOSED
+        t.close()
+    finally:
+        proxy.stop()
+        srv.stop()
+
+
+def test_breaker_metrics_in_scrape():
+    CircuitBreaker("scrape-peer", fail_threshold=1, open_s=9).record_failure()
+    from serverless_learn_tpu.telemetry import get_registry
+
+    snap = get_registry().snapshot()
+    fam = snap["slt_rpc_breaker_state"]
+    series = {dict(s["labels"]).get("peer"): s["value"]
+              for s in fam["series"]}
+    assert series.get("scrape-peer") == CircuitBreaker.OPEN
+
+
+# ---------------------------------------------------------------------------
+# reconnect-on-timeout: the poisoned-socket regression (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def shard_server(tmp_path):
+    srv = PyShardServer(port=0, root=str(tmp_path / "blobs"))
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_fetch_timeout_midstream_reconnects(shard_server):
+    """An RPC that times out mid-stream must not leave the transport in an
+    undefined state: the next call on the SAME Transport re-dials instead
+    of parsing the stalled stream's leftovers."""
+    proxy = TcpChaosProxy(upstream=shard_server.addr,
+                          delay_s=0.005).start()
+    blob = bytes(range(256)) * (1024 * 8)  # 2 MiB -> 2 chunk frames
+    # publish via a direct connection; the hardened client under test
+    # talks through the proxy
+    direct = Transport(shard_server.addr, prefer_native=False)
+    direct.put("chaos/a", blob)
+    direct.close()
+    try:
+        t = Transport(proxy.addr, prefer_native=False, rpc_timeout_s=1.0,
+                      max_attempts=1)
+        dst = np.empty(len(blob), np.uint8)
+        assert t.fetch_into("chaos/a", dst, 0, len(blob)) == len(blob)
+        sock_before = t._sock
+        timeouts_before = _counter_value("slt_rpc_timeouts_total")
+
+        # stall the stream once the NEXT fetch is mid-flight
+        fetch_err = []
+        baseline = proxy.stats["bytes_down"]
+
+        def fetch():
+            try:
+                t.fetch_into("chaos/a", np.empty(len(blob), np.uint8),
+                             0, len(blob))
+            except IOError as e:
+                fetch_err.append(e)
+
+        th = threading.Thread(target=fetch)
+        th.start()
+        deadline = time.time() + 5
+        while (proxy.stats["bytes_down"] < baseline + 128 * 1024
+               and time.time() < deadline):
+            time.sleep(0.002)
+        proxy.set_fault("stall")
+        th.join(timeout=10)
+        assert fetch_err, "stalled fetch did not surface an error"
+        assert "mid-stream" in str(fetch_err[0])
+        assert _counter_value("slt_rpc_timeouts_total") > timeouts_before
+        # the poisoned socket is GONE; healing the proxy lets the same
+        # transport re-dial and complete a clean exchange
+        assert t._sock is None
+        proxy.set_fault(None)
+        dst2 = np.empty(len(blob), np.uint8)
+        assert t.fetch_into("chaos/a", dst2, 0, len(blob)) == len(blob)
+        assert bytes(dst2) == blob
+        assert t._sock is not sock_before
+        t.close()
+    finally:
+        proxy.stop()
+
+
+def test_unary_timeout_poisons_then_recovers(shard_server):
+    proxy = TcpChaosProxy(upstream=shard_server.addr).start()
+    try:
+        t = Transport(proxy.addr, prefer_native=False, rpc_timeout_s=0.4,
+                      max_attempts=1)
+        t.call(MSG_STATS_REQ, b"")  # healthy round trip
+        proxy.set_fault("blackhole", direction="down")  # replies vanish
+        with pytest.raises(OSError):
+            t.call(MSG_STATS_REQ, b"")
+        assert t._sock is None  # poisoned, not reused
+        proxy.set_fault(None)
+        t.call(MSG_STATS_REQ, b"")  # re-dialed transparently
+        t.close()
+    finally:
+        proxy.stop()
+
+
+def test_idempotent_retry_rides_through_reset(shard_server):
+    """A connection reset between calls is retried (with backoff) for
+    idempotent RPCs — and the retry counter shows it."""
+    proxy = TcpChaosProxy(upstream=shard_server.addr).start()
+    try:
+        t = Transport(proxy.addr, prefer_native=False, rpc_timeout_s=2.0,
+                      max_attempts=3)
+        t.call(MSG_STATS_REQ, b"")
+        retries_before = _counter_value("slt_rpc_retries_total")
+        proxy.set_fault("reset")   # kills the live conns
+        proxy.set_fault(None)      # but new dials go through
+        mtype, _ = t.call(MSG_STATS_REQ, b"")
+        assert mtype  # got a real reply on the re-dialed connection
+        assert _counter_value("slt_rpc_retries_total") > retries_before
+        t.close()
+    finally:
+        proxy.stop()
+
+
+# ---------------------------------------------------------------------------
+# lease expiry under asymmetric partition + remesh hysteresis (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+def test_lease_expiry_asymmetric_partition_alert_and_no_flap(tmp_path):
+    """Worker A talks to the coordinator through a proxy that gets
+    blackholed (A cannot reach the master; B can). Asserts the failure
+    chain: heartbeat failures → lease expiry + re-register under the same
+    name → the health engine fires the lease_expiry alert — while B's
+    debounced elastic-style epoch consumer coalesces the evict+rejoin
+    epoch pair into at most one remesh decision (no flapping)."""
+    from serverless_learn_tpu.config import HealthConfig
+    from serverless_learn_tpu.control.client import WorkerAgent
+    from serverless_learn_tpu.telemetry import get_registry
+    from serverless_learn_tpu.telemetry.health import HealthEngine
+
+    coord = PyCoordinator(port=0, lease_ttl_ms=700, sweep_ms=100)
+    coord.start()
+    proxy = TcpChaosProxy(upstream=coord.addr).start()
+    engine = HealthEngine(registry=get_registry(),
+                          config=HealthConfig(sample_interval_s=3600),
+                          dump_on_critical=False)
+    b_epoch_changes = []
+    # must cover the evict -> re-register window: eviction lands one lease
+    # TTL into the outage; the rejoin lands after the blackholed
+    # heartbeat's in-transport retries finish (~2 deadlines + backoff)
+    debounce_s = 2.5
+    remeshes = []
+    last_change = [0.0]
+    t_fault = [0.0]
+
+    def b_on_epoch(epoch, peers):
+        b_epoch_changes.append((time.time(), epoch, len(peers)))
+        last_change[0] = time.time()
+
+    a = b = None
+    try:
+        a = WorkerAgent(proxy.addr, "local:a", name="wa",
+                        heartbeat_interval_ms=150).start()
+        b = WorkerAgent(coord.addr, "local:b", name="wb",
+                        heartbeat_interval_ms=150,
+                        on_epoch_change=b_on_epoch).start()
+        time.sleep(0.5)
+        # baseline sample AFTER the counters exist: the incident detector
+        # fires on increments between samples
+        engine.sample_once()
+        exp_before = _counter_value("slt_lease_expiries_total")
+        timeouts_before = _counter_value("slt_rpc_timeouts_total")
+
+        # asymmetric partition: A <-> master only
+        t_fault[0] = time.time()
+        proxy.set_fault("blackhole")
+        time.sleep(1.6)  # > lease TTL: master evicts A, A keeps trying
+        proxy.set_fault(None)
+
+        deadline = time.time() + 8
+        while (_counter_value("slt_lease_expiries_total") <= exp_before
+               and time.time() < deadline):
+            time.sleep(0.05)
+        assert _counter_value("slt_lease_expiries_total") > exp_before
+        # the blackholed heartbeats hit their deadline and were retried
+        # INSIDE the transport (the agent never even saw an error)
+        assert _counter_value("slt_rpc_timeouts_total") > timeouts_before
+
+        # the health engine names the incident
+        engine.sample_once()
+        firing = {al["alert"] for al in engine.alerts(firing_only=True)}
+        assert "event.lease_expiry" in firing, firing
+
+        # B saw (at least) two epoch bumps close together: the eviction
+        # and A's re-registration. A debounced consumer collapses them.
+        time.sleep(debounce_s + 0.3)
+        changes = [t for t, _, _ in b_epoch_changes if t >= t_fault[0]]
+        assert len(changes) >= 2, b_epoch_changes
+        # walk the change stream the way _remesh_due does: a remesh only
+        # fires when debounce_s elapses with no further change
+        fired = 0
+        i = 0
+        while i < len(changes):
+            j = i
+            while j + 1 < len(changes) and \
+                    changes[j + 1] - changes[j] < debounce_s:
+                j += 1
+            fired += 1
+            i = j + 1
+        remeshes.append(fired)
+        assert fired <= 1, (fired, b_epoch_changes)
+        # and the settled membership equals the pre-partition one: both
+        # workers live — the correct number of remeshes is ZERO (a real
+        # _remesh_due also compares the settled world and skips).
+        _, peers = b.snapshot()
+        assert sorted(p.name for p in peers) == ["wa", "wb"]
+    finally:
+        for agent in (a, b):
+            if agent is not None:
+                agent.stop(deregister=False)
+        engine.stop()
+        proxy.stop()
+        coord.stop()
+
+
+def test_elastic_remesh_debounce_skips_bounce(tmp_path):
+    """The real ElasticTrainer._remesh_due: an epoch flap whose settled
+    view equals the formed world clears the pending remesh without
+    triggering drain→save→remesh."""
+    from serverless_learn_tpu.config import ExperimentConfig
+    from serverless_learn_tpu.training.checkpoint import LocalStore
+    from serverless_learn_tpu.training.elastic import (ElasticTrainer,
+                                                       EpochTransition)
+
+    coord = PyCoordinator(port=0, lease_ttl_ms=5000, sweep_ms=200)
+    coord.start()
+    cfg = ExperimentConfig.from_dict({
+        "membership": {"remesh_debounce_s": 0.3},
+        "control": {"heartbeat_interval_ms": 100}})
+    et = ElasticTrainer(cfg, LocalStore(str(tmp_path / "ckpt")),
+                        coordinator_addr=coord.addr, name="debounce-w")
+    try:
+        et._start_agent()
+        time.sleep(0.3)
+        epoch, devices = et._current_world()
+        et.transitions.append(EpochTransition(
+            epoch=epoch, step=0, n_devices=len(devices),
+            stripe=et._stripe()))
+        et._remesh.clear()
+        # a bounce: two quick epoch-change notifications
+        et._on_epoch_change(epoch + 1, [])
+        et._on_epoch_change(epoch + 2, [])
+        assert not et._remesh_due()  # debounce holds it
+        time.sleep(0.45)
+        # settled view == formed world -> remesh skipped AND cleared
+        assert not et._remesh_due()
+        assert not et._remesh.is_set()
+        # a REAL change (world size differs) does fire after the debounce
+        et.transitions[-1].n_devices += 1
+        et._on_epoch_change(epoch + 3, [])
+        assert not et._remesh_due()
+        time.sleep(0.45)
+        assert et._remesh_due()
+    finally:
+        if et._agent is not None:
+            et._agent.stop(deregister=False)
+        coord.stop()
+
+
+def test_gossip_suspicion_fires_health_alert():
+    """Asymmetric partition, the other direction: a worker reaches the
+    master but its PEER probes time out — the gossip suspicion counter is
+    an incident signal and the health engine turns it into an alert."""
+    from serverless_learn_tpu.config import HealthConfig
+    from serverless_learn_tpu.control.gossip import (GossipConfig,
+                                                     GossipNode)
+    from serverless_learn_tpu.telemetry import get_registry
+    from serverless_learn_tpu.telemetry.health import HealthEngine
+
+    engine = HealthEngine(registry=get_registry(),
+                          config=HealthConfig(sample_interval_s=3600),
+                          dump_on_critical=False)
+    try:
+        cfg = GossipConfig(protocol_period_s=0.2, ping_timeout_s=0.05)
+        node = GossipNode("hx", "ahx", cfg, rng=random.Random("hx"))
+        engine.sample_once()  # baseline AFTER the counters exist
+        # hand it a peer that will never ack
+        import json as json_mod
+
+        node.on_message(json_mod.dumps(
+            {"v": 1, "t": "ping", "from": "ghost", "fa": "aghost",
+             "seq": 1, "g": [{"id": "ghost", "a": "aghost", "i": 0,
+                              "s": "alive", "m": {}}]}).encode(), 0.0)
+        now = 0.0
+        for _ in range(40):
+            now += 0.1
+            node.tick(now)
+            if node.suspect_ids():
+                break
+        assert node.suspect_ids() == ["ghost"]
+        engine.sample_once()
+        firing = {al["alert"] for al in engine.alerts(firing_only=True)}
+        assert "event.gossip_suspicion" in firing, firing
+    finally:
+        engine.stop()

@@ -1,0 +1,337 @@
+"""SWIM gossip membership (round 11): protocol core, wire fuzzing, and
+the live UDP agent/coordinator integration."""
+
+import json
+import os
+import random
+import time
+
+import pytest
+
+from serverless_learn_tpu.control.gossip import (
+    ALIVE, DEAD, SUSPECT, GossipConfig, GossipNode, decode_payload)
+
+
+# ---------------------------------------------------------------------------
+# deterministic in-line harness (no sockets, explicit clock)
+# ---------------------------------------------------------------------------
+
+
+class Loopnet:
+    """Tiny synchronous message bus for driving GossipNodes directly."""
+
+    def __init__(self, cfg, n, seed=0):
+        self.cfg = cfg
+        self.nodes = {f"n{i}": GossipNode(
+            f"n{i}", f"a{i}", cfg, rng=random.Random(f"t-{seed}-{i}"))
+            for i in range(n)}
+        self.addr2id = {f"a{i}": f"n{i}" for i in range(n)}
+        self.alive = set(self.nodes)
+        self.pending = []
+        self.now = 0.0
+        self.blocked = set()  # (src, dst) pairs dropped
+
+    def dispatch(self, src_id, outs):
+        for addr, payload in outs:
+            dst = self.addr2id.get(addr)
+            if dst and (src_id, dst) not in self.blocked:
+                self.pending.append((self.now + 0.01, dst, src_id, payload))
+
+    def join_all(self, seed_addr="a0"):
+        for nid, node in self.nodes.items():
+            if node.addr != seed_addr:
+                self.dispatch(nid, node.join([seed_addr], self.now))
+
+    def run(self, duration, dt=0.05):
+        end = self.now + duration
+        while self.now < end:
+            self.now += dt
+            due = [p for p in self.pending if p[0] <= self.now]
+            for p in due:
+                self.pending.remove(p)
+                _, dst, src, payload = p
+                if dst in self.alive and (src, dst) not in self.blocked:
+                    self.dispatch(dst, self.nodes[dst].on_message(
+                        payload, self.now))
+            for nid in list(self.alive):
+                self.dispatch(nid, self.nodes[nid].tick(self.now))
+
+    def views_agree(self):
+        want = sorted(self.alive)
+        return all(self.nodes[n].alive_ids() == want for n in self.alive)
+
+
+CFG = GossipConfig(protocol_period_s=0.5, ping_timeout_s=0.15)
+
+
+def test_membership_forms_and_agrees():
+    net = Loopnet(CFG, 10)
+    net.join_all()
+    net.run(8.0)
+    assert net.views_agree()
+    # epochs settle: every confirmed join bumped them, nothing after
+    epochs = [net.nodes[n].epoch for n in sorted(net.alive)]
+    net.run(4.0)
+    assert [net.nodes[n].epoch for n in sorted(net.alive)] == epochs
+
+
+def test_killed_node_detected_and_disseminated():
+    net = Loopnet(CFG, 10)
+    net.join_all()
+    net.run(8.0)
+    net.alive.discard("n3")
+    t_kill = net.now
+    for _ in range(200):
+        net.run(0.5)
+        if all("n3" not in net.nodes[n].alive_ids() for n in net.alive):
+            break
+    else:
+        pytest.fail("n3 never declared dead everywhere")
+    periods = (net.now - t_kill) / CFG.protocol_period_s
+    # detection (probe + suspicion timeout) + dissemination, all O(log N)
+    import math
+    log_n = math.ceil(math.log2(len(net.nodes) + 1))
+    assert periods <= 4 + (CFG.suspicion_mult + 3) * log_n
+
+
+def test_suspected_but_alive_refutes_without_flapping():
+    """The no-remesh-flap contract: a member that merely STOPS ANSWERING
+    for a while (blocked links, paused process) is suspected, refutes with
+    an incarnation bump once reachable, and no node ever (a) declares it
+    dead or (b) bumps its membership epoch — suspicion is invisible to
+    elastic."""
+    net = Loopnet(CFG, 8)
+    net.join_all()
+    net.run(8.0)
+    assert net.views_agree()
+    epochs_before = {n: net.nodes[n].epoch for n in net.alive}
+    # block everyone's path to n5 (and back) long enough to be suspected
+    # but shorter than the suspicion timeout
+    victim = "n5"
+    net.blocked = {(a, b) for a in net.nodes for b in net.nodes
+                   if victim in (a, b) and a != b}
+    suspicion_timeout = (CFG.suspicion_mult *
+                         __import__("math").ceil(
+                             __import__("math").log2(9))
+                         * CFG.protocol_period_s)
+    net.run(min(2.5 * CFG.protocol_period_s, 0.8 * suspicion_timeout))
+    suspected = any(victim in net.nodes[n].suspect_ids()
+                    for n in net.alive if n != victim)
+    assert suspected, "victim was never suspected while unreachable"
+    inc_before = net.nodes[victim].incarnation
+    net.blocked = set()
+    net.run(6.0)
+    # refuted: alive everywhere, incarnation bumped, never dead
+    for n in net.alive:
+        members = net.nodes[n].members()
+        if victim in members:
+            assert members[victim].state == ALIVE
+    assert net.nodes[victim].incarnation > inc_before
+    # zero epoch churn: suspicion + refutation is not a membership change
+    assert {n: net.nodes[n].epoch for n in net.alive} == epochs_before
+
+
+def test_graceful_leave_skips_suspicion():
+    net = Loopnet(CFG, 6)
+    net.join_all()
+    net.run(6.0)
+    leaver = net.nodes["n4"]
+    net.dispatch("n4", leaver.leave(net.now))
+    net.alive.discard("n4")
+    net.run(3.0)
+    for n in net.alive:
+        m = net.nodes[n].members().get("n4")
+        assert m is not None and m.state in ("left", "dead")
+        assert "n4" not in net.nodes[n].alive_ids()
+
+
+# ---------------------------------------------------------------------------
+# wire fuzzing: malformed payloads must be counted, never raised
+# ---------------------------------------------------------------------------
+
+
+def _counter_value(name):
+    from serverless_learn_tpu.telemetry import get_registry
+
+    fam = get_registry().snapshot().get(name)
+    if not fam:
+        return 0.0
+    return sum(s.get("value", 0.0) for s in fam.get("series", []))
+
+
+def test_fuzz_malformed_payloads_never_crash():
+    node = GossipNode("x", "ax", CFG, rng=random.Random("fuzz"))
+    rng = random.Random(1234)
+    bad_before = _counter_value("slt_gossip_bad_payloads_total")
+    cases = [
+        b"", b"{", b"null", b"[]", b'"str"', b"\xff\xfe\x00",
+        json.dumps({"v": 99, "t": "ping", "from": "a", "fa": "x",
+                    "seq": 1}).encode(),
+        json.dumps({"v": 1, "t": 3, "from": "a", "fa": "x",
+                    "seq": 1}).encode(),
+        json.dumps({"v": 1, "t": "ping", "from": None, "fa": "x",
+                    "seq": 1}).encode(),
+        json.dumps({"v": 1, "t": "ping", "from": "a", "fa": "x",
+                    "seq": "NaN"}).encode(),
+        json.dumps({"v": 1, "t": "ping", "from": "a", "fa": "x",
+                    "seq": True}).encode(),
+        json.dumps({"v": 1, "t": "ping", "from": "a", "fa": "x", "seq": 1,
+                    "g": {"not": "a list"}}).encode(),
+        b"x" * (70 * 1024),  # oversized datagram
+    ]
+    # seeded-random byte soup, including truncations of a VALID packet
+    valid = node.tick(0.0)
+    base = json.dumps({"v": 1, "t": "ping", "from": "z", "fa": "az",
+                       "seq": 7, "g": [{"id": "q", "a": "aq", "i": 3,
+                                        "s": "alive", "m": {}}]}).encode()
+    for _ in range(300):
+        cases.append(bytes(rng.randrange(256) for _ in
+                           range(rng.randrange(0, 200))))
+        cases.append(base[:rng.randrange(0, len(base))])
+    for data in cases:
+        node.on_message(data, 1.0)  # must never raise
+    assert _counter_value("slt_gossip_bad_payloads_total") > bad_before
+    # malformed g-entries inside a valid packet are skipped silently
+    mixed = json.dumps({"v": 1, "t": "ping", "from": "z", "fa": "az",
+                        "seq": 8, "g": [
+                            {"id": "ok", "a": "aok", "i": 1, "s": "alive",
+                             "m": {}},
+                            {"id": 5, "a": "bad"},
+                            {"id": "neg", "a": "x", "i": -3, "s": "alive",
+                             "m": {}},
+                            "not a dict"]}).encode()
+    node.on_message(mixed, 2.0)
+    assert "ok" in node.members()
+    assert "neg" not in node.members()
+
+
+def test_stale_incarnation_replay_dropped_with_counter():
+    node = GossipNode("x", "ax", CFG, rng=random.Random("stale"))
+
+    def pkt(inc, state, seq):
+        return json.dumps({"v": 1, "t": "ping", "from": "peer", "fa": "ap",
+                           "seq": seq, "g": [{"id": "m1", "a": "am1",
+                                              "i": inc, "s": state,
+                                              "m": {}}]}).encode()
+
+    node.on_message(pkt(5, "alive", 1), 1.0)
+    assert node.members()["m1"].incarnation == 5
+    stale_before = _counter_value("slt_gossip_stale_updates_total")
+    node.on_message(pkt(2, "alive", 2), 2.0)    # old-incarnation replay
+    node.on_message(pkt(5, "alive", 3), 3.0)    # same-rank duplicate
+    node.on_message(pkt(2, "suspect", 4), 4.0)  # stale suspicion replay
+    m = node.members()["m1"]
+    assert m.incarnation == 5 and m.state == ALIVE
+    assert _counter_value("slt_gossip_stale_updates_total") > stale_before
+    # fresher suspicion still lands
+    node.on_message(pkt(5, "suspect", 5), 5.0)
+    assert node.members()["m1"].state == SUSPECT
+
+
+def test_decode_payload_contract():
+    assert decode_payload(b"nope") is None
+    assert decode_payload(json.dumps(
+        {"v": 1, "t": "ping", "from": "a", "fa": "b", "seq": 0,
+         "g": []}).encode()) is not None
+
+
+# ---------------------------------------------------------------------------
+# live UDP integration: agents + gossip-mode py-coordinator
+# ---------------------------------------------------------------------------
+
+
+def _wait_until(fn, timeout=10.0, dt=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(dt)
+    return False
+
+
+def test_gossip_agents_with_coordinator():
+    """Three GossipAgents + a gossip-mode PyCoordinator over real UDP:
+    everyone sees everyone; killing one agent's process (no graceful
+    leave) gets it detected by gossip and evicted by the coordinator
+    without waiting out a lease."""
+    from serverless_learn_tpu.config import MembershipConfig
+    from serverless_learn_tpu.control.gossip import GossipAgent
+    from serverless_learn_tpu.control.py_daemons import PyCoordinator
+
+    coord = PyCoordinator(port=0, lease_ttl_ms=60000, sweep_ms=100,
+                          gossip_port=0)
+    coord.start()
+    mcfg = MembershipConfig(mode="gossip",
+                            seed=coord.gossip_runtime.addr,
+                            protocol_period_ms=100, ping_timeout_ms=30)
+    agents = []
+    try:
+        for i in range(3):
+            a = GossipAgent(coord.addr, f"local:{i}", name=f"g{i}",
+                            heartbeat_interval_ms=200,
+                            membership=mcfg).start()
+            agents.append(a)
+        assert _wait_until(
+            lambda: all(len(a.snapshot()[1]) == 3 for a in agents)), \
+            [a.snapshot() for a in agents]
+        victim = agents[2]
+        victim_id = victim.worker_id
+        # hard kill: no leave broadcast, no deregister
+        victim._runtime._stop.set()
+        victim._runtime.sock.close()
+        victim._inner._stop.set()
+        assert _wait_until(
+            lambda: all(len(a.snapshot()[1]) == 2 for a in agents[:2]),
+            timeout=15.0), [a.snapshot() for a in agents[:2]]
+        # the coordinator's gossip node evicted it (lease was 60s)
+        assert _wait_until(
+            lambda: victim_id not in {
+                p.worker_id for p in
+                agents[0]._inner.client.membership().peers},
+            timeout=15.0)
+    finally:
+        for a in agents:
+            try:
+                a.stop(deregister=False)
+            except Exception:
+                pass
+        coord.stop()
+
+
+def test_make_membership_agent_mode_switch():
+    from serverless_learn_tpu.config import ExperimentConfig
+    from serverless_learn_tpu.control.client import WorkerAgent
+    from serverless_learn_tpu.control.gossip import (
+        GossipAgent, make_membership_agent)
+    from serverless_learn_tpu.control.py_daemons import PyCoordinator
+
+    coord = PyCoordinator(port=0, gossip_port=0)
+    coord.start()
+    try:
+        cfg = ExperimentConfig.from_dict({})
+        a = make_membership_agent(cfg, coord.addr, "local:0", name="m0")
+        assert isinstance(a, WorkerAgent)
+        cfg2 = ExperimentConfig.from_dict({"membership": {
+            "mode": "gossip", "seed": coord.gossip_runtime.addr,
+            "protocol_period_ms": 100, "ping_timeout_ms": 30}})
+        b = make_membership_agent(cfg2, coord.addr, "local:1", name="m1")
+        assert isinstance(b, GossipAgent)
+        b.start()
+        assert _wait_until(lambda: any(
+            p.name == "m1" for p in b.snapshot()[1]))
+        b.stop()
+    finally:
+        coord.stop()
+
+
+def test_membership_config_roundtrip():
+    from serverless_learn_tpu.config import ExperimentConfig
+
+    cfg = ExperimentConfig.from_json(json.dumps({
+        "membership": {"mode": "gossip", "remesh_debounce_s": 1.5,
+                       "safe_pause": True}}))
+    assert cfg.membership.mode == "gossip"
+    assert cfg.membership.remesh_debounce_s == 1.5
+    assert cfg.membership.safe_pause
+    back = json.loads(cfg.to_json())
+    assert back["membership"]["quorum_fraction"] == 0.5

@@ -282,7 +282,10 @@ def test_zigzag_halves_causal_compute(sp2_mesh):
     for layout in ("contiguous", "zigzag"):
         fn = jax.jit(lambda q, k, v, lay=layout: ring_attention(
             q, k, v, causal=True, layout=lay, mesh=sp2_mesh))
-        flops[layout] = fn.lower(q, k, v).compile().cost_analysis()["flops"]
+        analysis = fn.lower(q, k, v).compile().cost_analysis()
+        if isinstance(analysis, (list, tuple)):  # newer jax: list of dicts
+            analysis = analysis[0]
+        flops[layout] = analysis["flops"]
     assert flops["zigzag"] < 0.6 * flops["contiguous"], flops
 
 
