@@ -10,6 +10,8 @@ Here one typed CLI fronts everything:
     python -m serverless_learn_tpu eval         # forward-only evaluation
     python -m serverless_learn_tpu generate     # KV-cache LM sampling
     python -m serverless_learn_tpu serve        # generation server (TCP/JSON)
+    python -m serverless_learn_tpu route        # fleet router (health-aware front door)
+    python -m serverless_learn_tpu loadgen      # open/closed-loop load generator
     python -m serverless_learn_tpu worker       # elastic worker (joins a cluster)
     python -m serverless_learn_tpu coordinator  # native membership daemon
     python -m serverless_learn_tpu shard-server # native data-plane daemon
@@ -270,6 +272,28 @@ def _init_tracing_from_args(args):
     log_json({"event": "tracing", "node": name,
               **({"events_log": events_log} if events_log else {}),
               "flight_dir": flight_dir}, stream=sys.stdout)
+
+
+def _light_config(args) -> "ExperimentConfig":
+    """Config for jax-free commands (route, loadgen): file + --set only,
+    no default-mesh derivation (which would import jax and touch the
+    device backend on nodes that have none)."""
+    from serverless_learn_tpu.config import ExperimentConfig
+
+    raw = {}
+    if getattr(args, "config", None):
+        with open(args.config) as f:
+            raw = json.load(f)
+    for item in getattr(args, "set", None) or []:
+        path, _, val = item.partition("=")
+        if not _:
+            raise SystemExit(f"--set expects dotted.key=value, got {item!r}")
+        node = raw
+        keys = path.split(".")
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = _coerce(val)
+    return ExperimentConfig.from_dict(raw)
 
 
 def _make_checkpointer(args, name: Optional[str] = None):
@@ -613,8 +637,39 @@ def cmd_serve(args) -> int:
                               profile_dir=args.profile_dir)
     health = _start_health(args, cfg, exporter=server._exporter,
                            registry=server.registry)
+    registration = None
+    if args.fleet:
+        # Replica self-registration (fleet/registration.py): join the
+        # coordinator directory at birth so the router discovers this
+        # replica without a static list; SIGTERM deregisters FIRST (the
+        # router stops routing here instantly), then drains in-flight
+        # work before exiting.
+        import signal
+
+        from serverless_learn_tpu.fleet.registration import (
+            FleetRegistration)
+
+        registration = FleetRegistration(
+            cfg.control.coordinator_addr, server.addr, service=args.fleet,
+            metrics_addr=server.metrics_addr,
+            heartbeat_interval_ms=cfg.control.heartbeat_interval_ms).start()
+        grace = (cfg.fleet.drain_grace_s if args.drain_grace_s is None
+                 else args.drain_grace_s)
+
+        def _terminate(signum, frame):
+            try:
+                registration.stop()
+            except Exception:
+                pass
+            server.drain(grace)
+            raise SystemExit(0)
+
+        signal.signal(signal.SIGTERM, _terminate)
     log_json({"event": "serving", "addr": server.addr,
               "model": cfg.model,
+              **({"fleet": args.fleet,
+                  "worker_id": registration.worker_id}
+                 if registration else {}),
               **({"metrics_addr": server.metrics_addr}
                  if server.metrics_addr else {})}, stream=sys.stdout)
     try:
@@ -622,10 +677,149 @@ def cmd_serve(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if registration is not None:
+            try:
+                registration.stop()
+            except Exception:
+                pass
         if health is not None:
             health.stop()
         server.stop()
     return 0
+
+
+def cmd_route(args) -> int:
+    """Run the fleet router: one front-door address over N engine
+    replicas (fleet/router.py). Replicas come from --replicas (static)
+    and/or coordinator membership discovery (`serve --fleet`
+    self-registration). Health-aware, least-loaded + session-affine,
+    hedging, brownout-shedding; with --health + a queue-wait SLO in
+    health.slos the burn-rate alerts can drive the autoscaler
+    (--autoscale + --replica-cmd). Deliberately jax-free — a router node
+    needs no devices."""
+    import dataclasses as _dc
+    import time as _time
+
+    from serverless_learn_tpu.fleet.router import FleetRouter
+    from serverless_learn_tpu.utils.metrics import log_json
+
+    _init_tracing_from_args(args)
+    cfg = _light_config(args)
+    fcfg = cfg.fleet
+    if args.host:
+        fcfg = _dc.replace(fcfg, router_host=args.host)
+    if args.port is not None:
+        fcfg = _dc.replace(fcfg, router_port=args.port)
+    replicas = []
+    for chunk in (args.replicas or []):
+        replicas.extend(a for a in chunk.split(",") if a.strip())
+    if not replicas and fcfg.replicas:
+        replicas = [a for a in fcfg.replicas.split(",") if a.strip()]
+    # Discovery runs when a coordinator is explicitly named (flag or
+    # config file) — the ControlConfig default must not make a
+    # static-list router dial a coordinator nobody started.
+    coordinator = args.coordinator
+    if coordinator is None and not replicas:
+        coordinator = cfg.control.coordinator_addr
+    exporter = _start_metrics(args)
+    health = _start_health(args, cfg, exporter=exporter)
+    router = FleetRouter(config=fcfg, replicas=tuple(replicas),
+                         coordinator_addr=coordinator)
+    scaler = None
+    if args.autoscale or fcfg.autoscale:
+        from serverless_learn_tpu.fleet.autoscaler import (FleetAutoscaler,
+                                                           ProcessLauncher)
+
+        if health is None:
+            raise SystemExit(
+                "--autoscale needs the health engine (--health + a "
+                "queue-wait SLO in health.slos) for burn-rate alerts")
+        if not args.replica_cmd:
+            raise SystemExit(
+                "--autoscale needs --replica-cmd 'slt serve --fleet ...' "
+                "to launch replicas")
+        import shlex
+
+        launcher = ProcessLauncher(shlex.split(args.replica_cmd),
+                                   baseline=len(replicas))
+        scaler = FleetAutoscaler(
+            launcher, lambda: health.alerts(firing_only=True),
+            min_replicas=fcfg.min_replicas,
+            max_replicas=fcfg.max_replicas,
+            alert_substr=fcfg.alert_substr,
+            scale_out_cooldown_s=fcfg.scale_out_cooldown_s,
+            scale_in_cooldown_s=fcfg.scale_in_cooldown_s,
+            scale_in_calm_s=fcfg.scale_in_calm_s).start()
+    router.start()
+    log_json({"event": "routing", "addr": router.addr,
+              "service": fcfg.service,
+              "replicas": [r["addr"] for r in router.replicas()],
+              **({"coordinator": coordinator} if coordinator else {}),
+              **({"autoscale": True} if scaler else {}),
+              **({"metrics_addr": exporter.addr} if exporter else {})},
+             stream=sys.stdout)
+    try:
+        while True:
+            _time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if scaler is not None:
+            scaler.stop()
+            launcher.stop_all()
+        if health is not None:
+            health.stop()
+        router.stop()
+        if exporter is not None:
+            exporter.stop()
+    return 0
+
+
+def cmd_loadgen(args) -> int:
+    """Closed/open-loop load generation (fleet/loadgen.py): Poisson,
+    diurnal or flash-crowd arrivals against any JSON-lines serving
+    address (a replica or the router), producing a latency-vs-offered-
+    load curve. --record appends fleet_*_p99_ms rows to
+    bench_history.json (gated by `slt bench --gate --metric fleet`).
+    --smoke runs the self-contained 2-replica kill/restart proof (CI)."""
+    from serverless_learn_tpu.fleet import loadgen
+
+    if args.smoke:
+        rep = loadgen.run_smoke(
+            seed=args.seed, rate_rps=args.rate or 40.0,
+            duration_s=args.duration or 6.0,
+            history_path=args.history if args.record else None)
+        out = dict(rep)
+        out["alerts"] = [{"alert": a.get("alert"), "state": a.get("state")}
+                         for a in rep.get("alerts", [])]
+        print(json.dumps(out, indent=None if args.compact else 2))
+        return 0 if rep["ok"] else 1
+    if not args.addr:
+        print("loadgen needs --addr HOST:PORT (or --smoke)",
+              file=sys.stderr)
+        return 2
+    if args.mode == "closed":
+        rep = loadgen.run_closed_loop(
+            args.addr, concurrency=args.concurrency,
+            n_requests=args.requests, seed=args.seed,
+            timeout_s=args.timeout)
+        print(json.dumps(rep, indent=None if args.compact else 2))
+        return 0
+    rates = ([float(r) for chunk in args.rates for r in chunk.split(",")
+              if r.strip()] if args.rates else [args.rate or 10.0])
+    points = loadgen.run_curve(
+        args.addr, rates, args.duration or 10.0, seed=args.seed,
+        arrival=args.arrival, timeout_s=args.timeout)
+    rows = loadgen.bench_rows(points, label=args.label,
+                              device_kind=args.device_kind)
+    if args.record:
+        loadgen.record_rows(rows, args.history)
+    rep = {"mode": "open", "arrival": args.arrival, "points": points,
+           "bench_rows": rows,
+           "recorded": bool(args.record),
+           "hard_failures": sum(p["hard_failures"] for p in points)}
+    print(json.dumps(rep, indent=None if args.compact else 2))
+    return 0 if rep["hard_failures"] == 0 else 1
 
 
 def cmd_diloco(args) -> int:
@@ -1123,6 +1317,38 @@ def cmd_chaos(args) -> int:
     from serverless_learn_tpu.chaos.sim import ChaosSim
     from serverless_learn_tpu.control.gossip import GossipConfig
 
+    if args.mode == "fleet":
+        # Real-socket fleet chaos (chaos/fleet.py): stub replicas behind
+        # TcpChaosProxy, a live router, open-loop load, REAL seconds.
+        # Default plan: kill one replica, restart it later — the doctor
+        # acceptance shape.
+        from serverless_learn_tpu.chaos.fleet import FleetChaosRun
+
+        if args.plan:
+            try:
+                with open(args.plan) as f:
+                    plan = FaultPlan.from_json(f.read())
+            except (OSError, ValueError) as e:
+                print(f"bad fault plan: {e}", file=sys.stderr)
+                return 2
+        else:
+            plan = FaultPlan.from_obj({"faults": [
+                {"at": 0.8, "op": "kill", "node": "replica-0"},
+                {"at": 2.4, "op": "restart", "node": "replica-0"}]})
+        try:
+            run = FleetChaosRun(n_replicas=min(args.nodes, 16), plan=plan,
+                                seed=args.seed,
+                                events_log=args.events_log)
+        except ValueError as e:
+            print(f"bad fleet plan: {e}", file=sys.stderr)
+            return 2
+        rep = run.run(args.duration)
+        if not args.full:
+            rep = dict(rep)
+            rep["faults_injected"] = len(rep["faults_injected"])
+        print(json.dumps(rep, indent=None if args.compact else 2))
+        return 0 if rep["ok"] else 1
+
     gossip = GossipConfig(
         protocol_period_s=args.period_ms / 1000.0,
         ping_timeout_s=args.period_ms / 1000.0 * 0.3)
@@ -1237,7 +1463,111 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--chunk-size", type=int, default=32,
                     help="decode tokens per jitted chunk between admission "
                          "boundaries (continuous engine)")
+    sv.add_argument("--fleet", nargs="?", const="serve", default=None,
+                    metavar="SERVICE",
+                    help="join the serving fleet: register with the "
+                         "coordinator (control.coordinator_addr) as "
+                         "replica:<SERVICE> at startup so `slt route` "
+                         "discovers this replica, and deregister + drain "
+                         "in-flight requests on SIGTERM (default service "
+                         "name: serve)")
+    sv.add_argument("--drain-grace-s", type=float, default=None,
+                    help="with --fleet: max seconds to wait for in-flight "
+                         "requests on SIGTERM (default: config "
+                         "fleet.drain_grace_s)")
     sv.set_defaults(fn=cmd_serve)
+
+    rt = sub.add_parser("route",
+                        help="fleet router: one front door over N engine "
+                             "replicas (health-gated, least-loaded + "
+                             "session-affine, hedging, brownout shedding)")
+    rt.add_argument("--config", help="JSON config file (fleet/health "
+                                     "sections)")
+    rt.add_argument("--set", action="append", metavar="dotted.key=value",
+                    help="override any config field, e.g. "
+                         "--set fleet.max_inflight=128")
+    rt.add_argument("--host", default=None,
+                    help="bind address (default fleet.router_host)")
+    rt.add_argument("--port", type=int, default=None,
+                    help="bind port (default fleet.router_port; 0 = auto)")
+    rt.add_argument("--replicas", action="append", metavar="ADDR[,ADDR]",
+                    default=None,
+                    help="static replica list (comma- or repeat-"
+                         "separated); without it, replicas are discovered "
+                         "from the coordinator (`serve --fleet`)")
+    rt.add_argument("--coordinator", metavar="ADDR", default=None,
+                    help="coordinator to poll for replica:<service> "
+                         "members (default: control.coordinator_addr "
+                         "when no --replicas are given)")
+    rt.add_argument("--autoscale", action="store_true",
+                    help="run the burn-rate autoscaler (needs --health, "
+                         "a queue-wait SLO in health.slos, and "
+                         "--replica-cmd)")
+    rt.add_argument("--replica-cmd", metavar="CMD", default=None,
+                    help="command line that launches one replica "
+                         "(e.g. 'python -m serverless_learn_tpu serve "
+                         "--fleet --port 0 ...'); scale-in SIGTERMs the "
+                         "youngest, which deregisters + drains")
+    rt.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                    help="serve /metrics (+/alerts,/healthz with "
+                         "--health) from this port (0 = auto)")
+    rt.add_argument("--health", action="store_true",
+                    help="run the health engine over the router's "
+                         "metrics — declare a queue-wait SLO on "
+                         "slt_router_queue_wait_seconds in health.slos "
+                         "to arm burn-rate scale-out alerts")
+    rt.add_argument("--events-log", metavar="PATH", default=None,
+                    help="append router alert/span JSONL here (doctor/"
+                         "trace input)")
+    rt.add_argument("--flight-dir", metavar="DIR", default=None)
+    rt.add_argument("--node", default=None)
+    rt.add_argument("--profile-dir", default=None, help=argparse.SUPPRESS)
+    rt.set_defaults(fn=cmd_route)
+
+    lg = sub.add_parser("loadgen",
+                        help="closed/open-loop load generator: Poisson/"
+                             "diurnal/flash-crowd arrivals, latency-vs-"
+                             "offered-load curves into bench_history.json")
+    lg.add_argument("--addr", metavar="HOST:PORT", default=None,
+                    help="serving address (router or single replica)")
+    lg.add_argument("--mode", choices=["open", "closed"], default="open")
+    lg.add_argument("--arrival", choices=["poisson", "diurnal", "flash"],
+                    default="poisson")
+    lg.add_argument("--rate", type=float, default=None,
+                    help="offered rps (open loop; --smoke default 40)")
+    lg.add_argument("--rates", action="append", metavar="R[,R]",
+                    default=None,
+                    help="sweep these offered rates into one curve")
+    lg.add_argument("--duration", type=float, default=None,
+                    help="seconds per curve point (default 10; "
+                         "--smoke default 6)")
+    lg.add_argument("--requests", type=int, default=100,
+                    help="closed loop: total requests")
+    lg.add_argument("--concurrency", type=int, default=8,
+                    help="closed loop: worker count")
+    lg.add_argument("--timeout", type=float, default=30.0,
+                    help="per-request client timeout")
+    lg.add_argument("--seed", type=int, default=0,
+                    help="arrival + payload RNG seed (same seed = "
+                         "identical request schedule)")
+    lg.add_argument("--label", default="fleet",
+                    help="bench row metric prefix "
+                         "(<label>_loadgen_<rate>rps_p99_ms)")
+    lg.add_argument("--device-kind", default="fleet",
+                    help="bench row comparability key")
+    lg.add_argument("--history", default="bench_history.json",
+                    help="bench history file for --record")
+    lg.add_argument("--record", action="store_true",
+                    help="append the curve's rows to the bench history "
+                         "(gate them via `slt bench --gate --metric "
+                         "<label>`)")
+    lg.add_argument("--smoke", action="store_true",
+                    help="self-contained CI proof: 2-replica stub fleet, "
+                         "open-loop load, one replica killed + restarted "
+                         "mid-run; exit 0 iff zero failed requests")
+    lg.add_argument("--compact", action="store_true",
+                    help="single-line JSON (for scripts)")
+    lg.set_defaults(fn=cmd_loadgen)
 
     w = sub.add_parser("worker", help="elastic worker: join a cluster & train")
     _add_train_flags(w)
@@ -1498,9 +1828,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="fault-injection chaos harness: run a "
                              "FaultPlan (or a seeded random soak) against "
                              "N simulated gossip members on virtual time")
-    ch.add_argument("mode", choices=["run", "soak"],
-                    help="run: execute --plan; soak: seeded random "
-                         "schedule of kills/partitions/stragglers")
+    ch.add_argument("mode", choices=["run", "soak", "fleet"],
+                    help="run: execute --plan on the gossip simulator; "
+                         "soak: seeded random schedule of kills/"
+                         "partitions/stragglers; fleet: execute --plan "
+                         "(kill/restart/pause/delay/heal) against a REAL "
+                         "router + stub replicas through TcpChaosProxy")
     ch.add_argument("--plan", metavar="FILE.json",
                     help="FaultPlan (chaos/plan.py DSL); required for run")
     ch.add_argument("--nodes", type=int, default=50,

@@ -274,6 +274,54 @@ class MembershipConfig:
 
 
 @dataclass(frozen=True)
+class FleetConfig:
+    """Serving-fleet knobs (``fleet/``): the front-door router
+    (``slt route``), replica self-registration (``serve --fleet``) and the
+    burn-rate-driven autoscaler.
+
+    The router is robustness-first: per-replica health gating from each
+    replica's ``/healthz``+``/alerts``, least-loaded + session-affine
+    picking, hedged retries for idempotent generation after a p95-based
+    hedge delay, outlier ejection, and brownout shedding (a typed
+    ``overloaded`` error before queues melt). The autoscaler consumes the
+    queue-wait SLO burn-rate alerts (``health.slos``) — scale-out on
+    fast-burn, scale-in only after a sustained calm window plus cooldown,
+    always through a graceful drain.
+    """
+
+    service: str = "serve"        # replicas register as replica:<service>
+    router_host: str = "127.0.0.1"
+    router_port: int = 50070
+    replicas: str = ""            # static comma-separated replica addrs
+    discover_interval_s: float = 2.0   # coordinator membership poll
+    health_interval_s: float = 1.0     # /healthz + liveness probe period
+    # ---- admission / brownout shedding ----
+    max_inflight: int = 64        # router-wide in-flight capacity
+    queue_timeout_s: float = 2.0  # bounded admission wait before shedding
+    shed_start_frac: float = 0.8  # brownout: shed priority<=0 above this
+    # ---- hedging (idempotent requests only) ----
+    hedge: bool = True
+    hedge_after_p95_mult: float = 1.5
+    hedge_min_delay_s: float = 0.05
+    max_retries: int = 2          # failover resends after transport errors
+    upstream_timeout_s: float = 60.0
+    # ---- outlier ejection ----
+    eject_consecutive_errors: int = 3
+    eject_s: float = 5.0
+    dead_after_probes: int = 3    # failed liveness probes => replica dead
+    # ---- drain / retirement ----
+    drain_grace_s: float = 10.0
+    # ---- autoscaler ----
+    autoscale: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 4
+    alert_substr: str = "queue_wait"   # react to alerts naming this
+    scale_out_cooldown_s: float = 30.0
+    scale_in_cooldown_s: float = 120.0
+    scale_in_calm_s: float = 60.0
+
+
+@dataclass(frozen=True)
 class HealthConfig:
     """Cluster-health engine knobs (``telemetry/health.py``).
 
@@ -337,6 +385,7 @@ class ExperimentConfig:
     local_sgd: LocalSGDConfig = field(default_factory=LocalSGDConfig)
     health: HealthConfig = field(default_factory=HealthConfig)
     membership: MembershipConfig = field(default_factory=MembershipConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
@@ -364,6 +413,7 @@ class ExperimentConfig:
             local_sgd=build(LocalSGDConfig, raw.get("local_sgd")),
             health=build(HealthConfig, raw.get("health")),
             membership=build(MembershipConfig, raw.get("membership")),
+            fleet=build(FleetConfig, raw.get("fleet")),
         )
 
     def override(self, **kwargs: Any) -> "ExperimentConfig":

@@ -1,0 +1,28 @@
+"""Serving fleet (round 12): router, replica registration, autoscaler,
+load generator.
+
+The reference's headline capability is elastic membership — processes
+join a well-known directory at birth and the cluster grows/shrinks at
+runtime (SURVEY §0, capability 1). ``fleet/`` applies that to the
+serving plane: ``serve --fleet`` replicas self-register with the
+coordinator, ``slt route`` fronts them with a health-aware,
+overload-shedding, hedging router speaking the SAME JSON-lines protocol
+as ``serve``, the autoscaler grows/shrinks the replica set off the
+queue-wait SLO burn-rate alerts, and ``slt loadgen`` turns "handles
+heavy traffic" into a measured TTFT/p99-vs-offered-load curve in
+``bench_history.json``.
+"""
+
+from serverless_learn_tpu.fleet.autoscaler import (CallbackLauncher,
+                                                   FleetAutoscaler,
+                                                   ProcessLauncher)
+from serverless_learn_tpu.fleet.registration import (FleetRegistration,
+                                                     parse_replica,
+                                                     replica_name)
+from serverless_learn_tpu.fleet.router import FleetRouter, Replica
+
+__all__ = [
+    "FleetRouter", "Replica", "FleetRegistration", "replica_name",
+    "parse_replica", "FleetAutoscaler", "CallbackLauncher",
+    "ProcessLauncher",
+]

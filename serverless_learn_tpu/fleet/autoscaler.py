@@ -1,0 +1,197 @@
+"""Elastic autoscaler: the burn-rate alerts drive the replica count.
+
+PR 3's SLO machinery already answers "is queue wait burning error budget
+faster than sustainable?" — this loop just acts on it, the same way the
+elastic trainer acts on membership epochs. Policy:
+
+* **Scale OUT** when a *critical* burn-rate alert matching
+  ``alert_substr`` (default ``queue_wait`` — declare the SLO on
+  ``slt_router_queue_wait_seconds`` in ``health.slos``) is firing: the
+  fast-burn page means clients are already waiting. Bounded by
+  ``max_replicas`` and ``scale_out_cooldown_s`` (one launch per cooldown
+  — a cold replica takes time to absorb load; launching five at once
+  just thrashes).
+* **Scale IN** only after the alert set has been completely calm for
+  ``scale_in_calm_s`` AND ``scale_in_cooldown_s`` has passed since the
+  last scale-in, down to ``min_replicas`` — and always through a
+  graceful drain (the launcher retires a replica by deregistering +
+  draining it, never by killing it).
+
+The launcher is pluggable: :class:`ProcessLauncher` spawns real
+``slt serve --fleet`` processes (scale-in SIGTERMs the youngest, whose
+``--fleet`` handler deregisters and drains); :class:`CallbackLauncher`
+adapts in-process fleets (tests, ``slt loadgen --smoke``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+
+class CallbackLauncher:
+    """Adapts (count, out, in) callables to the launcher interface."""
+
+    def __init__(self, n_replicas: Callable[[], int],
+                 scale_out: Callable[[], None],
+                 scale_in: Callable[[], None]):
+        self._n = n_replicas
+        self._out = scale_out
+        self._in = scale_in
+
+    def n_replicas(self) -> int:
+        return self._n()
+
+    def scale_out(self):
+        self._out()
+
+    def scale_in(self):
+        self._in()
+
+
+class ProcessLauncher:
+    """Spawns replica processes from an argv template. Scale-in retires
+    the YOUNGEST replica (the coldest cache) by SIGTERM — under
+    ``serve --fleet`` that deregisters, drains in-flight work, and
+    exits."""
+
+    def __init__(self, argv: List[str], baseline: int = 0):
+        import subprocess  # noqa: F401  (validated here, used below)
+
+        self.argv = list(argv)
+        self.baseline = baseline  # replicas not owned by this launcher
+        self._procs: List = []
+
+    def n_replicas(self) -> int:
+        self._procs = [p for p in self._procs if p.poll() is None]
+        return self.baseline + len(self._procs)
+
+    def scale_out(self):
+        import subprocess
+
+        self._procs.append(subprocess.Popen(self.argv))
+
+    def scale_in(self):
+        self._procs = [p for p in self._procs if p.poll() is None]
+        if not self._procs:
+            return
+        p = self._procs.pop()
+        p.terminate()  # SIGTERM -> deregister + drain + exit
+
+    def stop_all(self):
+        for p in self._procs:
+            p.terminate()
+        for p in self._procs:
+            try:
+                p.wait(timeout=15)
+            except Exception:
+                p.kill()
+        self._procs = []
+
+
+class FleetAutoscaler:
+    """tick() evaluates policy once; start() runs it on a timer. The
+    alert source is a callable returning the FIRING alert dicts —
+    usually ``lambda: engine.alerts(firing_only=True)`` from the
+    router's in-process HealthEngine, or a /alerts scrape."""
+
+    def __init__(self, launcher, alerts_fn: Callable[[], List[dict]],
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 alert_substr: str = "queue_wait",
+                 scale_out_cooldown_s: float = 30.0,
+                 scale_in_cooldown_s: float = 120.0,
+                 scale_in_calm_s: float = 60.0,
+                 interval_s: float = 2.0,
+                 clock=time.monotonic, registry=None, emit=None):
+        from serverless_learn_tpu.telemetry import get_registry
+
+        self.launcher = launcher
+        self.alerts_fn = alerts_fn
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.alert_substr = alert_substr
+        self.scale_out_cooldown_s = scale_out_cooldown_s
+        self.scale_in_cooldown_s = scale_in_cooldown_s
+        self.scale_in_calm_s = scale_in_calm_s
+        self.interval_s = interval_s
+        self.clock = clock
+        self._emit = emit or (lambda rec: None)
+        self._last_out = -1e18
+        self._last_in = -1e18
+        self._calm_since: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.events: List[dict] = []  # (direction, t, n) audit trail
+        reg = registry or get_registry()
+        self._g_desired = reg.gauge(
+            "slt_autoscaler_replicas", "replica count after the last tick")
+        self._m_outs = reg.counter(
+            "slt_autoscaler_scale_outs_total",
+            "replicas launched on burn-rate fires")
+        self._m_ins = reg.counter(
+            "slt_autoscaler_scale_ins_total",
+            "replicas retired (drained) after sustained calm")
+
+    def _relevant(self, alerts: List[dict]) -> List[dict]:
+        return [a for a in alerts
+                if self.alert_substr in str(a.get("alert", ""))]
+
+    def tick(self, now: Optional[float] = None) -> Optional[str]:
+        """One policy evaluation; returns "out"/"in" when it scaled."""
+        now = self.clock() if now is None else now
+        try:
+            firing = self._relevant(self.alerts_fn())
+        except Exception:
+            firing = []  # an unreachable alert source never scales
+        n = self.launcher.n_replicas()
+        action = None
+        critical = any(a.get("severity") == "critical" for a in firing)
+        if firing:
+            self._calm_since = None
+        elif self._calm_since is None:
+            self._calm_since = now
+        if (critical and n < self.max_replicas
+                and now - self._last_out >= self.scale_out_cooldown_s):
+            self.launcher.scale_out()
+            self._last_out = now
+            self._m_outs.inc()
+            action = "out"
+        elif (not firing and n > self.min_replicas
+                and self._calm_since is not None
+                and now - self._calm_since >= self.scale_in_calm_s
+                and now - self._last_in >= self.scale_in_cooldown_s):
+            self.launcher.scale_in()
+            self._last_in = now
+            self._m_ins.inc()
+            action = "in"
+        n_after = self.launcher.n_replicas()
+        self._g_desired.set(n_after)
+        if action:
+            rec = {"event": "autoscale", "direction": action,
+                   "replicas": n_after, "t": round(now, 3),
+                   "firing": [a.get("alert") for a in firing]}
+            self.events.append(rec)
+            try:
+                self._emit(rec)
+            except Exception:
+                pass
+        return action
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                pass  # a broken launcher must not kill the loop
+
+    def start(self) -> "FleetAutoscaler":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="fleet-autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

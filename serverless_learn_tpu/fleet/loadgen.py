@@ -1,0 +1,405 @@
+"""`slt loadgen`: closed- and open-loop load with realistic arrivals.
+
+"Handles heavy traffic" is a claim until there is a latency-vs-offered-
+load curve; this module produces it. Two loop disciplines (the
+difference matters — closed-loop load generators hide overload by
+slowing down with the server; open-loop keeps sending at the offered
+rate, which is what a flash crowd does), three arrival processes:
+
+* ``poisson`` — memoryless arrivals at a constant offered rate;
+* ``diurnal`` — a sinusoidal rate profile (daily peak/trough compressed
+  into the run), sampled by thinning;
+* ``flash`` — Poisson base load with a ``spike_mult`` x burst window,
+  the DrJAX-style skewed scenario that melts routers without shedding.
+
+All schedules are derived from a seeded RNG, so the same (process,
+seed, rate, duration) drives byte-identical request sequences. Results
+separate *shed* (the router's typed ``overloaded`` rejection — policy,
+counted separately) from *hard failures* (transport errors, missing
+replies — never acceptable) and write ``fleet_*_p99_ms`` rows into
+``bench_history.json`` through ``utils/benchlog.record`` so
+``slt bench --gate`` can hold the line on them.
+
+``run_smoke()`` is the self-contained CI proof: a 2-replica stub fleet
+behind a router, open-loop load, one replica killed mid-run and
+restarted — zero hard failures allowed (hedges + retries absorb the
+kill).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+MAX_LINE = 4 * 1024 * 1024
+
+
+# -- arrival processes -------------------------------------------------------
+
+
+def poisson_arrivals(rate_rps: float, duration_s: float,
+                     rng: random.Random) -> List[float]:
+    """Arrival offsets in [0, duration): exponential inter-arrivals."""
+    out, t = [], 0.0
+    if rate_rps <= 0:
+        return out
+    while True:
+        t += rng.expovariate(rate_rps)
+        if t >= duration_s:
+            return out
+        out.append(t)
+
+
+def diurnal_arrivals(base_rps: float, duration_s: float, rng: random.Random,
+                     amplitude: float = 0.6,
+                     period_s: Optional[float] = None) -> List[float]:
+    """Sinusoidal rate profile via thinning: peak = base*(1+amplitude),
+    trough = base*(1-amplitude), one full period over the run by
+    default."""
+    period_s = period_s or duration_s
+    peak = base_rps * (1.0 + amplitude)
+    cand = poisson_arrivals(peak, duration_s, rng)
+    out = []
+    for t in cand:
+        rate = base_rps * (1.0 + amplitude
+                           * math.sin(2.0 * math.pi * t / period_s))
+        if rng.random() < rate / peak:
+            out.append(t)
+    return out
+
+
+def flash_crowd_arrivals(base_rps: float, duration_s: float,
+                         rng: random.Random, spike_mult: float = 5.0,
+                         spike_at_frac: float = 0.4,
+                         spike_dur_frac: float = 0.2) -> List[float]:
+    """Poisson base with a spike_mult x burst window mid-run."""
+    t0 = duration_s * spike_at_frac
+    t1 = t0 + duration_s * spike_dur_frac
+    base = poisson_arrivals(base_rps, duration_s, rng)
+    spike = [t0 + t for t in poisson_arrivals(
+        base_rps * (spike_mult - 1.0), t1 - t0, rng)]
+    return sorted(base + spike)
+
+
+ARRIVALS: Dict[str, Callable] = {
+    "poisson": lambda rate, dur, rng: poisson_arrivals(rate, dur, rng),
+    "diurnal": lambda rate, dur, rng: diurnal_arrivals(rate, dur, rng),
+    "flash": lambda rate, dur, rng: flash_crowd_arrivals(rate, dur, rng),
+}
+
+
+# -- the client --------------------------------------------------------------
+
+
+def _one_request(addr: str, req: dict, timeout_s: float) -> dict:
+    host, _, port = addr.rpartition(":")
+    with socket.create_connection((host, int(port)), timeout=timeout_s) as s:
+        s.settimeout(timeout_s)
+        with s.makefile("rwb") as f:
+            f.write(json.dumps(req).encode() + b"\n")
+            f.flush()
+            line = f.readline(MAX_LINE + 2)
+    if not line:
+        raise ConnectionError("no reply")
+    return json.loads(line)
+
+
+def percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+class LoadReport:
+    """Mutable tally shared by the worker threads; summarize() freezes
+    it into the report dict the CLI prints and tests assert on."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.sent = 0
+        self.ok = 0
+        self.shed = 0
+        self.errors = 0           # server-side error replies (typed, alive)
+        self.hard_failures = 0    # transport errors / missing replies
+        self.latencies_s: List[float] = []
+        self.failure_examples: List[str] = []
+
+    def note(self, outcome: str, latency_s: Optional[float] = None,
+             detail: str = ""):
+        with self.lock:
+            self.sent += 1
+            if outcome == "ok":
+                self.ok += 1
+                if latency_s is not None:
+                    self.latencies_s.append(latency_s)
+            elif outcome == "shed":
+                self.shed += 1
+            elif outcome == "error":
+                self.errors += 1
+            else:
+                self.hard_failures += 1
+                if len(self.failure_examples) < 5:
+                    self.failure_examples.append(detail)
+
+    def summarize(self, offered_rps: Optional[float] = None,
+                  duration_s: Optional[float] = None) -> dict:
+        with self.lock:
+            lats = sorted(self.latencies_s)
+            out = {
+                "sent": self.sent, "ok": self.ok, "shed": self.shed,
+                "errors": self.errors,
+                "hard_failures": self.hard_failures,
+                "p50_ms": _ms(percentile(lats, 0.50)),
+                "p95_ms": _ms(percentile(lats, 0.95)),
+                "p99_ms": _ms(percentile(lats, 0.99)),
+                "mean_ms": _ms(sum(lats) / len(lats)) if lats else None,
+            }
+            if self.failure_examples:
+                out["failure_examples"] = list(self.failure_examples)
+        if offered_rps is not None:
+            out["offered_rps"] = offered_rps
+        if duration_s:
+            out["achieved_rps"] = round(self.ok / duration_s, 2)
+        return out
+
+
+def _ms(x: Optional[float]) -> Optional[float]:
+    return None if x is None else round(x * 1e3, 2)
+
+
+def _classify(rep: dict) -> str:
+    if "error" not in rep:
+        return "ok"
+    if rep.get("code") == "overloaded" or rep.get("shed"):
+        return "shed"
+    return "error"
+
+
+def default_request_factory(rng: random.Random, prompt_len: int = 4,
+                            max_new_tokens: int = 8,
+                            vocab: int = 100) -> Callable[[int], dict]:
+    """Per-request payloads: varied prompts/seeds (deterministic from the
+    run seed), a session key on ~half so affinity paths get traffic, and
+    ~10% priority-0 background traffic so brownout shedding has
+    something legitimate to reject first."""
+    def make(i: int) -> dict:
+        req = {"prompt": [rng.randrange(1, vocab)
+                          for _ in range(prompt_len)],
+               "max_new_tokens": max_new_tokens, "seed": rng.randrange(997)}
+        if rng.random() < 0.5:
+            req["session"] = f"sess-{rng.randrange(16)}"
+        if rng.random() < 0.1:
+            req["priority"] = 0
+        return req
+    return make
+
+
+def run_open_loop(addr: str, rate_rps: float, duration_s: float,
+                  seed: int = 0, arrival: str = "poisson",
+                  make_request: Optional[Callable[[int], dict]] = None,
+                  timeout_s: float = 30.0,
+                  report: Optional[LoadReport] = None) -> dict:
+    """Open loop: requests fire AT the scheduled offsets regardless of
+    how slow replies are — each on its own thread, so a melting server
+    faces the true offered load."""
+    rng = random.Random(f"loadgen-{seed}")
+    make_request = make_request or default_request_factory(rng)
+    offsets = ARRIVALS[arrival](rate_rps, duration_s, rng)
+    reqs = [make_request(i) for i in range(len(offsets))]
+    rep = report or LoadReport()
+    threads = []
+    t0 = time.monotonic()
+
+    def fire(req: dict):
+        ts = time.monotonic()
+        try:
+            out = _one_request(addr, req, timeout_s)
+        except (OSError, ValueError) as e:
+            rep.note("fail", detail=f"{type(e).__name__}: {e}")
+            return
+        rep.note(_classify(out), time.monotonic() - ts)
+
+    for off, req in zip(offsets, reqs):
+        delay = t0 + off - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        t = threading.Thread(target=fire, args=(req,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=timeout_s + 5.0)
+    return rep.summarize(offered_rps=rate_rps, duration_s=duration_s)
+
+
+def run_closed_loop(addr: str, concurrency: int, n_requests: int,
+                    seed: int = 0,
+                    make_request: Optional[Callable[[int], dict]] = None,
+                    timeout_s: float = 30.0) -> dict:
+    """Closed loop: ``concurrency`` workers, each sending its next
+    request only after the previous reply — the steady-state throughput
+    probe."""
+    rng = random.Random(f"loadgen-{seed}")
+    make_request = make_request or default_request_factory(rng)
+    reqs = [make_request(i) for i in range(n_requests)]
+    rep = LoadReport()
+    idx_lock = threading.Lock()
+    idx = [0]
+    t0 = time.monotonic()
+
+    def worker():
+        while True:
+            with idx_lock:
+                i = idx[0]
+                if i >= len(reqs):
+                    return
+                idx[0] += 1
+            ts = time.monotonic()
+            try:
+                out = _one_request(addr, reqs[i], timeout_s)
+            except (OSError, ValueError) as e:
+                rep.note("fail", detail=f"{type(e).__name__}: {e}")
+                continue
+            rep.note(_classify(out), time.monotonic() - ts)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(max(1, concurrency))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out = rep.summarize(duration_s=time.monotonic() - t0)
+    out["concurrency"] = concurrency
+    return out
+
+
+# -- the curve + bench history ----------------------------------------------
+
+
+def run_curve(addr: str, rates: List[float], duration_s: float,
+              seed: int = 0, arrival: str = "poisson",
+              make_request: Optional[Callable[[int], dict]] = None,
+              timeout_s: float = 30.0) -> List[dict]:
+    """One open-loop run per offered rate — the latency-vs-load curve."""
+    points = []
+    for i, rate in enumerate(rates):
+        points.append(run_open_loop(
+            addr, rate, duration_s, seed=seed + i, arrival=arrival,
+            make_request=make_request, timeout_s=timeout_s))
+    return points
+
+
+def bench_rows(points: List[dict], label: str = "fleet",
+               device_kind: str = "fleet") -> List[dict]:
+    """bench_history-shaped rows, one per curve point. The offered rate
+    is part of the METRIC NAME — the gate's comparability key is
+    (metric, device_kind, batch_per_chip), and a 5 rps p99 must never
+    gate against a 50 rps p99."""
+    rows = []
+    for p in points:
+        if p.get("p99_ms") is None:
+            continue
+        rate = p.get("offered_rps")
+        tag = f"{rate:g}rps" if rate is not None else "closed"
+        rows.append({
+            "metric": f"{label}_loadgen_{tag}_p99_ms",
+            "value": p["p99_ms"], "unit": "ms",
+            "device_kind": device_kind,
+            "offered_rps": rate, "achieved_rps": p.get("achieved_rps"),
+            "p50_ms": p.get("p50_ms"), "p95_ms": p.get("p95_ms"),
+            "shed": p.get("shed"), "hard_failures": p.get("hard_failures"),
+        })
+    return rows
+
+
+def record_rows(rows: List[dict], history_path: str) -> List[dict]:
+    from serverless_learn_tpu.utils.benchlog import record
+
+    for row in rows:
+        record(row, history_path, better="min",
+               key_fields=("metric", "device_kind"))
+    return rows
+
+
+# -- the CI smoke ------------------------------------------------------------
+
+
+def run_smoke(seed: int = 0, rate_rps: float = 40.0,
+              duration_s: float = 6.0,
+              kill_at_frac: float = 0.3, restart_at_frac: float = 0.6,
+              history_path: Optional[str] = None) -> dict:
+    """Self-contained fleet proof: 2 stub replicas + router, open-loop
+    load, one replica killed mid-run and restarted on the same port.
+    ok iff ZERO hard failures and ZERO shed (capacity is sized above the
+    offered load — every request must complete, the kill absorbed by
+    hedges/retries/probing)."""
+    from serverless_learn_tpu.config import FleetConfig
+    from serverless_learn_tpu.fleet.router import FleetRouter
+    from serverless_learn_tpu.fleet.testing import stub_server
+    from serverless_learn_tpu.telemetry.registry import MetricsRegistry
+
+    registry = MetricsRegistry()
+    events: List[dict] = []
+    r1 = stub_server(latency_s=0.005)
+    r2 = stub_server(latency_s=0.005)
+    cfg = FleetConfig(max_inflight=256, health_interval_s=0.2,
+                      dead_after_probes=2, hedge_min_delay_s=0.05,
+                      eject_s=0.5)
+    router = FleetRouter(config=cfg, host="127.0.0.1", port=0,
+                         replicas=(r1.addr, r2.addr), registry=registry,
+                         emit=events.append).start()
+    report = LoadReport()
+    victim_addr = r1.addr
+    restarted = []
+
+    def chaos():
+        time.sleep(duration_s * kill_at_frac)
+        r1.stop()  # hard kill: in-flight requests on r1 get re-routed
+        time.sleep(duration_s * (restart_at_frac - kill_at_frac))
+        host, _, port = victim_addr.rpartition(":")
+        restarted.append(stub_server(latency_s=0.005, host=host,
+                                     port=int(port)))
+
+    chaos_t = threading.Thread(target=chaos, daemon=True)
+    chaos_t.start()
+    try:
+        rng = random.Random(f"loadgen-{seed}")
+        out = run_open_loop(
+            router.addr, rate_rps, duration_s, seed=seed,
+            make_request=default_request_factory(rng), timeout_s=20.0,
+            report=report)
+    finally:
+        chaos_t.join(timeout=duration_s + 5)
+        router.stop()
+        for srv in [r2] + restarted:
+            try:
+                srv.stop()
+            except Exception:
+                pass
+    snap = registry.snapshot()
+
+    def _val(name):
+        fam = snap.get(name) or {}
+        return sum(s.get("value", 0) for s in fam.get("series", []))
+
+    rep = {
+        "ok": (out["hard_failures"] == 0 and out["shed"] == 0
+               and out["ok"] == out["sent"] and out["sent"] > 0),
+        "client": out,
+        "router": {"hedges": _val("slt_router_hedges_total"),
+                   "retries": _val("slt_router_retries_total"),
+                   "deaths": _val("slt_router_replica_deaths_total"),
+                   "ejections": _val("slt_router_ejections_total")},
+        "alerts": [e for e in events if e.get("event") == "alert"],
+        "killed": victim_addr, "restarted": bool(restarted),
+    }
+    if history_path:
+        rep["bench_rows"] = record_rows(
+            bench_rows([out], label="fleet_smoke",
+                       device_kind="fleet-stub"), history_path)
+    return rep

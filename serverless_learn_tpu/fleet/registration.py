@@ -1,0 +1,82 @@
+"""Replica self-registration: `serve --fleet` joins the directory at birth.
+
+The router must never need a static replica list — the source system's
+core property is that processes register with a well-known directory at
+startup and the membership plane tracks their liveness (SURVEY §0,
+capability 1). A serving replica reuses the exact machinery training
+workers use: a :class:`~serverless_learn_tpu.control.client.WorkerAgent`
+registers with the coordinator (hardened transport, lease heartbeats,
+re-registration after a lapse) under a ``replica:<service>[:<metrics
+addr>]`` name, and deregisters — after a graceful drain — on SIGTERM.
+The router polls coordinator membership and recognizes replicas purely
+by that name convention; a replica whose lease lapses (crash, partition)
+vanishes from membership, which the router treats as retirement.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+REPLICA_PREFIX = "replica:"
+
+
+def replica_name(service: str, metrics_addr: Optional[str] = None) -> str:
+    """The coordinator-visible name encoding this replica's role. The
+    metrics address rides in the name because PeerInfo carries exactly
+    (addr, name) — and changing the wire message is an SLT005 event."""
+    if ":" in service:
+        raise ValueError(f"fleet service name may not contain ':' "
+                         f"({service!r})")
+    name = REPLICA_PREFIX + service
+    if metrics_addr:
+        name += ":" + metrics_addr
+    return name
+
+
+def parse_replica(name: str, addr: str) -> Optional[dict]:
+    """Inverse of :func:`replica_name`: {"service", "serve_addr",
+    "metrics_addr"} for replica peers, None for anything else (training
+    workers share the same membership plane)."""
+    if not isinstance(name, str) or not name.startswith(REPLICA_PREFIX):
+        return None
+    rest = name[len(REPLICA_PREFIX):]
+    service, _, metrics_addr = rest.partition(":")
+    if not service:
+        return None
+    return {"service": service, "serve_addr": addr,
+            "metrics_addr": metrics_addr or None}
+
+
+class FleetRegistration:
+    """Owns the replica's WorkerAgent lifecycle. start() registers and
+    begins lease heartbeats; stop() deregisters (the router sees the
+    peer vanish and drains it). The agent's epoch callbacks are unused —
+    a serving replica doesn't re-mesh — but its lease-lapse
+    re-registration keeps a briefly-partitioned replica in the fleet."""
+
+    def __init__(self, coordinator_addr: str, serve_addr: str,
+                 service: str = "serve",
+                 metrics_addr: Optional[str] = None,
+                 heartbeat_interval_ms: int = 1000):
+        from serverless_learn_tpu.control.client import WorkerAgent
+
+        self.service = service
+        self.serve_addr = serve_addr
+        self.agent = WorkerAgent(
+            coordinator_addr, serve_addr,
+            name=replica_name(service, metrics_addr),
+            n_chips=1, heartbeat_interval_ms=heartbeat_interval_ms)
+
+    def start(self) -> "FleetRegistration":
+        self.agent.start()
+        return self
+
+    @property
+    def worker_id(self):
+        return self.agent.worker_id
+
+    def stop(self):
+        """Deregister-first teardown: the router stops picking this
+        replica the moment membership drops it, while the replica's own
+        drain finishes whatever was already in flight."""
+        self.agent.stop(deregister=True)

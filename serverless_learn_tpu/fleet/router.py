@@ -1,0 +1,730 @@
+"""Front-door fleet router: one address, N engine replicas, zero drama.
+
+``slt route`` speaks the SAME JSON-lines protocol as ``serve`` — a
+client that pointed at one replica points at the router unchanged — and
+spreads requests across every replica discovered via the coordinator
+membership plane (``serve --fleet`` self-registration) or a static list.
+The design is robustness-first; each mechanism exists because a specific
+failure killed a request somewhere:
+
+* **Health gating** — a background prober hits each replica's ``/healthz``
+  (503 while a critical health alert fires) and its wire-level
+  ``{"op": "ping"}``; unhealthy or draining replicas take no new traffic
+  but keep their in-flight requests.
+* **Least-loaded + session-affine picking** — default is min in-flight
+  (ties break on recent latency); a request carrying ``"session"`` maps
+  to a stable replica via rendezvous hashing over the currently-eligible
+  set, so KV/prefix locality survives membership churn with minimal
+  reshuffling.
+* **Hedged retries** — an idempotent request (greedy, or explicitly
+  seeded: the engines are deterministic under a fixed seed) that has not
+  answered within ``hedge_after_p95_mult x`` the replica-observed p95
+  gets a second attempt on a DIFFERENT replica; first completion wins,
+  the loser is discarded (never two replies — the client sees exactly
+  one line). Transport errors fail over immediately, up to
+  ``max_retries`` — through the shared per-peer circuit breakers of
+  ``control/client.py`` (``breaker_for``), not a new ad-hoc retry loop.
+* **Brownout shedding** — admission is a bounded queue
+  (``max_inflight`` slots, ``queue_timeout_s`` max wait). Above
+  ``shed_start_frac`` occupancy, priority<=0 traffic is rejected
+  immediately; a full queue rejects everything — always with the TYPED
+  overload error ``{"error": "overloaded", "code": "overloaded",
+  "shed": true, "retry_after_ms": N}``, so clients can tell "backed off
+  by policy" from "broken".
+* **Outlier ejection** — ``eject_consecutive_errors`` transport failures
+  eject a replica for ``eject_s`` (doubling per repeat); a dead TCP
+  endpoint (``dead_after_probes`` failed probes) fires a
+  ``fleet.replica_dead`` alert event that `slt doctor` ranks and names.
+* **Graceful draining** — retiring a replica (membership deregistration,
+  autoscaler scale-in, ``remove_replica``) stops NEW picks instantly and
+  sends the wire ``{"op": "drain"}`` so the replica finishes its
+  in-flight work before exiting.
+
+Replica state machine (docs/ARCHITECTURE.md has the full table)::
+
+    JOINING -> HEALTHY <-> UNHEALTHY -> DEAD
+                  |  \\-> EJECTED (timed, doubling) -> HEALTHY
+                  \\--> DRAINING -> removed
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import queue
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+from serverless_learn_tpu.config import FleetConfig
+
+MAX_LINE = 4 * 1024 * 1024
+
+_OVERLOAD_RETRY_MS = 250
+
+
+def _overload_reply(reason: str) -> dict:
+    """The typed brownout error: distinguishable from every other error
+    by ``code`` so loadgen/clients count shed separately from failures."""
+    return {"error": f"overloaded: {reason}", "code": "overloaded",
+            "shed": True, "retry_after_ms": _OVERLOAD_RETRY_MS}
+
+
+class Replica:
+    """Router-side view of one engine replica."""
+
+    JOINING, HEALTHY, UNHEALTHY, EJECTED, DRAINING, DEAD = (
+        "joining", "healthy", "unhealthy", "ejected", "draining", "dead")
+
+    def __init__(self, addr: str, metrics_addr: Optional[str] = None,
+                 name: str = "", static: bool = False):
+        self.addr = addr
+        self.metrics_addr = metrics_addr
+        self.name = name or addr
+        self.static = static          # never pruned by membership polls
+        self.state = self.JOINING
+        self.inflight = 0
+        self.consec_errors = 0
+        self.eject_count = 0
+        self.ejected_until = 0.0
+        self.failed_probes = 0
+        self.last_error: Optional[str] = None
+        # Recent request latencies (seconds) for the hedge delay's p95.
+        self.latencies: List[float] = []
+        self.requests = 0
+        self.errors = 0
+
+    def note_latency(self, s: float, keep: int = 128):
+        self.latencies.append(s)
+        if len(self.latencies) > keep:
+            del self.latencies[:len(self.latencies) - keep]
+
+    def p95(self) -> Optional[float]:
+        if len(self.latencies) < 8:
+            return None
+        xs = sorted(self.latencies)
+        return xs[min(len(xs) - 1, int(0.95 * len(xs)))]
+
+    def eligible(self, now: float) -> bool:
+        if self.state in (self.DRAINING, self.DEAD, self.UNHEALTHY):
+            return False
+        if self.state == self.EJECTED:
+            return now >= self.ejected_until
+        return True
+
+    def describe(self) -> dict:
+        return {"addr": self.addr, "state": self.state,
+                "inflight": self.inflight, "requests": self.requests,
+                "errors": self.errors,
+                **({"metrics_addr": self.metrics_addr}
+                   if self.metrics_addr else {}),
+                **({"last_error": self.last_error}
+                   if self.last_error else {})}
+
+
+class FleetRouter:
+    """The front-door process. start() binds and serves; stop() tears
+    down. Thread model mirrors GenerationServer: one accept loop, one
+    thread per client connection, plus a prober and (optionally) a
+    membership-discovery loop; forwards run on per-attempt threads so a
+    hedge can outlive the attempt it raced."""
+
+    def __init__(self, config: Optional[FleetConfig] = None,
+                 host: Optional[str] = None, port: Optional[int] = None,
+                 replicas: tuple = (), coordinator_addr: Optional[str] = None,
+                 registry=None, emit=None, clock=time.monotonic):
+        from serverless_learn_tpu.telemetry import get_registry
+
+        self.cfg = config or FleetConfig()
+        self.coordinator_addr = coordinator_addr
+        self.registry = registry or get_registry()
+        self.clock = clock
+        # Alert-shaped event emission (doctor/trace ingest); default rides
+        # the ambient tracing sink (--events-log), tests inject a list.
+        if emit is None:
+            from serverless_learn_tpu.telemetry.tracing import emit_event
+            emit = emit_event
+        self._emit = emit
+
+        self._replicas: Dict[str, Replica] = {}
+        self._lock = threading.Lock()          # replica table + counters
+        self._adm_lock = threading.Lock()      # admission queue
+        self._adm_cv = threading.Condition(self._adm_lock)
+        self._inflight = 0
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._conns: Dict[threading.Thread, socket.socket] = {}
+        self._conns_lock = threading.Lock()
+        self.max_connections = 128
+
+        reg = self.registry
+        self._m_requests = reg.counter(
+            "slt_router_requests_total", "requests accepted by the router")
+        self._m_errors = reg.counter(
+            "slt_router_errors_total",
+            "error replies returned to clients (upstream + validation)")
+        self._m_shed = reg.counter(
+            "slt_router_shed_total",
+            "requests rejected with the typed overload error")
+        self._m_hedges = reg.counter(
+            "slt_router_hedges_total", "hedge attempts launched")
+        self._m_hedge_wins = reg.counter(
+            "slt_router_hedge_wins_total",
+            "requests whose hedge attempt answered first")
+        self._m_retries = reg.counter(
+            "slt_router_retries_total",
+            "failover resends after an upstream transport error")
+        self._m_ejections = reg.counter(
+            "slt_router_ejections_total",
+            "replicas ejected for consecutive errors")
+        self._m_deaths = reg.counter(
+            "slt_router_replica_deaths_total",
+            "replicas declared dead after failed liveness probes")
+        self._g_replicas = reg.gauge(
+            "slt_router_replicas", "replicas known to the router")
+        self._g_healthy = reg.gauge(
+            "slt_router_replicas_healthy", "replicas eligible for traffic")
+        self._g_inflight = reg.gauge(
+            "slt_router_inflight", "requests currently held by the router")
+        self._h_queue_wait = reg.histogram(
+            "slt_router_queue_wait_seconds",
+            "admission wait below capacity (the autoscaler's SLO signal)")
+        self._h_latency = reg.histogram(
+            "slt_router_request_seconds",
+            "client-observed latency through the router")
+        self._h_upstream = reg.histogram(
+            "slt_router_upstream_seconds", "one forward attempt's latency")
+
+        for addr in replicas:
+            self.add_replica(addr, static=True)
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host if host is not None else self.cfg.router_host,
+                         port if port is not None else self.cfg.router_port))
+        self._sock.listen(64)
+        self.addr = "%s:%d" % self._sock.getsockname()[:2]
+
+    # -- fleet membership ---------------------------------------------------
+
+    def add_replica(self, addr: str, metrics_addr: Optional[str] = None,
+                    name: str = "", static: bool = False) -> Replica:
+        with self._lock:
+            r = self._replicas.get(addr)
+            if r is None:
+                r = self._replicas[addr] = Replica(
+                    addr, metrics_addr, name, static=static)
+            elif r.state in (Replica.DEAD, Replica.DRAINING):
+                # Re-registration of a known address = a restarted
+                # replica: forget the obituary.
+                r.state = Replica.JOINING
+                r.failed_probes = 0
+                r.consec_errors = 0
+                r.eject_count = 0
+            if metrics_addr:
+                r.metrics_addr = metrics_addr
+            self._refresh_gauges_locked()
+        return r
+
+    def remove_replica(self, addr: str, drain: bool = True,
+                       reason: str = "retired"):
+        """Retirement: no new picks from this instant; optionally tell
+        the replica to drain so its in-flight work completes."""
+        with self._lock:
+            r = self._replicas.get(addr)
+            if r is None:
+                return
+            r.state = Replica.DRAINING
+            self._refresh_gauges_locked()
+        self._emit_alert("fleet.replica_retired", "info", "firing",
+                         f"replica {addr} retiring ({reason})", addr)
+        if drain:
+            try:
+                self._wire_request(addr, {"op": "drain"}, timeout=2.0)
+            except OSError:
+                pass  # already gone; nothing to drain
+        with self._lock:
+            self._replicas.pop(addr, None)
+            self._refresh_gauges_locked()
+
+    def replicas(self) -> List[dict]:
+        with self._lock:
+            return [r.describe() for r in self._replicas.values()]
+
+    def _refresh_gauges_locked(self):
+        now = self.clock()
+        self._g_replicas.set(len(self._replicas))
+        self._g_healthy.set(sum(r.eligible(now)
+                                for r in self._replicas.values()))
+
+    def _emit_alert(self, name: str, severity: str, state: str,
+                    message: str, replica_addr: str):
+        """Health-engine-shaped alert record: `slt doctor` aggregates
+        these straight from the events log, so a dead replica is NAMED
+        from telemetry alone (labels.replica)."""
+        now = time.time()
+        try:
+            self._emit({"event": "alert", "alert": name,
+                        "severity": severity, "detector": "fleet",
+                        "state": state, "message": message,
+                        "labels": {"replica": replica_addr},
+                        "value": 1.0, "threshold": 0.0, "count": 1,
+                        "first_fired_unix_s": round(now, 3),
+                        "last_fired_unix_s": round(now, 3)})
+        except Exception:
+            pass
+
+    # -- health probing + discovery -----------------------------------------
+
+    def _probe_once(self):
+        with self._lock:
+            snapshot = list(self._replicas.values())
+        for r in snapshot:
+            if r.state == Replica.DRAINING:
+                continue
+            ok, draining, err = self._probe_replica(r)
+            died = resurrected = False
+            with self._lock:
+                if r.addr not in self._replicas:
+                    continue
+                if ok:
+                    r.failed_probes = 0
+                    was = r.state
+                    if draining:
+                        r.state = Replica.DRAINING
+                    elif r.state in (Replica.JOINING, Replica.UNHEALTHY,
+                                     Replica.DEAD):
+                        r.state = Replica.HEALTHY
+                    resurrected = (was == Replica.DEAD
+                                   and r.state == Replica.HEALTHY)
+                else:
+                    r.failed_probes += 1
+                    r.last_error = err
+                    if r.failed_probes >= self.cfg.dead_after_probes:
+                        if r.state != Replica.DEAD:
+                            r.state = Replica.DEAD
+                            self._m_deaths.inc()
+                            died = True
+                    elif r.state == Replica.HEALTHY:
+                        r.state = Replica.UNHEALTHY
+                self._refresh_gauges_locked()
+            if died:
+                self._emit_alert(
+                    "fleet.replica_dead", "critical", "firing",
+                    f"replica {r.addr} failed {self.cfg.dead_after_probes} "
+                    f"liveness probes ({err})", r.addr)
+            if resurrected:
+                self._emit_alert("fleet.replica_dead", "critical",
+                                 "resolved",
+                                 f"replica {r.addr} answering again",
+                                 r.addr)
+
+    def _probe_replica(self, r: Replica):
+        """(ok, draining, error): wire-level ping (cheap, definitive for
+        liveness + drain state), then /healthz when a metrics addr is
+        known (503 while a critical alert fires = no new traffic)."""
+        try:
+            rep = self._wire_request(r.addr, {"op": "ping"}, timeout=2.0)
+            draining = bool(rep.get("draining"))
+        except (OSError, ValueError) as e:
+            return False, False, f"{type(e).__name__}: {e}"
+        if r.metrics_addr:
+            try:
+                from serverless_learn_tpu.telemetry.exporter import fetch_text
+
+                hz = json.loads(fetch_text(r.metrics_addr, "/healthz",
+                                           timeout=2.0))
+                if not hz.get("ok", True):
+                    return False, draining, (
+                        "healthz not ok: "
+                        + ",".join(hz.get("firing_critical") or []))
+            except Exception:
+                # Unreachable *metrics* endpoint never condemns a replica
+                # whose serving socket answers — the gate, not the judge.
+                pass
+        return True, draining, None
+
+    def _discover_once(self):
+        """Poll coordinator membership for replica:<service> peers; new
+        peers join, vanished dynamic peers drain out (their deregistration
+        or lease expiry IS the retirement signal)."""
+        if not self.coordinator_addr:
+            return
+        from serverless_learn_tpu.control.client import CoordinatorClient
+        from serverless_learn_tpu.fleet.registration import parse_replica
+
+        client = getattr(self, "_coordinator", None)
+        if client is None:
+            try:
+                client = CoordinatorClient(self.coordinator_addr,
+                                           rpc_timeout_s=5.0)
+            except (ConnectionError, OSError):
+                return
+            self._coordinator = client
+        try:
+            rep = client.membership()
+        except (ConnectionError, OSError, ValueError):
+            self._coordinator = None
+            try:
+                client.close()
+            except Exception:
+                pass
+            return
+        seen = set()
+        for peer in rep.peers:
+            info = parse_replica(peer.name, peer.addr)
+            if info is None or info["service"] != self.cfg.service:
+                continue
+            seen.add(info["serve_addr"])
+            self.add_replica(info["serve_addr"],
+                             metrics_addr=info["metrics_addr"],
+                             name=peer.name)
+        with self._lock:
+            gone = [a for a, r in self._replicas.items()
+                    if not r.static and a not in seen
+                    and r.state != Replica.DRAINING]
+        for addr in gone:
+            self.remove_replica(addr, drain=True, reason="deregistered")
+
+    def _background_loop(self):
+        last_discover = 0.0
+        while not self._stop.is_set():
+            now = self.clock()
+            if now - last_discover >= self.cfg.discover_interval_s:
+                try:
+                    self._discover_once()
+                except Exception:
+                    pass
+                last_discover = now
+            try:
+                self._probe_once()
+            except Exception:
+                pass
+            self._stop.wait(self.cfg.health_interval_s)
+
+    # -- picking ------------------------------------------------------------
+
+    def _candidates(self) -> List[Replica]:
+        now = self.clock()
+        with self._lock:
+            return [r for r in self._replicas.values() if r.eligible(now)]
+
+    def _pick(self, candidates: List[Replica],
+              session: Optional[str], exclude=()) -> Optional[Replica]:
+        pool = [r for r in candidates if r.addr not in exclude]
+        if not pool:
+            return None
+        if session:
+            # Rendezvous hashing: stable per session, minimal reshuffle
+            # on membership change — and still health-gated, because the
+            # pool is already the eligible set.
+            return max(pool, key=lambda r: hashlib.md5(
+                f"{session}|{r.addr}".encode()).hexdigest())
+        with self._lock:
+            return min(pool, key=lambda r: (
+                r.inflight, r.consec_errors,
+                r.latencies[-1] if r.latencies else 0.0, r.addr))
+
+    # -- forwarding ---------------------------------------------------------
+
+    def _wire_request(self, addr: str, req: dict, timeout: float) -> dict:
+        host, _, port = addr.rpartition(":")
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout) as s:
+            s.settimeout(timeout)
+            with s.makefile("rwb") as f:
+                f.write(json.dumps(req).encode() + b"\n")
+                f.flush()
+                line = f.readline(MAX_LINE + 2)
+        if not line:
+            raise ConnectionError(f"{addr} closed without replying")
+        rep = json.loads(line)
+        if not isinstance(rep, dict):
+            raise ValueError(f"{addr} replied non-object")
+        return rep
+
+    def _forward_attempt(self, r: Replica, req: dict, out: "queue.Queue"):
+        from serverless_learn_tpu.control.client import breaker_for
+
+        breaker = breaker_for(r.addr)
+        t0 = self.clock()
+        try:
+            if not breaker.allow():
+                raise ConnectionError(f"circuit open to {r.addr}")
+            rep = self._wire_request(r.addr, req,
+                                     timeout=self.cfg.upstream_timeout_s)
+        except (OSError, ValueError) as e:
+            breaker.record_failure()
+            with self._lock:
+                r.inflight -= 1
+                r.errors += 1
+                r.consec_errors += 1
+                r.last_error = f"{type(e).__name__}: {e}"
+                ejected = (r.state == Replica.HEALTHY
+                           and r.consec_errors
+                           >= self.cfg.eject_consecutive_errors)
+                if ejected:
+                    r.state = Replica.EJECTED
+                    r.eject_count += 1
+                    r.ejected_until = (self.clock() + self.cfg.eject_s
+                                       * (2 ** (r.eject_count - 1)))
+                    self._m_ejections.inc()
+                    self._refresh_gauges_locked()
+            if ejected:
+                self._emit_alert(
+                    "fleet.replica_ejected", "warning", "firing",
+                    f"replica {r.addr} ejected after "
+                    f"{r.consec_errors} consecutive errors "
+                    f"({r.last_error})", r.addr)
+            out.put((r, None, f"{type(e).__name__}: {e}"))
+            return
+        dt = self.clock() - t0
+        breaker.record_success()
+        self._h_upstream.observe(dt)
+        with self._lock:
+            r.inflight -= 1
+            r.requests += 1
+            r.note_latency(dt)
+            if "error" in rep and rep.get("code") != "overloaded":
+                r.errors += 1
+            else:
+                r.consec_errors = 0
+                if r.state == Replica.EJECTED:
+                    r.state = Replica.HEALTHY
+                    self._refresh_gauges_locked()
+        out.put((r, rep, None))
+
+    def _launch(self, r: Replica, req: dict, out: "queue.Queue"):
+        with self._lock:
+            r.inflight += 1
+        t = threading.Thread(target=self._forward_attempt,
+                             args=(r, req, out), daemon=True)
+        t.start()
+
+    def _hedge_delay(self, r: Replica) -> float:
+        p95 = r.p95()
+        if p95 is None:
+            return max(self.cfg.hedge_min_delay_s, 0.2)
+        return max(self.cfg.hedge_min_delay_s,
+                   p95 * self.cfg.hedge_after_p95_mult)
+
+    @staticmethod
+    def _idempotent(req: dict) -> bool:
+        """Greedy decoding is deterministic; seeded sampling is too (the
+        engines derive the sampling rng from the request seed, and the
+        wire default seed is 0) — so a duplicate execution returns the
+        SAME completion and hedging is safe. Only an explicit
+        ``"idempotent": false`` opts a request out."""
+        if req.get("idempotent") is False:
+            return False
+        return True
+
+    def handle(self, req: dict) -> dict:
+        """One request end-to-end: admission (shed), pick, forward with
+        hedging/failover, exactly one reply."""
+        t_start = self.clock()
+        priority = req.pop("priority", 1)
+        session = req.pop("session", None)
+        try:
+            priority = int(priority)
+        except (TypeError, ValueError):
+            priority = 1
+
+        # ---- admission: bounded queue with brownout shedding ----
+        cap = max(1, self.cfg.max_inflight)
+        shed_at = max(1, int(cap * self.cfg.shed_start_frac))
+        deadline = t_start + self.cfg.queue_timeout_s
+        with self._adm_cv:
+            while True:
+                if self._inflight < cap and (
+                        self._inflight < shed_at or priority > 0):
+                    self._inflight += 1
+                    self._g_inflight.set(self._inflight)
+                    break
+                if priority <= 0:
+                    # Brownout: lowest-priority traffic never queues —
+                    # rejecting it instantly is what keeps the queue
+                    # short for traffic that matters.
+                    self._m_shed.inc()
+                    return _overload_reply(
+                        f"brownout at {self._inflight}/{cap} in flight")
+                remaining = deadline - self.clock()
+                if remaining <= 0:
+                    self._m_shed.inc()
+                    return _overload_reply(
+                        f"queue full ({cap} in flight, waited "
+                        f"{self.cfg.queue_timeout_s:g}s)")
+                self._adm_cv.wait(remaining)
+        self._h_queue_wait.observe(self.clock() - t_start)
+        self._m_requests.inc()
+        try:
+            rep = self._dispatch(req, session)
+        finally:
+            with self._adm_cv:
+                self._inflight -= 1
+                self._g_inflight.set(self._inflight)
+                self._adm_cv.notify()
+        if "error" in rep and rep.get("code") != "overloaded":
+            self._m_errors.inc()
+        else:
+            self._h_latency.observe(self.clock() - t_start)
+        return rep
+
+    def _dispatch(self, req: dict, session: Optional[str]) -> dict:
+        hedgeable = self.cfg.hedge and self._idempotent(req)
+        req = {k: v for k, v in req.items() if k != "idempotent"}
+        candidates = self._candidates()
+        if not candidates:
+            self._m_shed.inc()
+            return _overload_reply("no healthy replicas")
+        primary = self._pick(candidates, session)
+        out: "queue.Queue" = queue.Queue()
+        tried = {primary.addr}
+        self._launch(primary, req, out)
+        pending = 1
+        hedged = False
+        retries = 0
+        hedge_at = self.clock() + self._hedge_delay(primary)
+        last_err = None
+        while pending:
+            timeout = None
+            if hedgeable and not hedged:
+                timeout = max(0.0, hedge_at - self.clock())
+            try:
+                r, rep, err = out.get(timeout=timeout)
+            except queue.Empty:
+                # Hedge: the primary is slow, race one more replica.
+                hedge = self._pick(self._candidates(), None, exclude=tried)
+                hedged = True
+                if hedge is not None:
+                    tried.add(hedge.addr)
+                    self._m_hedges.inc()
+                    self._launch(hedge, req, out)
+                    pending += 1
+                continue
+            pending -= 1
+            if rep is not None:
+                if hedged and r.addr != primary.addr:
+                    self._m_hedge_wins.inc()
+                # Losing attempts keep running on their daemon threads;
+                # their replies land in `out`, which nothing reads — the
+                # client gets exactly this one completion.
+                return rep
+            last_err = err
+            if pending:
+                continue  # the race partner may still answer
+            if retries < self.cfg.max_retries:
+                nxt = self._pick(self._candidates(), None, exclude=tried)
+                if nxt is not None:
+                    tried.add(nxt.addr)
+                    retries += 1
+                    self._m_retries.inc()
+                    self._launch(nxt, req, out)
+                    pending += 1
+                    continue
+            return {"error": f"upstream failed after {len(tried)} "
+                             f"replica(s): {last_err}",
+                    "code": "upstream_unavailable"}
+
+    # -- wire server (same JSON-lines shape as GenerationServer) ------------
+
+    def _serve_conn(self, conn: socket.socket):
+        conn.settimeout(60.0)
+        with conn, conn.makefile("rwb") as f:
+            while True:
+                try:
+                    line = f.readline(MAX_LINE + 2)
+                except socket.timeout:
+                    return
+                if not line:
+                    return
+                if len(line.rstrip(b"\r\n")) > MAX_LINE:
+                    f.write(json.dumps(
+                        {"error": f"request line exceeds {MAX_LINE} bytes"}
+                    ).encode() + b"\n")
+                    f.flush()
+                    return
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    req = json.loads(line)
+                    if not isinstance(req, dict):
+                        raise ValueError("request must be a JSON object")
+                    if req.get("op") == "fleet":
+                        rep = {"ok": True, "replicas": self.replicas(),
+                               "inflight": self._inflight}
+                    else:
+                        rep = self.handle(req)
+                except Exception as e:
+                    rep = {"error": f"{type(e).__name__}: {e}"}
+                f.write(json.dumps(rep).encode() + b"\n")
+                f.flush()
+
+    def _serve_conn_safe(self, conn: socket.socket):
+        try:
+            self._serve_conn(conn)
+        except OSError:
+            pass
+        finally:
+            with self._conns_lock:
+                self._conns.pop(threading.current_thread(), None)
+
+    def serve_forever(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = None
+            with self._conns_lock:
+                if len(self._conns) < self.max_connections:
+                    t = threading.Thread(target=self._serve_conn_safe,
+                                         args=(conn,), daemon=True)
+                    self._conns[t] = conn
+            if t is None:
+                try:
+                    conn.sendall(json.dumps(_overload_reply(
+                        "router at connection capacity")).encode() + b"\n")
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            t.start()
+
+    def start(self) -> "FleetRouter":
+        bg = threading.Thread(target=self._background_loop, daemon=True,
+                              name="fleet-prober")
+        bg.start()
+        self._threads.append(bg)
+        acc = threading.Thread(target=self.serve_forever, daemon=True,
+                               name="fleet-router")
+        acc.start()
+        self._threads.append(acc)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            live = list(self._conns.items())
+        for _, c in live:
+            try:
+                c.shutdown(socket.SHUT_RD)
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5)
+        client = getattr(self, "_coordinator", None)
+        if client is not None:
+            try:
+                client.close()
+            except Exception:
+                pass

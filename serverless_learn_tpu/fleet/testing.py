@@ -1,0 +1,92 @@
+"""Stub replicas: the REAL wire server over fake compute.
+
+The fleet layer's failure modes (hedging, shedding, draining, ejection,
+chaos kills) are socket- and scheduling-level behaviors — exercising
+them through a jitted model would make every test pay a compile and hide
+timing bugs behind device noise. ``stub_server()`` builds a
+:class:`~serverless_learn_tpu.inference.server.GenerationServer` whose
+engine is a deterministic, latency-programmable stub, so router tests
+drive real TCP connections, real per-connection threads and the real
+drain path with zero jax imports. ``slt loadgen --smoke`` and the fleet
+chaos harness (``chaos/fleet.py``) run on the same stubs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+
+class StubModelCfg:
+    """Just enough model config for the wire server's request validation."""
+
+    def __init__(self, vocab_size: int = 1000, max_seq_len: int = 512):
+        self.vocab_size = vocab_size
+        self.max_seq_len = max_seq_len
+
+
+class StubModule:
+    def __init__(self, vocab_size: int = 1000, max_seq_len: int = 512):
+        self.cfg = StubModelCfg(vocab_size, max_seq_len)
+
+
+class StubEngine:
+    """Deterministic generation stand-in.
+
+    The reply depends only on (prompt, max_new, seed, tag-independent) so
+    two replicas given the same request produce the SAME completion — a
+    hedged request's winner is indistinguishable from the primary, which
+    is exactly the idempotency contract hedging relies on.
+    ``latency_s`` may be a float or a callable (for ramps); ``fail``
+    makes submit() return engine errors (ejection tests).
+    """
+
+    def __init__(self, latency_s=0.0, fail: bool = False,
+                 vocab_size: int = 1000, tag: str = ""):
+        self.latency = latency_s
+        self.fail = fail
+        self.vocab_size = vocab_size
+        self.tag = tag
+        self.submitted: List[Tuple[tuple, dict]] = []
+        self.inflight = 0
+        self._lock = threading.Lock()
+
+    def submit(self, prompt, max_new, temperature=0.0, top_k=0,
+               eos_id=None, seed=0, trace=None):
+        with self._lock:
+            self.submitted.append(((list(prompt), max_new),
+                                   {"temperature": temperature,
+                                    "seed": seed}))
+            self.inflight += 1
+        try:
+            lat = self.latency() if callable(self.latency) else self.latency
+            if lat:
+                time.sleep(lat)
+            if self.fail:
+                return {"error": "stub engine failure injected"}
+            base = (sum(prompt) * 31 + seed * 7) % self.vocab_size
+            toks = [(base + i) % self.vocab_size for i in range(max_new)]
+            return {"new_tokens": toks, "batch_size": 1}
+        finally:
+            with self._lock:
+                self.inflight -= 1
+
+    def stop(self):
+        pass
+
+
+def stub_server(port: int = 0, latency_s=0.0, fail: bool = False,
+                host: str = "127.0.0.1", registry=None,
+                conn_timeout_s: float = 30.0,
+                engine: Optional[Callable] = None):
+    """A started GenerationServer over a StubEngine; caller owns stop()."""
+    from serverless_learn_tpu.inference.server import GenerationServer
+    from serverless_learn_tpu.telemetry.registry import MetricsRegistry
+
+    eng = engine or StubEngine(latency_s=latency_s, fail=fail)
+    srv = GenerationServer(StubModule(), params=None, host=host, port=port,
+                           engine=eng, conn_timeout_s=conn_timeout_s,
+                           registry=registry or MetricsRegistry())
+    srv.start()
+    return srv

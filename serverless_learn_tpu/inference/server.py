@@ -54,7 +54,12 @@ class GenerationServer:
         self.registry = registry or get_registry()
         self.event_log = (JsonlEventLog(event_log_path)
                           if event_log_path else None)
-        if engine == "continuous":
+        if not isinstance(engine, str):
+            # A pre-built engine object (anything with submit()/stop()):
+            # the fleet layer's stub replicas and embedding tests inject
+            # their own compute here and reuse the REAL wire server.
+            self.engine = engine
+        elif engine == "continuous":
             # Slot-level scheduler (round-5): admits at chunk boundaries,
             # retires at EOS, FIFO — no group keys, nothing starves.
             from serverless_learn_tpu.inference.continuous import (
@@ -106,6 +111,7 @@ class GenerationServer:
         self._sock.bind((host, port))
         self._sock.listen(16)
         self.addr = f"{host}:{self._sock.getsockname()[1]}"
+        self.draining = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._conns = {}  # live connection thread -> socket, for stop()
@@ -132,6 +138,21 @@ class GenerationServer:
         elif "latency_ms" in rep:
             self._m_latency.observe(rep["latency_ms"] / 1e3)
         return rep
+
+    def _admin(self, req: dict) -> dict:
+        """Fleet admin surface on the same wire (never counted as model
+        requests): "ping" lets the router probe liveness + drain state
+        without touching the device; "drain" starts graceful retirement
+        (stop accepting, finish in-flight) — the router's retirement path
+        and `serve --fleet`'s SIGTERM handler share it."""
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True, "draining": self.draining,
+                    "requests_served": self.requests_served}
+        if op == "drain":
+            threading.Thread(target=self.drain, daemon=True).start()
+            return {"ok": True, "draining": True}
+        return {"error": f"unknown op {op!r}"}
 
     def _handle(self, req: dict) -> dict:
         t0 = time.perf_counter()
@@ -203,7 +224,8 @@ class GenerationServer:
                     # No device lock: the BatchingEngine's dispatcher is
                     # the sole device user; concurrent handlers just queue
                     # (and coalesce) their requests.
-                    rep = self.handle(req)
+                    rep = (self._admin(req) if "op" in req
+                           else self.handle(req))
                 except Exception as e:  # any bad request -> error reply,
                     rep = {"error": f"{type(e).__name__}: {e}"}  # server lives
                 f.write(json.dumps(rep).encode() + b"\n")
@@ -255,6 +277,24 @@ class GenerationServer:
         finally:
             with self._conns_lock:
                 self._conns.pop(threading.current_thread(), None)
+
+    def drain(self, grace_s: float = 10.0):
+        """Graceful retirement: stop accepting NEW connections, let every
+        in-flight request finish (bounded by ``grace_s``), leave the
+        engine running until stop(). A fleet replica drains when it is
+        retired (autoscaler scale-in, SIGTERM under ``serve --fleet``) so
+        the router's re-route happens with zero dropped completions."""
+        self.draining = True
+        try:
+            self._sock.close()  # accept() raises OSError -> loop exits
+        except OSError:
+            pass
+        deadline = time.monotonic() + grace_s
+        while time.monotonic() < deadline:
+            with self._conns_lock:
+                if not self._conns:
+                    return
+            time.sleep(0.02)
 
     def start(self):
         """Serve on a background thread (tests, embedding)."""

@@ -318,6 +318,24 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
         verdict_bits.append(f"{len(firing)} non-critical alert(s) firing")
     if flagged:
         verdict_bits.append(f"straggler worker(s): {', '.join(flagged)}")
+    # Serving fleet (round 12): name every replica the router declared
+    # dead — labels.replica rides the fleet.replica_dead alert, so the
+    # verdict points at the machine, not just the router that noticed.
+    dead_replicas = sorted({
+        (a.get("labels") or {}).get("replica", "?")
+        for a in alerts if a.get("alert") == "fleet.replica_dead"
+        and a.get("state") == "firing"})
+    recovered_replicas = sorted({
+        (a.get("labels") or {}).get("replica", "?")
+        for a in alerts if a.get("alert") == "fleet.replica_dead"
+        and a.get("state") != "firing"})
+    if dead_replicas:
+        verdict_bits.append(
+            f"dead fleet replica(s): {', '.join(dead_replicas)}")
+    elif recovered_replicas:
+        verdict_bits.append(
+            f"fleet replica(s) died and recovered: "
+            f"{', '.join(recovered_replicas)}")
     if bench and bench["regressions"]:
         verdict_bits.append(
             f"{len(bench['regressions'])} bench regression(s) vs history")

@@ -451,6 +451,14 @@ _EVENT_RULES = (
     # in a row that the client is now failing fast).
     ("gossip_suspicion", "slt_gossip_suspicions_total", "warning"),
     ("rpc_breaker_open", "slt_rpc_breaker_opens_total", "warning"),
+    # Round 12: the serving fleet's incident counters — a replica
+    # ejected for consecutive errors (latency/transport outlier) and a
+    # replica declared dead after failed liveness probes. The router
+    # also emits labeled fleet.replica_dead alert events directly; these
+    # rules make the same incidents visible to a health engine running
+    # over the router's registry (/alerts, slt top, scale decisions).
+    ("fleet_replica_ejected", "slt_router_ejections_total", "warning"),
+    ("fleet_replica_death", "slt_router_replica_deaths_total", "warning"),
 )
 
 

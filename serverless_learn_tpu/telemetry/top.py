@@ -186,12 +186,32 @@ def render(states: List[EndpointState]) -> str:
              + time.strftime("%H:%M:%S")]
     infer_rows: List[List[str]] = []
     train_rows: List[List[str]] = []
+    fleet_rows: List[List[str]] = []
     other_rows: List[str] = []
     for st in states:
         if st.data is None:
             other_rows.append(f"  {st.addr:<22} DOWN  {st.error}")
             continue
         roles = 0
+        if st.val("slt_router_replicas") is not None:
+            roles += 1
+            req_rate = st.rate("slt_router_requests_total")
+            fleet_rows.append([
+                st.addr,
+                f"{_num(st.val('slt_router_replicas_healthy'), 0)}"
+                f"/{_num(st.val('slt_router_replicas'), 0)}",
+                _num(st.val("slt_router_inflight"), 0),
+                "-" if req_rate is None else _num(req_rate),
+                _num(st.val("slt_router_shed_total") or 0, 0),
+                f"{_num(st.val('slt_router_hedges_total') or 0, 0)}"
+                f"({_num(st.val('slt_router_hedge_wins_total') or 0, 0)})",
+                _num(st.val("slt_router_retries_total") or 0, 0),
+                _num(st.val("slt_router_ejections_total") or 0, 0),
+                _ms(_p(st.hist("slt_router_queue_wait_seconds"), 0.5))
+                + "/" + _ms(_p(st.hist("slt_router_queue_wait_seconds"),
+                               0.95)),
+                _ms(_p(st.hist("slt_router_request_seconds"), 0.95)),
+            ])
         if (st.val("slt_requests_total") is not None
                 or st.val("slt_server_requests_total") is not None):
             roles += 1
@@ -240,6 +260,13 @@ def render(states: List[EndpointState]) -> str:
         header = ["endpoint", "step", "step p50 ms", "samples/s",
                   "sps/chip", "mfu", "loss", "members", "epoch", "rounds"]
         lines += _table(header, train_rows)
+    if fleet_rows:
+        lines.append("")
+        lines.append("  FLEET")
+        header = ["endpoint", "healthy", "inflight", "req/s", "shed",
+                  "hedges(won)", "retries", "eject",
+                  "qwait p50/p95 ms", "lat p95 ms"]
+        lines += _table(header, fleet_rows)
     alert_rows: List[List[str]] = []
     for st in states:
         for a in st.alerts:

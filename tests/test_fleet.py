@@ -1,0 +1,575 @@
+"""Serving fleet (round 12): router failure modes, registration,
+autoscaler, chaos.
+
+Everything here drives REAL sockets (the stub replicas run the actual
+``GenerationServer`` wire loop over deterministic fake compute —
+``fleet/testing.py``), so hedging, shedding, draining and death
+detection are exercised where they live: in the connection handling, not
+in a mock."""
+
+import hashlib
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from serverless_learn_tpu.config import FleetConfig
+from serverless_learn_tpu.fleet.router import FleetRouter, Replica
+from serverless_learn_tpu.fleet.testing import StubEngine, stub_server
+from serverless_learn_tpu.inference.server import request
+from serverless_learn_tpu.telemetry.registry import MetricsRegistry
+
+
+def make_router(replicas, registry=None, events=None, **cfg_kw):
+    defaults = dict(health_interval_s=0.15, dead_after_probes=2,
+                    discover_interval_s=0.3, hedge_min_delay_s=0.05,
+                    eject_s=0.4, upstream_timeout_s=5.0,
+                    queue_timeout_s=1.0)
+    defaults.update(cfg_kw)
+    cfg = FleetConfig(**defaults)
+    return FleetRouter(config=cfg, host="127.0.0.1", port=0,
+                       replicas=tuple(replicas),
+                       registry=registry or MetricsRegistry(),
+                       emit=(events.append if events is not None
+                             else lambda rec: None))
+
+
+def reg_val(registry, name):
+    fam = registry.snapshot().get(name) or {}
+    return sum(s.get("value", 0) for s in fam.get("series", []))
+
+
+# -- basics ------------------------------------------------------------------
+
+
+def test_router_routes_and_matches_direct():
+    r1, r2 = stub_server(), stub_server()
+    router = make_router([r1.addr, r2.addr]).start()
+    try:
+        time.sleep(0.3)
+        via = request(router.addr, {"prompt": [5, 9, 11],
+                                    "max_new_tokens": 4})
+        direct = request(r1.addr, {"prompt": [5, 9, 11],
+                                   "max_new_tokens": 4})
+        assert via["tokens"] == direct["tokens"]
+        assert via["new_tokens"] == direct["new_tokens"]
+    finally:
+        router.stop(), r1.stop(), r2.stop()
+
+
+def test_session_affinity_is_sticky_and_health_gated():
+    r1, r2 = stub_server(), stub_server()
+    router = make_router([r1.addr, r2.addr]).start()
+    try:
+        time.sleep(0.3)
+        for _ in range(4):
+            request(router.addr, {"prompt": [1], "max_new_tokens": 1,
+                                  "session": "alpha"})
+        served = [(r.engine, len(r.engine.submitted)) for r in (r1, r2)]
+        counts = sorted(n for _, n in served)
+        assert counts == [0, 4], counts  # all four on ONE replica
+        # The session's replica dies -> the session re-pins, not fails.
+        sticky = r1 if len(r1.engine.submitted) == 4 else r2
+        other = r2 if sticky is r1 else r1
+        sticky.stop()
+        time.sleep(0.6)  # prober marks it dead
+        rep = request(router.addr, {"prompt": [1], "max_new_tokens": 1,
+                                    "session": "alpha"})
+        assert "tokens" in rep
+        assert len(other.engine.submitted) >= 1
+    finally:
+        router.stop()
+        for s in (r1, r2):
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+# -- hedging -----------------------------------------------------------------
+
+
+def test_hedging_no_duplicate_completions():
+    """A slow primary gets hedged on a second replica; the client sees
+    EXACTLY one reply (and it equals the deterministic completion)."""
+    slow = StubEngine(latency_s=0.8)
+    fast = StubEngine(latency_s=0.0)
+    r1, r2 = stub_server(engine=slow), stub_server(engine=fast)
+    reg = MetricsRegistry()
+    router = make_router([r1.addr, r2.addr], registry=reg).start()
+    try:
+        time.sleep(0.3)
+        # Pin the pick to the slow replica so the hedge races the fast one.
+        session = next(
+            s for s in (f"s{i}" for i in range(64))
+            if max((r1.addr, r2.addr), key=lambda a: hashlib.md5(
+                f"{s}|{a}".encode()).hexdigest()) == r1.addr)
+        host, _, port = router.addr.rpartition(":")
+        t0 = time.monotonic()
+        with socket.create_connection((host, int(port)), timeout=10) as s:
+            f = s.makefile("rwb")
+            f.write(json.dumps({"prompt": [3, 4], "max_new_tokens": 3,
+                                "session": session}).encode() + b"\n")
+            f.flush()
+            rep = json.loads(f.readline())
+            took = time.monotonic() - t0
+            # Exactly one reply line: nothing further arrives.
+            s.settimeout(0.4)
+            try:
+                extra = s.recv(4096)
+            except socket.timeout:
+                extra = b""
+        assert "tokens" in rep, rep
+        assert extra == b"", "duplicate completion leaked to the client"
+        assert took < 0.7, f"hedge never fired ({took:.2f}s)"
+        assert reg_val(reg, "slt_router_hedges_total") == 1
+        assert reg_val(reg, "slt_router_hedge_wins_total") == 1
+        # Both replicas ran it (idempotent duplicate execution is the
+        # accepted cost); the losing reply was discarded.
+        assert len(slow.submitted) == 1 and len(fast.submitted) == 1
+    finally:
+        router.stop(), r1.stop(), r2.stop()
+
+
+def test_hedge_opt_out_is_honored():
+    slow = StubEngine(latency_s=0.4)
+    r1, r2 = stub_server(engine=slow), stub_server(engine=slow)
+    reg = MetricsRegistry()
+    router = make_router([r1.addr, r2.addr], registry=reg).start()
+    try:
+        time.sleep(0.3)
+        rep = request(router.addr, {"prompt": [2], "max_new_tokens": 2,
+                                    "idempotent": False}, timeout=10)
+        assert "tokens" in rep
+        assert reg_val(reg, "slt_router_hedges_total") == 0
+    finally:
+        router.stop(), r1.stop(), r2.stop()
+
+
+# -- shedding ----------------------------------------------------------------
+
+
+def test_shed_before_meltdown_typed_overload():
+    """Above capacity the router answers with the TYPED overload error
+    instead of queueing without bound; admitted requests still finish."""
+    eng = StubEngine(latency_s=0.5)
+    r1 = stub_server(engine=eng)
+    router = make_router([r1.addr], max_inflight=2, queue_timeout_s=0.15,
+                         shed_start_frac=0.5, hedge=False).start()
+    try:
+        time.sleep(0.3)
+        results = []
+        lock = threading.Lock()
+
+        def fire():
+            rep = request(router.addr, {"prompt": [1], "max_new_tokens": 1},
+                          timeout=10)
+            with lock:
+                results.append(rep)
+
+        threads = [threading.Thread(target=fire) for _ in range(6)]
+        for t in threads:
+            t.start()
+            time.sleep(0.02)
+        for t in threads:
+            t.join(timeout=10)
+        ok = [r for r in results if "tokens" in r]
+        shed = [r for r in results if r.get("code") == "overloaded"]
+        assert len(results) == 6
+        assert ok and shed, results
+        assert len(ok) + len(shed) == 6, results  # nothing hard-failed
+        for r in shed:
+            assert r.get("shed") is True
+            assert "retry_after_ms" in r
+    finally:
+        router.stop(), r1.stop()
+
+
+def test_brownout_sheds_lowest_priority_first():
+    eng = StubEngine(latency_s=0.4)
+    r1 = stub_server(engine=eng)
+    router = make_router([r1.addr], max_inflight=4, queue_timeout_s=1.0,
+                         shed_start_frac=0.5, hedge=False).start()
+    try:
+        time.sleep(0.3)
+        # Fill past the brownout threshold (2 of 4 slots).
+        bg = [threading.Thread(target=request, args=(
+            router.addr, {"prompt": [1], "max_new_tokens": 1}))
+            for _ in range(3)]
+        for t in bg:
+            t.start()
+        time.sleep(0.1)
+        t0 = time.monotonic()
+        low = request(router.addr, {"prompt": [1], "max_new_tokens": 1,
+                                    "priority": 0}, timeout=5)
+        instant = time.monotonic() - t0
+        assert low.get("code") == "overloaded", low
+        assert instant < 0.2, "priority-0 must shed instantly, not queue"
+        # Normal-priority traffic in the same band still completes.
+        ok = request(router.addr, {"prompt": [1], "max_new_tokens": 1},
+                     timeout=5)
+        assert "tokens" in ok
+        for t in bg:
+            t.join(timeout=5)
+    finally:
+        router.stop(), r1.stop()
+
+
+# -- draining ----------------------------------------------------------------
+
+
+def test_drain_completes_in_flight():
+    """remove_replica(drain=True) while a request is in flight: the
+    client still gets its completion; afterwards the replica takes no
+    new connections."""
+    eng = StubEngine(latency_s=0.5)
+    r1 = stub_server(engine=eng)
+    fast = stub_server()
+    router = make_router([r1.addr, fast.addr], hedge=False).start()
+    try:
+        time.sleep(0.3)
+        session = next(
+            s for s in (f"d{i}" for i in range(64))
+            if max((r1.addr, fast.addr), key=lambda a: hashlib.md5(
+                f"{s}|{a}".encode()).hexdigest()) == r1.addr)
+        out = []
+        t = threading.Thread(target=lambda: out.append(request(
+            router.addr, {"prompt": [7], "max_new_tokens": 2,
+                          "session": session}, timeout=10)))
+        t.start()
+        time.sleep(0.15)  # request is now inside the slow engine
+        router.remove_replica(r1.addr, drain=True)
+        t.join(timeout=10)
+        assert out and "tokens" in out[0], out
+        assert all(r["addr"] != r1.addr for r in router.replicas())
+        # The drained server refuses new connections once idle.
+        deadline = time.monotonic() + 5
+        refused = False
+        while time.monotonic() < deadline and not refused:
+            try:
+                request(r1.addr, {"op": "ping"}, timeout=1)
+                time.sleep(0.05)
+            except OSError:
+                refused = True
+        assert refused, "drained replica still accepting connections"
+        # New traffic flows to the surviving replica.
+        assert "tokens" in request(router.addr, {"prompt": [1],
+                                                 "max_new_tokens": 1})
+    finally:
+        router.stop(), fast.stop()
+        try:
+            r1.stop()
+        except Exception:
+            pass
+
+
+def test_server_drain_op_finishes_inflight():
+    """The wire-level {"op": "drain"} admin: in-flight completes, the
+    listener closes."""
+    eng = StubEngine(latency_s=0.4)
+    srv = stub_server(engine=eng)
+    out = []
+    t = threading.Thread(target=lambda: out.append(
+        request(srv.addr, {"prompt": [2], "max_new_tokens": 2},
+                timeout=10)))
+    t.start()
+    time.sleep(0.1)
+    ack = request(srv.addr, {"op": "drain"}, timeout=5)
+    assert ack.get("draining") is True
+    t.join(timeout=10)
+    assert out and "tokens" in out[0]
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        try:
+            request(srv.addr, {"op": "ping"}, timeout=1)
+            time.sleep(0.05)
+        except OSError:
+            break
+    else:
+        pytest.fail("drained server still accepting connections")
+    srv.stop()
+
+
+# -- ejection + death --------------------------------------------------------
+
+
+def test_outlier_ejection_and_readmission():
+    """Consecutive transport errors eject a replica (doubling window);
+    a later success readmits it."""
+    from serverless_learn_tpu.chaos.shim import TcpChaosProxy
+
+    r1 = stub_server()
+    proxy = TcpChaosProxy(upstream=r1.addr).start()
+    reg = MetricsRegistry()
+    events = []
+    router = make_router([proxy.addr], registry=reg, events=events,
+                         hedge=False, max_retries=0,
+                         eject_consecutive_errors=2,
+                         eject_s=0.3, health_interval_s=30.0,
+                         dead_after_probes=99).start()
+    try:
+        time.sleep(0.2)
+        proxy.set_fault("reset")
+        for _ in range(2):
+            rep = request(router.addr, {"prompt": [1], "max_new_tokens": 1},
+                          timeout=5)
+            assert rep.get("code") == "upstream_unavailable", rep
+        assert reg_val(reg, "slt_router_ejections_total") == 1
+        assert any(e.get("alert") == "fleet.replica_ejected"
+                   for e in events)
+        states = {r["addr"]: r["state"] for r in router.replicas()}
+        assert states[proxy.addr] == Replica.EJECTED
+        # While ejected: no candidates -> typed overload, instantly.
+        rep = request(router.addr, {"prompt": [1], "max_new_tokens": 1},
+                      timeout=5)
+        assert rep.get("code") == "overloaded"
+        # Heal + wait out the window: the next request readmits it.
+        proxy.set_fault(None)
+        time.sleep(0.45)
+        rep = request(router.addr, {"prompt": [1], "max_new_tokens": 1},
+                      timeout=5)
+        assert "tokens" in rep, rep
+        states = {r["addr"]: r["state"] for r in router.replicas()}
+        assert states[proxy.addr] == Replica.HEALTHY
+    finally:
+        router.stop(), proxy.stop(), r1.stop()
+
+
+def test_replica_kill_mid_stream_client_still_completes():
+    """The round-12 e2e satellite: a replica dies mid-request through
+    TcpChaosProxy; the client sees a successful (re-routed or hedged)
+    completion — never an error."""
+    from serverless_learn_tpu.chaos.shim import TcpChaosProxy
+
+    slow = StubEngine(latency_s=1.2)
+    r1 = stub_server(engine=slow)
+    proxy = TcpChaosProxy(upstream=r1.addr).start()
+    r2 = stub_server()
+    reg = MetricsRegistry()
+    router = make_router([proxy.addr, r2.addr], registry=reg).start()
+    try:
+        time.sleep(0.3)
+        session = next(
+            s for s in (f"k{i}" for i in range(64))
+            if max((proxy.addr, r2.addr), key=lambda a: hashlib.md5(
+                f"{s}|{a}".encode()).hexdigest()) == proxy.addr)
+
+        def killer():
+            time.sleep(0.3)
+            r1.stop()          # replica process dies...
+            proxy.set_fault("reset")  # ...and its connections RST
+
+        t = threading.Thread(target=killer)
+        t.start()
+        rep = request(router.addr, {"prompt": [9, 9], "max_new_tokens": 3,
+                                    "session": session}, timeout=15)
+        t.join()
+        assert "tokens" in rep, rep
+        direct = request(r2.addr, {"prompt": [9, 9], "max_new_tokens": 3})
+        assert rep["tokens"] == direct["tokens"]
+        assert (reg_val(reg, "slt_router_hedges_total")
+                + reg_val(reg, "slt_router_retries_total")) >= 1
+    finally:
+        router.stop(), proxy.stop(), r2.stop()
+        try:
+            r1.stop()
+        except Exception:
+            pass
+
+
+def test_dead_replica_alert_names_addr_and_resolves_on_restart():
+    events = []
+    r1 = stub_server()
+    addr = r1.addr
+    router = make_router([addr], events=events).start()
+    try:
+        time.sleep(0.4)
+        r1.stop()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if any(e.get("alert") == "fleet.replica_dead"
+                   and e.get("state") == "firing" for e in events):
+                break
+            time.sleep(0.05)
+        fired = [e for e in events if e.get("alert") == "fleet.replica_dead"
+                 and e.get("state") == "firing"]
+        assert fired and fired[0]["labels"]["replica"] == addr
+        # Restart on the same port: the obituary resolves.
+        host, _, port = addr.rpartition(":")
+        r1b = stub_server(host=host, port=int(port))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if any(e.get("alert") == "fleet.replica_dead"
+                   and e.get("state") == "resolved" for e in events):
+                break
+            time.sleep(0.05)
+        assert any(e.get("state") == "resolved" for e in events
+                   if e.get("alert") == "fleet.replica_dead")
+        r1b.stop()
+    finally:
+        router.stop()
+        try:
+            r1.stop()
+        except Exception:
+            pass
+
+
+# -- self-registration -------------------------------------------------------
+
+
+def test_replica_self_registration_and_discovery():
+    """serve --fleet machinery: a replica registers with the (python)
+    coordinator; the router discovers it with no static list; stopping
+    the registration (the SIGTERM path) drains it out of the fleet."""
+    from serverless_learn_tpu.control.py_daemons import PyCoordinator
+    from serverless_learn_tpu.fleet.registration import (FleetRegistration,
+                                                         parse_replica,
+                                                         replica_name)
+
+    assert parse_replica(replica_name("svc", "1.2.3.4:9"), "a:1") == {
+        "service": "svc", "serve_addr": "a:1", "metrics_addr": "1.2.3.4:9"}
+    assert parse_replica("worker-7", "a:1") is None
+    with pytest.raises(ValueError):
+        replica_name("has:colon")
+
+    coord = PyCoordinator(port=0, lease_ttl_ms=2000).start()
+    r1 = stub_server()
+    registration = FleetRegistration(coord.addr, r1.addr, service="serve",
+                                     heartbeat_interval_ms=200).start()
+    router = make_router([], discover_interval_s=0.2)
+    router.coordinator_addr = coord.addr
+    router.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if any(r["addr"] == r1.addr for r in router.replicas()):
+                break
+            time.sleep(0.05)
+        assert any(r["addr"] == r1.addr for r in router.replicas()), \
+            router.replicas()
+        time.sleep(0.3)  # let a probe mark it healthy
+        assert "tokens" in request(router.addr, {"prompt": [1],
+                                                 "max_new_tokens": 1})
+        # Deregistration (SIGTERM path) -> the router drains it out.
+        registration.stop()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if not router.replicas():
+                break
+            time.sleep(0.05)
+        assert not router.replicas(), router.replicas()
+    finally:
+        router.stop(), r1.stop(), coord.stop()
+
+
+# -- autoscaler --------------------------------------------------------------
+
+
+def test_autoscaler_scales_out_on_critical_and_in_after_calm():
+    from serverless_learn_tpu.fleet.autoscaler import (CallbackLauncher,
+                                                       FleetAutoscaler)
+
+    n = [1]
+    launcher = CallbackLauncher(
+        lambda: n[0],
+        lambda: n.__setitem__(0, n[0] + 1),
+        lambda: n.__setitem__(0, n[0] - 1))
+    alerts = []
+    clock = [1000.0]
+    scaler = FleetAutoscaler(
+        launcher, lambda: alerts, min_replicas=1, max_replicas=3,
+        alert_substr="queue_wait", scale_out_cooldown_s=5.0,
+        scale_in_cooldown_s=10.0, scale_in_calm_s=8.0,
+        clock=lambda: clock[0], registry=MetricsRegistry())
+
+    crit = {"alert": "slo.router_queue_wait", "severity": "critical"}
+    warn = {"alert": "slo.router_queue_wait", "severity": "warning"}
+    other = {"alert": "slo.ttft", "severity": "critical"}
+
+    assert scaler.tick() is None          # calm: nothing to do
+    alerts[:] = [other]
+    assert scaler.tick() is None          # unrelated alert: no action
+    alerts[:] = [crit]
+    assert scaler.tick() == "out" and n[0] == 2
+    clock[0] += 1.0
+    assert scaler.tick() is None          # cooldown holds
+    clock[0] += 5.0
+    assert scaler.tick() == "out" and n[0] == 3
+    clock[0] += 6.0
+    assert scaler.tick() is None and n[0] == 3   # max_replicas cap
+    # Warning alone neither scales out nor counts as calm.
+    alerts[:] = [warn]
+    clock[0] += 10.0
+    assert scaler.tick() is None
+    # Full calm: scale-in waits for the calm window, then drains one.
+    alerts[:] = []
+    assert scaler.tick() is None          # calm starts now
+    clock[0] += 7.0
+    assert scaler.tick() is None          # calm_s not yet reached
+    clock[0] += 2.0
+    assert scaler.tick() == "in" and n[0] == 2
+    clock[0] += 5.0
+    assert scaler.tick() is None          # scale-in cooldown
+    clock[0] += 6.0
+    assert scaler.tick() == "in" and n[0] == 1
+    clock[0] += 60.0
+    assert scaler.tick() is None and n[0] == 1   # min_replicas floor
+    assert [e["direction"] for e in scaler.events] == \
+        ["out", "out", "in", "in"]
+
+
+# -- chaos fleet + doctor ----------------------------------------------------
+
+
+def test_chaos_fleet_plan_doctor_names_dead_replica(tmp_path):
+    """`slt chaos` fleet plan: kill one replica (no restart) under load;
+    `slt doctor` over the events log ALONE must name the dead replica."""
+    from serverless_learn_tpu.chaos.fleet import FleetChaosRun
+    from serverless_learn_tpu.chaos.plan import FaultPlan
+    from serverless_learn_tpu.telemetry import doctor
+
+    events_log = str(tmp_path / "fleet-events.jsonl")
+    plan = FaultPlan.from_obj({"faults": [
+        {"at": 0.6, "op": "kill", "node": "replica-1"}]})
+    run = FleetChaosRun(n_replicas=3, plan=plan, seed=5, rate_rps=25.0,
+                        events_log=events_log)
+    rep = run.run(2.5)
+    assert rep["ok"], rep
+    assert rep["client"]["hard_failures"] == 0
+    assert rep["detections"].get("replica-1") is not None
+    dead_addr = next(f["addr"] for f in rep["faults_injected"]
+                     if f.get("op") == "kill")
+
+    diag = doctor.diagnose([events_log], bench_history="/nonexistent")
+    assert diag["summary"]["critical_firing"] >= 1
+    assert dead_addr in diag["summary"]["verdict"]
+    named = [a for a in diag["alerts"]
+             if a["alert"] == "fleet.replica_dead"
+             and (a.get("labels") or {}).get("replica") == dead_addr]
+    assert named, diag["alerts"]
+
+
+def test_chaos_fleet_rejects_unsupported_ops():
+    from serverless_learn_tpu.chaos.fleet import FleetChaosRun
+    from serverless_learn_tpu.chaos.plan import FaultPlan
+
+    plan = FaultPlan.from_obj({"faults": [
+        {"at": 1.0, "op": "partition", "split": 0.5}]})
+    with pytest.raises(ValueError, match="fleet chaos supports"):
+        FleetChaosRun(n_replicas=2, plan=plan)
+
+
+def test_chaos_fleet_stall_absorbed_by_hedging(tmp_path):
+    """A stalled (not dead) replica: hedges keep completions flowing and
+    the run stays failure-free."""
+    from serverless_learn_tpu.chaos.fleet import FleetChaosRun
+    from serverless_learn_tpu.chaos.plan import FaultPlan
+
+    plan = FaultPlan.from_obj({"faults": [
+        {"at": 0.5, "op": "pause", "node": "replica-0", "for": 1.0}]})
+    rep = FleetChaosRun(n_replicas=2, plan=plan, seed=9,
+                        rate_rps=20.0).run(2.2)
+    assert rep["client"]["hard_failures"] == 0, rep["client"]
+    assert rep["client"]["ok"] > 0

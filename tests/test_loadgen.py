@@ -1,0 +1,234 @@
+"""`slt loadgen` + the round-12 acceptance: measured, fault-injected
+serving curves."""
+
+import json
+import random
+import threading
+import time
+
+from serverless_learn_tpu.config import FleetConfig, HealthConfig
+from serverless_learn_tpu.fleet import loadgen
+from serverless_learn_tpu.fleet.router import FleetRouter
+from serverless_learn_tpu.fleet.testing import stub_server
+from serverless_learn_tpu.telemetry.registry import MetricsRegistry
+
+
+# -- arrival processes -------------------------------------------------------
+
+
+def test_arrivals_deterministic_and_shaped():
+    rng = lambda: random.Random("loadgen-7")  # noqa: E731
+    a = loadgen.poisson_arrivals(50.0, 10.0, rng())
+    b = loadgen.poisson_arrivals(50.0, 10.0, rng())
+    assert a == b, "same seed must give the identical schedule"
+    assert 300 < len(a) < 700  # ~500 expected
+    assert all(0 <= t < 10.0 for t in a)
+    assert a == sorted(a)
+
+    d = loadgen.diurnal_arrivals(50.0, 10.0, rng())
+    assert d == loadgen.diurnal_arrivals(50.0, 10.0, rng())
+    # First half-period runs above base rate, second half below.
+    first, second = [t for t in d if t < 5], [t for t in d if t >= 5]
+    assert len(first) > len(second)
+
+    f = loadgen.flash_crowd_arrivals(20.0, 10.0, rng(), spike_mult=5.0,
+                                     spike_at_frac=0.4, spike_dur_frac=0.2)
+    assert f == sorted(f)
+    in_spike = [t for t in f if 4.0 <= t < 6.0]
+    before = [t for t in f if 2.0 <= t < 4.0]
+    assert len(in_spike) > 2 * len(before), (len(in_spike), len(before))
+
+
+def test_closed_loop_against_stub():
+    srv = stub_server()
+    try:
+        rep = loadgen.run_closed_loop(srv.addr, concurrency=4,
+                                      n_requests=40, seed=1)
+        assert rep["sent"] == 40
+        assert rep["hard_failures"] == 0
+        assert rep["ok"] + rep["shed"] + rep["errors"] == 40
+        assert rep["p99_ms"] is not None
+    finally:
+        srv.stop()
+
+
+def test_bench_rows_gate_holds_the_line(tmp_path):
+    """Loadgen rows land in bench history keyed per offered rate, gate
+    with better=min, and a later 50% p99 regression FAILS the gate."""
+    from serverless_learn_tpu.telemetry import benchgate
+
+    history = str(tmp_path / "bench_history.json")
+    good = [{"offered_rps": 20.0, "p99_ms": 40.0, "p50_ms": 10.0,
+             "p95_ms": 30.0, "achieved_rps": 19.5, "shed": 0,
+             "hard_failures": 0}]
+    rows = loadgen.bench_rows(good, label="fleet", device_kind="fleet-stub")
+    assert rows[0]["metric"] == "fleet_loadgen_20rps_p99_ms"
+    loadgen.record_rows(rows, history)
+    rep = benchgate.run_gate(history, metric="fleet")
+    assert rep["ok"], rep  # first entry passes vacuously
+
+    bad = [dict(good[0], p99_ms=65.0)]
+    loadgen.record_rows(loadgen.bench_rows(
+        bad, label="fleet", device_kind="fleet-stub"), history)
+    rep = benchgate.run_gate(history, metric="fleet")
+    assert not rep["ok"], rep
+    assert rep["regressions"][0]["metric"] == "fleet_loadgen_20rps_p99_ms"
+
+
+def test_smoke_zero_failures_across_kill_and_restart(tmp_path):
+    """The CI smoke: 2-replica fleet, one killed + restarted mid-run,
+    zero failed requests; bench rows pass the dry-run gate."""
+    from serverless_learn_tpu.telemetry import benchgate
+
+    history = str(tmp_path / "bench_history.json")
+    rep = loadgen.run_smoke(seed=11, rate_rps=40.0, duration_s=3.5,
+                            history_path=history)
+    assert rep["ok"], {k: rep[k] for k in ("client", "router")}
+    assert rep["client"]["hard_failures"] == 0
+    assert rep["client"]["ok"] == rep["client"]["sent"] > 0
+    assert rep["restarted"]
+    alerts = {(a.get("alert"), a.get("state")) for a in rep["alerts"]}
+    assert ("fleet.replica_dead", "firing") in alerts
+    gate = benchgate.run_gate(history, metric=None)
+    assert gate["ok"], gate
+
+
+# -- the acceptance test -----------------------------------------------------
+
+
+def test_fleet_acceptance_chaos_load_autoscale_gate(tmp_path):
+    """ISSUE 7 acceptance: open-loop load with one replica KILLED and one
+    STALLED (TcpChaosProxy); zero client-visible hard failures (hedges +
+    retries absorb the faults; shedding is typed and only above
+    capacity); the autoscaler scales OUT on the queue-wait burn-rate
+    alert and drains back IN after calm; the run emits a
+    p99-vs-offered-load curve into bench_history.json that
+    `slt bench --gate --dry-run` accepts."""
+    from serverless_learn_tpu.chaos.shim import TcpChaosProxy
+    from serverless_learn_tpu.fleet.autoscaler import (CallbackLauncher,
+                                                       FleetAutoscaler)
+    from serverless_learn_tpu.telemetry import benchgate
+    from serverless_learn_tpu.telemetry.health import HealthEngine
+
+    registry = MetricsRegistry()
+    events = []
+    # Three modest replicas (~80 ms/request): offered 50 rps needs ~4
+    # concurrent slots, capacity is 3 -> genuine overload until the
+    # autoscaler adds the fast replica.
+    r_a = stub_server(latency_s=0.08)
+    r_b = stub_server(latency_s=0.08)
+    r_c = stub_server(latency_s=0.08)
+    proxy_b = TcpChaosProxy(upstream=r_b.addr).start()
+    cfg = FleetConfig(max_inflight=3, queue_timeout_s=0.5,
+                      shed_start_frac=0.9, health_interval_s=0.2,
+                      dead_after_probes=2, hedge_min_delay_s=0.05,
+                      upstream_timeout_s=2.0, eject_consecutive_errors=2,
+                      eject_s=0.3, max_retries=2)
+    router = FleetRouter(config=cfg, host="127.0.0.1", port=0,
+                         replicas=(r_a.addr, proxy_b.addr, r_c.addr),
+                         registry=registry, emit=events.append).start()
+
+    hcfg = HealthConfig(sample_interval_s=0.15, slo_short_window_s=1.0,
+                        slo_long_window_s=3.0, clear_after_ticks=2,
+                        slos=({"name": "router_queue_wait",
+                               "kind": "latency",
+                               "metric": "slt_router_queue_wait_seconds",
+                               "threshold_s": 0.05, "objective": 0.99},))
+    engine = HealthEngine(registry=registry, config=hcfg,
+                          emit=events.append,
+                          dump_on_critical=False).start()
+
+    extra = []      # autoscaler-launched fast replicas
+
+    def scale_out():
+        srv = stub_server(latency_s=0.002)
+        extra.append(srv)
+        router.add_replica(srv.addr, static=True)
+
+    def scale_in():
+        if extra:
+            srv = extra.pop()
+            router.remove_replica(srv.addr, drain=True,
+                                  reason="autoscaler scale-in")
+            srv.stop()
+
+    launcher = CallbackLauncher(lambda: len(router.replicas()),
+                                scale_out, scale_in)
+    scaler = FleetAutoscaler(
+        launcher, lambda: engine.alerts(firing_only=True),
+        min_replicas=3, max_replicas=5, alert_substr="queue_wait",
+        scale_out_cooldown_s=2.0, scale_in_cooldown_s=0.5,
+        scale_in_calm_s=0.6, interval_s=0.15,
+        registry=registry).start()
+
+    def chaos():
+        time.sleep(1.0)
+        r_c.stop()                    # one replica KILLED
+        time.sleep(0.4)
+        proxy_b.set_fault("stall")    # one replica STALLED
+        time.sleep(1.2)
+        proxy_b.set_fault(None)
+
+    chaos_t = threading.Thread(target=chaos, daemon=True)
+    chaos_t.start()
+    try:
+        # Phase 1: overload (50 rps > ~37 rps fleet capacity) + faults.
+        p1 = loadgen.run_open_loop(router.addr, 50.0, 4.0, seed=21,
+                                   timeout_s=10.0)
+        # Phase 2: light load on the scaled-out fleet.
+        p2 = loadgen.run_open_loop(router.addr, 10.0, 3.0, seed=22,
+                                   timeout_s=10.0)
+        # Let the calm window elapse so the scale-in lands.
+        deadline = time.monotonic() + 6.0
+        while time.monotonic() < deadline:
+            if any(e["direction"] == "in" for e in scaler.events):
+                break
+            time.sleep(0.1)
+    finally:
+        chaos_t.join(timeout=5)
+        scaler.stop()
+        engine.stop()
+        router.stop()
+        for srv in [r_a, r_b] + extra:
+            try:
+                srv.stop()
+            except Exception:
+                pass
+        proxy_b.stop()
+
+    # Zero hard failures through a kill + a stall; every rejection is
+    # the TYPED overload error (shed), never an untyped upstream error.
+    for phase, rep in (("overload", p1), ("calm", p2)):
+        assert rep["hard_failures"] == 0, (phase, rep)
+        assert rep["errors"] == 0, (phase, rep)
+        assert rep["ok"] + rep["shed"] == rep["sent"], (phase, rep)
+    assert p1["ok"] > 0
+    # Shedding only above capacity: the calm phase sheds nothing.
+    assert p2["shed"] == 0, p2
+    # The burn-rate alert fired critical and drove a scale-out, then the
+    # calm window drove a scale-in (drain) back down.
+    fired = [e for e in events if e.get("event") == "alert"
+             and e.get("alert") == "slo.router_queue_wait"
+             and e.get("severity") == "critical"
+             and e.get("state") == "firing"]
+    assert fired, "queue-wait burn-rate alert never fired critical"
+    directions = [e["direction"] for e in scaler.events]
+    assert "out" in directions, scaler.events
+    assert "in" in directions, scaler.events
+    # The kill was detected and named.
+    assert any(e.get("alert") == "fleet.replica_dead"
+               and (e.get("labels") or {}).get("replica") == r_c.addr
+               for e in events), "killed replica never declared dead"
+
+    # The curve lands in bench history and passes the dry-run gate.
+    history = str(tmp_path / "bench_history.json")
+    rows = loadgen.record_rows(
+        loadgen.bench_rows([p1, p2], label="fleet_accept",
+                           device_kind="fleet-stub"), history)
+    assert len(rows) == 2 and all(r["value"] > 0 for r in rows)
+    gate = benchgate.run_gate(history, metric=None)
+    assert gate["ok"], gate
+    from serverless_learn_tpu.cli import main
+
+    assert main(["bench", "--gate", "--dry-run", "--history", history,
+                 "--all", "--compact"]) == 0
