@@ -626,6 +626,17 @@ def cmd_serve(args) -> int:
     trainer = _build_inference_trainer(cfg)
     params, _ = _load_inference_params(args, cfg, trainer)
     module, params = _maybe_quantize(args, trainer, params)
+    kv = cfg.kv
+    if args.kv_monolithic:
+        kv = dataclasses.replace(kv, paged=False)
+    if args.kv_block_size is not None:
+        kv = dataclasses.replace(kv, block_size=args.kv_block_size)
+    if args.kv_num_blocks is not None:
+        kv = dataclasses.replace(kv, num_blocks=args.kv_num_blocks)
+    if args.prefill_chunk is not None:
+        kv = dataclasses.replace(kv, prefill_chunk=args.prefill_chunk)
+    if args.no_prefix_cache:
+        kv = dataclasses.replace(kv, prefix_cache=False)
     server = GenerationServer(module, params,
                               host=args.host, port=args.port,
                               max_batch=args.max_batch,
@@ -634,7 +645,8 @@ def cmd_serve(args) -> int:
                               chunk_size=args.chunk_size,
                               metrics_port=args.metrics_port,
                               event_log_path=args.events_log,
-                              profile_dir=args.profile_dir)
+                              profile_dir=args.profile_dir,
+                              kv=kv)
     health = _start_health(args, cfg, exporter=server._exporter,
                            registry=server.registry)
     registration = None
@@ -784,6 +796,17 @@ def cmd_loadgen(args) -> int:
     --smoke runs the self-contained 2-replica kill/restart proof (CI)."""
     from serverless_learn_tpu.fleet import loadgen
 
+    if args.kv_smoke:
+        # Round-13 serving headline: same seeded shared-prefix workload
+        # at the same offered load vs the paged and monolithic engines;
+        # exit 0 iff the paged engine measurably wins (short-class p99
+        # down, decode goodput share up) with zero hard failures.
+        rep = loadgen.run_kv_smoke(
+            seed=args.seed, rate_rps=args.rate or 10.0,
+            duration_s=args.duration or 6.0,
+            history_path=args.history if args.record else None)
+        print(json.dumps(rep, indent=None if args.compact else 2))
+        return 0 if rep["ok"] else 1
     if args.smoke:
         rep = loadgen.run_smoke(
             seed=args.seed, rate_rps=args.rate or 40.0,
@@ -1463,6 +1486,21 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--chunk-size", type=int, default=32,
                     help="decode tokens per jitted chunk between admission "
                          "boundaries (continuous engine)")
+    sv.add_argument("--kv-monolithic", action="store_true",
+                    help="legacy per-slot monolithic KV rows instead of "
+                         "the paged block pool (equivalence baseline)")
+    sv.add_argument("--kv-block-size", type=int, default=None,
+                    help="paged KV: tokens per block (config kv.block_size)")
+    sv.add_argument("--kv-num-blocks", type=int, default=None,
+                    help="paged KV: pool blocks per layer; 0 = auto "
+                         "no-overcommit sizing (config kv.num_blocks)")
+    sv.add_argument("--prefill-chunk", type=int, default=None,
+                    help="paged KV: prompt tokens per prefill chunk "
+                         "interleaved between decode boundaries "
+                         "(config kv.prefill_chunk; 0 = whole prompt)")
+    sv.add_argument("--no-prefix-cache", action="store_true",
+                    help="disable shared-prefix block reuse "
+                         "(config kv.prefix_cache)")
     sv.add_argument("--fleet", nargs="?", const="serve", default=None,
                     metavar="SERVICE",
                     help="join the serving fleet: register with the "
@@ -1565,6 +1603,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="self-contained CI proof: 2-replica stub fleet, "
                          "open-loop load, one replica killed + restarted "
                          "mid-run; exit 0 iff zero failed requests")
+    lg.add_argument("--kv-smoke", action="store_true",
+                    help="paged-KV serving headline: seeded shared-prefix "
+                         "+ long-prompt workload at fixed offered load vs "
+                         "paged AND monolithic engines (real tiny model); "
+                         "exit 0 iff paged wins p99 + decode goodput "
+                         "share with zero hard failures; --record appends "
+                         "serve_kv_* rows for `slt bench --gate`")
     lg.add_argument("--compact", action="store_true",
                     help="single-line JSON (for scripts)")
     lg.set_defaults(fn=cmd_loadgen)

@@ -274,6 +274,40 @@ class MembershipConfig:
 
 
 @dataclass(frozen=True)
+class KVCacheConfig:
+    """Paged KV cache for the serving engines (``inference/kvcache.py``,
+    consumed by ``inference/continuous.py`` and ``inference/batching.py``).
+
+    ``paged=True`` replaces the per-slot monolithic KV rows with one
+    device-resident block pool per layer (``[num_blocks, block_size, K,
+    D]``), a host-side free-list allocator and per-slot block tables, so a
+    slot only holds blocks for tokens it has actually produced and
+    retirement returns blocks to the free list immediately. On top of the
+    pool ride hash-based shared-prefix reuse (``prefix_cache``: identical
+    prompt prefixes map to refcounted read-only blocks, copy-on-write at
+    the first divergent block) and chunked prefill (``prefill_chunk``:
+    long prompts split into chunks the scheduler interleaves between
+    decode steps, budgeted per boundary by ``prefill_budget``).
+    """
+
+    paged: bool = True            # False = legacy monolithic KV rows
+    block_size: int = 16          # tokens per KV block (page)
+    # Total pool blocks per layer. 0 = auto: max_slots * ceil(max_seq_len
+    # / block_size) plus one row of slack for the prefix cache — the
+    # no-overcommit default; size it DOWN to overcommit memory (admission
+    # backpressure + preemption keep it correct).
+    num_blocks: int = 0
+    prefill_chunk: int = 32       # prompt tokens per prefill chunk (0 = whole)
+    # Max prompt tokens dispatched per scheduler boundary across all
+    # prefilling slots — bounds how long a decode boundary can stall.
+    prefill_budget: int = 64
+    prefix_cache: bool = True     # shared-prefix block reuse (trie)
+    # Max blocks the prefix trie may pin after their owners retire
+    # (0 = auto: num_blocks // 4). LRU-evicted under pool pressure.
+    prefix_cache_blocks: int = 0
+
+
+@dataclass(frozen=True)
 class FleetConfig:
     """Serving-fleet knobs (``fleet/``): the front-door router
     (``slt route``), replica self-registration (``serve --fleet``) and the
@@ -311,6 +345,11 @@ class FleetConfig:
     dead_after_probes: int = 3    # failed liveness probes => replica dead
     # ---- drain / retirement ----
     drain_grace_s: float = 10.0
+    # ---- KV memory pressure (paged engines report kv stats on ping) ----
+    # Below this pooled free-block fraction on EVERY eligible replica,
+    # priority<=0 traffic sheds with the typed overload error — queue
+    # depth alone cannot see a fleet whose KV pools are nearly exhausted.
+    kv_shed_free_frac: float = 0.02
     # ---- autoscaler ----
     autoscale: bool = False
     min_replicas: int = 1
@@ -386,6 +425,7 @@ class ExperimentConfig:
     health: HealthConfig = field(default_factory=HealthConfig)
     membership: MembershipConfig = field(default_factory=MembershipConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    kv: KVCacheConfig = field(default_factory=KVCacheConfig)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
@@ -414,6 +454,7 @@ class ExperimentConfig:
             health=build(HealthConfig, raw.get("health")),
             membership=build(MembershipConfig, raw.get("membership")),
             fleet=build(FleetConfig, raw.get("fleet")),
+            kv=build(KVCacheConfig, raw.get("kv")),
         )
 
     def override(self, **kwargs: Any) -> "ExperimentConfig":

@@ -94,6 +94,11 @@ class Replica:
         self.latencies: List[float] = []
         self.requests = 0
         self.errors = 0
+        # Paged-KV pressure from the replica's ping reply (round 13):
+        # free-block fraction + prefix hit rate. None until a paged
+        # replica reports them; monolithic replicas never do.
+        self.kv_free_frac: Optional[float] = None
+        self.prefix_hit_rate: Optional[float] = None
 
     def note_latency(self, s: float, keep: int = 128):
         self.latencies.append(s)
@@ -119,6 +124,10 @@ class Replica:
                 "errors": self.errors,
                 **({"metrics_addr": self.metrics_addr}
                    if self.metrics_addr else {}),
+                **({"kv_free_frac": self.kv_free_frac}
+                   if self.kv_free_frac is not None else {}),
+                **({"prefix_hit_rate": self.prefix_hit_rate}
+                   if self.prefix_hit_rate is not None else {}),
                 **({"last_error": self.last_error}
                    if self.last_error else {})}
 
@@ -187,6 +196,10 @@ class FleetRouter:
             "slt_router_replicas_healthy", "replicas eligible for traffic")
         self._g_inflight = reg.gauge(
             "slt_router_inflight", "requests currently held by the router")
+        self._g_kv_free = reg.gauge(
+            "slt_router_kv_free_frac",
+            "min free KV-block fraction across eligible paged replicas "
+            "(1.0 when none report)")
         self._h_queue_wait = reg.histogram(
             "slt_router_queue_wait_seconds",
             "admission wait below capacity (the autoscaler's SLO signal)")
@@ -319,6 +332,17 @@ class FleetRouter:
                                  "resolved",
                                  f"replica {r.addr} answering again",
                                  r.addr)
+        self._g_kv_free.set(self._kv_pressure())
+
+    def _kv_pressure(self) -> float:
+        """Min free KV-block fraction across the eligible set; 1.0 when
+        no replica reports paged-KV stats (monolithic fleets are never
+        memory-shed)."""
+        now = self.clock()
+        with self._lock:
+            fracs = [r.kv_free_frac for r in self._replicas.values()
+                     if r.eligible(now) and r.kv_free_frac is not None]
+        return min(fracs) if fracs else 1.0
 
     def _probe_replica(self, r: Replica):
         """(ok, draining, error): wire-level ping (cheap, definitive for
@@ -327,6 +351,11 @@ class FleetRouter:
         try:
             rep = self._wire_request(r.addr, {"op": "ping"}, timeout=2.0)
             draining = bool(rep.get("draining"))
+            kv = rep.get("kv")
+            if isinstance(kv, dict) and kv.get("blocks_total"):
+                r.kv_free_frac = (kv.get("blocks_free", 0)
+                                  / max(kv["blocks_total"], 1))
+                r.prefix_hit_rate = kv.get("prefix_hit_rate")
         except (OSError, ValueError) as e:
             return False, False, f"{type(e).__name__}: {e}"
         if r.metrics_addr:
@@ -422,8 +451,18 @@ class FleetRouter:
             return max(pool, key=lambda r: hashlib.md5(
                 f"{session}|{r.addr}".encode()).hexdigest())
         with self._lock:
+            # Memory pressure ranks between load and latency: among
+            # equally-loaded replicas, prefer the one with KV headroom
+            # (bucketed to 20% steps so probe-to-probe noise doesn't
+            # thrash affinity-free traffic between replicas).
+            def pressure(r: Replica) -> int:
+                if r.kv_free_frac is None:
+                    return 0
+                return int((1.0 - max(0.0, min(1.0, r.kv_free_frac)))
+                           * 5.0)
+
             return min(pool, key=lambda r: (
-                r.inflight, r.consec_errors,
+                r.inflight, r.consec_errors, pressure(r),
                 r.latencies[-1] if r.latencies else 0.0, r.addr))
 
     # -- forwarding ---------------------------------------------------------
@@ -556,6 +595,20 @@ class FleetRouter:
                         f"queue full ({cap} in flight, waited "
                         f"{self.cfg.queue_timeout_s:g}s)")
                 self._adm_cv.wait(remaining)
+        # KV-pressure brownout: when EVERY eligible replica's paged pool
+        # is nearly exhausted, background traffic sheds immediately —
+        # queue depth alone cannot see a fleet out of KV memory (its
+        # queues drain slowly but its admissions all backpressure).
+        if (priority <= 0
+                and self._kv_pressure() < self.cfg.kv_shed_free_frac):
+            with self._adm_cv:
+                self._inflight -= 1
+                self._g_inflight.set(self._inflight)
+                self._adm_cv.notify()
+            self._m_shed.inc()
+            return _overload_reply(
+                f"fleet KV pool pressure (free frac < "
+                f"{self.cfg.kv_shed_free_frac:g})")
         self._h_queue_wait.observe(self.clock() - t_start)
         self._m_requests.inc()
         try:

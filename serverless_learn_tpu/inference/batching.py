@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from serverless_learn_tpu.inference.generate import generate
+from serverless_learn_tpu.inference.generate import generate, init_cache
 from serverless_learn_tpu.telemetry import (RATE_BUCKETS, SIZE_BUCKETS,
                                             Span, get_registry, goodput)
 
@@ -59,9 +59,14 @@ def _bucket(n: int, floor: int = 8) -> int:
     return b
 
 
+# Prompt-length histogram buckets (slt_request_prompt_tokens): prompts
+# span tokens-to-books, unlike the batch-size-shaped SIZE_BUCKETS.
+PROMPT_BUCKETS = (1, 4, 16, 64, 256, 1024, 4096, 16384)
+
+
 @dataclass
 class _Pending:
-    prompt: List[int]
+    prompt: np.ndarray  # compact int32 array, built ONCE at submit()
     max_new: int
     temperature: float
     top_k: int
@@ -94,13 +99,22 @@ class BatchingEngine:
     """Owns the device; coalesces submitted requests into batched decodes."""
 
     def __init__(self, module, params, max_batch: int = 8,
-                 batch_wait_ms: float = 3.0, registry=None):
+                 batch_wait_ms: float = 3.0, registry=None, kv=None):
         self.module = module
         self.params = params
         self.max_batch = max_batch
         self.batch_wait_s = batch_wait_ms / 1e3
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
+        # Paged KV (round 13): the static engine shares the pool
+        # abstraction — each group runs against a per-group paged cache
+        # with a dense row-major block table (no cross-group sharing;
+        # groups are transient). Mostly an equivalence surface: the
+        # continuous engine is where the free list / prefix trie earn
+        # their keep.
+        self.kv = kv
+        self._paged = bool(kv is not None and kv.paged)
+        self._paged_modules: dict = {}
         reg = registry or get_registry()
         self.registry = reg
         lbl = {"engine": "static"}
@@ -125,6 +139,10 @@ class BatchingEngine:
             buckets=SIZE_BUCKETS, **lbl)
         self._m_tps = reg.histogram(
             "slt_request_tokens_per_sec", buckets=RATE_BUCKETS, **lbl)
+        self._m_prompt_tokens = reg.histogram(
+            "slt_request_prompt_tokens",
+            "prompt length per accepted request", buckets=PROMPT_BUCKETS,
+            **lbl)
         # Dispatcher liveness stamp (see the continuous engine): the
         # health engine reads this beside the chunk/batch counters.
         self._m_activity = reg.gauge(
@@ -158,8 +176,11 @@ class BatchingEngine:
             # than asked to direct engine callers.
             return {"error": f"prompt ({len(prompt)}) + max_new_tokens "
                              f"({max_new}) exceeds max_seq_len {max_seq}"}
-        p = _Pending(prompt=prompt, max_new=max_new, temperature=temperature,
-                     top_k=top_k, eos_id=eos_id, seed=seed)
+        # ONE compact array per request, built here and never re-copied.
+        p = _Pending(prompt=np.asarray(prompt, np.int32), max_new=max_new,
+                     temperature=temperature, top_k=top_k, eos_id=eos_id,
+                     seed=seed)
+        self._m_prompt_tokens.observe(len(prompt))
         # Compatible requests share sampling params and padded shapes.
         # Sampled requests additionally key on seed: a coalesced batch
         # draws one PRNG stream seeded by the group's FIRST request, so
@@ -248,12 +269,15 @@ class BatchingEngine:
                      first.eos_id is not None)
         new_shape = shape_key not in self._compiled_groups
         self._compiled_groups.add(shape_key)
+        module, cache = self.module, None
+        if self._paged:
+            module, cache = self._paged_group(batch_bucket)
         with goodput.phase("compile" if new_shape else "decode"):
             tokens = generate(
-                self.module, self.params, jnp.asarray(prompts), new_bucket,
+                module, self.params, jnp.asarray(prompts), new_bucket,
                 temperature=first.temperature, top_k=first.top_k,
                 eos_id=first.eos_id, rng=jax.random.PRNGKey(first.seed),
-                prompt_lengths=jnp.asarray(lengths))
+                prompt_lengths=jnp.asarray(lengths), cache=cache)
             new = np.asarray(jax.device_get(tokens))[:, prompt_bucket:]
         self.batches_run += 1
         self.requests_batched += n
@@ -273,6 +297,27 @@ class BatchingEngine:
                         self._m_tps.observe(p.max_new / lat)
             p.done.set()
 
+    def _paged_group(self, batch_bucket: int):
+        """(paged twin module, fresh cache) for one group: a dense
+        row-major block table over an exact-fit pool — the shared paged
+        abstraction (``inference/kvcache.py``) without cross-group
+        sharing. Token-identical to the monolithic cache (pinned by
+        tests/test_kvcache.py)."""
+        from serverless_learn_tpu.inference import kvcache
+
+        ps = self.kv.block_size
+        max_pages = kvcache.pages_for(self.module.cfg.max_seq_len, ps)
+        pm = self._paged_modules.get(batch_bucket)
+        if pm is None:
+            pm = kvcache.paged_module(self.module, ps,
+                                      batch_bucket * max_pages)
+            self._paged_modules[batch_bucket] = pm
+        cache = init_cache(pm, batch_bucket)
+        tbl = jnp.asarray(kvcache.sequential_table(
+            batch_bucket, max_pages, pm.cfg.kv_pages))
+        return pm, kvcache.with_tables(
+            cache, tbl, jnp.zeros((batch_bucket,), jnp.int32))
+
     def warm(self, prompt_len: int, max_new: int, temperature: float = 0.0,
              top_k: int = 0, eos_id: Optional[int] = None,
              batch_sizes=(1,)):
@@ -284,9 +329,9 @@ class BatchingEngine:
         for n in batch_sizes:
             group = []
             for _ in range(n):
-                p = _Pending(prompt=[1] * prompt_len, max_new=max_new,
-                             temperature=temperature, top_k=top_k,
-                             eos_id=eos_id, seed=0)
+                p = _Pending(prompt=np.full((prompt_len,), 1, np.int32),
+                             max_new=max_new, temperature=temperature,
+                             top_k=top_k, eos_id=eos_id, seed=0)
                 p.group_key = (temperature, top_k, eos_id,
                                0 if temperature > 0 else None,
                                _shape_buckets(prompt_len, max_new,

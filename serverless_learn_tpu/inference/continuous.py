@@ -1,4 +1,4 @@
-"""Continuous batching: a slot-level decode scheduler.
+"""Continuous batching: a slot-level decode scheduler over a paged KV pool.
 
 Round-5 verdict #2: the round-4 ``BatchingEngine`` coalesces an admission
 window and then runs the group to completion — an early-EOS sequence burns
@@ -6,31 +6,52 @@ its decode slot to the end of the group, a request arriving one tick after
 dispatch waits out the whole group, a long request head-of-line-blocks its
 bucket, and a steady stream of compatible traffic can starve a mismatched
 request behind new arrivals. This engine replaces run-to-completion groups
-with a persistent decode loop over ``max_slots`` KV-cache slots:
+with a persistent decode loop over ``max_slots`` KV-cache slots.
 
-* ONE resident KV cache of ``max_slots`` rows lives on device for the
-  engine's lifetime. Each row (``cached_k/v [slot, S, K, D]`` plus the
-  per-row ``cache_index`` vector, ``models/transformer.py``) is an
-  independent sequence — slots admit, decode, and retire individually.
-* Requests admit at chunk boundaries via a batched prefill of the new
-  prompts into a compacted ``[n_new, prompt_bucket]`` shape, scattered
-  into the free slots' cache rows (``.at[slot_ids].set(..., mode="drop")``
-  — padded slot ids drop instead of clobbering). FIFO, no compatibility
-  key: nothing starves.
-* Slots retire the moment their sequence hits EOS or its token budget —
-  the freed slot admits the next queued request at the next boundary
-  while the rest of the batch keeps decoding.
+Round 13 replaced the slots' ONE monolithic resident KV allocation
+(``max_slots`` full-length ``[slot, max_seq_len, K, D]`` rows) with a
+**paged KV pool** (``inference/kvcache.py``, ``KVCacheConfig``):
+
+* Each layer owns a block pool ``pages_k/v [num_blocks, block_size, K,
+  D]``; a host-side free-list allocator hands pages to slots through
+  per-slot block tables, so a slot only holds pages for tokens it has
+  actually produced and retirement returns them immediately. Decode runs
+  over a COMPACTED live batch with a bucketed table window ``W`` —
+  retired slots stop burning FLOPs and short sequences stop attending
+  over ``max_seq_len`` (both were the documented SPMD cost of the
+  monolithic layout).
+* **Shared-prefix reuse** (``prefix_cache``): full prompt blocks are
+  published to a token-keyed trie after prefill; an identical later
+  prefix (the fleet's system prompts) adopts the refcounted read-only
+  pages and skips recomputing them, with copy-on-write at the first
+  divergent block. Sound because K/V depend only on token values and
+  absolute RoPE positions.
+* **Chunked prefill** (``prefill_chunk``): long prompts admit in chunks
+  the scheduler interleaves between decode boundaries (budgeted by
+  ``prefill_budget``), so a 4k-token prompt no longer stalls the decode
+  batch for one giant admit. Admission under pool pressure is TYPED
+  backpressure (the request stays queued, ``slt_kv_admit_blocked_total``
+  counts, a ``kv.blocks_exhausted`` alert event fires for `slt doctor`);
+  decode-time pressure first evicts cached prefixes, then deterministically
+  preempts the youngest slot (restart is token-identical — the per-slot
+  ``fold_in(seed, position)`` streams are position-based).
+
+The legacy monolithic layout (``KVCacheConfig(paged=False)`` or
+``kv=None``) is kept as the equivalence baseline; the paged path is pinned
+token-identical to it (greedy + seeded) by ``tests/test_kvcache.py``.
 
 TPU shape discipline: decode runs in jitted CHUNKS — a ``lax.scan`` of
-``chunk_size`` single-token steps over all ``max_slots`` rows — because
-XLA wants static shapes and, on this tunneled dev chip, a per-token
-host round trip costs ~100 ms (the flash row's measurement). Host control
-returns only once per chunk, and the dispatcher keeps ``pipeline_depth``
-chunks in flight (JAX async dispatch): the fetch of chunk k's tokens
-overlaps chunk k+1's compute, so the tunnel RTT prices latency (admission
-granularity = one chunk), not throughput. Retired-slot rows keep burning
-decode FLOPs until re-admission — the SPMD cost of static shapes, and
-still ~free because decode is HBM-bound (a B=8 step costs ~a B=1 step).
+``chunk_size`` single-token steps — because XLA wants static shapes and,
+on this tunneled dev chip, a per-token host round trip costs ~100 ms (the
+flash row's measurement). Host control returns only once per chunk, and
+the dispatcher keeps ``pipeline_depth`` chunks in flight (JAX async
+dispatch). Paged compile keys are (live-batch bucket, table-window
+bucket) for decode and (batch, chunk, window) buckets for prefill — the
+round-5 admit-bucket warm-compile machinery extended to paged shapes.
+In-order device execution makes page recycling safe: every in-flight
+chunk that can still write a retired slot's pages was dispatched before
+the harvest that freed them, so it executes before any later prefill
+that reuses them.
 
 Per-slot sampling state (temperature, top_k, EOS id, PRNG seed) rides in
 [max_slots] device arrays, so a batch can mix greedy and sampled traffic —
@@ -64,12 +85,27 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from serverless_learn_tpu.inference.batching import _bucket
+from serverless_learn_tpu.config import KVCacheConfig
+from serverless_learn_tpu.inference import kvcache
+from serverless_learn_tpu.inference.batching import PROMPT_BUCKETS, _bucket
 from serverless_learn_tpu.inference.generate import init_cache
+from serverless_learn_tpu.inference.kvcache import (BlockPool, PrefixTrie,
+                                                    pages_for)
 from serverless_learn_tpu.telemetry import (RATE_BUCKETS, SIZE_BUCKETS,
                                             Span, TraceContext, get_registry)
 from serverless_learn_tpu.telemetry import flight, goodput
 from serverless_learn_tpu.telemetry.tracing import node_name
+
+
+def _wbucket(n: int) -> int:
+    """Power-of-FOUR bucket for table-window widths: the window only
+    changes attention span (cost is linear in it), so coarse buckets
+    trade <= 4x masked-out span for a 2x smaller XLA compile-key space —
+    on-line compiles, not FLOPs, dominated the first paged bench."""
+    b = 1
+    while b < n:
+        b *= 4
+    return b
 
 
 def _fold_keys(seeds: jax.Array, positions: jax.Array) -> jax.Array:
@@ -104,7 +140,7 @@ def _sample_slots(logits: jax.Array, temp: jax.Array, topk: jax.Array,
 
 @dataclass
 class _Request:
-    prompt: List[int]
+    prompt: np.ndarray  # compact int32 array, built ONCE at submit()
     max_new: int
     temperature: float
     top_k: int
@@ -114,13 +150,20 @@ class _Request:
     result: Optional[dict] = None
     tokens: List[int] = field(default_factory=list)
     finished: bool = False
-    admitted: bool = False  # False: still queued; True: decoding in a slot
+    admitted: bool = False  # False: still queued; True: owns a slot
     peak_batch: int = 1  # live slots alongside this request (stats)
-    # Set by submit() on timeout: the caller is gone, so _admit/_harvest
-    # retire the slot (or drop the queue entry) at the next boundary
+    # Set by submit() on timeout: the caller is gone, so the scheduler
+    # retires the slot (or drops the queue entry) at the next boundary
     # instead of decoding an abandoned request to its full budget.
     cancelled: bool = False
     span: Optional[Span] = None  # request trace: submit/admit/first/done
+    # ---- paged-mode scheduling state ----
+    prefilling: bool = False   # mid chunked prefill (not yet decodable)
+    prefill_pos: int = 0       # prompt tokens written (incl. shared prefix)
+    chunks_dispatched: int = 0  # decode chunks launched for this residency
+    admit_seq: int = 0         # admission order (preemption picks youngest)
+    gen: int = 0               # residency epoch; preemption invalidates
+    #                            in-flight futures from the old epoch
 
 
 class ContinuousBatchingEngine:
@@ -128,7 +171,8 @@ class ContinuousBatchingEngine:
 
     def __init__(self, module, params, max_slots: int = 8,
                  chunk_size: int = 32, pipeline_depth: int = 2,
-                 max_top_k: int = 64, registry=None, event_log=None):
+                 max_top_k: int = 64, registry=None, event_log=None,
+                 kv: Optional[KVCacheConfig] = None):
         self.module = module
         self.params = params
         self.max_slots = max_slots
@@ -139,13 +183,62 @@ class ContinuousBatchingEngine:
         self._stop = threading.Event()
         # Host-side slot table: index -> live _Request (None = free).
         self._slots: List[Optional[_Request]] = [None] * max_slots
+
+        # ---- paged KV pool (round 13) ----
+        self.kv = kv
+        self._paged = bool(kv is not None and kv.paged)
+        max_seq = module.cfg.max_seq_len
+        if self._paged:
+            ps = kv.block_size
+            self._ps = ps
+            self._max_pages = pages_for(max_seq, ps)
+            num_blocks = kv.num_blocks or (
+                max_slots * self._max_pages
+                + (self._max_pages if kv.prefix_cache else 0))
+            if num_blocks < self._max_pages:
+                raise ValueError(
+                    f"kv.num_blocks ({num_blocks}) cannot hold one "
+                    f"max-length sequence ({self._max_pages} blocks of "
+                    f"{ps}); the engine could deadlock")
+            self._pool = BlockPool(num_blocks, ps)
+            self._trie = (PrefixTrie(
+                self._pool,
+                max_blocks=kv.prefix_cache_blocks or num_blocks // 4)
+                if kv.prefix_cache else None)
+            self._pmod = kvcache.paged_module(module, ps, num_blocks)
+            self.prefill_chunk = kv.prefill_chunk or max_seq
+            self.prefill_budget = max(kv.prefill_budget,
+                                      self.prefill_chunk)
+            # Host-owned block tables: [max_slots, max_pages] page ids,
+            # sentinel (== num_blocks) marking unallocated entries.
+            self._tbl = np.full((max_slots, self._max_pages),
+                                self._pool.sentinel, np.int32)
+            self._slot_pages: List[List[int]] = [[] for _ in
+                                                 range(max_slots)]
+            self._pending_cow: Dict[int, tuple] = {}
+            self._prefill_jits: Dict[tuple, object] = {}
+            self._chunk_jits: Dict[tuple, object] = {}
+            self._kv_alert_firing = False
+            self._last_kv_alert = 0.0
         self._state = self._init_state()
-        self._chunk_jit = self._build_chunk()
+        if not self._paged:
+            self._chunk_jit = self._build_chunk()
         self._admit_jits: Dict[tuple, object] = {}
         self.chunks_run = 0
         self.requests_admitted = 0
         self.requests_finished = 0
         self.requests_cancelled = 0
+        self.prefill_chunks_run = 0
+        # Decode row accounting: ``decoded_rows_total`` counts rows that
+        # still owed tokens at dispatch; ``dispatched_rows_total`` counts
+        # rows of compute actually paid (paged: the compacted nb bucket;
+        # monolithic: ALL max_slots rows, every chunk — the retired-row
+        # burn). Their ratio is the decode-row utilization the serving
+        # bench discounts decode goodput by.
+        self.decoded_rows_total = 0
+        self.dispatched_rows_total = 0
+        self.preemptions = 0
+        self._admit_counter = 0
         # warm() raises this so a known batch size admits as ONE bucket
         # (compiling deterministically) instead of splitting on thread
         # arrival timing; 1 in normal service.
@@ -181,6 +274,35 @@ class ContinuousBatchingEngine:
             "slt_request_tokens_per_sec", buckets=RATE_BUCKETS, **lbl)
         self._m_slots = reg.gauge(
             "slt_slots_in_use", "occupied decode slots", **lbl)
+        self._m_prompt_tokens = reg.histogram(
+            "slt_request_prompt_tokens",
+            "prompt length per accepted request (the prefix-hit-rate "
+            "denominator)", buckets=PROMPT_BUCKETS, **lbl)
+        # Paged-KV telemetry (zero/static in monolithic mode).
+        self._m_kv_total = reg.gauge(
+            "slt_kv_blocks_total", "KV pool size in blocks", **lbl)
+        self._m_kv_in_use = reg.gauge(
+            "slt_kv_blocks_in_use", "allocated KV pool blocks", **lbl)
+        self._m_kv_hits = reg.counter(
+            "slt_kv_prefix_hits_total",
+            "admissions that reused shared prefix blocks", **lbl)
+        self._m_kv_hit_tokens = reg.counter(
+            "slt_kv_prefix_tokens_total",
+            "prompt tokens skipped via shared prefix blocks", **lbl)
+        self._m_prefill_chunks = reg.counter(
+            "slt_prefill_chunks_total",
+            "prefill chunks interleaved between decode boundaries", **lbl)
+        self._m_kv_blocked = reg.counter(
+            "slt_kv_admit_blocked_total",
+            "admission/prefill boundaries deferred on pool exhaustion",
+            **lbl)
+        self._m_preempt = reg.counter(
+            "slt_kv_preemptions_total",
+            "slots preempted to free KV blocks (deterministic restart)",
+            **lbl)
+        if self._paged:
+            self._m_kv_total.set(self._pool.num_blocks)
+            self._m_kv_in_use.set(0)
         # Dispatcher liveness stamp for the health engine: a wedged
         # dispatcher (poisoned device state, hung transfer) stops
         # advancing this while slots stay occupied — exactly the state
@@ -196,8 +318,7 @@ class ContinuousBatchingEngine:
 
     def _init_state(self) -> dict:
         B = self.max_slots
-        return {
-            "cache": init_cache(self.module, B),
+        vecs = {
             "next_tok": jnp.zeros((B,), jnp.int32),
             "pos": jnp.zeros((B,), jnp.int32),   # tokens generated so far
             "done": jnp.ones((B,), jnp.bool_),    # free slots count as done
@@ -206,6 +327,11 @@ class ContinuousBatchingEngine:
             "eos": jnp.full((B,), -1, jnp.int32),
             "seed": jnp.zeros((B,), jnp.uint32),
         }
+        if self._paged:
+            pages, _ = kvcache.split_cache(init_cache(self._pmod, B))
+            vecs["ci"] = jnp.zeros((B,), jnp.int32)  # absolute cache index
+            return {"pages": pages, "vecs": vecs}
+        return {"cache": init_cache(self.module, B), **vecs}
 
     def _build_chunk(self):
         module, C, ktop = self.module, self.chunk_size, self.max_top_k
@@ -241,7 +367,8 @@ class ContinuousBatchingEngine:
         prefill of the new prompts in a compacted [nb, pb] shape, sample
         each row's FIRST token from its own last-real-position logits,
         then scatter cache rows + slot arrays into the big state at
-        ``slot_ids`` (padded ids >= max_slots drop)."""
+        ``slot_ids`` (padded ids >= max_slots drop). Monolithic mode
+        only — the paged path admits through ``_prefill_step``."""
         key = (nb, pb)
         if key in self._admit_jits:
             return self._admit_jits[key]
@@ -282,9 +409,120 @@ class ContinuousBatchingEngine:
         self._admit_jits[key] = fn
         return fn
 
+    # -- paged jits --------------------------------------------------------
+
+    def _paged_prefill_jit(self, nb: int, T: int, W: int):
+        """Compiled prefill chunk for (batch, chunk, table-window)
+        buckets: ragged extend of up to T new prompt tokens per row into
+        the shared pool (per-row start index ``ci0``, COW page copies
+        first), then sample the FIRST token for rows whose prompt just
+        completed and flip them live for decode."""
+        key = (nb, T, W)
+        if key in self._prefill_jits:
+            return self._prefill_jits[key]
+        module, ktop, M = self._pmod, self.max_top_k, self.max_slots
+
+        def pre(params, pages, vecs, tbl, ci0, toks, lens, slot_ids, fin,
+                temp, topk, eos, seed, cow_src, cow_dst):
+            # COW: materialize the divergent-block copies before the
+            # extend overwrites from the divergent offset (sentinel
+            # src/dst = no copy: gather clips, scatter drops).
+            def cp(p):
+                src = p.at[cow_src].get(mode="clip")
+                return p.at[cow_dst].set(src, mode="drop")
+
+            pages = jax.tree_util.tree_map(cp, pages)
+            cache = kvcache.with_tables(pages, tbl, ci0)
+            logits, upd = module.apply(
+                {"params": params, "cache": cache}, toks,
+                extend=True, mutable=["cache"], seq_lengths=lens)
+            pages, ci1 = kvcache.split_cache(upd["cache"])
+            last = jnp.take_along_axis(
+                logits, jnp.maximum(lens - 1, 0)[:, None, None],
+                axis=1)[:, 0]
+            tok0 = _sample_slots(last, temp, topk, seed,
+                                 jnp.zeros((nb,), jnp.int32), ktop)
+            done0 = (eos >= 0) & (tok0 == eos)
+            # Only rows that FINISHED their prompt become decodable; the
+            # rest scatter nothing (sentinel ids drop).
+            fin_ids = jnp.where(fin, slot_ids, M)
+
+            def put(big, new, ids):
+                return big.at[ids].set(new, mode="drop")
+
+            out = dict(
+                vecs,
+                next_tok=put(vecs["next_tok"], tok0, fin_ids),
+                pos=put(vecs["pos"], jnp.ones((nb,), jnp.int32), fin_ids),
+                done=put(vecs["done"], done0, fin_ids),
+                temp=put(vecs["temp"], temp, fin_ids),
+                topk=put(vecs["topk"], topk, fin_ids),
+                eos=put(vecs["eos"], eos, fin_ids),
+                seed=put(vecs["seed"], seed, fin_ids),
+                ci=put(vecs["ci"], ci1, slot_ids),
+            )
+            return pages, out, tok0
+
+        fn = jax.jit(pre, donate_argnums=(1, 2))
+        self._prefill_jits[key] = fn
+        return fn
+
+    def _paged_chunk_jit(self, nb: int, W: int):
+        """Compiled decode chunk for (live-batch, table-window) buckets:
+        gather the live slots into a COMPACT batch, scan ``chunk_size``
+        single-token steps against the shared pool through the passed
+        table window, scatter the per-slot state back (padded live ids
+        drop). Retired slots never enter the batch — decode cost tracks
+        live slots, not ``max_slots``."""
+        key = (nb, W)
+        if key in self._chunk_jits:
+            return self._chunk_jits[key]
+        module, C, ktop = self._pmod, self.chunk_size, self.max_top_k
+
+        def chunk(params, pages, vecs, tbl, live):
+            def take(x):
+                return x.at[live].get(mode="clip")
+
+            tok, pos, done = (take(vecs["next_tok"]), take(vecs["pos"]),
+                              take(vecs["done"]))
+            ci = take(vecs["ci"])
+            temp, topk = take(vecs["temp"]), take(vecs["topk"])
+            eos, seed = take(vecs["eos"]), take(vecs["seed"])
+
+            def step(carry, _):
+                pages, tok, pos, done, ci = carry
+                cache = kvcache.with_tables(pages, tbl, ci)
+                logits, upd = module.apply(
+                    {"params": params, "cache": cache}, tok[:, None],
+                    decode=True, mutable=["cache"])
+                pages, ci = kvcache.split_cache(upd["cache"])
+                nxt = _sample_slots(logits[:, 0], temp, topk, seed, pos,
+                                    ktop)
+                keep = jnp.maximum(eos, 0)
+                nxt = jnp.where(done, keep, nxt)
+                done = done | ((eos >= 0) & (nxt == eos))
+                return (pages, nxt, pos + 1, done, ci), nxt
+
+            (pages, tok, pos, done, ci), toks = jax.lax.scan(
+                step, (pages, tok, pos, done, ci), None, length=C)
+
+            def put(big, new):
+                return big.at[live].set(new, mode="drop")
+
+            out = dict(vecs,
+                       next_tok=put(vecs["next_tok"], tok),
+                       pos=put(vecs["pos"], pos),
+                       done=put(vecs["done"], done),
+                       ci=put(vecs["ci"], ci))
+            return pages, out, jnp.swapaxes(toks, 0, 1)  # [nb, C]
+
+        fn = jax.jit(chunk, donate_argnums=(1, 2))
+        self._chunk_jits[key] = fn
+        return fn
+
     # -- client side -------------------------------------------------------
 
-    def submit(self, prompt: List[int], max_new: int, temperature: float,
+    def submit(self, prompt, max_new: int, temperature: float,
                top_k: int, eos_id: Optional[int], seed: int,
                timeout_s: float = 600.0,
                trace: Optional[TraceContext] = None) -> dict:
@@ -306,7 +544,9 @@ class ContinuousBatchingEngine:
         if top_k > self.max_top_k:
             return {"error": f"top_k ({top_k}) exceeds this engine's "
                              f"max_top_k ({self.max_top_k})"}
-        r = _Request(prompt=list(prompt), max_new=max_new,
+        # ONE compact array per request, built here and never re-copied:
+        # queue entries, prefill chunk slices and trie lookups all view it.
+        r = _Request(prompt=np.asarray(prompt, np.int32), max_new=max_new,
                      temperature=float(temperature), top_k=int(top_k),
                      eos_id=eos_id, seed=int(seed))
         if trace is not None:
@@ -315,6 +555,7 @@ class ContinuousBatchingEngine:
         else:
             r.span = Span("request")
         self._m_requests.inc()
+        self._m_prompt_tokens.observe(len(prompt))
         self._q.put(r)
         if not r.done.wait(timeout_s):
             # The caller is abandoning this request. Flag it so the
@@ -341,6 +582,12 @@ class ContinuousBatchingEngine:
             self.event_log.emit(rec)
         flight.record(rec)
 
+    def _emit_event(self, rec: dict) -> None:
+        rec.setdefault("node", node_name())
+        if self.event_log is not None:
+            self.event_log.emit(rec)
+        flight.record(rec)
+
     def _cancel(self, r: _Request):
         """Retire an abandoned request: its submitter already returned."""
         r.finished = True
@@ -351,9 +598,9 @@ class ContinuousBatchingEngine:
             r.span.mark("cancelled")
             self._emit_span(r.span)
 
-    def _admit(self, staged: List[_Request]) -> Optional[tuple]:
-        # Timed-out submitters never decode: drop their queue entries
-        # before they ever take a slot.
+    def _drop_cancelled(self, staged: List[_Request]) -> None:
+        """Timed-out submitters never decode: drop their queue entries
+        before they ever take a slot."""
         keep = []
         for r in staged:
             if r.cancelled and not r.finished:
@@ -361,6 +608,31 @@ class ContinuousBatchingEngine:
             elif not r.finished:
                 keep.append(r)
         staged[:] = keep
+
+    def _note_admitted(self, r: _Request, sid: int):
+        r.admitted = True
+        r.admit_seq = self._admit_counter
+        self._admit_counter += 1
+        self._slots[sid] = r
+        if r.span is not None:
+            r.span.mark("admit")
+            wait = r.span.between(None, "admit")
+            if wait is not None:
+                self._m_qwait.observe(wait)
+
+    def _post_admit_stats(self, n: int):
+        self.requests_admitted += n
+        self._m_admit_sz.observe(n)
+        live = self.max_slots - len(self._free_slots())
+        self._m_slots.set(live)
+        for r in self._slots:
+            if r is not None:
+                r.peak_batch = max(r.peak_batch, live)
+
+    # ---- monolithic admission (legacy baseline) ----
+
+    def _admit(self, staged: List[_Request]) -> Optional[tuple]:
+        self._drop_cancelled(staged)
         free = self._free_slots()
         n = min(len(free), len(staged))
         if n < max(1, min(self._min_admit, self.max_slots)):
@@ -385,20 +657,8 @@ class ContinuousBatchingEngine:
             topk[i] = r.top_k
             eos[i] = -1 if r.eos_id is None else r.eos_id
             seed[i] = r.seed & 0xFFFFFFFF
-            r.admitted = True
-            self._slots[ids[i]] = r
-            if r.span is not None:
-                r.span.mark("admit")
-                wait = r.span.between(None, "admit")
-                if wait is not None:
-                    self._m_qwait.observe(wait)
-        self.requests_admitted += n
-        self._m_admit_sz.observe(n)
-        live = self.max_slots - len(self._free_slots())
-        self._m_slots.set(live)
-        for r in self._slots:
-            if r is not None:
-                r.peak_batch = max(r.peak_batch, live)
+            self._note_admitted(r, ids[i])
+        self._post_admit_stats(n)
         # Goodput: a first-seen (nb, pb) bucket pays an XLA compile here
         # — that wall-clock is "compile" badput, not admission work.
         new_bucket = (nb, pb) not in self._admit_jits
@@ -417,31 +677,381 @@ class ContinuousBatchingEngine:
         # The admit's first tokens harvest like a 1-token chunk, in order.
         return ("admit", tok0, [(ids[i], batch[i]) for i in range(n)])
 
+    # ---- paged allocation helpers ----
+
+    def _try_alloc(self, n: int) -> Optional[List[int]]:
+        """Allocate, evicting cached prefixes under pressure; None when
+        the pool genuinely cannot satisfy it (typed backpressure)."""
+        try:
+            return self._pool.alloc(n)
+        except kvcache.KVBlocksExhausted:
+            if self._trie is not None and self._trie.blocks_held:
+                self._trie.release(n)
+                try:
+                    return self._pool.alloc(n)
+                except kvcache.KVBlocksExhausted:
+                    return None
+            return None
+
+    def _ensure_pages(self, sid: int, n_tokens: int) -> bool:
+        need = pages_for(n_tokens, self._ps) - len(self._slot_pages[sid])
+        if need <= 0:
+            return True
+        got = self._try_alloc(need)
+        if got is None:
+            return False
+        base = len(self._slot_pages[sid])
+        for j, b in enumerate(got):
+            self._tbl[sid, base + j] = b
+        self._slot_pages[sid].extend(got)
+        return True
+
+    def _retire_slot(self, sid: int):
+        pages = self._slot_pages[sid]
+        if pages:
+            self._pool.decref(pages)
+        self._slot_pages[sid] = []
+        self._tbl[sid, :] = self._pool.sentinel
+        self._pending_cow.pop(sid, None)
+        self._slots[sid] = None
+
+    def _note_kv_blocked(self):
+        """Pool exhaustion = admission backpressure, surfaced for the
+        doctor: counted, and emitted as a rate-limited health-engine-
+        shaped alert event so `slt doctor` can name the incident from
+        telemetry alone (blocks exhausted -> admit_wait badput)."""
+        self._m_kv_blocked.inc()
+        now = time.time()
+        if self._kv_alert_firing and now - self._last_kv_alert < 5.0:
+            return
+        self._kv_alert_firing = True
+        self._last_kv_alert = now
+        free, total = self._pool.free_blocks, self._pool.num_blocks
+        self._emit_event({
+            "event": "alert", "alert": "kv.blocks_exhausted",
+            "severity": "warning", "detector": "kvcache",
+            "state": "firing",
+            "message": f"KV block pool exhausted ({free}/{total} free): "
+                       f"admissions deferred (backpressure)",
+            "labels": {"engine": "continuous"},
+            "value": free / max(total, 1), "threshold": 0.0, "count": 1,
+            "first_fired_unix_s": round(now, 3),
+            "last_fired_unix_s": round(now, 3)})
+
+    def _maybe_resolve_kv_alert(self):
+        if not self._kv_alert_firing:
+            return
+        free, total = self._pool.free_blocks, self._pool.num_blocks
+        if free / max(total, 1) < 0.25:
+            return
+        self._kv_alert_firing = False
+        now = time.time()
+        self._emit_event({
+            "event": "alert", "alert": "kv.blocks_exhausted",
+            "severity": "warning", "detector": "kvcache",
+            "state": "resolved",
+            "message": f"KV pool pressure cleared ({free}/{total} free)",
+            "labels": {"engine": "continuous"},
+            "value": free / max(total, 1), "threshold": 0.0, "count": 1,
+            "first_fired_unix_s": round(self._last_kv_alert, 3),
+            "last_fired_unix_s": round(now, 3)})
+
+    def _preempt_candidate(self, exclude: int) -> Optional[int]:
+        """Youngest occupied slot (never the oldest — progress guarantee),
+        excluding ``exclude``."""
+        occupied = [(r.admit_seq, i) for i, r in enumerate(self._slots)
+                    if r is not None and not r.finished and i != exclude]
+        if len(occupied) < 1:
+            return None
+        occupied.sort()
+        # Never preempt the globally oldest residency: someone must finish.
+        all_occ = [(r.admit_seq, i) for i, r in enumerate(self._slots)
+                   if r is not None and not r.finished]
+        oldest = min(all_occ)[1] if all_occ else None
+        seq, sid = occupied[-1]
+        if sid == oldest:
+            return None
+        return sid
+
+    def _preempt(self, sid: int, staged: List[_Request]):
+        """Free a slot's pages and requeue its request at the FRONT.
+        Restart is token-identical: per-slot fold_in(seed, position)
+        streams depend only on the request, so the re-run reproduces the
+        same tokens (greedy and sampled alike)."""
+        r = self._slots[sid]
+        self._retire_slot(sid)
+        r.admitted = False
+        r.prefilling = False
+        r.prefill_pos = 0
+        r.chunks_dispatched = 0
+        r.tokens = []
+        r.gen += 1  # in-flight futures from the old residency are void
+        if r.span is not None:
+            r.span.mark("preempt")
+        staged.insert(0, r)
+        self.preemptions += 1
+        self._m_preempt.inc()
+
+    # ---- paged admission + prefill + decode ----
+
+    def _admit_paged(self, staged: List[_Request]) -> bool:
+        self._drop_cancelled(staged)
+        free = self._free_slots()
+        n = min(len(free), len(staged))
+        if n < max(1, min(self._min_admit, self.max_slots)):
+            return False
+        ps = self._ps
+        admitted = 0
+        for _ in range(n):
+            r = staged[0]
+            sid = free[admitted]
+            L = len(r.prompt)
+            pos0, shared, donor = 0, [], None
+            if self._trie is not None:
+                hit = self._trie.lookup(r.prompt)
+                extra = hit.cow_tokens if hit.cow_src is not None else 0
+                # Never skip the LAST prompt token: its logits seed the
+                # first sampled token, so it must be recomputed (its K/V
+                # rewrite lands in an owned/COW page with identical
+                # values — RoPE positions are absolute).
+                skip = min(hit.tokens_matched + extra, L - 1)
+                n_shared = skip // ps
+                r0 = skip - n_shared * ps
+                shared = hit.blocks[:n_shared]
+                if r0 > 0:
+                    donor = (hit.blocks[n_shared]
+                             if n_shared < len(hit.blocks)
+                             else hit.cow_src)
+                pos0 = skip
+            tk = min(L - pos0, self.prefill_chunk)
+            fresh = pages_for(pos0 + tk, ps) - len(shared)
+            got = self._try_alloc(fresh)
+            if got is None:
+                # FIFO backpressure: nothing behind this request admits
+                # either; it stays queued and retries next boundary.
+                self._note_kv_blocked()
+                break
+            self._pool.incref(shared)
+            pages = list(shared) + got
+            self._slot_pages[sid] = pages
+            self._tbl[sid, :] = self._pool.sentinel
+            self._tbl[sid, :len(pages)] = pages
+            if donor is not None:
+                # COW: the first fresh page (block index n_shared) gets a
+                # device-side copy of the donor before prefill overwrites
+                # it from the divergent offset.
+                self._pending_cow[sid] = (donor, got[0])
+            staged.pop(0)
+            r.prefilling = True
+            r.prefill_pos = pos0
+            self._note_admitted(r, sid)
+            if pos0 > 0:
+                self._m_kv_hits.inc()
+                self._m_kv_hit_tokens.inc(pos0)
+            admitted += 1
+        if admitted:
+            self._post_admit_stats(admitted)
+        return admitted > 0
+
+    def _prefill_step(self, staged: List[_Request]) -> Optional[tuple]:
+        """Advance mid-prefill slots by up to ``prefill_chunk`` tokens
+        each, bounded by ``prefill_budget`` per boundary — the policy
+        that keeps a long prompt from stalling the decode batch."""
+        rows = []
+        for sid, r in enumerate(self._slots):
+            if r is None or not r.prefilling or r.finished:
+                continue
+            if r.cancelled:
+                self._cancel(r)
+                self._retire_slot(sid)
+                continue
+            rows.append((sid, r))
+        if not rows:
+            return None
+        rows.sort(key=lambda sr: sr[1].admit_seq)  # FIFO budget
+        budget = self.prefill_budget
+        batch = []
+        for sid, r in rows:
+            rem = len(r.prompt) - r.prefill_pos
+            tk = min(rem, self.prefill_chunk)
+            if batch and tk > budget:
+                break
+            if not self._ensure_pages(sid, r.prefill_pos + tk):
+                self._note_kv_blocked()
+                continue
+            budget -= tk
+            batch.append((sid, r, tk))
+        if not batch:
+            return None
+        M = self.max_slots
+        nb = _bucket(len(batch), floor=1)
+        T = min(_bucket(max(tk for _, _, tk in batch), floor=8),
+                _bucket(self.prefill_chunk, floor=1))
+        W = min(_wbucket(max(len(self._slot_pages[sid])
+                             for sid, _, _ in batch)),
+                self._max_pages)
+        toks = np.zeros((nb, T), np.int32)
+        lens = np.zeros((nb,), np.int32)
+        ci0 = np.zeros((nb,), np.int32)
+        slot_ids = np.full((nb,), M, np.int32)
+        fin = np.zeros((nb,), bool)
+        temp = np.zeros((nb,), np.float32)
+        topk = np.zeros((nb,), np.int32)
+        eos = np.full((nb,), -1, np.int32)
+        seed = np.zeros((nb,), np.uint32)
+        sent = self._pool.sentinel
+        cow_src = np.full((nb,), sent, np.int32)
+        cow_dst = np.full((nb,), sent, np.int32)
+        tbl_rows = np.full((nb, W), sent, np.int32)
+        for i, (sid, r, tk) in enumerate(batch):
+            toks[i, :tk] = r.prompt[r.prefill_pos:r.prefill_pos + tk]
+            lens[i] = tk
+            ci0[i] = r.prefill_pos
+            slot_ids[i] = sid
+            fin[i] = (r.prefill_pos + tk == len(r.prompt))
+            temp[i] = r.temperature
+            topk[i] = r.top_k
+            eos[i] = -1 if r.eos_id is None else r.eos_id
+            seed[i] = r.seed & 0xFFFFFFFF
+            cow = self._pending_cow.pop(sid, None)
+            if cow is not None:
+                cow_src[i], cow_dst[i] = cow
+            tbl_rows[i] = self._tbl[sid, :W]
+        key = (nb, T, W)
+        new_bucket = key not in self._prefill_jits
+        fn = self._paged_prefill_jit(nb, T, W)
+        with goodput.phase("compile" if new_bucket else "prefill"):
+            self._state["pages"], self._state["vecs"], tok0 = fn(
+                self.params, self._state["pages"], self._state["vecs"],
+                jnp.asarray(tbl_rows), jnp.asarray(ci0),
+                jnp.asarray(toks), jnp.asarray(lens),
+                jnp.asarray(slot_ids), jnp.asarray(fin),
+                jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(eos),
+                jnp.asarray(seed), jnp.asarray(cow_src),
+                jnp.asarray(cow_dst))
+        snapshot = []
+        for i, (sid, r, tk) in enumerate(batch):
+            r.prefill_pos += tk
+            if fin[i]:
+                r.prefilling = False
+                if self._trie is not None and len(r.prompt) >= self._ps:
+                    # Publish the prompt's FULL blocks (their K/V are now
+                    # completely written); the boundary partial block
+                    # stays private so prefix pages are never rewritten.
+                    n_full = len(r.prompt) // self._ps
+                    self._trie.register(r.prompt,
+                                        self._slot_pages[sid][:n_full])
+            snapshot.append((sid, r, bool(fin[i]), r.gen))
+        self.prefill_chunks_run += len(batch)
+        self._m_prefill_chunks.inc(len(batch))
+        try:
+            tok0.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        return ("prefill", tok0, snapshot)
+
+    def _decode_step_paged(self, staged: List[_Request]) -> Optional[tuple]:
+        live = [sid for sid, r in enumerate(self._slots)
+                if r is not None and not r.finished and not r.prefilling]
+        if not live:
+            return None
+        C, ps = self.chunk_size, self._ps
+        rows = []
+        for sid in live:
+            r = self._slots[sid]
+            if r is None or r.finished or r.prefilling:
+                continue  # a preemption below may have evicted this row
+            # Pages for the next C tokens, capped at the request budget:
+            # overshoot past the allocation resolves to the sentinel and
+            # drops (a finished row's EOS filler must not clobber pages).
+            dispatched = len(r.prompt) + r.chunks_dispatched * C
+            needed = min(dispatched + C, len(r.prompt) + r.max_new)
+            while not self._ensure_pages(sid, needed):
+                victim = self._preempt_candidate(exclude=sid)
+                if victim is None:
+                    break
+                self._preempt(victim, staged)
+            if self._ensure_pages(sid, needed):
+                rows.append(sid)
+            else:
+                self._note_kv_blocked()
+        # Preemption may have evicted rows already collected.
+        rows = [sid for sid in rows if self._slots[sid] is not None
+                and not self._slots[sid].prefilling]
+        if not rows:
+            return None
+        M = self.max_slots
+        nb = _bucket(len(rows), floor=1)
+        W = min(_wbucket(max(len(self._slot_pages[sid]) for sid in rows)),
+                self._max_pages)
+        sent = self._pool.sentinel
+        live_arr = np.full((nb,), M, np.int32)
+        live_arr[:len(rows)] = rows
+        tbl_rows = np.full((nb, W), sent, np.int32)
+        for j, sid in enumerate(rows):
+            tbl_rows[j] = self._tbl[sid, :W]
+        key = (nb, W)
+        new_bucket = key not in self._chunk_jits
+        fn = self._paged_chunk_jit(nb, W)
+        with goodput.phase("compile" if new_bucket else "decode"):
+            self._state["pages"], self._state["vecs"], toks = fn(
+                self.params, self._state["pages"], self._state["vecs"],
+                jnp.asarray(tbl_rows), jnp.asarray(live_arr))
+        self.chunks_run += 1
+        self._m_chunks.inc()
+        self.decoded_rows_total += len(rows)
+        self.dispatched_rows_total += nb
+        snapshot = []
+        for sid in rows:
+            r = self._slots[sid]
+            r.chunks_dispatched += 1
+            snapshot.append((sid, r, r.gen))
+        try:
+            toks.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        return ("pchunk", toks, snapshot)
+
+    # -- harvest -----------------------------------------------------------
+
     def _harvest(self, fut) -> None:
         kind, toks, snapshot = fut
         arr = np.asarray(jax.device_get(toks))  # blocks; overlaps in-flight
         if kind == "admit":
             arr = arr[:, None]  # [nb] -> [nb, 1], rows indexed by snapshot
-            rows = {sid: arr[i] for i, (sid, _) in enumerate(snapshot)}
-        else:
-            rows = {sid: arr[sid] for sid, _ in snapshot}
-        for sid, r in snapshot:
+            pairs = [(sid, r, arr[i]) for i, (sid, r)
+                     in enumerate(snapshot)]
+        elif kind == "prefill":
+            # Only rows whose prompt COMPLETED carry a first token; rows
+            # mid-prefill (or preempted since dispatch) yield nothing.
+            pairs = [(sid, r, arr[i:i + 1])
+                     for i, (sid, r, fin, gen) in enumerate(snapshot)
+                     if fin and r.gen == gen]
+        elif kind == "pchunk":
+            pairs = [(sid, r, arr[j]) for j, (sid, r, gen)
+                     in enumerate(snapshot) if r.gen == gen]
+        else:  # "chunk": monolithic full-width rows, indexed by slot id
+            pairs = [(sid, r, arr[sid]) for sid, r in snapshot]
+        for sid, r, row in pairs:
             if r.finished:
                 continue  # tokens from a chunk dispatched before retirement
             if r.cancelled:
                 # Submit timed out mid-decode: retire the slot at this
                 # boundary; the freed slot admits queued live traffic at
-                # the next _admit instead of decoding to full budget.
+                # the next boundary instead of decoding to full budget.
                 self._cancel(r)
                 if self._slots[sid] is r:
-                    self._slots[sid] = None
+                    if self._paged:
+                        self._retire_slot(sid)
+                    else:
+                        self._slots[sid] = None
                 continue
             if r.span is not None and "first_token" not in r.span.marks:
                 r.span.mark("first_token")
                 ttft = r.span.between(None, "first_token")
                 if ttft is not None:
                     self._m_ttft.observe(ttft)
-            for t in rows[sid]:
+            for t in row:
                 r.tokens.append(int(t))
                 if len(r.tokens) >= r.max_new:
                     break
@@ -473,7 +1083,10 @@ class ContinuousBatchingEngine:
                     r.span.meta["batch_size"] = r.peak_batch
                     self._emit_span(r.span)
                 if self._slots[sid] is r:
-                    self._slots[sid] = None
+                    if self._paged:
+                        self._retire_slot(sid)
+                    else:
+                        self._slots[sid] = None
                 r.done.set()
         self._m_slots.set(self.max_slots - len(self._free_slots()))
 
@@ -499,18 +1112,42 @@ class ContinuousBatchingEngine:
                 pass
             try:
                 if staged:
-                    fut = self._admit(staged)
+                    if self._paged:
+                        # Paged admission only allocates pages + a slot;
+                        # the compute happens in the prefill step below.
+                        with goodput.phase("admit"):
+                            if self._admit_paged(staged):
+                                self._m_activity.set(time.time())
+                    else:
+                        fut = self._admit(staged)
+                        if fut is not None:
+                            futures.append(fut)
+                            self._m_activity.set(time.time())
+                if self._paged:
+                    fut = self._prefill_step(staged)
                     if fut is not None:
                         futures.append(fut)
                         self._m_activity.set(time.time())
-                if any(r is not None and not r.finished
-                       for r in self._slots):
+                    fut = self._decode_step_paged(staged)
+                    if fut is not None:
+                        futures.append(fut)
+                        self._m_activity.set(time.time())
+                    self._m_kv_in_use.set(self._pool.used_blocks)
+                    self._maybe_resolve_kv_alert()
+                elif any(r is not None and not r.finished
+                         for r in self._slots):
                     with goodput.phase("compile" if self.chunks_run == 0
                                        else "decode"):
                         self._state, toks = self._chunk_jit(self.params,
                                                             self._state)
                     self.chunks_run += 1
                     self._m_chunks.inc()
+                    # Row accounting: the monolithic chunk always pays
+                    # max_slots rows of compute, live or not.
+                    self.decoded_rows_total += sum(
+                        1 for r in self._slots
+                        if r is not None and not r.finished)
+                    self.dispatched_rows_total += self.max_slots
                     self._m_activity.set(time.time())
                     # Start the D2H transfer NOW, behind the enqueued
                     # compute: on a tunneled dev chip a device_get costs
@@ -542,7 +1179,8 @@ class ContinuousBatchingEngine:
                 # device state must not wedge the dispatcher silently.
                 err = {"error": f"{type(ex).__name__}: {ex}"}
                 for _, _, snapshot in futures:
-                    for _, r in snapshot:
+                    for entry in snapshot:
+                        r = entry[1]
                         if not r.finished:
                             r.finished, r.result = True, dict(err)
                             r.done.set()
@@ -556,12 +1194,121 @@ class ContinuousBatchingEngine:
                         r.finished, r.result = True, dict(err)
                         r.done.set()
                     self._slots[i] = None
+                if self._paged:
+                    # Rebuild the allocator with the device state: a
+                    # poisoned pool's tables point at freed pages.
+                    self._pool = BlockPool(self._pool.num_blocks, self._ps)
+                    if self._trie is not None:
+                        self._trie = PrefixTrie(
+                            self._pool, max_blocks=self._trie.max_blocks)
+                    self._tbl[:] = self._pool.sentinel
+                    self._slot_pages = [[] for _ in range(self.max_slots)]
+                    self._pending_cow.clear()
                 self._state = self._init_state()
+
+    # -- stats / warm / stop ----------------------------------------------
+
+    def kv_stats(self) -> Optional[dict]:
+        """Paged-pool pressure for the serving wire's admin ping: the
+        router's least-loaded picking and brownout shedding read this
+        (memory pressure, not just queue depth)."""
+        if not self._paged:
+            return None
+        total = self._pool.num_blocks
+        lookups = self._trie.lookups if self._trie is not None else 0
+        hits = self._trie.hits if self._trie is not None else 0
+        return {"paged": True, "block_size": self._ps,
+                "blocks_total": total,
+                "blocks_free": self._pool.free_blocks,
+                "prefix_hit_rate": (round(hits / lookups, 4)
+                                    if lookups else 0.0),
+                "prefix_blocks_cached": (self._trie.blocks_held
+                                         if self._trie is not None else 0),
+                "preemptions": self.preemptions}
+
+    def warm_shapes(self, workloads, batch_sizes=None) -> int:
+        """Deterministically pre-compile every paged compile bucket the
+        given workloads can touch, WITHOUT traffic: each reachable
+        (nb, T, W) prefill jit and (nb, W) decode jit is invoked once on
+        throwaway donated state (all-sentinel tables, padded slot ids —
+        every write drops), so a measured window pays zero XLA compiles
+        no matter how arrivals happen to batch. Traffic-based warmup
+        alone was timing-dependent: a bucket the warm leg's Poisson
+        coincidences missed cost the measured p99 a multi-second compile
+        (the first serve_kv bench flaked exactly this way).
+
+        ``workloads``: iterable of (prompt_len, max_new) pairs — the
+        request shapes the measured traffic will carry. ``batch_sizes``
+        defaults to every admit-bucket representative up to
+        ``max_slots``. Monolithic mode delegates to the submit-based
+        :meth:`warm` per workload (its bucket space is tiny). Returns
+        the number of buckets compiled."""
+        if batch_sizes is None:
+            batch_sizes = range(1, self.max_slots + 1)
+        workloads = [(int(L), int(new)) for L, new in workloads]
+        if not self._paged:
+            for L, new in workloads:
+                self.warm(L, new, batch_sizes=tuple(batch_sizes))
+            return 0
+        ps = self._ps
+        nbs = sorted({_bucket(min(n, self.max_slots), floor=1)
+                      for n in batch_sizes})
+        t_cap = _bucket(self.prefill_chunk, floor=1)
+        pre_t, pre_w, dec_w = set(), set(), set()
+        for L, new in workloads:
+            # Prefill can start at ANY offset (prefix hits land on block
+            # multiples, COW shifts within a block), so it touches every
+            # partial-chunk T bucket and every page count up to the full
+            # prompt; mixed batches take maxes, which these unions
+            # already contain.
+            for t in range(1, min(self.prefill_chunk, L) + 1):
+                pre_t.add(min(_bucket(t, floor=8), t_cap))
+            for p in range(1, pages_for(L, ps) + 1):
+                pre_w.add(min(_wbucket(p), self._max_pages))
+            # Decode rows grow from the first post-prefill allocation to
+            # the request's full budget.
+            lo = pages_for(min(L + self.chunk_size, L + new), ps)
+            for p in range(lo, pages_for(L + new, ps) + 1):
+                dec_w.add(min(_wbucket(p), self._max_pages))
+        sent, M = self._pool.sentinel, self.max_slots
+        compiled = 0
+        for nb in nbs:
+            pad = jnp.full((nb,), M, jnp.int32)
+            for W in sorted(dec_w):
+                if (nb, W) in self._chunk_jits:
+                    continue
+                st = self._init_state()
+                self._paged_chunk_jit(nb, W)(
+                    self.params, st["pages"], st["vecs"],
+                    jnp.full((nb, W), sent, jnp.int32), pad)
+                compiled += 1
+            for T in sorted(pre_t):
+                for W in sorted(pre_w):
+                    if (nb, T, W) in self._prefill_jits:
+                        continue
+                    st = self._init_state()
+                    self._paged_prefill_jit(nb, T, W)(
+                        self.params, st["pages"], st["vecs"],
+                        jnp.full((nb, W), sent, jnp.int32),
+                        jnp.zeros((nb,), jnp.int32),
+                        jnp.zeros((nb, T), jnp.int32),
+                        jnp.zeros((nb,), jnp.int32), pad,
+                        jnp.zeros((nb,), jnp.bool_),
+                        jnp.zeros((nb,), jnp.float32),
+                        jnp.zeros((nb,), jnp.int32),
+                        jnp.full((nb,), -1, jnp.int32),
+                        jnp.zeros((nb,), jnp.uint32),
+                        jnp.full((nb,), sent, jnp.int32),
+                        jnp.full((nb,), sent, jnp.int32))
+                    compiled += 1
+        return compiled
 
     def warm(self, prompt_len: int, max_new: int, batch_sizes=(1,),
              temperature: float = 0.0, top_k: int = 0):
-        """Pre-compile the admit buckets + the chunk for a known workload
-        by pushing synthetic requests through the real dispatcher.
+        """Pre-compile the admit/prefill buckets + the chunk for a known
+        workload by pushing synthetic requests through the real
+        dispatcher (paged mode: the (nb, T, W) prefill buckets and
+        (nb, W) chunk buckets the workload will touch).
 
         Each batch size admits ATOMICALLY: ``_min_admit`` gates the
         dispatcher until all ``n`` warm requests are staged, so warm

@@ -117,6 +117,7 @@ def generate(
     eos_id: Optional[int] = None,
     rng: Optional[jax.Array] = None,
     prompt_lengths: Optional[jax.Array] = None,  # [B] int32
+    cache=None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
@@ -129,6 +130,10 @@ def generate(
     shape and each sequence decodes from its own true length (the batched
     serving path); its new tokens are the [B, max_new_tokens] suffix of
     the return value regardless of padding.
+
+    ``cache``: a pre-built cache pytree (the paged serving path passes
+    one whose block tables are already allocated — ``inference/kvcache``);
+    default builds the module's own zeroed cache.
     """
     cfg = module.cfg
     if max_new_tokens <= 0:
@@ -138,7 +143,8 @@ def generate(
         raise ValueError(
             f"prompt ({prompt.shape[1]}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds max_seq_len {cfg.max_seq_len}")
-    cache = init_cache(module, prompt.shape[0])
+    if cache is None:
+        cache = init_cache(module, prompt.shape[0])
     tokens, _ = _generate_jit(module, params, cache,
                               prompt.astype(jnp.int32), max_new_tokens,
                               float(temperature), int(top_k), eos_id, rng,

@@ -44,9 +44,16 @@ class GenerationServer:
                  engine: str = "continuous", chunk_size: int = 32,
                  registry=None, metrics_port: Optional[int] = None,
                  event_log_path: Optional[str] = None,
-                 profile_dir: Optional[str] = None):
+                 profile_dir: Optional[str] = None, kv=None):
+        from serverless_learn_tpu.config import KVCacheConfig
         from serverless_learn_tpu.telemetry import (JsonlEventLog,
                                                     get_registry)
+
+        # Paged KV is the serving default (round 13): pass an explicit
+        # KVCacheConfig to tune it or KVCacheConfig(paged=False) for the
+        # legacy monolithic rows (the equivalence baseline).
+        if kv is None:
+            kv = KVCacheConfig()
 
         self.module = module
         self.params = params
@@ -67,7 +74,7 @@ class GenerationServer:
 
             self.engine = ContinuousBatchingEngine(
                 module, params, max_slots=max_batch, chunk_size=chunk_size,
-                registry=self.registry, event_log=self.event_log)
+                registry=self.registry, event_log=self.event_log, kv=kv)
         elif engine == "static":
             # Round-4 group coalescer, kept for comparison benches.
             from serverless_learn_tpu.inference.batching import (
@@ -76,7 +83,7 @@ class GenerationServer:
             self.engine = BatchingEngine(module, params,
                                          max_batch=max_batch,
                                          batch_wait_ms=batch_wait_ms,
-                                         registry=self.registry)
+                                         registry=self.registry, kv=kv)
         else:
             raise ValueError(f"unknown engine {engine!r}: "
                              "expected 'continuous' or 'static'")
@@ -147,8 +154,17 @@ class GenerationServer:
         and `serve --fleet`'s SIGTERM handler share it."""
         op = req.get("op")
         if op == "ping":
-            return {"ok": True, "draining": self.draining,
-                    "requests_served": self.requests_served}
+            rep = {"ok": True, "draining": self.draining,
+                   "requests_served": self.requests_served}
+            # Paged engines report KV pool pressure + prefix hit rate so
+            # the fleet router's picking/shedding can weigh MEMORY, not
+            # just queue depth (fleet/router.py).
+            kv_stats = getattr(self.engine, "kv_stats", None)
+            if callable(kv_stats):
+                kv = kv_stats()
+                if kv:
+                    rep["kv"] = kv
+            return rep
         if op == "drain":
             threading.Thread(target=self.drain, daemon=True).start()
             return {"ok": True, "draining": True}

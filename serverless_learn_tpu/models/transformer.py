@@ -104,6 +104,21 @@ class TransformerConfig:
     # is unsupported (STE is out of scope).
     quant: Optional[str] = None
     head_dim_override: Optional[int] = None  # local-slice cfgs must pin it
+    # Paged KV cache for INFERENCE (round 13): > 0 replaces the per-row
+    # monolithic ``cached_k/v [B, max_seq_len, K, D]`` with one shared
+    # block pool per layer (``pages_k/v [kv_pages, kv_page_size, K, D]``)
+    # plus a per-row block table (``page_tbl [B, W]`` of page ids, the
+    # sentinel id == kv_pages marking unallocated entries) and the same
+    # ``cache_index`` vector. The table is HOST-OWNED: the engine
+    # (``inference/continuous.py``) allocates pages from a free list,
+    # shares refcounted prefix pages across rows, and passes the table
+    # window it wants attended (W pages => attention span W*page_size,
+    # usually far below max_seq_len). Writes resolve (position -> page id,
+    # offset) through the table and DROP on the sentinel — a stray write
+    # would corrupt another sequence's page, not this row's padding.
+    # Training never reads these fields.
+    kv_page_size: int = 0   # 0 => monolithic cache
+    kv_pages: int = 0       # pool size; required > 0 when kv_page_size > 0
 
     @property
     def kv_heads(self) -> int:
@@ -292,14 +307,88 @@ class Attention(nn.Module):
             # hold garbage K/V that the per-seq mask never reads and the
             # next decode writes straight over (inference/batching.py).
             B = x.shape[0]
-            is_init = not self.has_variable("cache", "cached_k")
-            ck = self.variable("cache", "cached_k", jnp.zeros,
-                               (B, cfg.max_seq_len, K, D), k.dtype)
-            cv = self.variable("cache", "cached_v", jnp.zeros,
-                               (B, cfg.max_seq_len, K, D), v.dtype)
-            ci = self.variable("cache", "cache_index",
-                               lambda: jnp.zeros((B,), jnp.int32))
-            if not is_init and prefill:
+            if cfg.kv_page_size > 0:
+                # Paged KV cache: one block pool per layer, shared by every
+                # row through per-row page tables. All three entry modes
+                # collapse to ONE write pattern — append the new tokens at
+                # each row's current index — because chunked prefill IS
+                # repeated ragged appends (prefill on a fresh cache starts
+                # at index 0, matching the monolithic semantics).
+                ps, P = cfg.kv_page_size, cfg.kv_pages
+                if P <= 0:
+                    raise ValueError(
+                        "kv_page_size > 0 requires kv_pages > 0")
+                max_pages = -(-cfg.max_seq_len // ps)
+                is_init = not self.has_variable("cache", "pages_k")
+                pk = self.variable("cache", "pages_k", jnp.zeros,
+                                   (P, ps, K, D), k.dtype)
+                pv = self.variable("cache", "pages_v", jnp.zeros,
+                                   (P, ps, K, D), v.dtype)
+                tbl = self.variable(
+                    "cache", "page_tbl",
+                    lambda: jnp.full((B, max_pages), P, jnp.int32))
+                ci = self.variable("cache", "cache_index",
+                                   lambda: jnp.zeros((B,), jnp.int32))
+                if not is_init:
+                    T = x.shape[1]
+                    if decode and T != 1:
+                        raise ValueError(
+                            f"decode feeds one token at a time, got "
+                            f"T={T}")
+                    W = tbl.value.shape[1]  # engine passes the live window
+                    S = W * ps
+                    pos0 = ci.value  # [B]
+                    positions_bt = (pos0[:, None]
+                                    + jnp.arange(T, dtype=jnp.int32))
+                    if cfg.use_rope:
+                        sin, cos = rope_angles(positions_bt, D,
+                                               cfg.rope_theta)
+                        q = apply_rope(q, sin, cos)
+                        k = apply_rope(k, sin, cos)
+                    # Ragged appends: rows may carry fewer than T real new
+                    # tokens (chunked prefill pads the batch to a bucket).
+                    if seq_lengths is None:
+                        new_len = jnp.full((B,), T, jnp.int32)
+                    else:
+                        new_len = seq_lengths.astype(jnp.int32)
+                    valid = jnp.arange(T)[None, :] < new_len[:, None]
+                    page_idx = positions_bt // ps  # [B, T]
+                    ids = jnp.take_along_axis(
+                        tbl.value, jnp.clip(page_idx, 0, W - 1), axis=1)
+                    # Pad positions and positions beyond the passed window
+                    # resolve to the sentinel: the pool is SHARED, so a
+                    # stray write would land in another sequence's page.
+                    ids = jnp.where(valid & (page_idx < W), ids, P)
+                    offs = positions_bt % ps
+                    pk.value = pk.value.at[
+                        ids.reshape(-1), offs.reshape(-1)].set(
+                        k.reshape(B * T, K, D), mode="drop")
+                    pv.value = pv.value.at[
+                        ids.reshape(-1), offs.reshape(-1)].set(
+                        v.reshape(B * T, K, D), mode="drop")
+                    ci.value = pos0 + new_len
+                    # Attention reads the gathered window; sentinel table
+                    # entries clip to a real page whose garbage the
+                    # per-position mask below never admits.
+                    safe_tbl = jnp.clip(tbl.value, 0, P - 1)
+                    k = jnp.take(pk.value, safe_tbl, axis=0).reshape(
+                        B, S, K, D)
+                    v = jnp.take(pv.value, safe_tbl, axis=0).reshape(
+                        B, S, K, D)
+                    mask = (jnp.arange(S)[None, None, :]
+                            <= positions_bt[:, :, None])[:, None]
+                    causal = False
+            else:
+                is_init = not self.has_variable("cache", "cached_k")
+                ck = self.variable("cache", "cached_k", jnp.zeros,
+                                   (B, cfg.max_seq_len, K, D), k.dtype)
+                cv = self.variable("cache", "cached_v", jnp.zeros,
+                                   (B, cfg.max_seq_len, K, D), v.dtype)
+                ci = self.variable("cache", "cache_index",
+                                   lambda: jnp.zeros((B,), jnp.int32))
+            if cfg.kv_page_size > 0:
+                pass  # the paged branch above handled everything
+            elif not is_init and prefill:
                 T = x.shape[1]
                 if cfg.use_rope:
                     p = jnp.broadcast_to(

@@ -336,6 +336,26 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
         verdict_bits.append(
             f"fleet replica(s) died and recovered: "
             f"{', '.join(recovered_replicas)}")
+    # Paged-KV pressure (round 13): the engine emits kv.blocks_exhausted
+    # when admissions defer on pool exhaustion; correlate with the same
+    # node's admit/admit_wait badput so the verdict names the INCIDENT
+    # (out of KV memory) rather than its symptom (slow admissions) —
+    # from metrics + events alone.
+    kv_firing = [a for a in alerts
+                 if a.get("alert") == "kv.blocks_exhausted"
+                 and a.get("state") == "firing"]
+    if kv_firing:
+        worst = kv_firing[0]
+        node = worst.get("node") or "?"
+        bit = (f"KV pressure on {node}: blocks exhausted, admissions "
+               f"deferred (backpressure)")
+        rep = goodput_by_node.get(node)
+        if rep:
+            bad = rep.get("badput_breakdown") or {}
+            aw = bad.get("admit_wait", 0.0) + bad.get("admit", 0.0)
+            if aw > 0:
+                bit += f"; admit/admit_wait badput {aw * 100:.0f}%"
+        verdict_bits.append(bit)
     if bench and bench["regressions"]:
         verdict_bits.append(
             f"{len(bench['regressions'])} bench regression(s) vs history")

@@ -16,8 +16,12 @@ no way to even see. This module is the accounting layer:
 * **Phase taxonomy** (shared, so reports compose across roles):
   training — ``compile`` / ``step`` / ``data_wait`` / ``checkpoint`` /
   ``remesh`` / ``eval`` / ``diloco_round_wait``; serving — ``decode`` /
-  ``admit`` / ``admit_wait`` / ``idle``. ``step`` and ``decode`` are the
-  *productive* phases; everything else is badput with a name.
+  ``prefill`` / ``admit`` / ``admit_wait`` / ``idle``. ``step`` and
+  ``decode`` are the *productive* phases; everything else is badput with
+  a name (``prefill`` is real model work but deliberately non-productive
+  on the ledger: the round-13 acceptance metric is the DECODE share, and
+  chunked prefill's whole point is shrinking what prefill steals from
+  it).
 * **Reports** — :meth:`PhaseLedger.report` returns per-phase wall-clock
   seconds, counts and fractions plus ``goodput`` (productive fraction of
   total run time) and, when an MFU gauge is live, MFU-weighted goodput
@@ -48,7 +52,8 @@ from typing import Callable, Dict, Iterator, List, Optional
 # beats a registry), but these are the ones the framework itself emits.
 TRAIN_PHASES = ("compile", "step", "data_wait", "checkpoint", "remesh",
                 "eval", "diloco_round_wait")
-SERVE_PHASES = ("compile", "decode", "admit", "admit_wait", "idle")
+SERVE_PHASES = ("compile", "decode", "prefill", "admit", "admit_wait",
+                "idle")
 
 # Phases that count as goodput. Everything else — including
 # "unattributed" — is badput with a name.

@@ -196,11 +196,13 @@ def render(states: List[EndpointState]) -> str:
         if st.val("slt_router_replicas") is not None:
             roles += 1
             req_rate = st.rate("slt_router_requests_total")
+            kv_free = st.val("slt_router_kv_free_frac")
             fleet_rows.append([
                 st.addr,
                 f"{_num(st.val('slt_router_replicas_healthy'), 0)}"
                 f"/{_num(st.val('slt_router_replicas'), 0)}",
                 _num(st.val("slt_router_inflight"), 0),
+                "-" if kv_free is None else f"{kv_free * 100:.0f}%",
                 "-" if req_rate is None else _num(req_rate),
                 _num(st.val("slt_router_shed_total") or 0, 0),
                 f"{_num(st.val('slt_router_hedges_total') or 0, 0)}"
@@ -216,12 +218,22 @@ def render(states: List[EndpointState]) -> str:
                 or st.val("slt_server_requests_total") is not None):
             roles += 1
             tok_rate = st.rate("slt_decode_tokens_total")
+            # KV line (round 13): paged pool occupancy + prefix reuse.
+            kv_total = st.val("slt_kv_blocks_total")
+            kv_used = st.val("slt_kv_blocks_in_use")
+            if kv_total:
+                kv_col = (f"{_num((kv_total - (kv_used or 0)), 0)}"
+                          f"/{_num(kv_total, 0)}")
+            else:
+                kv_col = "-"
             infer_rows.append([
                 st.addr,
                 _num(st.val("slt_requests_total"), 0),
                 _num(st.val("slt_server_errors_total") or 0, 0),
                 _num(st.val("slt_requests_cancelled_total") or 0, 0),
                 f"{_num(st.val('slt_slots_in_use'), 0)}",
+                kv_col,
+                _num(st.val("slt_kv_prefix_hits_total") or 0, 0),
                 _ms(_p(st.hist("slt_request_queue_wait_seconds"), 0.5))
                 + "/" + _ms(_p(st.hist("slt_request_queue_wait_seconds"),
                                0.95)),
@@ -251,6 +263,7 @@ def render(states: List[EndpointState]) -> str:
         lines.append("")
         lines.append("  INFERENCE")
         header = ["endpoint", "reqs", "err", "cancel", "slots",
+                  "kv free", "pfx hit",
                   "qwait p50/p95 ms", "ttft p50/p95 ms", "lat p95 ms",
                   "tokens", "tok/s"]
         lines += _table(header, infer_rows)
@@ -263,8 +276,8 @@ def render(states: List[EndpointState]) -> str:
     if fleet_rows:
         lines.append("")
         lines.append("  FLEET")
-        header = ["endpoint", "healthy", "inflight", "req/s", "shed",
-                  "hedges(won)", "retries", "eject",
+        header = ["endpoint", "healthy", "inflight", "kv free", "req/s",
+                  "shed", "hedges(won)", "retries", "eject",
                   "qwait p50/p95 ms", "lat p95 ms"]
         lines += _table(header, fleet_rows)
     alert_rows: List[List[str]] = []

@@ -206,6 +206,17 @@ SLOW_TESTS = {
     "tests/test_quantize.py::test_quant_moe_experts",
     # round 9 (goodput acceptance: a real train run through the ledger)
     "tests/test_goodput.py::test_train_run_records_goodput",
+    # round 13 (paged KV: model-backed equivalence suite; the jax-free
+    # allocator/trie/doctor units stay fast)
+    "tests/test_kvcache.py::test_paged_generate_matches_monolithic",
+    "tests/test_kvcache.py::test_paged_engine_greedy_exact_with_chunked_prefill",
+    "tests/test_kvcache.py::test_paged_engine_seeded_sampling_matches_monolithic",
+    "tests/test_kvcache.py::test_paged_engine_eos_retires_and_frees_blocks",
+    "tests/test_kvcache.py::test_shared_prefix_reuse_hits_and_stays_exact",
+    "tests/test_kvcache.py::test_exhaustion_backpressure_and_preemption_stay_exact",
+    "tests/test_kvcache.py::test_decode_cost_tracks_live_slots",
+    "tests/test_kvcache.py::test_static_engine_paged_matches_monolithic",
+    "tests/test_kvcache.py::test_server_ping_reports_kv_and_prompt_histogram",
     # round 6 (telemetry integration; registry/endpoint/top units stay fast)
     "tests/test_telemetry.py::test_server_metrics_endpoint_scrape",
     "tests/test_telemetry.py::test_continuous_cancellation_retires_slot",

@@ -217,6 +217,43 @@ def test_brownout_sheds_lowest_priority_first():
         router.stop(), r1.stop()
 
 
+def test_kv_pressure_shapes_picking_and_sheds_background_traffic():
+    """Round 13: paged replicas report KV pool pressure on ping; the
+    router prefers headroom among equally-loaded replicas and sheds
+    priority<=0 traffic (typed overload) when EVERY eligible replica is
+    out of blocks. Stub replicas report nothing -> never memory-shed."""
+    r1, r2 = stub_server(), stub_server()
+    registry = MetricsRegistry()
+    router = make_router([r1.addr, r2.addr], registry=registry).start()
+    try:
+        time.sleep(0.4)  # probes mark both healthy
+        # Stub replicas carry no kv stats: pressure reads 1.0 (never
+        # shed) and picking is unaffected.
+        assert router._kv_pressure() == 1.0
+        reps = {r.addr: r for r in router._replicas.values()}
+        a, b = reps[r1.addr], reps[r2.addr]
+        # Memory-aware picking: equal load, unequal KV headroom.
+        a.kv_free_frac, b.kv_free_frac = 0.05, 0.9
+        picked = {router._pick([a, b], session=None).addr
+                  for _ in range(4)}
+        assert picked == {r2.addr}, \
+            "equally-loaded pick must prefer KV headroom"
+        # Fleet-wide exhaustion: background traffic sheds instantly,
+        # interactive traffic still routes (backpressure belongs to the
+        # replicas' admission, not to a hard router error).
+        a.kv_free_frac = b.kv_free_frac = 0.0
+        assert router._kv_pressure() == 0.0
+        shed = request(router.addr, {"prompt": [1], "max_new_tokens": 1,
+                                     "priority": 0}, timeout=5)
+        assert shed.get("code") == "overloaded" and shed.get("shed"), shed
+        assert "KV pool pressure" in shed["error"]
+        ok = request(router.addr, {"prompt": [1], "max_new_tokens": 1},
+                     timeout=5)
+        assert "tokens" in ok
+    finally:
+        router.stop(), r1.stop(), r2.stop()
+
+
 # -- draining ----------------------------------------------------------------
 
 
