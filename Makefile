@@ -1,7 +1,7 @@
 # Developer entry points. The Python package needs no build; `native/` holds
 # the C++ control/data-plane daemons.
 
-.PHONY: test test-all lint check lockcheck native tsan bench lm-bench data-bench gen-bench dryrun clean
+.PHONY: test test-all lint check lockcheck racecheck native tsan bench lm-bench data-bench gen-bench dryrun clean
 
 test:  ## fast tier (<2 min on CPU); compile-heavy tests are marked slow
 	python -m pytest tests/ -q -m "not slow"
@@ -15,12 +15,17 @@ lint:  ## ruff (when installed) + bytecode-compile + project-aware `slt check`
 	python -m compileall -q serverless_learn_tpu tests benchmarks bench.py
 	python -m serverless_learn_tpu check
 
-check:  ## project-aware static analysis alone (SLT001-SLT006)
+check:  ## project-aware static analysis alone (SLT001-SLT009)
 	python -m serverless_learn_tpu check
 
 lockcheck:  ## fast telemetry/health/goodput tier under the runtime lock-order detector
 	SLT_LOCKCHECK=1 python -m pytest tests/test_analysis.py tests/test_telemetry.py \
 		tests/test_health.py tests/test_goodput.py -q -m "not slow"
+
+racecheck:  ## concurrency surface under the vector-clock happens-before race detector
+	SLT_RACECHECK=1 python -m pytest tests/test_fleet.py tests/test_gossip.py \
+		tests/test_kvcache.py tests/test_continuous.py tests/test_telemetry.py \
+		tests/test_health.py -q -m "not slow"
 
 test-all:  ## the full suite (~13 min on CPU)
 	python -m pytest tests/ -q

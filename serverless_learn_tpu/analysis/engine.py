@@ -25,7 +25,12 @@ from typing import Dict, List, Optional, Sequence
 
 # Directories/files scanned for Python rules, relative to the repo root.
 DEFAULT_PY_ROOTS = ("serverless_learn_tpu", "benchmarks", "bench.py")
-EXCLUDE_DIRS = {"__pycache__", "fixtures"}
+# Pruned by NAME anywhere in the tree: caches, generated code ("gen" is
+# the protoc output convention here — native/gen today, any future
+# generated tree tomorrow), VCS and build litter. Explicit so a stray
+# `gen/slt_pb2.py` can never slow the scan or leak findings.
+EXCLUDE_DIRS = {"__pycache__", "fixtures", "gen", ".git", "build",
+                ".mypy_cache", ".pytest_cache"}
 EXCLUDE_PATHS = {"native/gen"}
 
 SEVERITIES = ("error", "warning")
@@ -116,6 +121,29 @@ def discover(root: str,
     return proj
 
 
+def git_changed_files(root: str) -> Optional[set]:
+    """Repo-relative paths changed vs HEAD (staged, unstaged, untracked).
+    None when git is unavailable or the root is not a work tree — the
+    caller falls back to a full scan rather than silently checking
+    nothing."""
+    import subprocess
+
+    out: set = set()
+    for cmd in (["git", "-C", root, "diff", "--name-only", "HEAD"],
+                ["git", "-C", root, "ls-files", "--others",
+                 "--exclude-standard"]):
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if res.returncode != 0:
+            return None
+        out.update(line.strip() for line in res.stdout.splitlines()
+                   if line.strip())
+    return out
+
+
 def _add_file(proj: Project, root: str, path: str):
     rel = os.path.relpath(path, root).replace(os.sep, "/")
     try:
@@ -153,12 +181,23 @@ def load_baseline(path: str) -> Dict[str, dict]:
 
 
 def save_baseline(path: str, findings: List[Finding],
-                  previous: Optional[Dict[str, dict]] = None):
-    """Write the baseline from the current findings, preserving the
-    hand-written justification of any entry that survives the update."""
+                  previous: Optional[Dict[str, dict]] = None,
+                  pruned_rules: Optional[Sequence[str]] = None):
+    """Rewrite the baseline from the current findings: entries whose
+    fingerprint no longer fires are PRUNED (a fixed defect's suppression
+    must not outlive the defect), hand-written justifications of
+    surviving entries are preserved. ``pruned_rules`` limits pruning to
+    the rules that actually ran — a ``--rule SLT002 --update-baseline``
+    run has no evidence about SLT001's entries and must not drop them."""
     previous = previous or {}
     entries = []
     seen = set()
+    if pruned_rules is not None:
+        ran = set(pruned_rules)
+        for fp, old in previous.items():
+            if old.get("rule") not in ran and fp not in seen:
+                seen.add(fp)
+                entries.append(dict(old))
     for f in sorted(findings, key=lambda f: (f.rule, f.path, f.line)):
         if f.fingerprint in seen:
             continue
@@ -172,6 +211,8 @@ def save_baseline(path: str, findings: List[Finding],
             "justification": old.get("justification",
                                      "TODO: justify or fix"),
         })
+    entries.sort(key=lambda e: (e.get("rule", ""), e.get("path", ""),
+                                e.get("fingerprint", "")))
     payload = {
         "_comment": ("Baseline suppressions for `slt check`. Every entry "
                      "needs a one-line justification explaining why the "
@@ -188,11 +229,18 @@ def save_baseline(path: str, findings: List[Finding],
 
 def run_check(root: str, rule_ids: Optional[Sequence[str]] = None,
               baseline_path: Optional[str] = None,
-              update_baseline: bool = False) -> dict:
+              update_baseline: bool = False,
+              changed_only: bool = False) -> dict:
     """Run the selected rules; returns the report dict the CLI prints.
 
     ``ok`` is True when no un-baselined finding remains (warnings
     included: an undocumented metric is a docs bug, not noise).
+
+    ``changed_only`` scopes per-file rules to files git reports changed
+    vs HEAD (staged, unstaged, untracked) — the fast pre-commit mode.
+    Project-scoped rules (``SCOPE = "project"``: metric drift, proto
+    compat, config drift) always see the full tree: their findings come
+    from cross-file absence, and a partial view would invent them.
     """
     from serverless_learn_tpu.analysis.rules import RULES
 
@@ -206,30 +254,47 @@ def run_check(root: str, rule_ids: Optional[Sequence[str]] = None,
         selected = dict(RULES)
 
     proj = discover(root)
+    scoped = proj
+    if changed_only:
+        changed = git_changed_files(root)
+        if changed is not None:
+            scoped = Project(root=root, files=[
+                f for f in proj.files if f.path in changed])
+        else:
+            changed_only = False  # no git: full scan, reported as such
     findings: List[Finding] = []
-    for f in proj.files:
+    for f in scoped.files:
         if f.parse_error is not None:
             findings.append(Finding("SLT000", f.path, 0,
                                     f"file does not parse: {f.parse_error}"))
     for rid in sorted(selected):
-        findings.extend(selected[rid].run(proj))
+        mod = selected[rid]
+        scope = getattr(mod, "SCOPE", "file")
+        findings.extend(mod.run(proj if scope == "project" else scoped))
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
 
     bpath = os.path.join(root, baseline_path or DEFAULT_BASELINE)
     baseline = load_baseline(bpath)
     if update_baseline:
-        save_baseline(bpath, findings, previous=baseline)
+        if changed_only:
+            raise ValueError(
+                "--update-baseline needs a full scan: refusing to prune "
+                "the baseline from a --changed-only subset")
+        save_baseline(bpath, findings, previous=baseline,
+                      pruned_rules=sorted(selected))
         baseline = load_baseline(bpath)
 
     new = [f for f in findings if f.fingerprint not in baseline]
     suppressed = [f for f in findings if f.fingerprint in baseline]
     current = {f.fingerprint for f in findings}
-    stale = [fp for fp, entry in baseline.items()
-             if entry.get("rule") in selected and fp not in current]
+    stale = [] if changed_only else [
+        fp for fp, entry in baseline.items()
+        if entry.get("rule") in selected and fp not in current]
     return {
         "ok": not new,
         "rules": sorted(selected),
-        "files_scanned": len(proj.files),
+        "files_scanned": len(scoped.files),
+        "changed_only": changed_only,
         "counts": {"new": len(new), "baselined": len(suppressed),
                    "stale_baseline_entries": len(stale)},
         "findings": [f.to_dict() for f in new],

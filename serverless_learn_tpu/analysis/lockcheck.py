@@ -73,6 +73,28 @@ class LockOrderMonitor:
         self._edges: Dict[Tuple[str, str], dict] = {}
         self._violations: List[dict] = []
         self._tls = threading.local()
+        # Acquire/release listeners: racecheck.py layers its vector-clock
+        # happens-before tracking on this same instrumentation instead of
+        # wrapping the wrappers. fn("acquire"|"release", lock_wrapper).
+        self._listeners: List = []
+
+    def add_listener(self, fn):
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+        return fn
+
+    def remove_listener(self, fn):
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
+
+    def _notify(self, event: str, lk: "_InstrumentedLock"):
+        for fn in self._listeners:
+            try:
+                fn(event, lk)
+            except Exception:
+                pass  # a broken listener must never break locking
 
     # -- wrapper API -------------------------------------------------------
 
@@ -89,6 +111,7 @@ class LockOrderMonitor:
         return held
 
     def _on_acquired(self, lk: "_InstrumentedLock"):
+        self._notify("acquire", lk)
         held = self._held()
         if any(h is lk for h in held):
             held.append(lk)   # reentrant RLock acquire: no new edges
@@ -118,6 +141,7 @@ class LockOrderMonitor:
                         })
 
     def _on_released(self, lk: "_InstrumentedLock"):
+        self._notify("release", lk)
         held = self._held()
         for i in range(len(held) - 1, -1, -1):
             if held[i] is lk:
@@ -200,9 +224,11 @@ class _InstrumentedLock:
 
     def _release_save(self):
         if hasattr(self._inner, "_release_save"):
-            state = self._inner._release_save()
+            # Notify BEFORE the real release: a racing acquirer must see
+            # the releasing thread's published state (racecheck's
+            # happens-before edge), not a stale one.
             self._mon._on_released(self)
-            return state
+            return self._inner._release_save()
         self.release()
         return None
 
@@ -215,8 +241,10 @@ class _InstrumentedLock:
         return True
 
     def release(self):
-        self._inner.release()
+        # Notify first (see _release_save): the happens-before publish
+        # must be visible before any other thread can acquire.
         self._mon._on_released(self)
+        self._inner.release()
 
     def __enter__(self):
         self.acquire()

@@ -1,0 +1,701 @@
+"""Runtime happens-before race detection: the dynamic half of SLT007.
+
+``lockcheck.py`` proves the package's locks are *ordered*; this module
+asks the harder question — is shared state locked (or otherwise ordered)
+at all? Opt-in via ``SLT_RACECHECK=1``: ``install()`` (called from
+``tests/conftest.py`` before any package import) layers a vector-clock
+monitor on the existing lockcheck instrumentation plus the other
+synchronization primitives the package uses:
+
+* **locks** — lockcheck's instrumented wrappers report acquire/release
+  through :meth:`LockOrderMonitor.add_listener`; a release publishes the
+  releasing thread's clock on the lock, an acquire joins it (the
+  classic mutex happens-before edge). ``Condition`` built on an
+  instrumented lock inherits the edge through ``_release_save`` /
+  ``_acquire_restore``.
+* **threads** — ``Thread.start`` hands the parent's clock to the child;
+  ``Thread.join`` hands the child's final clock back to the joiner.
+* **queues / events** — ``queue.Queue.put``/``get`` and
+  ``threading.Event.set``/``wait`` act as channels: publishers merge
+  their clock into the channel, consumers join it. The merge is
+  deliberately conservative (a get joins EVERY prior put, not just its
+  item's) — extra happens-before edges can only hide a race, never
+  invent one, and false positives are what kill adoption.
+
+Shared-state observation is **sampled attribute-write instrumentation**
+on classes defined in this repo's concurrency modules (``install()``
+wraps ``__setattr__`` via an import hook scoped like lockcheck — jax,
+flax and stdlib classes are never touched). Objects are keyed by
+creation site (the ``file:line`` of their first recorded write, like
+lockcheck keys locks), so the report names ``router.py:97 Replica.state``
+rather than an object id. Two access kinds are checked against the
+happens-before order:
+
+* **write/write** — two threads wrote the same attribute with neither
+  write ordered before the other;
+* **read/write** — an unordered read (reads are recorded when
+  ``SLT_RACECHECK_READS=1`` wraps ``__getattribute__``, or when a
+  recorded access log replays through ``slt race``).
+
+Races print with BOTH stacks at pytest sessionfinish and fail the
+session; by-design exceptions live in :data:`ALLOWLIST` with written
+justifications (the dynamic analogue of ``analysis/baseline.json``).
+``SLT_RACECHECK_LOG=path`` additionally records every sync + access
+event as JSONL, and ``slt race LOG`` replays such a log through the
+same monitor offline — deterministic triage of a race a CI run caught.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from serverless_learn_tpu.analysis import lockcheck
+
+ENV_VAR = "SLT_RACECHECK"
+_STACK_DEPTH = 8
+
+# Attribute names the instrumentation itself writes, plus interpreter
+# plumbing that is never shared state.
+_SKIP_ATTRS = ("_slt_rc_oid",)
+
+# (class qualname, attribute) -> justification. The dynamic baseline:
+# accesses that ARE unordered by design. Keep every entry justified —
+# this list is reviewed like analysis/baseline.json.
+ALLOWLIST: Dict[Tuple[str, str], str] = {
+    # Monotonic best-effort stats counters read by scrapes; a torn read
+    # shows a value one tick stale, never corrupts state.
+    ("PrefixTrie", "hits"): "monotonic stats counter; stale reads benign",
+    ("PrefixTrie", "lookups"): "monotonic stats counter; stale reads benign",
+}
+
+
+_SELF_FILE = os.path.abspath(__file__)
+
+
+def _stack() -> List[str]:
+    """Manual frame walk — called on every sampled write, so it must be
+    cheap (traceback.extract_stack is ~10x slower)."""
+    import sys
+
+    f = sys._getframe(1)
+    out: List[str] = []
+    while f is not None and len(out) < _STACK_DEPTH:
+        fn = f.f_code.co_filename
+        if (os.path.abspath(fn) != _SELF_FILE
+                and "threading.py" not in fn and "/queue.py" not in fn):
+            out.append(f"{fn}:{f.f_lineno} in {f.f_code.co_name}")
+        f = f.f_back
+    out.reverse()
+    return out
+
+
+def _site_from_stack(stack: List[str]) -> str:
+    return stack[-1].split(" in ")[0] if stack else "<unknown>"
+
+
+class _ThreadState:
+    """One logical thread's vector clock. ``vc[tid]`` is that thread's
+    event counter; event A on thread t happens-before event B iff
+    ``A.tick <= B.vc.get(A.tid, 0)``."""
+
+    __slots__ = ("tid", "vc")
+
+    def __init__(self, tid: str, vc: Optional[dict] = None):
+        self.tid = tid
+        self.vc = dict(vc or {})
+        self.vc[tid] = self.vc.get(tid, 0) + 1
+
+    def tick(self):
+        self.vc[self.tid] += 1
+
+    def join(self, other: Optional[dict]):
+        if not other:
+            return
+        vc = self.vc
+        for t, c in other.items():
+            if c > vc.get(t, 0):
+                vc[t] = c
+
+    def snapshot(self) -> Tuple[str, int]:
+        return self.tid, self.vc[self.tid]
+
+
+class _Access:
+    __slots__ = ("tid", "tick", "thread_name", "stack", "is_write")
+
+    def __init__(self, tid, tick, thread_name, stack, is_write):
+        self.tid = tid
+        self.tick = tick
+        self.thread_name = thread_name
+        self.stack = stack
+        self.is_write = is_write
+
+
+class _Var:
+    """Happens-before state of one (object, attribute) pair."""
+
+    __slots__ = ("cls", "attr", "site", "last_write", "reads")
+
+    def __init__(self, cls: str, attr: str, site: str):
+        self.cls = cls
+        self.attr = attr
+        self.site = site
+        self.last_write: Optional[_Access] = None
+        self.reads: Dict[str, _Access] = {}  # latest read per thread
+
+
+class RaceMonitor:
+    """Vector-clock happens-before checker. Thread-safe; internal state
+    is guarded by a RAW interpreter lock (never an instrumented one)."""
+
+    def __init__(self, name: str = "default", sample: int = 1,
+                 log_path: Optional[str] = None):
+        self.name = name
+        self.sample = max(1, int(sample))
+        self._mu = lockcheck._allocate()
+        self._tls = threading.local()
+        self._vars: Dict[Tuple[str, str], _Var] = {}  # (oid, attr)
+        self._races: List[dict] = []
+        self._race_keys = set()
+        self._chan_clocks: Dict[str, dict] = {}
+        self._oid_serial = 0
+        self._tid_serial = 0
+        self._write_serial = 0
+        self._log_path = log_path
+        # Opened eagerly (no lock held): opening lazily inside _log would
+        # perform file I/O under _mu — the exact SLT001 pattern this
+        # package's own checker flags.
+        self._log_fh = None
+        if log_path is not None:
+            try:
+                self._log_fh = open(log_path, "a")
+            except OSError:
+                self._log_path = None
+        self.enabled = True
+
+    # -- thread state --------------------------------------------------------
+
+    def _enter_hook(self) -> bool:
+        """Reentrancy guard: monitor hooks fired from inside another hook
+        (e.g. interpreter plumbing while we walk frames) must no-op, not
+        recurse. Returns True when already inside a hook."""
+        if getattr(self._tls, "busy", False):
+            return True
+        self._tls.busy = True
+        return False
+
+    def _exit_hook(self):
+        self._tls.busy = False
+
+    def _state(self) -> _ThreadState:
+        st = getattr(self._tls, "state", None)
+        if st is None:
+            t = threading.current_thread()
+            birth = getattr(t, "_slt_rc_birth", None)
+            with self._mu:
+                self._tid_serial += 1
+                tid = f"t{self._tid_serial}"
+            st = self._tls.state = _ThreadState(tid, birth)
+        return st
+
+    def thread_state(self, tid: str) -> _ThreadState:
+        """Explicit thread handle for offline replay (``slt race``)."""
+        st = self._chan_clocks.get(f"__thread__:{tid}")
+        if st is None:
+            st = _ThreadState(tid)
+            self._chan_clocks[f"__thread__:{tid}"] = st
+        return st
+
+    # -- happens-before edges ------------------------------------------------
+
+    def publish(self, channel: str, st: Optional[_ThreadState] = None):
+        """Merge the thread's clock into a channel (lock release, queue
+        put, event set, thread exit)."""
+        live = st is None
+        if live:
+            if self._enter_hook():
+                return
+            st = self._state()
+        try:
+            with self._mu:
+                clk = self._chan_clocks.setdefault(channel, {})
+                for t, c in st.vc.items():
+                    if c > clk.get(t, 0):
+                        clk[t] = c
+            st.tick()
+            self._log({"op": "publish", "ch": channel, "t": st.tid})
+        finally:
+            if live:
+                self._exit_hook()
+
+    def acquire_from(self, channel: str, st: Optional[_ThreadState] = None):
+        """Join a channel's clock (lock acquire, queue get, event wait,
+        thread start/join handoff)."""
+        live = st is None
+        if live:
+            if self._enter_hook():
+                return
+            st = self._state()
+        try:
+            with self._mu:
+                clk = self._chan_clocks.get(channel)
+            st.join(clk)
+            self._log({"op": "acquire", "ch": channel, "t": st.tid})
+        finally:
+            if live:
+                self._exit_hook()
+
+    # -- accesses ------------------------------------------------------------
+
+    def _var_for(self, obj, attr: str) -> Tuple[Tuple[str, str], str]:
+        """Stable (oid, attr) key + class name for an object. The serial
+        is stashed on the object so an id()-reuse after gc can never
+        merge two objects' histories."""
+        oid = getattr(obj, "_slt_rc_oid", None)
+        if oid is None:
+            with self._mu:
+                self._oid_serial += 1
+                oid = f"o{self._oid_serial}"
+            try:
+                object.__setattr__(obj, "_slt_rc_oid", oid)
+            except (AttributeError, TypeError):
+                oid = f"id{id(obj)}"  # __slots__: best-effort identity
+        return (oid, attr), type(obj).__qualname__
+
+    def on_write(self, obj, attr: str):
+        if not self.enabled or attr in _SKIP_ATTRS or self._enter_hook():
+            return
+        try:
+            if self.sample > 1:
+                with self._mu:
+                    self._write_serial += 1
+                    if self._write_serial % self.sample:
+                        return
+            key, cls = self._var_for(obj, attr)
+            self.record_access(key, cls, attr, self._state(),
+                               is_write=True)
+        finally:
+            self._exit_hook()
+
+    def on_read(self, obj, attr: str):
+        if not self.enabled or attr in _SKIP_ATTRS or self._enter_hook():
+            return
+        try:
+            key, cls = self._var_for(obj, attr)
+            self.record_access(key, cls, attr, self._state(),
+                               is_write=False)
+        finally:
+            self._exit_hook()
+
+    def record_access(self, key: tuple, cls: str, attr: str,
+                      st: _ThreadState, is_write: bool,
+                      stack: Optional[List[str]] = None,
+                      thread_name: Optional[str] = None):
+        stack = _stack() if stack is None else stack
+        tid, tick = st.snapshot()
+        acc = _Access(tid, tick,
+                      thread_name or threading.current_thread().name,
+                      stack, is_write)
+        with self._mu:
+            var = self._vars.get(key)
+            if var is None:
+                var = self._vars[key] = _Var(
+                    cls, attr, _site_from_stack(stack))
+            lw = var.last_write
+            if lw is not None and lw.tid != tid \
+                    and lw.tick > st.vc.get(lw.tid, 0):
+                self._report_locked(var, lw, acc,
+                                    "write/write" if is_write
+                                    else "read/write")
+            if is_write:
+                for rd in var.reads.values():
+                    if rd.tid != tid and rd.tick > st.vc.get(rd.tid, 0):
+                        self._report_locked(var, rd, acc, "read/write")
+                var.last_write = acc
+                var.reads.clear()
+            else:
+                var.reads[tid] = acc
+        st.tick()
+        self._log({"op": "write" if is_write else "read",
+                   "var": f"{cls}.{attr}", "obj": key[0], "t": tid,
+                   "stack": stack})
+
+    def _report_locked(self, var: _Var, first: _Access, second: _Access,
+                       kind: str):
+        dedup = (var.cls, var.attr, kind)
+        if dedup in self._race_keys:
+            return
+        self._race_keys.add(dedup)
+        self._races.append({
+            "kind": kind, "class": var.cls, "attr": var.attr,
+            "site": var.site,
+            "first": {"thread": first.thread_name,
+                      "op": "write" if first.is_write else "read",
+                      "stack": first.stack},
+            "second": {"thread": second.thread_name,
+                       "op": "write" if second.is_write else "read",
+                       "stack": second.stack},
+            "allowlisted": (var.cls, var.attr) in ALLOWLIST,
+        })
+
+    # -- event log -----------------------------------------------------------
+
+    def _log(self, rec: dict):
+        if self._log_fh is None:
+            return
+        with self._mu:
+            try:
+                self._log_fh.write(json.dumps(rec) + "\n")
+            except (OSError, ValueError):
+                pass
+
+    def close_log(self):
+        with self._mu:
+            if self._log_fh is not None:
+                try:
+                    self._log_fh.close()
+                except OSError:
+                    pass
+                self._log_fh = None
+
+    # -- read side -----------------------------------------------------------
+
+    def races(self, include_allowlisted: bool = False) -> List[dict]:
+        with self._mu:
+            out = list(self._races)
+        if not include_allowlisted:
+            out = [r for r in out if not r["allowlisted"]]
+        return out
+
+    def reset(self):
+        with self._mu:
+            self._vars.clear()
+            self._races.clear()
+            self._race_keys.clear()
+            self._chan_clocks.clear()
+
+    def report(self) -> str:
+        races = self.races()
+        allow = len(self.races(include_allowlisted=True)) - len(races)
+        lines = [f"racecheck[{self.name}]: {len(self._vars)} variables "
+                 f"tracked, {len(races)} race(s)"
+                 + (f", {allow} allowlisted" if allow else "")]
+        for r in races:
+            lines.append(f"  {r['kind']} race on {r['class']}.{r['attr']} "
+                         f"(first written at {r['site']})")
+            for side in ("first", "second"):
+                a = r[side]
+                lines.append(f"    {side}: {a['op']} on thread "
+                             f"{a['thread']}, at:")
+                for fr in a["stack"][-4:]:
+                    lines.append(f"      {fr}")
+        return "\n".join(lines)
+
+    def assert_clean(self):
+        if self.races():
+            raise RaceViolation(self.report())
+
+
+class RaceViolation(AssertionError):
+    """Unordered conflicting accesses were observed."""
+
+
+# -- live instrumentation -----------------------------------------------------
+
+_default_monitor = RaceMonitor(
+    sample=int(os.environ.get("SLT_RACECHECK_SAMPLE", "1") or 1),
+    log_path=os.environ.get("SLT_RACECHECK_LOG") or None)
+_installed = False
+
+# Modules whose classes get write-instrumented: the round 11-13
+# concurrency surface. Deliberately narrow — instrumenting jax/flax
+# model classes would break tracing, and the telemetry registry's hot
+# counters are exercised through their own (instrumented) locks anyway.
+DEFAULT_MODULES = (
+    "serverless_learn_tpu.fleet.router",
+    "serverless_learn_tpu.fleet.autoscaler",
+    "serverless_learn_tpu.fleet.registration",
+    "serverless_learn_tpu.control.gossip",
+    "serverless_learn_tpu.inference.kvcache",
+    "serverless_learn_tpu.telemetry.health",
+    "serverless_learn_tpu.chaos.shim",
+)
+
+
+def monitor() -> RaceMonitor:
+    return _default_monitor
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get(ENV_VAR, "") == "1"
+
+
+def _wrap_setattr(cls, mon: RaceMonitor):
+    orig = cls.__setattr__
+
+    def __setattr__(self, name, value, _orig=orig, _mon=mon):
+        _orig(self, name, value)
+        _mon.on_write(self, name)
+
+    __setattr__._slt_rc = True
+    cls.__setattr__ = __setattr__
+
+
+def _wrap_getattribute(cls, mon: RaceMonitor):
+    orig = cls.__getattribute__
+
+    def __getattribute__(self, name, _orig=orig, _mon=mon):
+        val = _orig(self, name)
+        if not name.startswith("__") and name not in _SKIP_ATTRS \
+                and name in _orig(self, "__dict__"):
+            _mon.on_read(self, name)
+        return val
+
+    __getattribute__._slt_rc = True
+    cls.__getattribute__ = __getattribute__
+
+
+def instrument_class(cls, mon: Optional[RaceMonitor] = None,
+                     reads: Optional[bool] = None):
+    """Wrap one class's attribute writes (and reads, when asked). Only
+    classes whose ``__setattr__`` is the plain ``object`` slot are
+    touched — anything with custom attribute magic (flax Modules,
+    frozen dataclasses) is left alone."""
+    mon = mon or _default_monitor
+    if reads is None:
+        reads = os.environ.get("SLT_RACECHECK_READS", "") == "1"
+    if getattr(cls.__setattr__, "_slt_rc", False):
+        return cls
+    if cls.__setattr__ is not object.__setattr__:
+        return cls
+    _wrap_setattr(cls, mon)
+    if reads and cls.__getattribute__ is object.__getattribute__:
+        _wrap_getattribute(cls, mon)
+    return cls
+
+
+def instrument_module(mod, mon: Optional[RaceMonitor] = None):
+    import inspect
+
+    for _, cls in inspect.getmembers(mod, inspect.isclass):
+        if cls.__module__ == mod.__name__:
+            instrument_class(cls, mon)
+    return mod
+
+
+class _ImportHook:
+    """Meta-path finder that write-instruments scoped modules as they
+    import (conftest installs racecheck BEFORE the package imports, so
+    classes are wrapped from first use)."""
+
+    def __init__(self, prefixes):
+        self.prefixes = tuple(prefixes)
+
+    def find_spec(self, fullname, path=None, target=None):
+        if fullname not in self.prefixes:
+            return None
+        import importlib.machinery
+
+        spec = importlib.machinery.PathFinder.find_spec(fullname, path)
+        if spec is None or spec.loader is None:
+            return None
+        spec.loader = _LoaderProxy(spec.loader)
+        return spec
+
+
+class _LoaderProxy:
+    def __init__(self, inner):
+        self._inner = inner
+
+    def create_module(self, spec):
+        return self._inner.create_module(spec)
+
+    def exec_module(self, module):
+        self._inner.exec_module(module)
+        instrument_module(module)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+# originals for uninstall()
+_ORIG = {}
+_REAL_EVENT = threading.Event
+# Only Events CREATED from these path fragments are instrumented —
+# threading's own internals (Thread._started is an Event!) must never
+# route through the monitor.
+DEFAULT_SCOPE = ("serverless_learn_tpu", "tests")
+
+
+def _in_scope(scope) -> bool:
+    """True when the CREATION site (first frame outside this module) is
+    in scope. threading.py frames are NOT skipped: an Event created by
+    threading's own machinery (Thread._started!) must stay a plain
+    Event, or set() would re-enter the monitor from inside bootstrap."""
+    import sys
+
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if os.path.abspath(fn) == _SELF_FILE:
+            f = f.f_back
+            continue
+        return any(s in fn for s in scope)
+    return False
+
+
+class _InstrumentedEvent(_REAL_EVENT):
+    """Event whose set() -> wait() pair is a happens-before edge."""
+
+    def set(self):
+        _default_monitor.publish(f"ev:{id(self)}")
+        super().set()
+
+    def wait(self, timeout=None):
+        got = super().wait(timeout)
+        if got:
+            _default_monitor.acquire_from(f"ev:{id(self)}")
+        return got
+
+
+def _patch_threading(mon: RaceMonitor, scope=DEFAULT_SCOPE):
+    _ORIG["thread_start"] = threading.Thread.start
+    _ORIG["thread_join"] = threading.Thread.join
+    _ORIG["queue_put"] = queue.Queue.put
+    _ORIG["queue_get"] = queue.Queue.get
+
+    def start(self, _orig=_ORIG["thread_start"]):
+        st = mon._state()
+        self._slt_rc_birth = dict(st.vc)
+        st.tick()
+        orig_run = self.run
+
+        def run(*a, **kw):
+            try:
+                return orig_run(*a, **kw)
+            finally:
+                # Publish the child's final clock for join() to collect.
+                child = mon._state()
+                self._slt_rc_final = dict(child.vc)
+
+        self.run = run
+        return _orig(self)
+
+    def join(self, timeout=None, _orig=_ORIG["thread_join"]):
+        _orig(self, timeout)
+        final = getattr(self, "_slt_rc_final", None)
+        if final is not None and not self.is_alive():
+            mon._state().join(final)
+
+    def put(self, item, block=True, timeout=None, _orig=_ORIG["queue_put"]):
+        mon.publish(f"q:{id(self)}")
+        return _orig(self, item, block, timeout)
+
+    def get(self, block=True, timeout=None, _orig=_ORIG["queue_get"]):
+        item = _orig(self, block, timeout)
+        mon.acquire_from(f"q:{id(self)}")
+        return item
+
+    def event_factory():
+        if _in_scope(scope):
+            return _InstrumentedEvent()
+        return _REAL_EVENT()
+
+    threading.Thread.start = start
+    threading.Thread.join = join
+    queue.Queue.put = put
+    queue.Queue.get = get
+    threading.Event = event_factory
+
+
+def _on_lock_event(event: str, lk):
+    chan = f"lock:{id(lk)}"
+    if event == "acquire":
+        _default_monitor.acquire_from(chan)
+    else:
+        _default_monitor.publish(chan)
+
+
+def install(modules=DEFAULT_MODULES) -> RaceMonitor:
+    """Patch sync primitives + scoped class writes. Idempotent. Layered
+    on lockcheck: installing racecheck installs the lock wrappers too
+    (cycle FAILURE still only arms under SLT_LOCKCHECK=1 — conftest
+    gates that separately)."""
+    global _installed
+    if _installed:
+        return _default_monitor
+    import sys
+
+    lockcheck.install()
+    lockcheck.monitor().add_listener(_on_lock_event)
+    _patch_threading(_default_monitor)
+    sys.meta_path.insert(0, _ImportHook(modules))
+    # Modules already imported (install() normally runs first, but be
+    # correct for late installs from tests).
+    for name in modules:
+        mod = sys.modules.get(name)
+        if mod is not None:
+            instrument_module(mod)
+    _installed = True
+    return _default_monitor
+
+
+def uninstall():
+    global _installed
+    if not _installed:
+        return
+    import sys
+
+    lockcheck.monitor().remove_listener(_on_lock_event)
+    threading.Thread.start = _ORIG["thread_start"]
+    threading.Thread.join = _ORIG["thread_join"]
+    queue.Queue.put = _ORIG["queue_put"]
+    queue.Queue.get = _ORIG["queue_get"]
+    threading.Event = _REAL_EVENT
+    sys.meta_path = [f for f in sys.meta_path
+                     if not isinstance(f, _ImportHook)]
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+# -- offline replay (slt race) ------------------------------------------------
+
+
+def replay_log(path: str) -> RaceMonitor:
+    """Rebuild the happens-before order from a recorded access log
+    (``SLT_RACECHECK_LOG``) and re-run the race check deterministically.
+    Unknown record shapes are skipped — the log format may grow."""
+    mon = RaceMonitor(name=f"replay:{os.path.basename(path)}")
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            op = rec.get("op")
+            tid = rec.get("t")
+            if not isinstance(tid, str):
+                continue
+            st = mon.thread_state(tid)
+            if op == "publish" and isinstance(rec.get("ch"), str):
+                mon.publish(rec["ch"], st)
+            elif op == "acquire" and isinstance(rec.get("ch"), str):
+                mon.acquire_from(rec["ch"], st)
+            elif op in ("read", "write") and isinstance(rec.get("var"), str):
+                cls, _, attr = rec["var"].rpartition(".")
+                stack = [s for s in rec.get("stack", [])
+                         if isinstance(s, str)]
+                mon.record_access((str(rec.get("obj")), attr), cls or "?",
+                                  attr, st, is_write=(op == "write"),
+                                  stack=stack, thread_name=tid)
+    return mon

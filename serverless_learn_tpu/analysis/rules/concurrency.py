@@ -1,0 +1,430 @@
+"""Shared class/concurrency model for SLT007-SLT009.
+
+The three race/lifecycle rules all need the same facts about a module:
+which classes own locks, which attribute accesses happen under which
+lock, which methods run on background threads, and where resources are
+acquired. This module extracts them once per file; the rules stay thin.
+
+The model is deliberately *module-local* and conservative, in the same
+spirit as SLT001: ``self.X`` accesses resolve to the enclosing class;
+``var.X`` accesses resolve to a class only when exactly one class in the
+module assigns ``self.X`` in its body (the router mutating ``Replica``
+fields under ``FleetRouter._lock`` is the motivating case — the guard is
+a *lock id*, not "the owner's own lock"). Anything ambiguous is skipped,
+not guessed: a guarded-by checker that cries wolf gets turned off.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from serverless_learn_tpu.analysis.rules.slt001_lock_order import (
+    _LOCKISH_ATTR, _call_name, _is_lock_ctor)
+
+# Methods whose writes are construction, not sharing: the object is not
+# yet published to another thread.
+INIT_METHODS = {"__init__", "__post_init__", "__new__", "__init_subclass__"}
+
+
+def caller_holds_lock(method_name: str) -> bool:
+    """The package's ``_locked`` suffix convention: the caller holds the
+    class lock for the whole call (SLT001's runtime lockcheck validates
+    that claim dynamically). Accesses inside such methods are neither
+    evidence for a guard nor violations of one."""
+    return method_name.endswith("_locked")
+
+
+def _is_sync_ctor(node: ast.AST) -> Tuple[bool, Optional[str]]:
+    """(is lock-like ctor, underlying lock attr for Condition(self.X))."""
+    if _is_lock_ctor(node):
+        return True, None
+    if isinstance(node, ast.Call):
+        _, attr = _call_name(node.func)
+        if attr in ("Condition", "Semaphore", "BoundedSemaphore"):
+            under = None
+            if node.args:
+                a0 = node.args[0]
+                if (isinstance(a0, ast.Attribute)
+                        and isinstance(a0.value, ast.Name)
+                        and a0.value.id == "self"):
+                    under = a0.attr
+            return True, under
+    return False, None
+
+
+@dataclass
+class Access:
+    """One attribute access attributed to (owner_class, attr)."""
+
+    owner: str            # class name the attribute belongs to
+    attr: str
+    line: int
+    is_write: bool
+    method: str           # "Class.method" or module-level "func"
+    locks: frozenset      # lock ids held at the access
+    receiver_self: bool   # self.X vs var.X
+    local_obj: bool = False  # receiver constructed in this same function
+
+
+@dataclass
+class DictOp:
+    """A read (``k in self.D`` / ``self.D.get``) or write (``self.D[k] =``,
+    ``self.D.pop``/``del``/``setdefault``) on a dict-like attribute."""
+
+    owner: str
+    attr: str
+    line: int
+    is_write: bool
+    method: str
+    locks: frozenset
+
+
+@dataclass
+class ClassModel:
+    name: str
+    path: str
+    line: int
+    lock_attrs: Dict[str, str] = field(default_factory=dict)  # attr -> id
+    cond_under: Dict[str, str] = field(default_factory=dict)  # cond -> lock
+    methods: Set[str] = field(default_factory=set)
+    public_methods: Set[str] = field(default_factory=set)
+    thread_targets: Set[str] = field(default_factory=set)
+    calls: Dict[str, Set[str]] = field(default_factory=dict)  # m -> callees
+    inst_attrs: Set[str] = field(default_factory=set)  # self.X assigned
+    acquire_calls: Dict[str, List[int]] = field(default_factory=dict)
+    release_calls: Dict[str, List[int]] = field(default_factory=dict)
+
+    def reachable_from(self, entries: Set[str]) -> Set[str]:
+        seen = set(e for e in entries if e in self.methods)
+        work = list(seen)
+        while work:
+            m = work.pop()
+            for callee in self.calls.get(m, ()):
+                if callee in self.methods and callee not in seen:
+                    seen.add(callee)
+                    work.append(callee)
+        return seen
+
+
+@dataclass
+class ModuleModel:
+    path: str
+    classes: Dict[str, ClassModel] = field(default_factory=dict)
+    accesses: List[Access] = field(default_factory=list)
+    dict_ops: List[DictOp] = field(default_factory=list)
+    has_threads: bool = False
+    # attribute name -> owning class, only when unique in the module
+    attr_owner: Dict[str, str] = field(default_factory=dict)
+
+
+# Attributes that are synchronization/bookkeeping, never racy data.
+_IGNORED_ATTRS = {"daemon", "name"}
+
+
+class _MethodWalk:
+    """One function/method body: held-lock stack + access recording."""
+
+    def __init__(self, model: ModuleModel, cls: Optional[ClassModel],
+                 qual: str):
+        self.model = model
+        self.cls = cls
+        self.qual = qual
+        self.held: List[str] = []
+        # locals bound from a constructor call in this function: writes
+        # to their attributes are initialization, not sharing.
+        self.local_objs: Set[str] = set()
+
+    # -- lock resolution ---------------------------------------------------
+
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and self.cls is not None):
+            attr = expr.attr
+            attr = self.cls.cond_under.get(attr, attr)
+            if attr in self.cls.lock_attrs:
+                return self.cls.lock_attrs[attr]
+            if _LOCKISH_ATTR.search(attr):
+                return f"{self.model.path}::{self.cls.name}.{attr}"
+        if isinstance(expr, ast.Name) and _LOCKISH_ATTR.search(expr.id):
+            return f"{self.model.path}::{expr.id}"
+        return None
+
+    # -- access recording --------------------------------------------------
+
+    def _owner_of(self, recv: ast.AST, attr: str
+                  ) -> Tuple[Optional[str], bool, bool]:
+        """(owner class, receiver is self, receiver is local ctor obj)."""
+        if isinstance(recv, ast.Name):
+            if recv.id == "self":
+                if self.cls is None:
+                    return None, False, False
+                return self.cls.name, True, False
+            owner = self.model.attr_owner.get(attr)
+            if owner is not None:
+                return owner, False, recv.id in self.local_objs
+        return None, False, False
+
+    def _note_attr(self, node: ast.Attribute, is_write: bool):
+        if caller_holds_lock(self.qual.split(".")[-1]):
+            return
+        attr = node.attr
+        if attr.startswith("__") or attr in _IGNORED_ATTRS:
+            return
+        owner, is_self, local = self._owner_of(node.value, attr)
+        if owner is None:
+            return
+        self.model.accesses.append(Access(
+            owner, attr, node.lineno, is_write, self.qual,
+            frozenset(self.held), is_self, local))
+
+    def _note_dict_op(self, owner_expr: ast.AST, attr: str, line: int,
+                      is_write: bool):
+        if caller_holds_lock(self.qual.split(".")[-1]):
+            return
+        owner, _, _ = self._owner_of(owner_expr, attr)
+        if owner is None:
+            return
+        self.model.dict_ops.append(DictOp(
+            owner, attr, line, is_write, self.qual, frozenset(self.held)))
+
+    # -- the walk ----------------------------------------------------------
+
+    def visit(self, stmts):
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in stmt.items:
+                lock = self._lock_id(item.context_expr)
+                if lock is not None:
+                    self.held.append(lock)
+                    pushed += 1
+                else:
+                    self._expr(item.context_expr)
+            self.visit(stmt.body)
+            for _ in range(pushed):
+                self.held.pop()
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._assign(stmt)
+            return
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                if (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Attribute)
+                        and isinstance(tgt.value.value, ast.Name)):
+                    self._note_dict_op(tgt.value.value, tgt.value.attr,
+                                       stmt.lineno, is_write=True)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._stmt(child)
+            elif isinstance(child, ast.expr):
+                self._expr(child)
+            elif isinstance(child, ast.excepthandler):
+                self.visit(child.body)
+            elif isinstance(getattr(child, "body", None), list):
+                self.visit(child.body)
+
+    def _assign(self, stmt):
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        value = stmt.value
+        if value is not None:
+            self._expr(value)
+            # x = Foo(...) marks x as a locally-constructed object.
+            if (isinstance(stmt, ast.Assign) and isinstance(value, ast.Call)
+                    and len(targets) == 1
+                    and isinstance(targets[0], ast.Name)):
+                _, ctor = _call_name(value.func)
+                if ctor and ctor[:1].isupper():
+                    self.local_objs.add(targets[0].id)
+        for tgt in targets:
+            if isinstance(stmt, ast.AugAssign):
+                # self.x += 1 reads AND writes
+                if isinstance(tgt, ast.Attribute):
+                    self._note_attr(tgt, is_write=False)
+            if isinstance(tgt, ast.Attribute):
+                self._note_attr(tgt, is_write=True)
+            elif isinstance(tgt, ast.Subscript):
+                if (isinstance(tgt.value, ast.Attribute)
+                        and isinstance(tgt.value.value, ast.Name)):
+                    self._note_dict_op(tgt.value.value, tgt.value.attr,
+                                       stmt.lineno, is_write=True)
+                self._expr(tgt.slice)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for el in tgt.elts:
+                    if isinstance(el, ast.Attribute):
+                        self._note_attr(el, is_write=True)
+
+    def _expr(self, expr: ast.expr):
+        skip = set()
+        for node in ast.walk(expr):
+            if id(node) in skip:
+                continue
+            if isinstance(node, ast.Lambda):
+                for sub in ast.walk(node):
+                    if sub is not node:
+                        skip.add(id(sub))
+                continue
+            if isinstance(node, ast.Attribute) and isinstance(
+                    node.ctx, ast.Load):
+                # A method CALL is not a state read of the method name;
+                # the Call branch below marks its func before ast.walk
+                # reaches it (parents precede children).
+                if not getattr(node, "_slt_is_callee", False):
+                    self._note_attr(node, is_write=False)
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute):
+                    node.func._slt_is_callee = True
+                self._call(node)
+            if isinstance(node, ast.Compare):
+                # k in self.D
+                for op, cmp in zip(node.ops, node.comparators):
+                    if (isinstance(op, (ast.In, ast.NotIn))
+                            and isinstance(cmp, ast.Attribute)
+                            and isinstance(cmp.value, ast.Name)):
+                        self._note_dict_op(cmp.value, cmp.attr,
+                                           node.lineno, is_write=False)
+
+    def _call(self, node: ast.Call):
+        recv, attr = _call_name(node.func)
+        if attr is None:
+            return
+        # self.m() intra-class call edges
+        if recv == "self" and self.cls is not None:
+            self.cls.calls.setdefault(
+                self.qual.split(".")[-1], set()).add(attr)
+        # Thread(target=self.m)
+        if attr == "Thread" and recv in (None, "threading"):
+            self.model.has_threads = True
+            for kw in node.keywords:
+                if kw.arg == "target" and isinstance(kw.value,
+                                                     ast.Attribute):
+                    if (isinstance(kw.value.value, ast.Name)
+                            and kw.value.value.id == "self"
+                            and self.cls is not None):
+                        self.cls.thread_targets.add(kw.value.attr)
+        # dict-ish method ops on self.D / var.D
+        if isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            if isinstance(base, ast.Attribute) and isinstance(
+                    base.value, ast.Name):
+                if attr in ("get", "keys", "values", "items"):
+                    self._note_dict_op(base.value, base.attr,
+                                       node.lineno, is_write=False)
+                elif attr in ("pop", "setdefault", "update", "clear",
+                              "append", "remove", "add", "discard",
+                              "extend"):
+                    self._note_dict_op(base.value, base.attr,
+                                       node.lineno, is_write=True)
+        # resource acquire/release verbs (SLT008)
+        if self.cls is not None and attr in ("incref", "adopt"):
+            self.cls.acquire_calls.setdefault(attr, []).append(node.lineno)
+        if self.cls is not None and attr in ("decref", "release", "free"):
+            self.cls.release_calls.setdefault(attr, []).append(node.lineno)
+
+
+def build_module(sf) -> Optional[ModuleModel]:
+    """Extract the concurrency model of one SourceFile (None when the
+    file has no classes and no threads — nothing for the rules to do)."""
+    if sf.tree is None:
+        return None
+    model = ModuleModel(path=sf.path)
+
+    # Pass 1: classes, lock attributes, instance attributes, methods.
+    for node in sf.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        cm = ClassModel(node.name, sf.path, node.lineno)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                is_sync, under = _is_sync_ctor(sub.value)
+                for tgt in sub.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        cm.inst_attrs.add(tgt.attr)
+                        if is_sync:
+                            if under:
+                                cm.cond_under[tgt.attr] = under
+                            else:
+                                cm.lock_attrs[tgt.attr] = \
+                                    f"{sf.path}::{node.name}.{tgt.attr}"
+            elif isinstance(sub, ast.AnnAssign):
+                if (isinstance(sub.target, ast.Attribute)
+                        and isinstance(sub.target.value, ast.Name)
+                        and sub.target.value.id == "self"):
+                    cm.inst_attrs.add(sub.target.attr)
+        # Dataclass-style fields: annotated class-level names ARE the
+        # instance attributes (gossip's Member, the fleet's PeerInfo).
+        for sub in node.body:
+            if (isinstance(sub, ast.AnnAssign)
+                    and isinstance(sub.target, ast.Name)):
+                cm.inst_attrs.add(sub.target.id)
+        for sub in node.body:
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cm.methods.add(sub.name)
+                if not sub.name.startswith("_"):
+                    cm.public_methods.add(sub.name)
+        model.classes[node.name] = cm
+
+    # Unique attr -> owner mapping (var.X attribution).
+    seen: Dict[str, List[str]] = {}
+    for cname, cm in model.classes.items():
+        for a in cm.inst_attrs:
+            seen.setdefault(a, []).append(cname)
+    model.attr_owner = {a: owners[0] for a, owners in seen.items()
+                        if len(owners) == 1}
+
+    # Pass 2: walk every function/method.
+    def walk_fn(fn, cls: Optional[ClassModel], qual: str):
+        _MethodWalk(model, cls, qual).visit(fn.body)
+
+    for node in sf.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk_fn(node, None, node.name)
+        elif isinstance(node, ast.ClassDef):
+            cm = model.classes[node.name]
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    walk_fn(sub, cm, f"{node.name}.{sub.name}")
+    return model
+
+
+def infer_guards(model: ModuleModel) -> Dict[Tuple[str, str], dict]:
+    """(owner, attr) -> {lock, guarded, total_locked, total} for every
+    attribute with a majority guard: the lock held at >50% of its locked
+    accesses, with at least 2 locked accesses. Accesses in INIT_METHODS
+    and on locally-constructed receivers don't count against (or toward)
+    the guard — construction is single-threaded by definition."""
+    stats: Dict[Tuple[str, str], Dict[str, int]] = {}
+    totals: Dict[Tuple[str, str], int] = {}
+    locked_totals: Dict[Tuple[str, str], int] = {}
+    for acc in model.accesses:
+        m = acc.method.split(".")[-1]
+        if m in INIT_METHODS or acc.local_obj:
+            continue
+        key = (acc.owner, acc.attr)
+        totals[key] = totals.get(key, 0) + 1
+        if acc.locks:
+            locked_totals[key] = locked_totals.get(key, 0) + 1
+        for lock in acc.locks:
+            stats.setdefault(key, {}).setdefault(lock, 0)
+            stats[key][lock] += 1
+    out = {}
+    for key, by_lock in stats.items():
+        lock, guarded = max(by_lock.items(), key=lambda kv: (kv[1], kv[0]))
+        if guarded >= 2 and guarded * 2 > locked_totals.get(key, 0):
+            out[key] = {"lock": lock, "guarded": guarded,
+                        "total_locked": locked_totals.get(key, 0),
+                        "total": totals.get(key, 0)}
+    return out

@@ -29,6 +29,7 @@ from serverless_learn_tpu.analysis.engine import Finding, Project
 
 RULE_ID = "SLT002"
 TITLE = "metric-name drift (emitted vs consumed vs documented)"
+SCOPE = "project"  # cross-file absence: needs the full tree
 
 _EMIT_METHODS = {"counter", "gauge", "histogram"}
 _NAME_RE = re.compile(r"^slt_[a-z0-9_]+$")

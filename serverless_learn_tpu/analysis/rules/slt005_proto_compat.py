@@ -31,6 +31,7 @@ from serverless_learn_tpu.analysis.engine import Finding, Project
 
 RULE_ID = "SLT005"
 TITLE = "wire-protocol compatibility (slt.proto / gen / native headers)"
+SCOPE = "project"  # cross-file absence: needs the full tree
 
 PROTO_PATH = "native/proto/slt.proto"
 GEN_PATH = "native/gen/slt_pb2.py"

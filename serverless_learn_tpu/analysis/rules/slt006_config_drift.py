@@ -29,6 +29,7 @@ from serverless_learn_tpu.analysis.engine import Finding, Project
 
 RULE_ID = "SLT006"
 TITLE = "config-schema drift (reads vs declared dataclass fields)"
+SCOPE = "project"  # cross-file absence: needs the full tree
 
 CONFIG_MODULE = "serverless_learn_tpu/config.py"
 CONFIGS_DIR = "configs"

@@ -1,0 +1,124 @@
+"""SLT007: guarded-by inference — shared attributes written lock-free.
+
+RacerD's core insight, scaled to this package: a codebase with locking
+*discipline* tells you what the discipline is. For every attribute of
+every class in a thread-spawning module, infer the guarding lock from
+the majority of its lock-held accesses (``concurrency.infer_guards``);
+an attribute guarded at most sites and then WRITTEN with no lock held is
+either a race or an undocumented exception — both deserve a finding.
+
+What keeps this precise rather than noisy:
+
+* only modules that construct ``threading.Thread`` are in scope — a
+  single-threaded helper has no races to find;
+* construction is exempt (``__init__``-family methods, and writes to
+  objects constructed in the same function) — an object not yet
+  published to another thread cannot race;
+* the guard must be a real majority (>50% of the attribute's lock-held
+  accesses, at least 2 of them), so ad-hoc once-locked reads don't
+  invent discipline that isn't there;
+* the attribute must be reachable from more than one thread entry
+  point ACROSS ALL of its accesses — a background-thread target plus a
+  public method, or two thread targets. A write on one thread races
+  with a read on another; requiring the write itself to be
+  multi-entrant would miss exactly the single-writer/many-reader case.
+
+The attribution is lock-ID based, not owner-based: the router guarding
+``Replica`` fields with ``FleetRouter._lock`` is a discipline this rule
+understands (``var.attr`` accesses resolve to a class when the
+attribute name is unique in the module). The dynamic counterpart is
+``analysis/racecheck.py`` (SLT_RACECHECK=1), which checks the same
+invariant against observed vector-clock orderings.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from serverless_learn_tpu.analysis.engine import Finding, Project
+from serverless_learn_tpu.analysis.rules import concurrency
+
+RULE_ID = "SLT007"
+TITLE = "guarded-by inference (unguarded write to lock-disciplined attr)"
+
+
+def _reach_maps(model: concurrency.ModuleModel
+                ) -> Dict[str, Dict[str, Set[str]]]:
+    """class -> (method -> entry points reaching it). Entries are thread
+    targets (background threads) and public methods (caller threads)."""
+    out: Dict[str, Dict[str, Set[str]]] = {}
+    for cname, cm in model.classes.items():
+        reach: Dict[str, Set[str]] = {m: set() for m in cm.methods}
+        entries = set(cm.thread_targets) | set(cm.public_methods)
+        if "run" in cm.methods:
+            entries.add("run")
+        for entry in entries:
+            for m in cm.reachable_from({entry}):
+                reach[m].add(f"{cname}.{entry}")
+        out[cname] = reach
+    return out
+
+
+def _access_entries(model, reach_maps, acc: "concurrency.Access"
+                    ) -> Set[str]:
+    if "." in acc.method:
+        cls, m = acc.method.split(".", 1)
+        return reach_maps.get(cls, {}).get(m, set())
+    # Module-level function: itself an entry for whatever thread calls it.
+    return {acc.method}
+
+
+def _thread_entries(model) -> Set[str]:
+    out = set()
+    for cname, cm in model.classes.items():
+        for t in cm.thread_targets:
+            out.add(f"{cname}.{t}")
+    return out
+
+
+def run(proj: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in proj.files:
+        model = concurrency.build_module(sf) if sf.tree is not None else None
+        if model is None or not model.has_threads:
+            continue
+        guards = concurrency.infer_guards(model)
+        if not guards:
+            continue
+        reach_maps = _reach_maps(model)
+        thread_entries = _thread_entries(model)
+
+        # Entry-point union per (owner, attr) across ALL accesses.
+        attr_entries: Dict[Tuple[str, str], Set[str]] = {}
+        for acc in model.accesses:
+            if acc.method.split(".")[-1] in concurrency.INIT_METHODS:
+                continue
+            attr_entries.setdefault((acc.owner, acc.attr), set()).update(
+                _access_entries(model, reach_maps, acc))
+
+        for acc in model.accesses:
+            if not acc.is_write or acc.locks:
+                continue
+            method = acc.method.split(".")[-1]
+            if method in concurrency.INIT_METHODS or acc.local_obj:
+                continue
+            guard = guards.get((acc.owner, acc.attr))
+            if guard is None:
+                continue
+            entries = attr_entries.get((acc.owner, acc.attr), set())
+            threads = entries & thread_entries
+            if not (len(threads) >= 2 or (threads and entries - threads)):
+                continue
+            # A private helper no public method or thread target reaches
+            # is a construction helper (called from __init__ only): its
+            # writes predate publication, like __init__'s own.
+            if not _access_entries(model, reach_maps, acc):
+                continue
+            lock_short = guard["lock"].split("::")[-1]
+            findings.append(Finding(
+                RULE_ID, sf.path, acc.line,
+                f"{acc.owner}.{acc.attr} is written in {method}() with no "
+                f"lock held, but {guard['guarded']} of "
+                f"{guard['total_locked']} lock-held accesses guard it "
+                f"with {lock_short} (inferred guard)"))
+    return findings

@@ -1,0 +1,328 @@
+"""SLT008: resource lifecycle — refcounts, sockets and files balanced on
+every path.
+
+The paged KV cache (round 13) turned block bookkeeping into correctness:
+a ``BlockPool`` reference acquired (``alloc``/``incref``) and never
+``decref``'d is HBM leaked until restart, and the exception edge is
+where it happens — ``incref(shared)`` followed by an ``alloc()`` that
+raises ``KVBlocksExhausted`` strands the shared refs unless the order
+(or a try/finally) protects them. Three checks:
+
+1. **class-level pairing** — a class whose methods call an acquire verb
+   (``incref``/``adopt``) must somewhere call the matching release verb
+   (``decref``/``release``/``free``). The trie increfs in ``register``
+   and decrefs in ``release``; a class that only ever acquires is a
+   leak by construction.
+2. **exception-edge ordering** — refs acquired (``incref``/``alloc``)
+   and not yet recorded anywhere (self.*, a container, return) when a
+   known-raising acquisition (another ``alloc``/``incref``) or an
+   explicit ``raise`` executes are leaked on that edge, unless a
+   try/finally (or except) wraps the window.
+3. **socket/file lifecycle** — ``socket.socket()`` /
+   ``create_connection()`` / ``open()`` results must be context-managed,
+   ``.close()``d on the same binding, or escape the function (returned /
+   stored / passed on). Stored-to-``self`` resources additionally need
+   a ``self.X.close()`` (or ``.shutdown()``) somewhere in the class —
+   the teardown half of SLT004's thread-lifecycle contract.
+
+Ownership transfer discharges an obligation: this rule tracks leaks, not
+aliasing — a ref stored into ``self._slot_pages[sid]`` is the retire
+path's problem (check 1 covers that class), not this function's.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from serverless_learn_tpu.analysis.engine import Finding, Project
+from serverless_learn_tpu.analysis.rules.slt001_lock_order import _call_name
+
+RULE_ID = "SLT008"
+TITLE = "resource lifecycle (refcount/socket/file balance on all paths)"
+
+ACQUIRE_VERBS = {"incref", "adopt"}
+RELEASE_VERBS = {"decref", "release", "free", "clear"}
+# Acquire calls that can themselves raise (pool exhaustion): executing
+# one while holding unrecorded refs is the canonical leak edge.
+RAISING_ACQUIRES = {"alloc", "incref"}
+
+_SOCKET_CTORS = {("socket", "socket"), ("socket", "create_connection")}
+
+
+def _is_resource_ctor(node: ast.Call) -> Optional[str]:
+    recv, attr = _call_name(node.func)
+    if (recv, attr) in _SOCKET_CTORS:
+        return "socket"
+    if recv is None and attr == "open":
+        return "file"
+    return None
+
+
+class _FnCheck:
+    """Single-function walk tracking open obligations in statement order
+    (statement order approximates path order well enough for the
+    straight-line acquire/record idiom this rule polices)."""
+
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+        # local name -> ("socket"|"file", line) awaiting discharge
+        self.resources: Dict[str, tuple] = {}
+        # names holding acquired-but-unrecorded refs: name -> (verb, line)
+        self.refs: Dict[str, tuple] = {}
+        self.self_stores: Dict[str, int] = {}  # self.X = <resource>: line
+        # names already stored into a container/attribute: increfs on an
+        # ALREADY-recorded object owe nothing new to this function (the
+        # trie stores the node, then increfs its block — that's the
+        # correct order, not a leak).
+        self.escaped: Set[str] = set()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _names_in(self, expr: ast.AST) -> Set[str]:
+        return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+    def _discharge_refs(self, names: Set[str]):
+        for n in names:
+            self.refs.pop(n, None)
+
+    def _leak_check(self, line: int, what: str):
+        """A raising operation executes NOW: anything unrecorded leaks."""
+        for name, (verb, l0) in list(self.refs.items()):
+            self.findings.append(Finding(
+                RULE_ID, self.path, line,
+                f"refs acquired by {verb}() at line {l0} (bound to "
+                f"'{name}') are not yet recorded when {what} can raise "
+                f"— leaked on the exception edge (record refs after the "
+                f"last fallible acquisition, or guard with try/finally)"))
+            self.refs.pop(name, None)  # one report per acquisition
+
+    # -- walk --------------------------------------------------------------
+
+    def run(self, fn) -> None:
+        self._stmts(fn.body, protected=False)
+        # function ended: undischarged local resources leak
+        for name, (kind, line) in self.resources.items():
+            self.findings.append(Finding(
+                RULE_ID, self.path, line,
+                f"{kind} opened here (bound to '{name}') is never closed, "
+                f"context-managed, stored or returned in {fn.name}()"))
+
+    def _stmts(self, stmts, protected: bool):
+        for stmt in stmts:
+            self._stmt(stmt, protected)
+
+    def _stmt(self, stmt: ast.stmt, protected: bool):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Try):
+            has_finally = bool(stmt.finalbody)
+            has_handler = bool(stmt.handlers)
+            pre_refs = dict(self.refs)
+            pre_res = dict(self.resources)
+            self._stmts(stmt.body, protected or has_finally or has_handler)
+            body_refs, body_res = self.refs, self.resources
+            for h in stmt.handlers:
+                # The handler runs after the body raised PARTWAY: refs the
+                # body acquired may or may not be held on that path, so
+                # the handler is judged only against pre-try obligations
+                # the body didn't discharge (the decref-on-error idiom).
+                self.refs = {k: v for k, v in pre_refs.items()
+                             if k in body_refs}
+                self.resources = {k: v for k, v in pre_res.items()
+                                  if k in body_res}
+                self._stmts(h.body, protected)
+            # Fall-through continues on the non-raising path.
+            self.refs, self.resources = body_refs, body_res
+            self._stmts(stmt.orelse, protected)
+            self._stmts(stmt.finalbody, protected)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                # `with open(...) as f` / `with socket.create_connection`
+                # is the blessed form: no obligation at all.
+                self._scan_calls(item.context_expr, stmt.lineno, protected,
+                                 in_with=True)
+            self._stmts(stmt.body, protected)
+            return
+        if isinstance(stmt, ast.Raise) and not protected:
+            self._leak_check(stmt.lineno, "the raise here")
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                names = self._names_in(stmt.value)
+                for n in names:
+                    self.resources.pop(n, None)
+                self._discharge_refs(names)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._assign(stmt, protected)
+            return
+        # generic statement: scan IMMEDIATE expressions for calls (child
+        # statements recurse — walking the whole subtree here would scan
+        # nested calls twice and out of program order)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._stmt(child, protected)
+            elif isinstance(child, ast.expr):
+                self._scan_calls(child, stmt.lineno, protected)
+            elif isinstance(child, ast.excepthandler):
+                self._stmts(child.body, protected)
+            elif isinstance(getattr(child, "body", None), list):
+                self._stmts(child.body, protected)
+
+    def _assign(self, stmt: ast.Assign, protected: bool):
+        self._scan_calls(stmt.value, stmt.lineno, protected)
+        value_names = self._names_in(stmt.value)
+        tgt = stmt.targets[0] if len(stmt.targets) == 1 else None
+        # binding a fresh resource/ref result to a local name
+        if isinstance(tgt, ast.Name) and isinstance(stmt.value, ast.Call):
+            kind = _is_resource_ctor(stmt.value)
+            if kind is not None:
+                self.resources[tgt.id] = (kind, stmt.lineno)
+                return
+            _, attr = _call_name(stmt.value.func)
+            if attr == "alloc":
+                self.refs[tgt.id] = ("alloc", stmt.lineno)
+                return
+        # storing to self.X / container / another name = ownership escape
+        if tgt is not None and not isinstance(tgt, ast.Name):
+            for n in value_names:
+                if n in self.resources:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        self.self_stores[tgt.attr] = self.resources[n][1]
+                    self.resources.pop(n)
+            self._discharge_refs(value_names)
+            self.escaped |= value_names
+        elif isinstance(tgt, ast.Name):
+            # x = list(shared) + got : obligation flows into x too
+            for n in value_names:
+                if n in self.refs and tgt.id not in self.refs:
+                    self.refs[tgt.id] = self.refs[n]
+        if (isinstance(tgt, ast.Attribute) and isinstance(
+                tgt.value, ast.Name) and tgt.value.id == "self"):
+            # self.X = socket.socket(...) directly: stored without ever
+            # being a local, but the class still owes a teardown path.
+            if isinstance(stmt.value, ast.Call) \
+                    and _is_resource_ctor(stmt.value) is not None:
+                self.self_stores[tgt.attr] = stmt.lineno
+            for n in value_names:
+                if n in self.resources:
+                    self.self_stores[tgt.attr] = self.resources[n][1]
+                    self.resources.pop(n)
+            self._discharge_refs(value_names)
+
+    def _scan_calls(self, expr: ast.AST, line: int, protected: bool,
+                    in_with: bool = False):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._call(node, protected, in_with=in_with)
+
+    def _call(self, node: ast.Call, protected: bool, in_with: bool = False):
+        recv, attr = _call_name(node.func)
+        if attr is None:
+            return
+        if attr == "close" or attr == "shutdown":
+            if isinstance(node.func, ast.Attribute) and isinstance(
+                    node.func.value, ast.Name):
+                self.resources.pop(node.func.value.id, None)
+            return
+        if attr in RAISING_ACQUIRES and recv is not None and not protected:
+            # This acquisition can raise: previously acquired,
+            # still-unrecorded refs leak on that edge.
+            self._leak_check(node.lineno, f"{attr}() at line {node.lineno}")
+        if attr == "incref" and recv is not None and not in_with:
+            # incref(args): the args' refs are now counted but recorded
+            # nowhere new — the CALLER owes a decref. Track under the
+            # argument names. Names already stored into a container owe
+            # nothing (the store IS the record; trie-style store-then-
+            # incref is the correct order).
+            for a in node.args:
+                for n in self._names_in(a):
+                    if n not in self.escaped:
+                        self.refs.setdefault(n, ("incref", node.lineno))
+            return
+        if attr in RELEASE_VERBS:
+            for a in node.args:
+                self._discharge_refs(self._names_in(a))
+            return
+        # any other call consuming a tracked name = ownership handoff
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            names = self._names_in(a)
+            for n in names:
+                self.resources.pop(n, None)
+            self._discharge_refs(names)
+
+
+def run(proj: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in proj.files:
+        if sf.tree is None:
+            continue
+        # ---- per-function obligations ----
+        class_of_fn = {}
+        fns = []
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.append((node, None))
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        fns.append((sub, node))
+                        class_of_fn[id(sub)] = node
+
+        self_stores: Dict[str, List[tuple]] = {}  # class -> [(attr, line)]
+        for fn, cls in fns:
+            chk = _FnCheck(sf.path, findings)
+            chk.run(fn)
+            if cls is not None:
+                for attr, line in chk.self_stores.items():
+                    self_stores.setdefault(cls.name, []).append(
+                        (attr, line))
+
+        # ---- class-level: self.X resources need a close path ----
+        for node in sf.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            stored = self_stores.get(node.name, [])
+            if not stored:
+                continue
+            closed: Set[str] = set()
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in ("close", "shutdown", "stop")):
+                    base = sub.func.value
+                    if (isinstance(base, ast.Attribute)
+                            and isinstance(base.value, ast.Name)
+                            and base.value.id == "self"):
+                        closed.add(base.attr)
+            for attr, line in stored:
+                if attr not in closed:
+                    findings.append(Finding(
+                        RULE_ID, sf.path, line,
+                        f"self.{attr} holds a socket/file opened here but "
+                        f"{node.name} never closes it — add a close/stop "
+                        f"teardown path"))
+
+        # ---- class-level: acquire verbs need a release path ----
+        from serverless_learn_tpu.analysis.rules import concurrency
+
+        model = concurrency.build_module(sf)
+        if model is None:
+            continue
+        for cname, cm in model.classes.items():
+            if cm.acquire_calls and not cm.release_calls:
+                verb, lines = next(iter(cm.acquire_calls.items()))
+                findings.append(Finding(
+                    RULE_ID, sf.path, lines[0],
+                    f"{cname} acquires pool references ({verb}() at line"
+                    f"{'s' if len(lines) > 1 else ''} "
+                    f"{', '.join(map(str, lines))}) but never calls "
+                    f"{'/'.join(sorted(RELEASE_VERBS))} — refcount leak "
+                    f"by construction"))
+    return findings

@@ -1,0 +1,252 @@
+"""SLT009: atomicity — check-then-act on shared state outside the guard.
+
+A lock-free ``if self._last_out older than cooldown: … self._last_out =
+now`` is two atomic operations, not one: a second thread can pass the
+same check before the first thread's write lands (double scale-out,
+double admission, lost replica-state transition). This rule flags an
+``If`` whose *test* reads an attribute (or probes a dict: ``k in
+self.D`` / ``self.D.get(k)``) and whose *body* writes that same
+attribute/dict, when BOTH ends execute with no lock held in a class
+other threads can enter.
+
+Concurrency evidence required (either suffices):
+
+* the attribute has an inferred majority guard elsewhere in the module
+  (SLT007's inference) — the discipline exists, this site skipped it;
+* the attribute's accesses span more than one thread entry point of its
+  class (a ``Thread(target=self.X)`` method plus a public method, or
+  two thread targets) — the autoscaler-cooldown shape, where no lock
+  exists anywhere and the check-then-act IS the bug.
+
+Check-unlocked/act-locked (double-checked locking) is deliberately NOT
+flagged: re-checking under the lock is the standard fix, and the write
+is safe — only the stale-check branchwork needs care, which SLT007
+already polices via the read side when a guard exists.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from serverless_learn_tpu.analysis.engine import Finding, Project
+from serverless_learn_tpu.analysis.rules import concurrency
+from serverless_learn_tpu.analysis.rules.slt007_guarded_by import (
+    _reach_maps, _thread_entries)
+
+RULE_ID = "SLT009"
+TITLE = "atomicity (check-then-act outside the inferred guard)"
+
+
+class _IfScan:
+    """Per-method walk pairing unlocked attr reads in If tests with
+    unlocked writes in the matching body."""
+
+    def __init__(self, model: concurrency.ModuleModel,
+                 cls: Optional[concurrency.ClassModel], method: str):
+        self.model = model
+        self.cls = cls
+        self.method = method
+        self.held: List[str] = []
+        self.pairs: List[tuple] = []  # (owner, attr, test_line, act_line)
+
+    def _owner_of(self, recv: ast.AST, attr: str) -> Optional[str]:
+        if isinstance(recv, ast.Name):
+            if recv.id == "self":
+                return self.cls.name if self.cls is not None else None
+            return self.model.attr_owner.get(attr)
+        return None
+
+    def _attr_reads(self, test: ast.expr) -> List[Tuple[str, str]]:
+        out = []
+        for node in ast.walk(test):
+            if isinstance(node, ast.Attribute) and isinstance(
+                    node.ctx, ast.Load):
+                owner = self._owner_of(node.value, node.attr)
+                if owner is not None:
+                    out.append((owner, node.attr))
+            elif isinstance(node, ast.Compare):
+                for op, cmp in zip(node.ops, node.comparators):
+                    if (isinstance(op, (ast.In, ast.NotIn))
+                            and isinstance(cmp, ast.Attribute)
+                            and isinstance(cmp.value, ast.Name)):
+                        owner = self._owner_of(cmp.value, cmp.attr)
+                        if owner is not None:
+                            out.append((owner, cmp.attr))
+        return out
+
+    def _writes_in(self, stmts, checked: Set[Tuple[str, str]],
+                   test_line: int):
+        """Find unlocked writes to checked attrs inside the branch body
+        (nested lock acquisitions clear the unlocked status)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                # writes under a nested lock are the double-checked
+                # pattern — not flagged (module docstring).
+                locked = any(self._lock_id(i.context_expr) is not None
+                             for i in stmt.items)
+                if not locked:
+                    self._writes_in(stmt.body, checked, test_line)
+                continue
+            tgts: List[ast.expr] = []
+            if isinstance(stmt, ast.Assign):
+                tgts = stmt.targets
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                tgts = [stmt.target]
+            for tgt in tgts:
+                key = None
+                if isinstance(tgt, ast.Attribute):
+                    owner = self._owner_of(tgt.value, tgt.attr)
+                    if owner is not None:
+                        key = (owner, tgt.attr)
+                elif (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Attribute)
+                        and isinstance(tgt.value.value, ast.Name)):
+                    owner = self._owner_of(tgt.value.value, tgt.value.attr)
+                    if owner is not None:
+                        key = (owner, tgt.value.attr)
+                if key is not None and key in checked:
+                    self.pairs.append((key[0], key[1], test_line,
+                                       stmt.lineno))
+            # dict .pop()/.setdefault inside the branch
+            for node in ast.walk(stmt):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("pop", "setdefault",
+                                               "update")):
+                    base = node.func.value
+                    if isinstance(base, ast.Attribute) and isinstance(
+                            base.value, ast.Name):
+                        owner = self._owner_of(base.value, base.attr)
+                        if owner is not None and (owner, base.attr) \
+                                in checked:
+                            self.pairs.append((owner, base.attr,
+                                               test_line, node.lineno))
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    self._writes_in([child], checked, test_line)
+                elif isinstance(getattr(child, "body", None), list) \
+                        and not isinstance(child, ast.expr):
+                    self._writes_in(child.body, checked, test_line)
+
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and self.cls is not None):
+            attr = self.cls.cond_under.get(expr.attr, expr.attr)
+            if attr in self.cls.lock_attrs:
+                return self.cls.lock_attrs[attr]
+            if concurrency._LOCKISH_ATTR.search(attr):
+                return f"{self.model.path}::{self.cls.name}.{attr}"
+        if isinstance(expr, ast.Name) \
+                and concurrency._LOCKISH_ATTR.search(expr.id):
+            return f"{self.model.path}::{expr.id}"
+        return None
+
+    def visit(self, stmts):
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in stmt.items:
+                lock = self._lock_id(item.context_expr)
+                if lock is not None:
+                    self.held.append(lock)
+                    pushed += 1
+            self.visit(stmt.body)
+            for _ in range(pushed):
+                self.held.pop()
+            return
+        if isinstance(stmt, ast.If) and not self.held:
+            checked = set(self._attr_reads(stmt.test))
+            if checked:
+                self._writes_in(stmt.body, checked, stmt.lineno)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._stmt(child)
+            elif isinstance(child, ast.excepthandler):
+                self.visit(child.body)
+            elif isinstance(getattr(child, "body", None), list) \
+                    and not isinstance(child, ast.expr):
+                self.visit(child.body)
+
+
+def run(proj: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in proj.files:
+        model = concurrency.build_module(sf) if sf.tree is not None else None
+        if model is None or not model.has_threads:
+            continue
+        guards = concurrency.infer_guards(model)
+        reach_maps = _reach_maps(model)
+        thread_entries = _thread_entries(model)
+
+        # Entry union per attr (same notion as SLT007).
+        attr_entries: Dict[Tuple[str, str], Set[str]] = {}
+        for acc in model.accesses:
+            if acc.method.split(".")[-1] in concurrency.INIT_METHODS:
+                continue
+            if "." in acc.method:
+                cls, m = acc.method.split(".", 1)
+                ents = reach_maps.get(cls, {}).get(m, set())
+            else:
+                ents = {acc.method}
+            attr_entries.setdefault((acc.owner, acc.attr),
+                                    set()).update(ents)
+        for op in model.dict_ops:
+            if "." in op.method:
+                cls, m = op.method.split(".", 1)
+                ents = reach_maps.get(cls, {}).get(m, set())
+            else:
+                ents = {op.method}
+            attr_entries.setdefault((op.owner, op.attr),
+                                    set()).update(ents)
+
+        # Walk each method for unlocked check-then-act pairs.
+        import ast as _ast
+
+        for node in sf.tree.body:
+            bodies = []
+            if isinstance(node, (_ast.FunctionDef, _ast.AsyncFunctionDef)):
+                bodies.append((node, None, node.name))
+            elif isinstance(node, _ast.ClassDef):
+                cm = model.classes.get(node.name)
+                for sub in node.body:
+                    if isinstance(sub, (_ast.FunctionDef,
+                                        _ast.AsyncFunctionDef)):
+                        bodies.append((sub, cm, f"{node.name}.{sub.name}"))
+            for fn, cm, qual in bodies:
+                if fn.name in concurrency.INIT_METHODS:
+                    continue
+                if concurrency.caller_holds_lock(fn.name):
+                    continue  # the _locked suffix: caller owns the guard
+                scan = _IfScan(model, cm, qual)
+                scan.visit(fn.body)
+                for owner, attr, t_line, a_line in scan.pairs:
+                    key = (owner, attr)
+                    entries = attr_entries.get(key, set())
+                    threads = entries & thread_entries
+                    multi = (len(threads) >= 2
+                             or (threads and entries - threads))
+                    guard = guards.get(key)
+                    if guard is None and not multi:
+                        continue
+                    why = (f"other accesses hold "
+                           f"{guard['lock'].split('::')[-1]}" if guard
+                           else "the attribute is reached from "
+                                f"{len(entries)} thread entry points")
+                    findings.append(Finding(
+                        RULE_ID, sf.path, t_line,
+                        f"check-then-act on {owner}.{attr} in "
+                        f"{qual.split('.')[-1]}(): tested at line "
+                        f"{t_line}, written at line {a_line}, no lock "
+                        f"held on either side ({why})"))
+    return findings

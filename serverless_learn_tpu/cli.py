@@ -24,6 +24,7 @@ Here one typed CLI fronts everything:
     python -m serverless_learn_tpu profile      # trigger a device-trace capture
     python -m serverless_learn_tpu bench        # perf regression gate (--gate)
     python -m serverless_learn_tpu check        # project-aware static analysis
+    python -m serverless_learn_tpu race         # replay a recorded race-check log
     python -m serverless_learn_tpu chaos        # fault-injection chaos harness
     python -m serverless_learn_tpu models       # list registered model families
 
@@ -1309,7 +1310,8 @@ def cmd_check(args) -> int:
     try:
         rep = run_check(root, rule_ids=args.rule or None,
                         baseline_path=args.baseline,
-                        update_baseline=args.update_baseline)
+                        update_baseline=args.update_baseline,
+                        changed_only=args.changed_only)
     except ValueError as e:
         raise SystemExit(str(e))
     if args.json:
@@ -1319,14 +1321,45 @@ def cmd_check(args) -> int:
             loc = f"{f['path']}:{f['line']}" if f["line"] else f["path"]
             print(f"{loc}: {f['rule']} [{f['severity']}] {f['message']}")
         c = rep["counts"]
+        scope = " (changed files only)" if rep.get("changed_only") else ""
         print(f"slt check: {c['new']} finding(s), {c['baselined']} "
-              f"baselined, {rep['files_scanned']} files "
+              f"baselined, {rep['files_scanned']} files{scope} "
               f"({', '.join(rep['rules'])})")
         if c["stale_baseline_entries"]:
             print(f"note: {c['stale_baseline_entries']} stale baseline "
                   f"entr{'y' if c['stale_baseline_entries'] == 1 else 'ies'}"
                   f" no longer match any finding (run --update-baseline)")
     return 0 if rep["ok"] else 1
+
+
+def cmd_race(args) -> int:
+    """Offline happens-before replay (analysis/racecheck.py): rebuild
+    the vector-clock order from a JSONL event log recorded under
+    ``SLT_RACECHECK=1 SLT_RACECHECK_LOG=path`` and re-run the race
+    check deterministically. Exit 0 = no unordered conflicting access
+    beyond the allowlist; 2 = races found. The live monitor already
+    failed the recording session — this command is for triage: the same
+    log replays to the same verdict every time, with both stacks."""
+    from serverless_learn_tpu.analysis import racecheck
+
+    try:
+        mon = racecheck.replay_log(args.log)
+    except OSError as e:
+        raise SystemExit(f"cannot read {args.log}: {e}")
+    races = mon.races(include_allowlisted=args.include_allowlisted)
+    if args.json:
+        print(json.dumps({"log": args.log, "races": races,
+                          "ok": not mon.races()}, indent=2))
+    else:
+        print(mon.report())
+        if args.include_allowlisted:
+            for r in mon.races(include_allowlisted=True):
+                if r["allowlisted"]:
+                    just = racecheck.ALLOWLIST.get(
+                        (r["class"], r["attr"]), "")
+                    print(f"  allowlisted: {r['class']}.{r['attr']} "
+                          f"({r['kind']}) — {just}")
+    return 0 if not mon.races() else 2
 
 
 def cmd_chaos(args) -> int:
@@ -1848,9 +1881,15 @@ def build_parser() -> argparse.ArgumentParser:
     ck = sub.add_parser("check",
                         help="project-aware static analysis: lock order, "
                              "metric drift, jit purity, thread lifecycle, "
-                             "proto compat, config drift (SLT001-SLT006)")
+                             "proto compat, config drift, guarded-by, "
+                             "resource lifecycle, atomicity "
+                             "(SLT001-SLT009)")
     ck.add_argument("--rule", action="append", metavar="SLTxxx",
                     help="run only this rule (repeatable)")
+    ck.add_argument("--changed-only", action="store_true",
+                    help="scope per-file rules to files git reports "
+                         "changed vs HEAD (fast pre-commit mode; "
+                         "project-wide rules still see the full tree)")
     ck.add_argument("--list-rules", action="store_true",
                     help="print the rule registry and exit")
     ck.add_argument("--json", action="store_true",
@@ -1868,6 +1907,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="rewrite the baseline from the current findings "
                          "(then hand-edit each justification)")
     ck.set_defaults(fn=cmd_check)
+
+    rc = sub.add_parser("race",
+                        help="replay a recorded SLT_RACECHECK_LOG access "
+                             "log through the vector-clock monitor: "
+                             "deterministic offline triage of a race a "
+                             "CI run caught")
+    rc.add_argument("log", help="JSONL event log written by a run with "
+                                "SLT_RACECHECK=1 SLT_RACECHECK_LOG=path")
+    rc.add_argument("--json", action="store_true",
+                    help="machine-readable race list on stdout")
+    rc.add_argument("--include-allowlisted", action="store_true",
+                    help="also report races the racecheck ALLOWLIST "
+                         "suppresses (with their justifications)")
+    rc.set_defaults(fn=cmd_race)
 
     ch = sub.add_parser("chaos",
                         help="fault-injection chaos harness: run a "

@@ -120,6 +120,11 @@ class FleetAutoscaler:
         self._last_out = -1e18
         self._last_in = -1e18
         self._calm_since: Optional[float] = None
+        # Serializes policy evaluations: tick() is entered both by the
+        # background _run loop and directly (tests, manual kicks); two
+        # concurrent ticks passing the same cooldown check would both
+        # scale out.
+        self._tick_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.events: List[dict] = []  # (direction, t, n) audit trail
@@ -144,6 +149,10 @@ class FleetAutoscaler:
             firing = self._relevant(self.alerts_fn())
         except Exception:
             firing = []  # an unreachable alert source never scales
+        with self._tick_lock:
+            return self._tick_locked(now, firing)
+
+    def _tick_locked(self, now: float, firing: List[dict]) -> Optional[str]:
         n = self.launcher.n_replicas()
         action = None
         critical = any(a.get("severity") == "critical" for a in firing)

@@ -353,9 +353,13 @@ class FleetRouter:
             draining = bool(rep.get("draining"))
             kv = rep.get("kv")
             if isinstance(kv, dict) and kv.get("blocks_total"):
-                r.kv_free_frac = (kv.get("blocks_free", 0)
-                                  / max(kv["blocks_total"], 1))
-                r.prefix_hit_rate = kv.get("prefix_hit_rate")
+                # Under _lock like every other Replica-field mutation:
+                # _pick/_kv_pressure read these mid-iteration and a torn
+                # probe write could shed on a half-updated fraction.
+                with self._lock:
+                    r.kv_free_frac = (kv.get("blocks_free", 0)
+                                      / max(kv["blocks_total"], 1))
+                    r.prefix_hit_rate = kv.get("prefix_hit_rate")
         except (OSError, ValueError) as e:
             return False, False, f"{type(e).__name__}: {e}"
         if r.metrics_addr:

@@ -687,9 +687,13 @@ class HealthEngine:
         """Direct _fire/_calm callers (tests, future manual injectors) get
         synchronous emission; inside a tick the flush waits for the lock
         to drop."""
-        if self._in_tick or not self._pending_actions:
-            return
-        actions, self._pending_actions = self._pending_actions, []
+        # The check-and-swap of _pending_actions must be one atomic step
+        # under _lock: racing sample_once() also swaps it, and an
+        # unlocked swap could drop (or double-emit) staged actions.
+        with self._lock:
+            if self._in_tick or not self._pending_actions:
+                return
+            actions, self._pending_actions = self._pending_actions, []
         self._flush_actions(now, actions)
 
     def _flush_actions(self, now: float, actions: List[tuple]):

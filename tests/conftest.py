@@ -41,8 +41,29 @@ if _LOCKCHECK:
 
     _lockcheck.install()
 
+# -- runtime happens-before race detection (SLT_RACECHECK=1) ------------------
+#
+# The dynamic half of SLT007 (analysis/racecheck.py): vector clocks over
+# lock acquire/release, Thread start/join and queue/Event handoffs, plus
+# sampled attribute-write instrumentation on the fleet/gossip/kvcache/
+# health classes. Unordered write/write (and, with SLT_RACECHECK_READS=1,
+# read/write) pairs fail the session with both stacks.
+
+_RACECHECK = os.environ.get("SLT_RACECHECK", "") == "1"
+if _RACECHECK:
+    from serverless_learn_tpu.analysis import racecheck as _racecheck
+
+    _racecheck.install()
+
 
 def pytest_sessionfinish(session, exitstatus):
+    if _RACECHECK:
+        rmon = _racecheck.monitor()
+        print(f"\n{rmon.report()}")
+        rmon.close_log()
+        if rmon.races():
+            pytest.exit("racecheck: unordered conflicting accesses "
+                        "observed (see report above)", returncode=4)
     if not _LOCKCHECK:
         return
     mon = _lockcheck.monitor()

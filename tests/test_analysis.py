@@ -20,7 +20,10 @@ from serverless_learn_tpu.analysis.rules import (RULES, slt001_lock_order,
                                                  slt003_jit_purity,
                                                  slt004_thread_lifecycle,
                                                  slt005_proto_compat,
-                                                 slt006_config_drift)
+                                                 slt006_config_drift,
+                                                 slt007_guarded_by,
+                                                 slt008_resource_lifecycle,
+                                                 slt009_atomicity)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -348,6 +351,298 @@ def test_slt006_unknown_committed_config_key(tmp_path):
 
 # -- engine: baseline + CLI --------------------------------------------------
 
+# -- SLT007: guarded-by inference --------------------------------------------
+
+_GUARDED_BY_FIXTURE = """\
+    import threading
+
+    class Stats:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self._loop, daemon=True)
+            self._t.start()
+
+        def _loop(self):
+            while True:
+                with self._lock:
+                    self.count += 1
+
+        def snapshot(self):
+            with self._lock:
+                return self.count
+
+        def reset(self):
+            self.count = 0
+    """
+
+
+def test_slt007_unguarded_write_to_disciplined_attr(tmp_path):
+    root = _tree(tmp_path, {"serverless_learn_tpu/m.py":
+                            _GUARDED_BY_FIXTURE})
+    fs = _run_rule(slt007_guarded_by, root)
+    assert len(fs) == 1, fs
+    assert "Stats.count" in fs[0].message and "reset()" in fs[0].message
+    assert "_lock" in fs[0].message
+
+
+def test_slt007_locked_write_passes(tmp_path):
+    fixed = _GUARDED_BY_FIXTURE.replace(
+        "        def reset(self):\n"
+        "            self.count = 0",
+        "        def reset(self):\n"
+        "            with self._lock:\n"
+        "                self.count = 0")
+    assert fixed != _GUARDED_BY_FIXTURE
+    root = _tree(tmp_path, {"serverless_learn_tpu/m.py": fixed})
+    assert _run_rule(slt007_guarded_by, root) == []
+
+
+def test_slt007_init_and_single_thread_exempt(tmp_path):
+    # No Thread in the module -> out of scope; __init__ writes never
+    # count; a locally-constructed object's writes never count.
+    root = _tree(tmp_path, {"serverless_learn_tpu/m.py": """\
+        import threading
+
+        class Quiet:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0
+
+            def bump(self):
+                with self._lock:
+                    self.x += 1
+
+            def rebuild(self):
+                q = Quiet()
+                q.x = 9
+                return q
+        """})
+    assert _run_rule(slt007_guarded_by, root) == []
+
+
+def test_slt007_locked_suffix_convention_respected(tmp_path):
+    fixed = _GUARDED_BY_FIXTURE.replace(
+        "        def reset(self):", "        def reset_locked(self):")
+    assert fixed != _GUARDED_BY_FIXTURE
+    root = _tree(tmp_path, {"serverless_learn_tpu/m.py": fixed})
+    assert _run_rule(slt007_guarded_by, root) == []
+
+
+# -- SLT008: resource lifecycle ----------------------------------------------
+
+def test_slt008_refcount_leak_by_construction(tmp_path):
+    # BlockPool-like: a class that increfs and never releases.
+    root = _tree(tmp_path, {"serverless_learn_tpu/m.py": """\
+        class Registry:
+            def __init__(self, pool):
+                self.pool = pool
+                self.held = []
+
+            def register(self, bid):
+                self.pool.incref(bid)
+                self.held.append(bid)
+        """})
+    fs = _run_rule(slt008_resource_lifecycle, root)
+    assert any("Registry" in f.message and "refcount leak" in f.message
+               for f in fs), fs
+
+
+def test_slt008_balanced_refcounts_pass(tmp_path):
+    root = _tree(tmp_path, {"serverless_learn_tpu/m.py": """\
+        class Registry:
+            def __init__(self, pool):
+                self.pool = pool
+                self.held = []
+
+            def register(self, bid):
+                self.pool.incref(bid)
+                self.held.append(bid)
+
+            def release(self, bid):
+                self.held.remove(bid)
+                self.pool.decref(bid)
+        """})
+    assert _run_rule(slt008_resource_lifecycle, root) == []
+
+
+def test_slt008_exception_edge_leak(tmp_path):
+    # incref'd refs unrecorded when a later alloc can raise = leak edge.
+    root = _tree(tmp_path, {"serverless_learn_tpu/m.py": """\
+        class Cache:
+            def admit(self, ids):
+                shared = self.trie.lookup(ids)
+                self.pool.incref(shared)
+                fresh = self.pool.alloc(4)
+                self.pages = (shared, fresh)
+
+            def evict(self):
+                self.pool.decref(self.pages)
+        """})
+    fs = _run_rule(slt008_resource_lifecycle, root)
+    assert any("exception edge" in f.message and "incref" in f.message
+               for f in fs), fs
+
+
+def test_slt008_guarded_exception_edge_passes(tmp_path):
+    # try/except around the fallible window discharges the obligation:
+    # the handler is where the incref'd refs get returned.
+    root = _tree(tmp_path, {"serverless_learn_tpu/m.py": """\
+        class Cache:
+            def admit(self, ids):
+                shared = self.trie.lookup(ids)
+                self.pool.incref(shared)
+                try:
+                    fresh = self.pool.alloc(4)
+                except Exception:
+                    self.pool.decref(shared)
+                    raise
+                self.pages = (shared, fresh)
+
+            def evict(self):
+                self.pool.decref(self.pages)
+        """})
+    fs = _run_rule(slt008_resource_lifecycle, root)
+    assert not any("exception edge" in f.message for f in fs), fs
+
+
+def test_slt008_socket_never_closed(tmp_path):
+    root = _tree(tmp_path, {"serverless_learn_tpu/m.py": """\
+        import socket
+
+        def probe(addr):
+            s = socket.create_connection(addr)
+            s.sendall(b"ping")
+            return True
+        """})
+    fs = _run_rule(slt008_resource_lifecycle, root)
+    assert any("never closed" in f.message for f in fs), fs
+
+
+def test_slt008_closed_managed_or_escaping_sockets_pass(tmp_path):
+    root = _tree(tmp_path, {"serverless_learn_tpu/m.py": """\
+        import socket
+
+        def probe(addr):
+            s = socket.create_connection(addr)
+            try:
+                s.sendall(b"ping")
+            finally:
+                s.close()
+
+        def managed(addr):
+            with socket.create_connection(addr) as s:
+                s.sendall(b"ping")
+
+        def dialed(addr):
+            return socket.create_connection(addr)
+
+        class Holder:
+            def connect(self, addr):
+                self._sock = socket.create_connection(addr)
+
+            def close(self):
+                self._sock.close()
+        """})
+    assert _run_rule(slt008_resource_lifecycle, root) == []
+
+
+def test_slt008_self_stored_socket_needs_teardown(tmp_path):
+    root = _tree(tmp_path, {"serverless_learn_tpu/m.py": """\
+        import socket
+
+        class Holder:
+            def connect(self, addr):
+                self._sock = socket.create_connection(addr)
+        """})
+    fs = _run_rule(slt008_resource_lifecycle, root)
+    assert any("never closes" in f.message and "_sock" in f.message
+               for f in fs), fs
+
+
+# -- SLT009: atomicity (check-then-act) --------------------------------------
+
+_CHECK_THEN_ACT_FIXTURE = """\
+    import threading
+
+    class Cooldown:
+        def __init__(self):
+            self.last = -1.0
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            self.tick(0.0)
+
+        def tick(self, now):
+            if now - self.last > 5.0:
+                self.last = now
+    """
+
+
+def test_slt009_check_then_act_fires(tmp_path):
+    root = _tree(tmp_path, {"serverless_learn_tpu/m.py":
+                            _CHECK_THEN_ACT_FIXTURE})
+    fs = _run_rule(slt009_atomicity, root)
+    assert len(fs) == 1, fs
+    assert "Cooldown.last" in fs[0].message
+    assert "tick()" in fs[0].message
+
+
+def test_slt009_locked_check_then_act_passes(tmp_path):
+    fixed = _CHECK_THEN_ACT_FIXTURE.replace(
+        "            self.last = -1.0",
+        "            self.last = -1.0\n"
+        "            self._lock = threading.Lock()").replace(
+        "        def tick(self, now):\n"
+        "            if now - self.last > 5.0:\n"
+        "                self.last = now",
+        "        def tick(self, now):\n"
+        "            with self._lock:\n"
+        "                if now - self.last > 5.0:\n"
+        "                    self.last = now")
+    root = _tree(tmp_path, {"serverless_learn_tpu/m.py": fixed})
+    assert _run_rule(slt009_atomicity, root) == []
+
+
+def test_slt009_double_checked_locking_not_flagged(tmp_path):
+    root = _tree(tmp_path, {"serverless_learn_tpu/m.py": """\
+        import threading
+
+        class Lazy:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.cache = None
+                self._t = threading.Thread(target=self.get, daemon=True)
+                self._t.start()
+
+            def get(self):
+                if self.cache is None:
+                    with self._lock:
+                        if self.cache is None:
+                            self.cache = object()
+                return self.cache
+        """})
+    assert _run_rule(slt009_atomicity, root) == []
+
+
+def test_slt009_single_thread_class_not_flagged(tmp_path):
+    # Same shape, but no thread entry points and no inferred guard:
+    # no concurrency evidence, no finding.
+    root = _tree(tmp_path, {"serverless_learn_tpu/m.py": """\
+        import threading
+
+        def spawn():
+            threading.Thread(target=print, daemon=True).start()
+
+        class Local:
+            def tick(self, now):
+                if now - self.last > 5.0:
+                    self.last = now
+        """})
+    assert _run_rule(slt009_atomicity, root) == []
+
+
 _SEEDED = {
     # one seeded defect per acceptance bullet
     "serverless_learn_tpu/locks.py": """\
@@ -385,6 +680,14 @@ _SEEDED = {
           string b = 1;
         }
         """,
+    "serverless_learn_tpu/guarded.py": _GUARDED_BY_FIXTURE,
+    "serverless_learn_tpu/leak.py": """\
+        class Registry:
+            def register(self, bid):
+                self.pool.incref(bid)
+                self.held.append(bid)
+        """,
+    "serverless_learn_tpu/cooldown.py": _CHECK_THEN_ACT_FIXTURE,
 }
 
 
@@ -393,7 +696,8 @@ def test_seeded_defects_fail_the_check(tmp_path):
     rep = run_check(root, baseline_path="baseline.json")
     assert not rep["ok"]
     rules_hit = {f["rule"] for f in rep["findings"]}
-    assert {"SLT001", "SLT002", "SLT003", "SLT005"} <= rules_hit
+    assert {"SLT001", "SLT002", "SLT003", "SLT005",
+            "SLT007", "SLT008", "SLT009"} <= rules_hit
 
 
 def test_baseline_roundtrip(tmp_path):
@@ -460,6 +764,113 @@ def test_repo_at_head_is_clean():
     assert rep["counts"]["stale_baseline_entries"] == 0
     for entry in baseline.values():
         assert not entry["justification"].startswith("TODO"), entry
+
+
+def test_update_baseline_prunes_fixed_defects(tmp_path):
+    """Satellite: a removed defect's suppression must not outlive it."""
+    root = _tree(tmp_path, _SEEDED)
+    rep = run_check(root, baseline_path="baseline.json",
+                    update_baseline=True)
+    assert rep["ok"]
+    from serverless_learn_tpu.analysis.engine import load_baseline
+
+    before = load_baseline(str(tmp_path / "baseline.json"))
+    lock_fps = {fp for fp, e in before.items() if e["rule"] == "SLT001"}
+    assert lock_fps
+    # Fix the lock-order defect, then update again: its entries vanish,
+    # the others survive with their justifications intact.
+    (tmp_path / "serverless_learn_tpu" / "locks.py").write_text(
+        "X = 1\n")
+    rep2 = run_check(root, baseline_path="baseline.json",
+                     update_baseline=True)
+    assert rep2["ok"]
+    after = load_baseline(str(tmp_path / "baseline.json"))
+    assert not (lock_fps & set(after)), "fixed defect's entry survived"
+    assert any(e["rule"] == "SLT009" for e in after.values())
+
+
+def test_update_baseline_preserves_unselected_rules(tmp_path):
+    """--rule SLTxxx --update-baseline must not drop entries of rules
+    that did not run (no evidence either way)."""
+    root = _tree(tmp_path, _SEEDED)
+    run_check(root, baseline_path="baseline.json", update_baseline=True)
+    from serverless_learn_tpu.analysis.engine import load_baseline
+
+    before = load_baseline(str(tmp_path / "baseline.json"))
+    run_check(root, rule_ids=["SLT003"], baseline_path="baseline.json",
+              update_baseline=True)
+    after = load_baseline(str(tmp_path / "baseline.json"))
+    assert set(after) == set(before)
+
+
+def test_discovery_skips_pycache_and_gen_trees(tmp_path):
+    root = _tree(tmp_path, {
+        "serverless_learn_tpu/ok.py": "X = 1\n",
+        "serverless_learn_tpu/__pycache__/junk.py": "import threading\n",
+        "serverless_learn_tpu/gen/slt_pb2.py": "this is not python(\n",
+    })
+    proj = discover(root)
+    assert [f.path for f in proj.files] == ["serverless_learn_tpu/ok.py"]
+
+
+def _git(tmp_path, *args):
+    import subprocess
+
+    return subprocess.run(["git", "-C", str(tmp_path)] + list(args),
+                          capture_output=True, text=True, check=True)
+
+
+def test_changed_only_scopes_per_file_rules(tmp_path):
+    """Satellite: --changed-only runs per-file rules on git-changed files
+    only; project-scoped rules still see the full tree; --update-baseline
+    refuses to run from a subset."""
+    _tree(tmp_path, {"serverless_learn_tpu/clean.py": "X = 1\n"})
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+         "add", "-A")
+    _git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "seed")
+    # A new (untracked) defective file + an untouched committed file.
+    _tree(tmp_path, {"serverless_learn_tpu/new.py": """\
+        import threading
+        import time
+
+        L = threading.Lock()
+
+        def f():
+            with L:
+                time.sleep(9)
+        """})
+    rep = run_check(str(tmp_path), baseline_path="baseline.json",
+                    changed_only=True)
+    assert rep["changed_only"] is True
+    assert rep["files_scanned"] == 1
+    # Per-file findings come from the changed file only (project-scoped
+    # rules — here SLT005's missing-proto warning — still run on the
+    # full tree and are unaffected by the scoping).
+    per_file = [f for f in rep["findings"] if f["rule"] == "SLT001"]
+    assert per_file and {f["path"] for f in per_file} == \
+        {"serverless_learn_tpu/new.py"}
+    with pytest.raises(ValueError):
+        run_check(str(tmp_path), baseline_path="baseline.json",
+                  changed_only=True, update_baseline=True)
+    # Nothing changed -> nothing scanned, no per-file findings.
+    _git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+         "add", "-A")
+    _git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "new file")
+    rep2 = run_check(str(tmp_path), baseline_path="baseline.json",
+                     changed_only=True)
+    assert rep2["files_scanned"] == 0
+    assert not any(f["rule"] == "SLT001" for f in rep2["findings"])
+
+
+def test_changed_only_without_git_falls_back_to_full_scan(tmp_path):
+    root = _tree(tmp_path, {"serverless_learn_tpu/m.py": "X = 1\n"})
+    rep = run_check(root, baseline_path="baseline.json",
+                    changed_only=True)
+    assert rep["changed_only"] is False
+    assert rep["files_scanned"] == 1
 
 
 # -- runtime lockcheck -------------------------------------------------------

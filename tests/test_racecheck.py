@@ -1,0 +1,249 @@
+"""Tier-1 tests for the runtime happens-before race detector
+(serverless_learn_tpu/analysis/racecheck.py) and the `slt race` replay.
+
+The unit tests drive the vector-clock monitor synthetically (explicit
+thread handles, like the offline replay does) so they are deterministic
+by construction; one integration test exercises the real
+install()-patched primitives end to end and is skipped when the session
+itself runs under SLT_RACECHECK=1 (the global monitor then belongs to
+the session, not to this test).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from serverless_learn_tpu.analysis import racecheck
+from serverless_learn_tpu.analysis.racecheck import RaceMonitor
+
+
+def _w(mon, tid, var="Obj.v", obj="o1"):
+    st = mon.thread_state(tid)
+    cls, _, attr = var.rpartition(".")
+    mon.record_access((obj, attr), cls, attr, st, is_write=True,
+                      stack=[f"{tid}.py:1 in w"], thread_name=tid)
+
+
+def _r(mon, tid, var="Obj.v", obj="o1"):
+    st = mon.thread_state(tid)
+    cls, _, attr = var.rpartition(".")
+    mon.record_access((obj, attr), cls, attr, st, is_write=False,
+                      stack=[f"{tid}.py:2 in r"], thread_name=tid)
+
+
+# -- vector-clock core -------------------------------------------------------
+
+def test_unordered_write_write_is_a_race():
+    mon = RaceMonitor("unit")
+    _w(mon, "t1")
+    _w(mon, "t2")
+    races = mon.races()
+    assert len(races) == 1
+    r = races[0]
+    assert r["kind"] == "write/write"
+    assert r["class"] == "Obj" and r["attr"] == "v"
+    assert r["first"]["stack"] and r["second"]["stack"]
+
+
+def test_lock_edge_orders_the_writes():
+    mon = RaceMonitor("unit")
+    st1, st2 = mon.thread_state("t1"), mon.thread_state("t2")
+    mon.acquire_from("lock:L", st1)
+    _w(mon, "t1")
+    mon.publish("lock:L", st1)
+    mon.acquire_from("lock:L", st2)   # joins t1's release clock
+    _w(mon, "t2")
+    mon.publish("lock:L", st2)
+    assert mon.races() == []
+
+
+def test_unordered_read_write_is_a_race():
+    mon = RaceMonitor("unit")
+    _w(mon, "t1")
+    # Order the second thread AFTER the write via a channel, then read —
+    # clean; a third thread's unordered read against a later write races.
+    st1 = mon.thread_state("t1")
+    mon.publish("q", st1)
+    st2 = mon.thread_state("t2")
+    mon.acquire_from("q", st2)
+    _r(mon, "t2")
+    assert mon.races() == []
+    _w(mon, "t3")                      # unordered vs t2's read
+    races = mon.races()
+    assert len(races) >= 1
+    assert any(r["kind"] in ("read/write", "write/write") for r in races)
+
+
+def test_distinct_objects_do_not_conflate():
+    mon = RaceMonitor("unit")
+    _w(mon, "t1", obj="o1")
+    _w(mon, "t2", obj="o2")            # different creation identity
+    assert mon.races() == []
+
+
+def test_allowlist_suppresses_with_justification():
+    mon = RaceMonitor("unit")
+    _w(mon, "t1", var="PrefixTrie.hits")
+    _w(mon, "t2", var="PrefixTrie.hits")
+    assert mon.races() == []           # allowlisted by default
+    allowed = mon.races(include_allowlisted=True)
+    assert len(allowed) == 1 and allowed[0]["allowlisted"]
+    assert ("PrefixTrie", "hits") in racecheck.ALLOWLIST
+
+
+# -- event log + offline replay (slt race) -----------------------------------
+
+def test_replay_log_reproduces_the_race(tmp_path):
+    log = tmp_path / "access.jsonl"
+    mon = RaceMonitor("rec", log_path=str(log))
+    _w(mon, "t1")
+    _w(mon, "t2")
+    mon.close_log()
+    assert len(mon.races()) == 1
+
+    replayed = racecheck.replay_log(str(log))
+    races = replayed.races()
+    assert len(races) == 1
+    assert races[0]["class"] == "Obj" and races[0]["attr"] == "v"
+
+
+def test_replay_log_clean_run_is_clean(tmp_path):
+    log = tmp_path / "access.jsonl"
+    recs = [
+        {"op": "acquire", "ch": "lock:L", "t": "t1"},
+        {"op": "write", "var": "Obj.v", "obj": "o1", "t": "t1",
+         "stack": ["a.py:1 in w"]},
+        {"op": "publish", "ch": "lock:L", "t": "t1"},
+        {"op": "acquire", "ch": "lock:L", "t": "t2"},
+        {"op": "write", "var": "Obj.v", "obj": "o1", "t": "t2",
+         "stack": ["a.py:2 in w"]},
+        {"op": "publish", "ch": "lock:L", "t": "t2"},
+        {"malformed": True},           # unknown shapes are skipped
+    ]
+    log.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    assert racecheck.replay_log(str(log)).races() == []
+
+
+def test_cli_race_replay(tmp_path, capsys):
+    from serverless_learn_tpu.cli import main
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("".join(json.dumps(r) + "\n" for r in [
+        {"op": "write", "var": "Foo.x", "obj": "o1", "t": "t1",
+         "stack": ["a.py:1 in w1"]},
+        {"op": "write", "var": "Foo.x", "obj": "o1", "t": "t2",
+         "stack": ["a.py:9 in w2"]},
+    ]))
+    rc = main(["race", str(bad), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 2 and out["ok"] is False and len(out["races"]) == 1
+
+    good = tmp_path / "good.jsonl"
+    good.write_text(json.dumps(
+        {"op": "write", "var": "Foo.x", "obj": "o1", "t": "t1",
+         "stack": []}) + "\n")
+    assert main(["race", str(good)]) == 0
+
+
+# -- live instrumentation ----------------------------------------------------
+
+@pytest.mark.skipif(racecheck.enabled_by_env() or racecheck.installed(),
+                    reason="session-global monitor belongs to the session")
+def test_install_catches_seeded_unguarded_write_and_respects_locks():
+    """End to end: install() patches Thread/queue/Event + lockcheck
+    listeners; a class with two threads writing the same attribute
+    lock-free races, the same writes under an instrumented lock do not."""
+    mon = racecheck.install()
+    mon.reset()
+    try:
+        from serverless_learn_tpu.analysis import lockcheck
+
+        class Shared:
+            pass
+
+        racecheck.instrument_class(Shared, mon)
+
+        # seeded race: two threads, no synchronization
+        obj = Shared()
+        obj.v = 0
+
+        def bump():
+            for _ in range(3):
+                obj.v += 1
+                time.sleep(0.001)
+
+        ts = [threading.Thread(target=bump) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        races = mon.races()
+        assert any(r["class"].endswith("Shared") and r["attr"] == "v"
+                   for r in races), races
+
+        # clean under a (lockcheck-instrumented) lock
+        mon.reset()
+        lk = lockcheck.monitor().wrap(site="test_racecheck.py:guard")
+        obj2 = Shared()
+        with lk:
+            obj2.v = 0
+
+        def bump_locked():
+            for _ in range(3):
+                with lk:
+                    obj2.v += 1
+
+        ts = [threading.Thread(target=bump_locked) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert [r for r in mon.races()
+                if r["class"].endswith("Shared")] == [], mon.report()
+    finally:
+        mon.reset()
+        racecheck.uninstall()
+
+
+@pytest.mark.skipif(racecheck.enabled_by_env() or racecheck.installed(),
+                    reason="session-global monitor belongs to the session")
+def test_install_queue_handoff_is_an_edge():
+    """Producer writes, consumer reads after q.get(): the put/get pair
+    publishes the producer's clock, so the pair is ordered — no race."""
+    import queue
+
+    mon = racecheck.install()
+    mon.reset()
+    try:
+        class Box:
+            pass
+
+        racecheck.instrument_class(Box, mon)
+        q = queue.Queue()
+
+        def produce():
+            b = Box()
+            b.payload = 42
+            q.put(b)
+
+        got = []
+
+        def consume():
+            b = q.get()
+            got.append(b.payload)
+            b.payload = 43         # ordered after the producer's write
+
+        t1 = threading.Thread(target=produce)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=consume)
+        t2.start()
+        t2.join()
+        assert got == [42]
+        assert [r for r in mon.races()
+                if r["class"].endswith("Box")] == [], mon.report()
+    finally:
+        mon.reset()
+        racecheck.uninstall()
