@@ -423,6 +423,11 @@ DEFAULT_MODULES = (
     "serverless_learn_tpu.inference.kvcache",
     "serverless_learn_tpu.telemetry.health",
     "serverless_learn_tpu.chaos.shim",
+    # round 15: the replication tier's push thread shares ReplicatedStore
+    # state with the training thread; the Checkpointer shares its pending
+    # upload + emergency-save fields with flight's death path.
+    "serverless_learn_tpu.training.replicate",
+    "serverless_learn_tpu.training.checkpoint",
 )
 
 

@@ -10,6 +10,8 @@ A plan is a JSON object::
       {"at": 30.0, "op": "delay",     "s": 0.05, "jitter": 0.02},
       {"at": 30.0, "op": "drop",      "rate": 0.2},
       {"at": 35.0, "op": "skew",      "node": "node-1", "offset_s": 1.5},
+      {"at": 38.0, "op": "corrupt",   "scope": "store"},
+      {"at": 39.0, "op": "truncate",  "scope": "everywhere"},
       {"at": 40.0, "op": "heal"}
     ]}
 
@@ -31,9 +33,16 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 OPS = ("kill", "restart", "partition", "heal", "drop", "delay", "pause",
-       "skew")
+       "skew", "corrupt", "truncate")
 
 _SELECTOR_OPS = ("kill", "restart", "pause", "skew")
+
+# corrupt/truncate (round 15, `slt chaos recover`): damage the newest
+# committed checkpoint's payload. "scope" picks which replicas: "store"
+# (central store only — an intact local cache/peer heals it), "local"
+# (store + the worker's cache; a peer replica still heals it) or
+# "everywhere" (every copy; restore must quarantine and fall back).
+_CORRUPT_SCOPES = ("store", "local", "everywhere")
 
 
 @dataclass(frozen=True)
@@ -50,6 +59,7 @@ class Fault:
     s: Optional[float] = None         # added one-way delay
     jitter: Optional[float] = None
     offset_s: Optional[float] = None  # clock skew
+    scope: Optional[str] = None       # corrupt/truncate: which replicas
 
     def describe(self) -> str:
         sel = (self.node or
@@ -99,7 +109,7 @@ class FaultPlan:
         if not isinstance(at, (int, float)) or isinstance(at, bool) or at < 0:
             bad("'at' must be a non-negative number of virtual seconds")
         known = {"at", "op", "node", "frac", "count", "for", "split",
-                 "groups", "rate", "s", "jitter", "offset_s"}
+                 "groups", "rate", "s", "jitter", "offset_s", "scope"}
         unknown = set(f) - known
         if unknown:
             bad(f"unknown keys {sorted(unknown)}")
@@ -158,6 +168,12 @@ class FaultPlan:
             bad("'skew' needs 'offset_s'")
         if op == "pause" and dur is None:
             bad("'pause' needs 'for' (how long the process stalls)")
+        scope = f.get("scope")
+        if op in ("corrupt", "truncate"):
+            if scope is not None and scope not in _CORRUPT_SCOPES:
+                bad(f"'scope' must be one of {_CORRUPT_SCOPES}")
+        elif scope is not None:
+            bad("'scope' only applies to corrupt/truncate")
 
         return Fault(at=float(at), op=op, node=node,
                      frac=None if frac is None else float(frac),
@@ -167,7 +183,8 @@ class FaultPlan:
                      groups=groups, rate=None if rate is None else float(rate),
                      s=None if s is None else float(s),
                      jitter=None if jitter is None else float(jitter),
-                     offset_s=None if off is None else float(off))
+                     offset_s=None if off is None else float(off),
+                     scope=scope)
 
     def end_time(self) -> float:
         """When the last fault (including its 'for' window) is over."""

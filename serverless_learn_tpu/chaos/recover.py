@@ -1,0 +1,518 @@
+"""`slt chaos recover`: FaultPlan-driven crash/recovery proof for the
+training-state layer, with measured RPO and RTO.
+
+``chaos/sim.py`` proves the MEMBERSHIP plane converges under churn; this
+harness proves the STATE plane recovers: it drives the REAL round-15
+checkpoint stack (``training/checkpoint.py`` verified restores +
+quarantine/fallback, ``training/replicate.py`` local-cache + peer
+replicas, ``LocalStore`` orphan-tmp sweep) through injected deaths and
+data damage, then asserts the recovery contract:
+
+* **bounded RPO** — a worker killed mid-run (or mid-save: the harness
+  strands a partial ``.tmp`` write like a real crashed writer) resumes
+  with steps-lost ≤ the checkpoint interval; a checkpoint corrupted in
+  SOME replicas is healed by any intact copy of the same step (RPO bound
+  unchanged), and one corrupted EVERYWHERE is quarantined with fallback
+  to the previous verified step (bound widens by exactly one interval
+  per quarantined step — reported, never silent);
+* **measured RTO** — per incident, the wall-clock restore cost
+  (``slt_recovery_rto_seconds``) plus the virtual time from death to
+  resumed stepping;
+* **no garbage** — every restored state is re-derived from its step and
+  compared; a mismatch is a violation (the verified-restore contract is
+  that corruption raises ``CheckpointCorrupt``, never loads);
+* **attributable** — ground-truth ``fault_injected`` records and
+  health-engine-shaped ``alert`` / ``recovery`` records land in one
+  JSONL events log, from which ``slt doctor`` names every incident
+  (cause, RPO, RTO) with no access to the harness.
+
+Time is VIRTUAL (one event loop, ``step_interval_s`` per step) so the
+same (plan, seed) is deterministic; only the store I/O itself — the
+thing RTO measures — runs on the real clock. ``store_latency_s`` adds
+synthetic per-read latency to the CENTRAL store only, which is how the
+acceptance test shows the peer/cache path measurably shrinking restore
+time against a slow store.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import tempfile
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from serverless_learn_tpu.chaos.plan import Fault, FaultPlan
+from serverless_learn_tpu.telemetry import get_registry
+
+SIM_EPOCH = 1_700_000_000.0  # deterministic unix base for emitted records
+
+_SUPPORTED = ("kill", "restart", "partition", "heal", "corrupt", "truncate")
+
+
+class _SimulatedDeath(Exception):
+    """Raised inside a store op to model a worker dying mid-save."""
+
+
+class _ChaosStore:
+    """Wraps the central store: injectable partition windows, per-read
+    latency, and die-mid-put (which strands a partial ``.tmp`` file under
+    a synthetic dead pid — exactly the debris a crashed writer leaves,
+    and what ``LocalStore._sweep_orphan_tmp`` must clean on reboot)."""
+
+    DEAD_PID = 99999999  # no real pid: the sweep sees a dead writer
+
+    def __init__(self, inner, latency_s: float = 0.0):
+        self.inner = inner
+        self.latency_s = latency_s
+        self.partitioned = False
+        self.die_on_next_put = False
+
+    def _check(self):
+        if self.partitioned:
+            raise ConnectionError("central store partitioned (injected)")
+
+    def _lag(self):
+        if self.latency_s:
+            time.sleep(self.latency_s)
+
+    def put(self, key: str, data: bytes):
+        self._check()
+        if self.die_on_next_put:
+            self.die_on_next_put = False
+            # Half the payload into a tmp file no rename will ever commit.
+            path = os.path.join(self.inner.root, key)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path + f".tmp.{self.DEAD_PID}", "wb") as f:
+                f.write(data[:max(1, len(data) // 2)])
+            raise _SimulatedDeath(key)
+        self.inner.put(key, data)
+
+    def get(self, key: str) -> bytes:
+        self._check()
+        self._lag()
+        return self.inner.get(key)
+
+    def get_range(self, key: str, offset: int, length: int) -> bytes:
+        self._check()
+        self._lag()
+        return self.inner.get_range(key, offset, length)
+
+    def exists(self, key: str) -> bool:
+        self._check()
+        return self.inner.exists(key)
+
+    def list(self, prefix: str):
+        self._check()
+        return self.inner.list(prefix)
+
+    def delete(self, key: str):
+        self._check()
+        self.inner.delete(key)
+
+
+def default_plan() -> FaultPlan:
+    """The smoke schedule: kill mid-run, corrupt (peer heals it), kill
+    mid-save, and a kill under a store partition — each followed by a
+    restart that must recover within the RPO bound."""
+    return FaultPlan.from_obj({"faults": [
+        {"at": 3.0, "op": "kill", "node": "worker"},
+        {"at": 3.4, "op": "restart", "node": "worker"},
+        {"at": 5.0, "op": "corrupt", "scope": "local"},
+        {"at": 5.2, "op": "kill", "node": "worker"},
+        {"at": 5.6, "op": "restart", "node": "worker"},
+        {"at": 8.0, "op": "kill", "node": "worker-midsave"},
+        {"at": 8.6, "op": "restart", "node": "worker"},
+        {"at": 10.0, "op": "partition", "for": 1.5},
+        {"at": 10.2, "op": "kill", "node": "worker"},
+        {"at": 10.6, "op": "restart", "node": "worker"},
+    ]})
+
+
+class RecoveryRun:
+    """One seeded recovery simulation over the real checkpoint stack."""
+
+    def __init__(self, seed: int = 0, steps: int = 260,
+                 checkpoint_every: int = 20, step_interval_s: float = 0.05,
+                 plan: Optional[FaultPlan] = None,
+                 events_log: Optional[str] = None,
+                 store_latency_s: float = 0.0, peer_cache: bool = True,
+                 keep: int = 4, root: Optional[str] = None):
+        self.seed = seed
+        self.steps = int(steps)
+        self.every = int(checkpoint_every)
+        self.dt = float(step_interval_s)
+        self.plan = plan or default_plan()
+        for f in self.plan.faults:
+            if f.op not in _SUPPORTED:
+                raise ValueError(f"chaos recover supports ops {_SUPPORTED}; "
+                                 f"plan uses {f.op!r}")
+        self.events_log = events_log
+        self.store_latency_s = float(store_latency_s)
+        self.peer_cache = peer_cache
+        self.keep = keep
+        self._own_root = root is None
+        self.root = root or tempfile.mkdtemp(prefix="slt-recover-")
+        self.rng = np.random.default_rng(abs(hash(f"recover-{seed}")) %
+                                         (2 ** 32))
+        self._base = np.arange(64, dtype=np.float32) * 0.5 + float(seed % 7)
+
+        self._events: List[dict] = []
+        self.incidents: List[dict] = []
+        self.violations: List[str] = []
+        self.saves = 0
+        self.missed_saves = 0
+        self.tmp_swept = 0
+        reg = get_registry()
+        self._m_incidents = reg.counter(
+            "slt_recovery_incidents_total",
+            "worker deaths recovered by checkpoint restore")
+        self._m_rto = reg.histogram(
+            "slt_recovery_rto_seconds",
+            "wall-clock restore cost per recovery incident")
+        self._m_rpo = reg.gauge(
+            "slt_recovery_rpo_steps",
+            "steps lost in the most recent recovery incident")
+        self._c_corrupt = reg.counter("slt_ckpt_corrupt_total")
+        self._c_peer = reg.counter("slt_ckpt_peer_restores_total")
+
+        # live run state
+        self.now = 0.0
+        self.step = 0
+        self.alive = True
+        self._death: Optional[dict] = None
+        self._midsave_armed = False
+        self._ckpt = None
+        self._store: Optional[_ChaosStore] = None
+
+    # -- state model --------------------------------------------------------
+
+    def _make_state(self, step: int) -> dict:
+        return {"step": np.asarray(step, np.int64),
+                "w": self._base + np.float32(step)}
+
+    def _template(self) -> dict:
+        return {"step": np.asarray(0, np.int64),
+                "w": np.zeros_like(self._base)}
+
+    def _state_ok(self, state: dict) -> bool:
+        s = int(np.asarray(state["step"]))
+        return bool(np.array_equal(np.asarray(state["w"]),
+                                   self._base + np.float32(s)))
+
+    # -- stores / worker ----------------------------------------------------
+
+    def _paths(self):
+        return (os.path.join(self.root, "store"),
+                os.path.join(self.root, "cache"),
+                os.path.join(self.root, "peer"))
+
+    def _boot_worker(self):
+        """(Re)build the worker's store stack + Checkpointer — exactly
+        what a restarted process does, including the LocalStore orphan
+        tmp sweep."""
+        from serverless_learn_tpu.training.checkpoint import (Checkpointer,
+                                                              LocalStore)
+        from serverless_learn_tpu.training.replicate import ReplicatedStore
+
+        store_dir, cache_dir, peer_dir = self._paths()
+        before = self._count_tmps(store_dir)
+        primary = LocalStore(store_dir)  # sweeps dead writers' tmp files
+        self.tmp_swept += before - self._count_tmps(store_dir)
+        chaos = _ChaosStore(primary, latency_s=self.store_latency_s)
+        chaos.partitioned = getattr(self, "_partitioned", False)
+        self._store = chaos
+        if self.peer_cache:
+            store = ReplicatedStore(
+                chaos, cache=LocalStore(cache_dir),
+                peers=[LocalStore(peer_dir)], fanout=1)
+        else:
+            store = chaos
+        self._ckpt = Checkpointer(store, name="train", keep=self.keep,
+                                  async_save=False, sharded=False,
+                                  verify=True)
+
+    @staticmethod
+    def _count_tmps(root: str) -> int:
+        n = 0
+        for dirpath, _, files in os.walk(root) if os.path.isdir(root) else ():
+            n += sum(1 for fn in files if ".tmp." in fn)
+        return n
+
+    def _settle_pushes(self):
+        store = self._ckpt.store if self._ckpt is not None else None
+        if store is not None and hasattr(store, "flush"):
+            store.flush()
+
+    # -- telemetry ----------------------------------------------------------
+
+    def _emit(self, rec: dict):
+        rec = dict(rec)
+        rec.setdefault("node", "worker")
+        rec.setdefault("t_virtual_s", round(self.now, 3))
+        rec.setdefault("t_unix_s", round(SIM_EPOCH + self.now, 3))
+        self._events.append(rec)
+
+    def _alert(self, alert: str, firing: bool, severity: str, message: str,
+               **extra):
+        t = round(SIM_EPOCH + self.now, 3)
+        rec = {"event": "alert",
+               "state": "firing" if firing else "resolved",
+               "alert": alert, "severity": severity, "detector": "recover",
+               "message": message, "count": 1, "value": 1.0,
+               "threshold": 0.0, "first_fired_unix_s": t,
+               "last_fired_unix_s": t, **extra}
+        if not firing:
+            rec["resolved_unix_s"] = t
+        self._emit(rec)
+
+    def _flush_events(self):
+        if not self.events_log or not self._events:
+            return
+        with open(self.events_log, "a") as f:
+            for rec in self._events:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._events = []
+
+    # -- faults -------------------------------------------------------------
+
+    def _apply(self, f: Fault):
+        rec = {"event": "fault_injected", "op": f.op}
+        if f.op == "kill":
+            if self.alive:
+                if f.node == "worker-midsave":
+                    # Arm a death INSIDE the next checkpoint put: the
+                    # commit protocol (blob → manifest → LATEST) must
+                    # make the torn save invisible to restore.
+                    self._midsave_armed = True
+                    rec["during"] = "save"
+                else:
+                    self._die("kill")
+        elif f.op == "restart":
+            if not self.alive:
+                self._recover()
+        elif f.op == "partition":
+            if self._store is not None:
+                self._store.partitioned = True
+            self._partitioned = True
+            if f.duration:
+                self._pending.append(Fault(at=self.now + f.duration,
+                                           op="heal"))
+                self._pending.sort(key=lambda x: x.at)
+            rec["for_s"] = f.duration
+        elif f.op == "heal":
+            self._partitioned = False
+            if self._store is not None:
+                self._store.partitioned = False
+        elif f.op in ("corrupt", "truncate"):
+            rec.update(self._damage(f.op, f.scope or "local"))
+        self._emit(rec)
+
+    def _die(self, cause: str):
+        self.alive = False
+        self._death = {"cause": cause, "step": self.step,
+                       "t_virtual_s": round(self.now, 3),
+                       "corrupt_before": self._c_corrupt.value,
+                       "peer_before": self._c_peer.value}
+        if self._ckpt is not None and hasattr(self._ckpt.store, "close"):
+            self._ckpt.store.close()
+        self._ckpt = None
+        self._store = None
+        self._alert("recovery.worker_down", True, "critical",
+                    f"worker died ({cause}) at step {self.step}")
+
+    def _quarantined_steps(self) -> List[int]:
+        store_dir, cache_dir, peer_dir = self._paths()
+        out = set()
+        for base in (store_dir, cache_dir, peer_dir):
+            d = os.path.join(base, "train")
+            if not os.path.isdir(d):
+                continue
+            for fn in os.listdir(d):
+                m = re.match(r"step-(\d+)\.CORRUPT$", fn)
+                if m:
+                    out.add(int(m.group(1)))
+        return sorted(out)
+
+    def _damage(self, op: str, scope: str) -> dict:
+        """Flip a byte in (or truncate) the newest committed step's blob,
+        in the replicas the scope selects."""
+        self._settle_pushes()
+        store_dir, cache_dir, peer_dir = self._paths()
+        roots = [store_dir]
+        if scope in ("local", "everywhere") and self.peer_cache:
+            roots.append(cache_dir)
+        if scope == "everywhere" and self.peer_cache:
+            roots.append(peer_dir)
+        newest = None
+        for fn in os.listdir(os.path.join(store_dir, "train")):
+            m = re.match(r"step-(\d+)$", fn)
+            if m:
+                s = int(m.group(1))
+                if newest is None or s > newest:
+                    newest = s
+        hit = []
+        if newest is not None:
+            for base in roots:
+                path = os.path.join(base, "train", f"step-{newest:010d}")
+                if not os.path.isfile(path):
+                    continue
+                size = os.path.getsize(path)
+                with open(path, "r+b") as fh:
+                    if op == "truncate":
+                        fh.truncate(max(1, size // 2))
+                    else:
+                        off = int(self.rng.integers(0, max(1, size)))
+                        fh.seek(off)
+                        byte = fh.read(1) or b"\0"
+                        fh.seek(off)
+                        fh.write(bytes([byte[0] ^ 0xFF]))
+                hit.append(os.path.relpath(path, self.root))
+        return {"scope": scope, "step": newest, "files": hit}
+
+    # -- recovery -----------------------------------------------------------
+
+    def _recover(self):
+        death = self._death or {"cause": "?", "step": self.step,
+                                "corrupt_before": self._c_corrupt.value,
+                                "peer_before": self._c_peer.value}
+        q_before = set(self._quarantined_steps())
+        t_wall0 = time.perf_counter()
+        t_virt0 = self.now
+        restored = None
+        attempts = 0
+        while restored is None:
+            attempts += 1
+            try:
+                self._boot_worker()
+                restored = self._ckpt.restore_host(self._template())
+            except (ConnectionError, OSError) as e:
+                if attempts > 10_000:
+                    self.violations.append(
+                        f"recovery from {death['cause']} at step "
+                        f"{death['step']} never completed: {e}")
+                    self.alive = True  # resume from nothing: cold start
+                    self.step = 0
+                    return
+                # Store unreachable and no replica had a copy: wait (in
+                # virtual time) for the partition to heal, applying any
+                # due faults (heal included) as the clock advances.
+                self.now += self.dt
+                while self._pending and self._pending[0].at <= self.now:
+                    self._apply(self._pending.pop(0))
+        rto = time.perf_counter() - t_wall0
+        s_r = int(np.asarray(restored["step"]))
+        rpo = max(0, death["step"] - s_r)
+        corrupt_hits = int(self._c_corrupt.value - death["corrupt_before"])
+        peer_reads = int(self._c_peer.value - death["peer_before"])
+        newly_q = sorted(set(self._quarantined_steps()) - q_before)
+        bound = self.every * (1 + len(newly_q))
+        if not self._state_ok(restored):
+            self.violations.append(
+                f"restore after {death['cause']} loaded garbage at step "
+                f"{s_r} — verification let corruption through")
+        if rpo > bound:
+            self.violations.append(
+                f"RPO bound violated after {death['cause']}: lost {rpo} "
+                f"steps (bound {bound} = interval x "
+                f"(1 + {len(newly_q)} quarantined))")
+        self.alive = True
+        self.step = s_r
+        self._death = None
+        incident = {
+            "cause": death["cause"], "death_step": death["step"],
+            "restored_step": s_r, "rpo_steps": rpo,
+            "rpo_bound_steps": bound, "rto_s": round(rto, 4),
+            "rto_virtual_s": round(self.now - t_virt0, 3),
+            "corruption_detected": corrupt_hits > 0,
+            "quarantined_steps": newly_q,
+            "replica_reads": peer_reads,
+            "restore_attempts": attempts,
+        }
+        self.incidents.append(incident)
+        self._m_incidents.inc()
+        self._m_rto.observe(rto)
+        self._m_rpo.set(rpo)
+        if corrupt_hits:
+            self._alert("ckpt.corrupt", True, "critical",
+                        f"checkpoint verification failed on "
+                        f"{corrupt_hits} cop(y/ies)"
+                        + (f"; quarantined step(s) {newly_q}" if newly_q
+                           else "; healed by an intact replica"))
+            self._alert("ckpt.corrupt", False, "critical",
+                        f"restored verified state at step {s_r}")
+        self._alert("recovery.worker_down", False, "critical",
+                    f"worker recovered at step {s_r}")
+        self._emit({"event": "recovery", **incident})
+
+    # -- the run ------------------------------------------------------------
+
+    def run(self) -> dict:
+        wall0 = time.perf_counter()
+        self._pending: List[Fault] = sorted(self.plan.faults,
+                                            key=lambda f: f.at)
+        self._partitioned = False
+        self._boot_worker()
+        try:
+            duration = max(self.steps * self.dt,
+                           self.plan.end_time() + 2 * self.dt)
+            while self.now < duration and self.step < self.steps:
+                while self._pending and self._pending[0].at <= self.now:
+                    self._apply(self._pending.pop(0))
+                if self.alive:
+                    self.step += 1
+                    state = self._make_state(self.step)
+                    if self.step % self.every == 0:
+                        try:
+                            if self._midsave_armed and self._store:
+                                self._midsave_armed = False
+                                self._store.die_on_next_put = True
+                            self._ckpt.save(state, step=self.step)
+                            self.saves += 1
+                        except _SimulatedDeath:
+                            self._die("kill-midsave")
+                        except (ConnectionError, OSError):
+                            self.missed_saves += 1  # partitioned store
+                self.now += self.dt
+            if self._death is not None:
+                self.violations.append(
+                    f"worker still dead at end of plan "
+                    f"(died: {self._death['cause']})")
+            if self.incidents and self.step <= max(
+                    i["restored_step"] for i in self.incidents):
+                self.violations.append(
+                    "training made no progress after the last recovery")
+            self._settle_pushes()
+        finally:
+            if self._ckpt is not None:
+                self._ckpt.close()
+                if hasattr(self._ckpt.store, "close"):
+                    self._ckpt.store.close()
+            self._flush_events()
+            if self._own_root:
+                shutil.rmtree(self.root, ignore_errors=True)
+        report = {
+            "ok": not self.violations,
+            "seed": self.seed,
+            "steps": self.step,
+            "checkpoint_every": self.every,
+            "checkpoints_committed": self.saves,
+            "missed_saves": self.missed_saves,
+            "orphan_tmp_swept": self.tmp_swept,
+            "peer_cache": self.peer_cache,
+            "store_latency_s": self.store_latency_s,
+            "faults_injected": [f.describe() for f in self.plan.faults],
+            "incidents": self.incidents,
+            "rpo_worst_steps": max((i["rpo_steps"] for i in self.incidents),
+                                   default=0),
+            "rto_worst_s": max((i["rto_s"] for i in self.incidents),
+                               default=0.0),
+            "violations": list(self.violations),
+            "events_log": self.events_log,
+            "wall_time_s": round(time.perf_counter() - wall0, 3),
+        }
+        return report

@@ -297,16 +297,24 @@ def _light_config(args) -> "ExperimentConfig":
     return ExperimentConfig.from_dict(raw)
 
 
-def _make_checkpointer(args, name: Optional[str] = None):
+def _make_checkpointer(args, name: Optional[str] = None, cfg=None):
     from serverless_learn_tpu.training.checkpoint import (
         Checkpointer, LocalStore, ShardServerStore)
+    from serverless_learn_tpu.training.replicate import maybe_replicated
 
     name = name or getattr(args, "checkpoint_name", None) or "ckpt"
     if args.checkpoint_store:
-        return Checkpointer(ShardServerStore(args.checkpoint_store), name=name)
-    if args.checkpoint_dir:
-        return Checkpointer(LocalStore(args.checkpoint_dir), name=name)
-    return None
+        store = ShardServerStore(args.checkpoint_store)
+    elif args.checkpoint_dir:
+        store = LocalStore(args.checkpoint_dir)
+    else:
+        return None
+    ck = cfg.checkpoint if cfg is not None else None
+    store = maybe_replicated(store, ck)
+    if ck is not None:
+        return Checkpointer(store, name=name, keep=ck.keep,
+                            verify=ck.verify)
+    return Checkpointer(store, name=name)
 
 
 def cmd_train(args) -> int:
@@ -357,8 +365,9 @@ def cmd_train(args) -> int:
             return capture_session(args.profile_dir)
         return contextlib.nullcontext()
 
+    ckpt = None
     try:
-        ckpt = _make_checkpointer(args)
+        ckpt = _make_checkpointer(args, cfg=cfg)
         every = cfg.train.checkpoint_every
 
         if cfg.local_sgd.outer:
@@ -379,9 +388,21 @@ def cmd_train(args) -> int:
             return 0
 
         callback = None
-        if ckpt is not None and every:
+        if ckpt is not None:
+            # Shadow the newest state for the emergency-save death hook
+            # (round 15): a SIGTERM'd or crashing run commits it
+            # synchronously via the flight recorder, losing at most
+            # emergency_min_interval_s of steps instead of everything
+            # since the last periodic save. note_state keeps a HOST
+            # copy — the live state's buffers are donated into the next
+            # step and dead by the time the handler runs.
+            if cfg.checkpoint.emergency_save:
+                ckpt.arm_emergency(
+                    min_interval_s=cfg.checkpoint.emergency_min_interval_s)
+
             def callback(step, state, stats):
-                if step % every == 0:
+                ckpt.note_state(state)
+                if every and step % every == 0:
                     ckpt.save(state)
 
         with _bracket_ctx():
@@ -403,6 +424,10 @@ def cmd_train(args) -> int:
                   "badput_breakdown": grep["badput_breakdown"],
                   "spans": get_tracer().summary()}, stream=sys.stdout)
     finally:
+        if ckpt is not None:
+            ckpt.close()  # drain async upload, disarm the emergency hook
+            if hasattr(ckpt.store, "close"):
+                ckpt.store.close()
         if health is not None:
             health.stop()
         if exporter is not None:
@@ -938,6 +963,19 @@ def cmd_worker(args) -> int:
             "membership changes instead — see --multihost)")
     _init_tracing_from_args(args)
     cfg = _config_from_args(args)
+    if (args.ckpt_cache_dir is not None or args.ckpt_peers is not None
+            or args.ckpt_serve_cache):
+        import dataclasses as _dc
+
+        cfg = cfg.override(checkpoint=_dc.replace(
+            cfg.checkpoint,
+            cache_dir=(args.ckpt_cache_dir
+                       if args.ckpt_cache_dir is not None
+                       else cfg.checkpoint.cache_dir),
+            peers=(args.ckpt_peers if args.ckpt_peers is not None
+                   else cfg.checkpoint.peers),
+            serve_cache=(args.ckpt_serve_cache
+                         or cfg.checkpoint.serve_cache)))
     if args.checkpoint_store:
         store = ShardServerStore(args.checkpoint_store)
     elif args.checkpoint_dir:
@@ -1373,6 +1411,74 @@ def cmd_chaos(args) -> int:
     from serverless_learn_tpu.chaos.sim import ChaosSim
     from serverless_learn_tpu.control.gossip import GossipConfig
 
+    if args.mode == "recover":
+        # Crash/recovery proof over the REAL checkpoint stack
+        # (chaos/recover.py): kills mid-run and mid-save, checkpoint
+        # corruption, store partitions — asserts bounded RPO, measures
+        # RTO, and emits doctor-attributable telemetry.
+        from serverless_learn_tpu.chaos.recover import RecoveryRun
+
+        plan = None
+        if args.plan:
+            try:
+                with open(args.plan) as f:
+                    plan = FaultPlan.from_json(f.read())
+            except (OSError, ValueError) as e:
+                print(f"bad fault plan: {e}", file=sys.stderr)
+                return 2
+        events_log = args.events_log
+        smoke_tmp = None
+        if args.smoke and not events_log:
+            # The smoke's doctor-attribution half needs an event trail.
+            import tempfile
+
+            fd, smoke_tmp = tempfile.mkstemp(prefix="slt-recover-smoke-",
+                                             suffix=".jsonl")
+            os.close(fd)
+            events_log = smoke_tmp
+        try:
+            run = RecoveryRun(
+                seed=args.seed, steps=args.steps,
+                checkpoint_every=args.ckpt_every, plan=plan,
+                events_log=events_log,
+                store_latency_s=args.store_latency_ms / 1000.0,
+                peer_cache=not args.no_peer_cache)
+        except ValueError as e:
+            print(f"bad recover plan: {e}", file=sys.stderr)
+            return 2
+        rep = run.run()
+        if args.smoke:
+            # Self-contained CI proof: the default plan already kills
+            # mid-run AND mid-save, corrupts a checkpoint and partitions
+            # the store; on top of the harness's own RPO/garbage
+            # invariants, require that doctor NAMES the recoveries and
+            # the corruption from the events log alone.
+            from serverless_learn_tpu.telemetry.doctor import diagnose
+
+            verdict = diagnose(paths=[events_log])["summary"]["verdict"]
+            rep["doctor_verdict"] = verdict
+            if "recovery incident" not in verdict:
+                rep["ok"] = False
+                rep["violations"].append(
+                    "doctor failed to name the recovery incidents")
+            if not rep["incidents"]:
+                rep["ok"] = False
+                rep["violations"].append("smoke plan injected no incidents")
+            if "corruption detected" not in verdict:
+                rep["ok"] = False
+                rep["violations"].append(
+                    "doctor failed to name the checkpoint corruption")
+            if smoke_tmp is not None:
+                try:
+                    os.remove(smoke_tmp)
+                except OSError:
+                    pass
+        if not args.full:
+            rep = dict(rep)
+            rep["incidents"] = len(rep["incidents"])
+        print(json.dumps(rep, indent=None if args.compact else 2))
+        return 0 if rep["ok"] else 1
+
     if args.mode == "fleet":
         # Real-socket fleet chaos (chaos/fleet.py): stub replicas behind
         # TcpChaosProxy, a live router, open-loop load, REAL seconds.
@@ -1670,6 +1776,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "can size satisfiable worlds for the configured mesh "
                         "WITHOUT touching the local chips itself (the inner "
                         "trainer must be the only libtpu owner)")
+    w.add_argument("--ckpt-cache-dir", default=None,
+                   help="worker-local checkpoint cache dir (round 15): "
+                        "remesh restores read local disk instead of the "
+                        "central store; served to peers with "
+                        "--ckpt-serve-cache")
+    w.add_argument("--ckpt-peers", default=None,
+                   help="comma-separated peer cache addrs to replicate "
+                        "checkpoints to (and restore from when the "
+                        "central store is slow or partitioned)")
+    w.add_argument("--ckpt-serve-cache", action="store_true",
+                   help="serve --ckpt-cache-dir to peers over the "
+                        "shard-server wire protocol (ephemeral port)")
     w.set_defaults(fn=cmd_worker)
 
     c = sub.add_parser("coordinator", help="run the membership daemon")
@@ -1926,12 +2044,15 @@ def build_parser() -> argparse.ArgumentParser:
                         help="fault-injection chaos harness: run a "
                              "FaultPlan (or a seeded random soak) against "
                              "N simulated gossip members on virtual time")
-    ch.add_argument("mode", choices=["run", "soak", "fleet"],
+    ch.add_argument("mode", choices=["run", "soak", "fleet", "recover"],
                     help="run: execute --plan on the gossip simulator; "
                          "soak: seeded random schedule of kills/"
                          "partitions/stragglers; fleet: execute --plan "
                          "(kill/restart/pause/delay/heal) against a REAL "
-                         "router + stub replicas through TcpChaosProxy")
+                         "router + stub replicas through TcpChaosProxy; "
+                         "recover: kill/corrupt/partition the REAL "
+                         "checkpoint stack and assert bounded RPO + "
+                         "measured RTO per incident")
     ch.add_argument("--plan", metavar="FILE.json",
                     help="FaultPlan (chaos/plan.py DSL); required for run")
     ch.add_argument("--nodes", type=int, default=50,
@@ -1952,6 +2073,24 @@ def build_parser() -> argparse.ArgumentParser:
                     help="full report (per-fault and per-node detail)")
     ch.add_argument("--compact", action="store_true",
                     help="single-line JSON (for scripts)")
+    ch.add_argument("--steps", type=int, default=260,
+                    help="recover: virtual training steps to run")
+    ch.add_argument("--ckpt-every", type=int, default=20,
+                    help="recover: checkpoint interval (the RPO bound)")
+    ch.add_argument("--store-latency-ms", type=float, default=0.0,
+                    help="recover: injected per-read latency on the "
+                         "CENTRAL store (peer/cache reads stay fast — "
+                         "how the replica win is measured)")
+    ch.add_argument("--no-peer-cache", action="store_true",
+                    help="recover: disable the local cache + peer "
+                         "replica tier (store-only restores)")
+    ch.add_argument("--smoke", action="store_true",
+                    help="recover: self-contained CI proof — seeded "
+                         "default plan (kill mid-run AND mid-save, "
+                         "corrupt, partition), assert the RPO bound, "
+                         "and require `slt doctor` to name every "
+                         "recovery + the corruption from the events "
+                         "log alone")
     ch.set_defaults(fn=cmd_chaos)
 
     tp = sub.add_parser("top", help="live cluster telemetry: poll /metrics "

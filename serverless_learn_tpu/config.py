@@ -274,6 +274,41 @@ class MembershipConfig:
 
 
 @dataclass(frozen=True)
+class CheckpointConfig:
+    """Crash-safe training-state knobs (``training/checkpoint.py``,
+    ``training/replicate.py``; round 15).
+
+    ``verify`` gates restore-time size/CRC verification (corrupt steps
+    raise ``CheckpointCorrupt``, get quarantined and fall back to the
+    newest verified step). ``emergency_save`` hooks a rate-limited
+    synchronous blob save into the flight recorder's death path
+    (SIGTERM / unhandled exception), so a crash loses at most the
+    in-flight step.
+
+    The replication trio makes remesh/rejoin fast: ``cache_dir`` keeps a
+    worker-local copy of every checkpoint file (a remeshing worker
+    restores from local disk instead of a central-store round trip),
+    ``serve_cache`` exposes that cache to peers over the shard-server
+    wire protocol (pure-Python twin, ephemeral port unless
+    ``serve_cache_port``), and ``peers`` + ``replica_fanout`` push each
+    commit to that many peer caches so a REJOINING worker restores from
+    the nearest live peer even when the central store is slow or
+    partitioned.
+    """
+
+    verify: bool = True
+    keep: int = 3                       # retained steps (Checkpointer GC)
+    emergency_save: bool = True
+    emergency_min_interval_s: float = 30.0
+    # ---- peer state replication ----
+    cache_dir: str = ""                 # "" = no worker-local cache
+    peers: str = ""                     # comma-separated peer cache addrs
+    replica_fanout: int = 2             # peers to push each commit to
+    serve_cache: bool = False           # serve cache_dir to peers
+    serve_cache_port: int = 0           # 0 = ephemeral
+
+
+@dataclass(frozen=True)
 class KVCacheConfig:
     """Paged KV cache for the serving engines (``inference/kvcache.py``,
     consumed by ``inference/continuous.py`` and ``inference/batching.py``).
@@ -426,6 +461,7 @@ class ExperimentConfig:
     membership: MembershipConfig = field(default_factory=MembershipConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
     kv: KVCacheConfig = field(default_factory=KVCacheConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
@@ -455,6 +491,7 @@ class ExperimentConfig:
             membership=build(MembershipConfig, raw.get("membership")),
             fleet=build(FleetConfig, raw.get("fleet")),
             kv=build(KVCacheConfig, raw.get("kv")),
+            checkpoint=build(CheckpointConfig, raw.get("checkpoint")),
         )
 
     def override(self, **kwargs: Any) -> "ExperimentConfig":

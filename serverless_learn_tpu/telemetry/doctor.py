@@ -356,6 +356,52 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
             if aw > 0:
                 bit += f"; admit/admit_wait badput {aw * 100:.0f}%"
         verdict_bits.append(bit)
+    # Crash-safe training state (round 15): name every recovery incident
+    # — cause, steps lost vs the checkpoint-interval bound, restore cost
+    # — and every checkpoint-corruption detection/quarantine, from the
+    # event trail alone (`{"event": "recovery"}` records from the real
+    # restore path and `slt chaos recover`, `ckpt_corrupt` /
+    # `ckpt_quarantined` / `ckpt_emergency_save` records from
+    # training/checkpoint.py).
+    recoveries = [r for r in records if r.get("event") == "recovery"]
+    if recoveries:
+        causes = sorted({str(r.get("cause", "?")) for r in recoveries})
+        worst_rpo = max((r.get("rpo_steps") or 0) for r in recoveries)
+        worst_rto = max((r.get("rto_s") or 0.0) for r in recoveries)
+        bounded = all((r.get("rpo_steps") or 0)
+                      <= (r.get("rpo_bound_steps") or float("inf"))
+                      for r in recoveries)
+        verdict_bits.append(
+            f"{len(recoveries)} training recovery incident(s) "
+            f"({', '.join(causes)}): worst RPO {worst_rpo} step(s), "
+            f"worst RTO {worst_rto:.3f}s"
+            + (" — within the checkpoint-interval bound" if bounded
+               else " — RPO BOUND EXCEEDED"))
+    corrupt_recs = [r for r in records
+                    if r.get("event") in ("ckpt_corrupt",
+                                          "ckpt_quarantined")]
+    corrupt_alerts = [a for a in alerts if a.get("alert") == "ckpt.corrupt"]
+    if corrupt_recs or corrupt_alerts:
+        q_steps = sorted({r.get("step") for r in corrupt_recs
+                          if r.get("event") == "ckpt_quarantined"
+                          and r.get("step") is not None})
+        bit = (f"checkpoint corruption detected "
+               f"({len(corrupt_recs) or len(corrupt_alerts)} event(s))")
+        if q_steps:
+            bit += (f"; quarantined step(s) {q_steps} — restores fell "
+                    f"back to the newest verified step")
+        elif corrupt_recs or any(a.get("state") != "firing"
+                                 for a in corrupt_alerts):
+            bit += "; healed by an intact replica"
+        verdict_bits.append(bit)
+    emergencies = [r for r in records
+                   if r.get("event") == "ckpt_emergency_save"]
+    if emergencies:
+        steps_e = sorted({r.get("step") for r in emergencies
+                          if r.get("step") is not None})
+        verdict_bits.append(
+            f"{len(emergencies)} emergency checkpoint save(s) on the "
+            f"death path" + (f" (step(s) {steps_e})" if steps_e else ""))
     if bench and bench["regressions"]:
         verdict_bits.append(
             f"{len(bench['regressions'])} bench regression(s) vs history")

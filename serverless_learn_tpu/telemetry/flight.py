@@ -42,6 +42,14 @@ _prev_thread_hook = None
 # what it was doing. Keyed (last wins) so a restarted engine replaces
 # its predecessor instead of stacking.
 _providers: dict = {}
+# Named death hooks: fn(reason) -> JSON-able summary (or None), run at
+# the START of every dump — BEFORE the ring snapshot, so any events the
+# hook records land in the dump too. This is the emergency-save path:
+# the checkpointer registers a rate-limited synchronous save here
+# (training/checkpoint.py ``arm_emergency``), so a SIGTERM'd or crashing
+# trainer commits its in-memory state before the post-mortem is written.
+# Hooks are best-effort: a raising hook is recorded, never fatal.
+_death_hooks: dict = {}
 
 
 def record(event: dict):
@@ -69,6 +77,19 @@ def add_context_provider(name: str, fn):
 def remove_context_provider(name: str):
     with _lock:
         _providers.pop(name, None)
+
+
+def add_death_hook(name: str, fn):
+    """Attach ``fn(reason) -> JSON-able | None`` to run first on every
+    dump (emergency work for a dying process — see ``_death_hooks``).
+    Keyed, last wins; remove with :func:`remove_death_hook`."""
+    with _lock:
+        _death_hooks[name] = fn
+
+
+def remove_death_hook(name: str):
+    with _lock:
+        _death_hooks.pop(name, None)
 
 
 def set_capacity(n: int):
@@ -115,6 +136,19 @@ def dump(reason: str, dir: Optional[str] = None) -> Optional[str]:
         safe = "".join(c if (c.isalnum() or c in "-_.") else "_"
                        for c in node)
         path = os.path.join(out_dir, f"flight-{safe}-{int(time.time())}.json")
+        # Death hooks run FIRST: an emergency checkpoint save must happen
+        # even if writing the dump itself fails, and its events should be
+        # in the ring snapshot below.
+        with _lock:
+            hooks = list(_death_hooks.items())
+        hook_out = {}
+        for hname, fn in hooks:
+            try:
+                res = fn(reason)
+                if res is not None:
+                    hook_out[hname] = res
+            except Exception as e:
+                hook_out[hname] = {"error": f"{type(e).__name__}: {e}"}
         payload = {
             "event": "flight_dump",
             "node": node,
@@ -123,6 +157,8 @@ def dump(reason: str, dir: Optional[str] = None) -> Optional[str]:
             "dumped_at_unix_s": round(time.time(), 6),
             "events": events(),
         }
+        if hook_out:
+            payload["death_hooks"] = hook_out
         try:
             payload["metrics"] = get_registry().snapshot()
         except Exception:

@@ -459,6 +459,14 @@ _EVENT_RULES = (
     # over the router's registry (/alerts, slt top, scale decisions).
     ("fleet_replica_ejected", "slt_router_ejections_total", "warning"),
     ("fleet_replica_death", "slt_router_replica_deaths_total", "warning"),
+    # Round 15: crash-safe training state. A checkpoint copy failing
+    # verification is critical — the run is one more corruption away
+    # from losing a checkpoint interval; emergency saves and completed
+    # recoveries are incidents worth an alert trail even though the
+    # system handled them.
+    ("ckpt_corrupt", "slt_ckpt_corrupt_total", "critical"),
+    ("ckpt_emergency_save", "slt_ckpt_emergency_saves_total", "warning"),
+    ("recovery", "slt_recovery_incidents_total", "warning"),
 )
 
 

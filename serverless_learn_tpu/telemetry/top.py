@@ -245,6 +245,10 @@ def render(states: List[EndpointState]) -> str:
             ])
         if st.val("slt_train_steps_total") is not None:
             roles += 1
+            # Crash-safety columns (round 15): the newest committed
+            # checkpoint step (how much a crash right now would lose)
+            # and corrupt-copy detections.
+            corrupt = st.val("slt_ckpt_corrupt_total")
             train_rows.append([
                 st.addr,
                 _num(st.val("slt_train_steps_total"), 0),
@@ -256,6 +260,8 @@ def render(states: List[EndpointState]) -> str:
                 _num(st.val("slt_membership_size"), 0),
                 _num(st.val("slt_membership_epoch"), 0),
                 _num(st.val("slt_diloco_rounds_total"), 0),
+                _num(st.val("slt_ckpt_last_step"), 0),
+                "-" if corrupt is None else _num(corrupt, 0),
             ])
         if roles == 0:
             other_rows.append(f"  {st.addr:<22} up (no slt_ metrics yet)")
@@ -271,7 +277,8 @@ def render(states: List[EndpointState]) -> str:
         lines.append("")
         lines.append("  TRAINING")
         header = ["endpoint", "step", "step p50 ms", "samples/s",
-                  "sps/chip", "mfu", "loss", "members", "epoch", "rounds"]
+                  "sps/chip", "mfu", "loss", "members", "epoch", "rounds",
+                  "ckpt", "corrupt"]
         lines += _table(header, train_rows)
     if fleet_rows:
         lines.append("")

@@ -16,27 +16,114 @@ Design:
   ``file_server.cc`` streaming "data shards and checkpoints").
 * Saves can run asynchronously: the device→host gather happens at call time,
   the store write on a background thread (step N+1 overlaps the upload).
+
+Crash-safety (round 15) — every checkpoint is VERIFIED, every restore
+falls back:
+
+* **Checksums + manifests.** Blob saves commit a size-stamped
+  ``<key>.manifest`` (nbytes + CRC-32) after the blob and before
+  ``LATEST``; sharded saves stamp a CRC per chunk into the ``.idx`` and
+  upgrade ``COMMIT`` from a bare marker to a JSON manifest. (CRC-32 via
+  ``zlib.crc32`` — C speed with zero new deps; a hardware CRC32C would be
+  a drop-in for ``_crc``.)
+* **Verification before device_put.** Restore verifies sizes and
+  checksums (and treats undecodable msgpack / uncovered chunks as
+  corruption) and raises the typed :class:`CheckpointCorrupt` — it never
+  places garbage on devices.
+* **Quarantine + fallback.** A latest-step restore that hits corruption
+  quarantines the bad step (a ``step-N.CORRUPT`` marker removes it from
+  every future candidate list, the data stays for forensics until GC'd)
+  and falls back to the newest step that verifies. An EXPLICIT
+  ``restore(step=N)`` of a corrupt step raises — no silent substitution.
+  ``_gc`` never collects the last verified-good step.
+* **Emergency save.** :meth:`Checkpointer.arm_emergency` registers a
+  rate-limited, best-effort synchronous blob save on the flight
+  recorder's death path (SIGTERM / unhandled exception / lease expiry).
+  It commits the :meth:`note_state` host shadow — the training thread
+  refreshes it at step boundaries, one device→host gather per
+  ``emergency_min_interval_s`` — because the LIVE state's buffers are
+  donated into the next jitted step and dead by handler time. A dirty
+  death therefore loses at most ``min_interval_s`` of steps (vs a whole
+  ``checkpoint_every`` interval). An ``atexit`` hook drains the async
+  upload thread so a clean exit can't strand a half-finished ``LATEST``
+  commit.
+* **Replica-aware restore.** When the store exposes ``restore_sources()``
+  (``training/replicate.py``), each step is tried per source —
+  local cache, then the central store, then peer replicas — so a copy
+  corrupted in ONE place is healed by any intact replica of the same
+  step before the step-level fallback gives up ground.
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import re
 import threading
-from typing import Any, Callable, Optional
+import time
+import zlib
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 import numpy as np
 from flax import serialization
 
+from serverless_learn_tpu.telemetry import flight, get_registry
+from serverless_learn_tpu.telemetry import tracing as ttrace
 from serverless_learn_tpu.training.train_state import TrainState
+
+
+class CheckpointCorrupt(IOError):
+    """A checkpoint failed verification (size/CRC mismatch, undecodable
+    payload, missing chunks). Raised BEFORE any device placement."""
+
+    def __init__(self, step: int, detail: str):
+        super().__init__(f"checkpoint step {step} is corrupt: {detail}")
+        self.step = step
+        self.detail = detail
+
+
+def _crc(data) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):
+        return True  # exists (owned by someone else) — don't touch
+    return True
 
 
 class LocalStore:
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
+        self._sweep_orphan_tmp()
+
+    def _sweep_orphan_tmp(self):
+        """Remove ``*.tmp.<pid>`` debris from crashed writers. ``put`` is
+        atomic tmp+rename, so a crash mid-write strands the tmp file
+        forever (``list`` merely skips them). Only files whose writer pid
+        is provably gone are swept — a live sibling process (or another
+        thread of THIS one) mid-put keeps its tmp file."""
+        try:
+            for dirpath, _, files in os.walk(self.root):
+                for fn in files:
+                    m = re.search(r"\.tmp\.(\d+)$", fn)
+                    if m is None:
+                        continue
+                    pid = int(m.group(1))
+                    if pid != os.getpid() and not _pid_alive(pid):
+                        try:
+                            os.remove(os.path.join(dirpath, fn))
+                        except OSError:
+                            pass
+        except OSError:
+            pass  # an unreadable root will fail loudly on first use
 
     def put(self, key: str, data: bytes):
         path = os.path.join(self.root, key)
@@ -97,9 +184,17 @@ class ShardServerStore:
         return self.client.fetch(key, offset=offset, length=length)
 
     def exists(self, key: str) -> bool:
+        # "Key absent" and "store unreachable" are DIFFERENT answers: the
+        # old blanket except swallowed a partitioned store into False, and
+        # a restore would conclude "no checkpoint" and cold-start over a
+        # perfectly good state. Only the server's own no-such-key verdict
+        # maps to False; transport failures propagate (the Transport layer
+        # already retried with backoff and tripped its breaker).
+        from serverless_learn_tpu.control.client import KeyNotFound
+
         try:
             return self.client.size_of(key) >= 0
-        except (IOError, OSError):
+        except KeyNotFound:
             return False
 
     def list(self, prefix: str):
@@ -134,43 +229,94 @@ def _norm_index(index, shape):
     return tuple(out)
 
 
+def _absent_errors() -> tuple:
+    """Exception types that mean "the key is not there" (as opposed to
+    transport trouble, which must propagate to the caller)."""
+    from serverless_learn_tpu.control.client import KeyNotFound
+
+    return (FileNotFoundError, KeyNotFound)
+
+
 class Checkpointer:
     """Save/restore TrainStates under ``<name>/step-<N>`` keys.
 
     Two on-store layouts:
 
     * **blob** (`save`): the whole host-gathered state as one flax-msgpack
-      value at ``<name>/step-N``. Simple, but the full state transits one
-      host — unusable past single-host model sizes.
+      value at ``<name>/step-N``, plus a ``step-N.manifest`` (nbytes +
+      CRC-32) committed after the blob and before ``LATEST``. Simple, but
+      the full state transits one host — unusable past single-host model
+      sizes.
     * **sharded** (`save_sharded`): each process writes only the replica-0
       shards it can address, as one raw-bytes blob + a JSON chunk index:
 
           <name>/step-N/META           tree paths, global shapes/dtypes
-          <name>/step-N/proc-K.idx     [{leaf, start, stop, offset, nbytes}]
+          <name>/step-N/proc-K.idx     {"chunks": [{leaf, start, stop,
+                                        offset, nbytes, crc}], "dat_nbytes"}
           <name>/step-N/proc-K.dat     concatenated C-order chunk bytes
-          <name>/step-N/COMMIT         written last, by process 0 only
+          <name>/step-N/COMMIT         JSON manifest, written last, by
+                                       process 0 only
 
       Restore reads META + all .idx files (small), then ranged-fetches
       exactly the chunks overlapping the *target* sharding's local shards —
       so a state saved on dp=8 restores onto fsdp=4×tp=2 (or a different
-      process count) without any host ever holding the full state. This is
-      what the reference's file server could never do for its model (an
-      in-memory double vector, ``src/master.cc:58-59``): checkpoints here
-      are first-class sharded objects on the same data plane as training
-      shards.
+      process count) without any host ever holding the full state. Every
+      fetched chunk is CRC-verified before assembly.
 
     `restore` auto-detects the layout, so callers (the elastic trainer)
-    are agnostic to how a predecessor saved.
+    are agnostic to how a predecessor saved. ``restore(step=None)`` walks
+    the candidate steps newest-first, quarantining corrupt steps and
+    falling back to the newest one that verifies; ``restore(step=N)`` of
+    a corrupt step raises :class:`CheckpointCorrupt` instead.
     """
 
     def __init__(self, store, name: str = "ckpt", keep: int = 3,
-                 async_save: bool = True, sharded: bool = False):
+                 async_save: bool = True, sharded: bool = False,
+                 verify: bool = True):
         self.store = store
         self.name = name
         self.keep = keep
         self.async_save = async_save
         self.sharded = sharded
+        self.verify = verify
         self._pending: Optional[threading.Thread] = None
+        # The newest step that PROVABLY restored (verified) — _gc never
+        # collects it: after quarantining a corrupt newer step this is the
+        # only state the run can fall back to.
+        self._last_verified: Optional[int] = None
+        self._atexit_armed = False
+        # Emergency-save state (arm_emergency / note_state). The shadow
+        # is a HOST (numpy) copy: the live state's device buffers are
+        # donated into the next jitted step and deleted, so a death hook
+        # that dereferences them mid-run raises instead of saving.
+        self._emg_fn: Optional[Callable[[], Any]] = None
+        self._emg_min_s = 0.0
+        self._emg_last_t: Optional[float] = None
+        self._emg_armed = False
+        self._emg_shadow: Optional[Any] = None
+        self._emg_shadow_step: Optional[int] = None
+        self._emg_shadow_t: Optional[float] = None
+        reg = get_registry()
+        self._m_saves = reg.counter("slt_ckpt_saves_total",
+                                    "checkpoint commits (incl. emergency)")
+        self._m_last_step = reg.gauge("slt_ckpt_last_step",
+                                      "newest committed checkpoint step")
+        self._m_verified = reg.counter(
+            "slt_ckpt_verified_restores_total",
+            "restores that passed size+CRC verification")
+        self._m_corrupt = reg.counter(
+            "slt_ckpt_corrupt_total",
+            "checkpoint copies that failed verification")
+        self._m_fallbacks = reg.counter(
+            "slt_ckpt_fallbacks_total",
+            "restores that fell back past a quarantined step")
+        self._m_emergency = reg.counter(
+            "slt_ckpt_emergency_saves_total",
+            "best-effort saves on the flight recorder's death path")
+        self._m_peer_restores = reg.counter(
+            "slt_ckpt_peer_restores_total",
+            "step loads served by a local cache or peer replica "
+            "instead of the central store")
 
     # -- save --------------------------------------------------------------
 
@@ -190,17 +336,33 @@ class Checkpointer:
             self.wait()  # at most one upload in flight
 
         def upload():
-            self.store.put(self._key(step), blob)
-            self.store.put(f"{self.name}/LATEST",
-                           json.dumps({"step": step}).encode())
+            self._put_blob(step, blob)
             self._gc(step)
 
         if self.async_save:
             self._pending = threading.Thread(target=upload, daemon=True)
             self._pending.start()
+            self._arm_atexit()
         else:
             upload()
         return step
+
+    def _put_blob(self, step: int, blob: bytes, reason: str = ""):
+        """Blob + manifest + LATEST, in commit order: the manifest lands
+        only after the (atomic) blob, LATEST only after the manifest —
+        a crash between any two leaves either a complete older commit or
+        a complete newer one, never a pointer at torn bytes."""
+        key = self._key(step)
+        self.store.put(key, blob)
+        manifest = {"step": step, "layout": "blob",
+                    "nbytes": len(blob), "crc32": _crc(blob)}
+        if reason:
+            manifest["emergency"] = reason
+        self.store.put(key + ".manifest", json.dumps(manifest).encode())
+        self.store.put(f"{self.name}/LATEST",
+                       json.dumps({"step": step}).encode())
+        self._m_saves.inc()
+        self._m_last_step.set(step)
 
     def save_sharded(self, state: TrainState, step: Optional[int] = None,
                      barrier: Optional[Callable[[str], None]] = None) -> int:
@@ -244,7 +406,8 @@ class Checkpointer:
                                    "start": [b[0] for b in box],
                                    "stop": [b[1] for b in box],
                                    "offset": len(data),
-                                   "nbytes": flat_u8.nbytes})
+                                   "nbytes": flat_u8.nbytes,
+                                   "crc": _crc(flat_u8)})
                     data.extend(flat_u8)
             else:  # host scalar / numpy leaf: replicated, process 0 owns it
                 arr = np.asarray(leaf)
@@ -255,7 +418,8 @@ class Checkpointer:
                                    "start": [0] * arr.ndim,
                                    "stop": list(shape),
                                    "offset": len(data),
-                                   "nbytes": len(raw)})
+                                   "nbytes": len(raw),
+                                   "crc": _crc(raw)})
                     data.extend(raw)
             leaves_meta.append({"path": jax.tree_util.keystr(path),
                                 "shape": list(shape), "dtype": dtype})
@@ -263,8 +427,8 @@ class Checkpointer:
         self.wait()
         prefix = self._key(step)
         self.store.put(f"{prefix}/proc-{proc:05d}.dat", bytes(data))
-        self.store.put(f"{prefix}/proc-{proc:05d}.idx",
-                       json.dumps(chunks).encode())
+        self.store.put(f"{prefix}/proc-{proc:05d}.idx", json.dumps(
+            {"chunks": chunks, "dat_nbytes": len(data)}).encode())
         if proc == 0:
             self.store.put(f"{prefix}/META", json.dumps(
                 {"step": step, "n_procs": n_procs,
@@ -276,9 +440,13 @@ class Checkpointer:
         if barrier is not None:
             barrier(f"ckpt-save-{self.name}-{step}")
         if proc == 0:
-            self.store.put(f"{prefix}/COMMIT", b"ok")
+            # COMMIT is the step's manifest: size-stamped, written LAST.
+            self.store.put(f"{prefix}/COMMIT", json.dumps(
+                {"step": step, "n_procs": n_procs}).encode())
             self.store.put(f"{self.name}/LATEST",
                            json.dumps({"step": step}).encode())
+            self._m_saves.inc()
+            self._m_last_step.set(step)
             self._gc(step)
         if barrier is not None:
             # No process may return (and possibly tear its world down, as the
@@ -291,18 +459,176 @@ class Checkpointer:
             self._pending.join()
             self._pending = None
 
+    # -- lifecycle ---------------------------------------------------------
+
+    def _arm_atexit(self):
+        """A clean process exit must not strand a half-finished async
+        upload (blob landed, LATEST commit still queued on the dying
+        thread): drain the pending upload at interpreter exit."""
+        if not self._atexit_armed:
+            atexit.register(self._drain_at_exit)
+            self._atexit_armed = True
+
+    def _drain_at_exit(self):
+        try:
+            self.wait()
+        except Exception:
+            pass  # exit paths must never raise
+
+    def close(self):
+        """Drain pending uploads, disarm the emergency hook and the atexit
+        drain. Idempotent."""
+        self.wait()
+        self.disarm_emergency()
+        if self._atexit_armed:
+            try:
+                atexit.unregister(self._drain_at_exit)
+            except Exception:
+                pass
+            self._atexit_armed = False
+
+    # -- emergency save ----------------------------------------------------
+
+    def arm_emergency(self, state_fn: Optional[Callable[[], Any]] = None,
+                      min_interval_s: float = 30.0):
+        """Best-effort synchronous save on the flight recorder's death
+        path (SIGTERM, unhandled exception, lease expiry): a dying
+        trainer commits its newest state so the crash loses at most
+        ``min_interval_s`` worth of steps.
+
+        The state comes from :meth:`note_state`'s host shadow (the
+        training thread refreshes it at step boundaries), or from
+        ``state_fn()`` when given — with the shadow as fallback, because
+        a live state's device buffers are usually DONATED into the next
+        jitted step by death time and dereferencing them raises. The
+        save is rate-limited to one per ``min_interval_s`` — a crash loop
+        must not turn the store into a write amplifier — and always uses
+        the blob layout (a sharded save needs cross-process barriers; a
+        crash handler has no peers to meet). Restore auto-detects layout
+        per step, so blob emergency commits coexist with sharded
+        periodic ones."""
+        self._emg_fn = state_fn
+        self._emg_min_s = float(min_interval_s)
+        self._emg_armed = True
+        flight.add_death_hook(f"ckpt:{self.name}", self._emergency_save)
+
+    def note_state(self, state) -> None:
+        """Refresh the emergency-save host shadow — call from the
+        TRAINING thread at a step boundary, where the state is never
+        mid-donation. Rate-limited to one device→host gather per
+        ``min_interval_s`` (the same cadence the save itself is limited
+        to), so the steady-state cost is one gather per interval, not
+        per step; charged to the ``checkpoint`` phase."""
+        if not self._emg_armed:
+            return  # no death hook: a shadow would be dead weight
+        if self._emg_fn is not None:
+            return  # an explicit state_fn owns the state
+        now = time.monotonic()
+        if (self._emg_shadow_t is not None
+                and now - self._emg_shadow_t < self._emg_min_s):
+            return
+        from serverless_learn_tpu.telemetry import goodput
+
+        with goodput.phase("checkpoint"):
+            host = jax.device_get(state)
+        self._emg_shadow = host
+        self._emg_shadow_step = (int(np.asarray(host.step))
+                                 if hasattr(host, "step") else 0)
+        self._emg_shadow_t = now
+
+    def disarm_emergency(self):
+        self._emg_fn = None
+        self._emg_armed = False
+        self._emg_shadow = None
+        flight.remove_death_hook(f"ckpt:{self.name}")
+
+    def _death_state(self) -> Tuple[Optional[Any], Optional[int]]:
+        """(host_state, step) for the death hook: the explicit state_fn
+        if it yields a LIVE state, else the note_state host shadow. A
+        state_fn's arrays are often donated-dead by death time
+        (``RuntimeError: Array has been deleted``) — that is exactly
+        what the shadow exists for, so any failure falls through."""
+        fn = self._emg_fn
+        if fn is not None:
+            try:
+                state = fn()
+                if state is not None:
+                    host = jax.device_get(state)
+                    step = (int(np.asarray(host.step))
+                            if hasattr(host, "step") else 0)
+                    return host, step
+            except Exception:
+                pass
+        return self._emg_shadow, self._emg_shadow_step
+
+    def _emergency_save(self, reason: str):
+        """The death hook proper. Never raises; returns a JSON-able
+        summary stamped into the flight dump."""
+        try:
+            now = time.monotonic()
+            if (self._emg_last_t is not None
+                    and now - self._emg_last_t < self._emg_min_s):
+                return {"skipped": "rate-limited"}
+            host, step = self._death_state()
+            if host is None:
+                return {"skipped": "no-state"}
+            self._emg_last_t = now
+            try:
+                self.wait()
+            except Exception:
+                pass
+            blob = serialization.to_bytes(host)
+            self._put_blob(step, blob, reason=f"emergency:{reason}")
+            self._m_emergency.inc()
+            rec = {"event": "ckpt_emergency_save", "name": self.name,
+                   "step": step, "reason": reason, "nbytes": len(blob)}
+            flight.record(rec)
+            ttrace.emit_event(rec)
+            return {"step": step, "nbytes": len(blob)}
+        except Exception as e:  # a crash handler must never crash
+            return {"error": f"{type(e).__name__}: {e}"}
+
     # -- restore -----------------------------------------------------------
 
+    def candidate_steps(self) -> List[int]:
+        """Restorable steps, newest first: committed (blob key or sharded
+        COMMIT), not quarantined."""
+        keys = self.store.list(self.name)
+        quarantined = set()
+        for key in keys:
+            m = re.search(r"step-(\d+)\.CORRUPT$", key)
+            if m:
+                quarantined.add(int(m.group(1)))
+        return sorted((s for s in self._steps_from(keys)
+                       if s not in quarantined), reverse=True)
+
     def latest_step(self) -> Optional[int]:
+        """The newest restorable step. ``LATEST`` is an advisory pointer:
+        when it is missing, unreadable, stale (pointing at a deleted
+        step) or pointing at a quarantined step, the listing wins."""
+        cands = self.candidate_steps()
         try:
             meta = json.loads(self.store.get(f"{self.name}/LATEST"))
-            return int(meta["step"])
-        except (IOError, OSError, ValueError, KeyError):
-            steps = self._steps()
-            return max(steps) if steps else None
+            step = int(meta["step"])
+        except (IOError, OSError, ValueError, KeyError, TypeError):
+            step = None
+        if step is not None and step in cands:
+            # A newer COMMITTED step can exist above a lagging pointer
+            # (crash between a step commit and the LATEST put) — prefer
+            # the newest committed state; LATEST never hides progress.
+            return max(step, cands[0]) if cands else step
+        return cands[0] if cands else None
 
     def _is_sharded(self, step: int) -> bool:
-        return self.store.exists(f"{self._key(step)}/COMMIT")
+        return self._src_is_sharded(self.store, step)
+
+    def _src_is_sharded(self, src, step: int) -> bool:
+        return src.exists(f"{self._key(step)}/COMMIT")
+
+    def _sources(self) -> List[Tuple[str, Any]]:
+        if hasattr(self.store, "restore_sources"):
+            return list(self.store.restore_sources())
+        return [("store", self.store)]
 
     def restore_host(self, template: TrainState,
                      step: Optional[int] = None) -> TrainState:
@@ -313,24 +639,7 @@ class Checkpointer:
         sharded checkpoint this materializes the FULL state on this host —
         fine for inference-scale params, wrong for the elastic restore path
         (use ``restore`` with shardings there)."""
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(f"no checkpoint under {self.name!r}")
-        if self._is_sharded(step):
-            reader = _ShardedReader(self.store, self._key(step))
-            flat, treedef = jax.tree_util.tree_flatten(template)
-            out = []
-            for i, leaf in enumerate(flat):
-                shape, dtype = reader.leaf_meta(i, leaf)
-                box = tuple((0, n) for n in shape)
-                out.append(reader.assemble(i, box, shape, dtype))
-            return jax.tree_util.tree_unflatten(treedef, out)
-        blob = self.store.get(self._key(step))
-        host_template = jax.tree_util.tree_map(
-            lambda x: np.zeros(x.shape, x.dtype), template,
-            is_leaf=lambda x: hasattr(x, "shape"))
-        return serialization.from_bytes(host_template, blob)
+        return self._restore_any(template, step, None, host_only=True)
 
     def restore_params_host(self, step: Optional[int] = None) -> Any:
         """The checkpoint's ``params`` subtree as host numpy arrays —
@@ -351,9 +660,15 @@ class Checkpointer:
         if not self._is_sharded(step):
             from flax.serialization import msgpack_restore
 
-            state = msgpack_restore(self.store.get(self._key(step)))
+            blob = self.store.get(self._key(step))
+            self._check_blob(self.store, step, blob)
+            try:
+                state = msgpack_restore(blob)
+            except Exception as e:
+                raise CheckpointCorrupt(step, f"undecodable msgpack: {e}")
             return state["params"]
-        reader = _ShardedReader(self.store, self._key(step))
+        reader = _ShardedReader(self.store, self._key(step),
+                                verify=self.verify)
         out: dict = {}
         for i, info in enumerate(reader.meta["leaves"]):
             path = info["path"]
@@ -385,21 +700,158 @@ class Checkpointer:
         from serverless_learn_tpu.telemetry import goodput
 
         with goodput.phase("checkpoint"):
-            if step is None:
-                step = self.latest_step()
-                if step is None:
-                    raise FileNotFoundError(
-                        f"no checkpoint under {self.name!r}")
-            if shardings is not None and self._is_sharded(step):
-                return self._restore_resharded(template, shardings, step)
-            restored = self.restore_host(template, step)
-            if shardings is not None:
-                return jax.tree_util.tree_map(
-                    lambda x, s: jax.device_put(x, s), restored, shardings)
-            return jax.tree_util.tree_map(jax.numpy.asarray, restored)
+            return self._restore_any(template, step, shardings,
+                                     host_only=False)
 
-    def _restore_resharded(self, template, shardings, step: int):
-        reader = _ShardedReader(self.store, self._key(step))
+    def _restore_any(self, template, step: Optional[int], shardings,
+                     host_only: bool):
+        if step is not None:
+            out = self._restore_step(template, step, shardings, host_only)
+            self._last_verified = step
+            self._m_verified.inc()
+            return out
+        cands = self.candidate_steps()
+        if not cands:
+            raise FileNotFoundError(f"no checkpoint under {self.name!r}")
+        corrupt_seen = False
+        last: Optional[Exception] = None
+        for s in cands:
+            try:
+                out = self._restore_step(template, s, shardings, host_only)
+            except CheckpointCorrupt as e:
+                self._quarantine(s, e)
+                corrupt_seen = True
+                last = e
+                continue
+            except _absent_errors() as e:
+                last = e  # a racing GC / torn listing: try the next older
+                continue
+            self._last_verified = s
+            self._m_verified.inc()
+            if corrupt_seen:
+                self._m_fallbacks.inc()
+                rec = {"event": "ckpt_fallback", "name": self.name,
+                       "restored_step": s}
+                flight.record(rec)
+                ttrace.emit_event(rec)
+            return out
+        assert last is not None
+        raise last
+
+    def _restore_step(self, template, step: int, shardings,
+                      host_only: bool):
+        """Load + verify one step, trying every restore source (local
+        cache → central store → peer replicas for a ReplicatedStore; just
+        the store otherwise). A copy corrupt in one source is healed by
+        any intact replica; CheckpointCorrupt surfaces only when EVERY
+        source's copy fails verification."""
+        absent = _absent_errors()
+        last: Optional[Exception] = None
+        corrupt: Optional[CheckpointCorrupt] = None
+        for label, src in self._sources():
+            try:
+                if self._src_is_sharded(src, step):
+                    out = self._load_sharded(src, template, step, shardings,
+                                             host_only)
+                elif src.exists(self._key(step)):
+                    out = self._load_blob(src, template, step, shardings,
+                                          host_only)
+                else:
+                    continue
+            except CheckpointCorrupt as e:
+                self._m_corrupt.inc()
+                rec = {"event": "ckpt_corrupt", "name": self.name,
+                       "step": step, "source": label, "detail": e.detail}
+                flight.record(rec)
+                ttrace.emit_event(rec)
+                corrupt = e
+                continue
+            except absent as e:
+                last = last or e
+                continue
+            except (ConnectionError, OSError) as e:
+                # Source unreachable — try the next replica; with a single
+                # source this re-raises below (the caller retries/backs
+                # off, it must NOT mistake a partition for a missing or
+                # corrupt checkpoint).
+                last = last or e
+                continue
+            if label not in ("store", "primary"):
+                self._m_peer_restores.inc()
+            return out
+        if corrupt is not None:
+            raise corrupt
+        if last is not None:
+            raise last
+        raise FileNotFoundError(
+            f"checkpoint step {step} absent under {self.name!r}")
+
+    def _read_manifest(self, src, step: int) -> Optional[dict]:
+        try:
+            raw = src.get(self._key(step) + ".manifest")
+        except _absent_errors():
+            return None  # pre-round-15 checkpoint: nothing to verify
+        try:
+            man = json.loads(raw)
+            if not isinstance(man, dict):
+                raise ValueError("manifest is not an object")
+            return man
+        except (ValueError, UnicodeDecodeError) as e:
+            raise CheckpointCorrupt(step, f"unreadable manifest: {e}")
+
+    def _check_blob(self, src, step: int, blob: bytes):
+        if not self.verify:
+            return
+        man = self._read_manifest(src, step)
+        if man is None:
+            return
+        if "nbytes" in man and int(man["nbytes"]) != len(blob):
+            raise CheckpointCorrupt(
+                step, f"size mismatch: manifest says {man['nbytes']} B, "
+                      f"store has {len(blob)} B (truncated?)")
+        if "crc32" in man and int(man["crc32"]) != _crc(blob):
+            raise CheckpointCorrupt(
+                step, f"crc mismatch: manifest {man['crc32']:#010x}, "
+                      f"payload {_crc(blob):#010x}")
+
+    def _load_blob(self, src, template, step: int, shardings,
+                   host_only: bool):
+        blob = src.get(self._key(step))
+        self._check_blob(src, step, blob)
+        host_template = jax.tree_util.tree_map(
+            lambda x: np.zeros(x.shape, x.dtype), template,
+            is_leaf=lambda x: hasattr(x, "shape"))
+        try:
+            restored = serialization.from_bytes(host_template, blob)
+        except Exception as e:
+            # An unverified (legacy) blob can still be torn — msgpack
+            # decode failure is corruption, not a crash.
+            raise CheckpointCorrupt(step, f"undecodable msgpack: {e}")
+        if host_only:
+            return restored
+        if shardings is not None:
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s), restored, shardings)
+        return jax.tree_util.tree_map(jax.numpy.asarray, restored)
+
+    def _load_sharded(self, src, template, step: int, shardings,
+                      host_only: bool):
+        reader = _ShardedReader(src, self._key(step), verify=self.verify)
+        if shardings is not None and not host_only:
+            return self._restore_resharded(reader, template, shardings)
+        flat, treedef = jax.tree_util.tree_flatten(template)
+        out = []
+        for i, leaf in enumerate(flat):
+            shape, dtype = reader.leaf_meta(i, leaf)
+            box = tuple((0, n) for n in shape)
+            out.append(reader.assemble(i, box, shape, dtype))
+        restored = jax.tree_util.tree_unflatten(treedef, out)
+        if host_only:
+            return restored
+        return jax.tree_util.tree_map(jax.numpy.asarray, restored)
+
+    def _restore_resharded(self, reader: "_ShardedReader", template,
+                           shardings):
         flat, treedef = jax.tree_util.tree_flatten(template)
         flat_sh = treedef.flatten_up_to(shardings)
         out = []
@@ -419,6 +871,24 @@ class Checkpointer:
             out.append(jax.make_array_from_callback(shape, sharding, cb))
             reader.drop_cache()  # chunk cache is only useful within a leaf
         return jax.tree_util.tree_unflatten(treedef, out)
+
+    # -- quarantine --------------------------------------------------------
+
+    def _quarantine(self, step: int, err: CheckpointCorrupt):
+        """Mark a step corrupt so no future restore retries it. The data
+        stays in place for forensics (GC sweeps it with the dead debris
+        once newer commits exist); the marker is what removes it from
+        ``candidate_steps``."""
+        rec = {"event": "ckpt_quarantined", "name": self.name,
+               "step": step, "detail": err.detail}
+        try:
+            self.store.put(self._key(step) + ".CORRUPT", json.dumps(
+                {"step": step, "detail": err.detail,
+                 "at_unix_s": round(time.time(), 3)}).encode())
+        except (IOError, OSError):
+            rec["marker_write_failed"] = True
+        flight.record(rec)
+        ttrace.emit_event(rec)
 
     # -- internals ---------------------------------------------------------
 
@@ -447,20 +917,32 @@ class Checkpointer:
         # committed — debris from a crash between the proc PUTs and COMMIT.
         # They are invisible to restore (no COMMIT) but each holds a full
         # local-state blob; a crash-restart loop would leak unboundedly.
+        # Quarantined steps ride the same sweep: their .CORRUPT marker and
+        # payload go together once newer commits age them out.
         seen = set()
         for key in keys:
-            m = re.search(r"step-(\d+)/", key)
+            m = re.search(r"step-(\d+)[/.]", key)
             if m:
                 seen.add(int(m.group(1)))
-        dead = [s for s in seen - set(steps) if s < current]
-        for old in list(steps[:-self.keep] if self.keep > 0 else []) + dead:
-            prefix = self._key(old)
-            # A sharded step is a directory of keys; a blob step is one key.
+        # Never collect the last verified-good step: after a quarantine
+        # it is the only restorable state until a NEWER step verifies.
+        protected = {current, self._last_verified}
+        dead = [s for s in seen - set(steps)
+                if s < current and s not in protected]
+        old = [s for s in (steps[:-self.keep] if self.keep > 0 else [])
+               if s not in protected]
+        for victim in old + dead:
+            prefix = self._key(victim)
+            # A sharded step is a directory of keys; a blob step is one key
+            # plus dot-suffixed sidecars (.manifest, .CORRUPT).
             victims = [k for k in keys
-                       if k == prefix or k.startswith(prefix + "/")]
-            # COMMIT first: a fetch racing the GC sees the step vanish
-            # atomically instead of finding a committed step with holes.
-            victims.sort(key=lambda k: not k.endswith("/COMMIT"))
+                       if k == prefix or k.startswith(prefix + "/")
+                       or k.startswith(prefix + ".")]
+            # Commit markers first: a fetch racing the GC sees the step
+            # vanish atomically (no COMMIT / no manifest = not a
+            # candidate) instead of finding a committed step with holes.
+            victims.sort(key=lambda k: not (k.endswith("/COMMIT")
+                                            or k.endswith(".manifest")))
             for key in victims:
                 try:
                     self.store.delete(key)
@@ -473,19 +955,43 @@ class _ShardedReader:
 
     Fetches META and every (small) proc index eagerly; chunk *data* is
     ranged-fetched on demand and cached per leaf, so a restore only moves
-    the bytes that overlap the target sharding's local shards."""
+    the bytes that overlap the target sharding's local shards. With
+    ``verify`` every fetched chunk's CRC is checked against the index
+    (round-15 saves stamp one per chunk) before it lands in any output
+    array, and structural damage (unparseable META/idx, chunks past the
+    stamped .dat size, uncovered slices) raises CheckpointCorrupt."""
 
-    def __init__(self, store, prefix: str):
+    def __init__(self, store, prefix: str, verify: bool = True):
         self.store = store
         self.prefix = prefix
-        self.meta = json.loads(store.get(f"{prefix}/META"))
+        self.verify = verify
+        m = re.search(r"step-(\d+)", prefix)
+        self.step = int(m.group(1)) if m else -1
+        self.meta = self._json(f"{prefix}/META")
         self.by_leaf: dict = {}
+        self.dat_nbytes: dict = {}
         for p in range(self.meta["n_procs"]):
-            idx = json.loads(store.get(f"{prefix}/proc-{p:05d}.idx"))
+            idx = self._json(f"{prefix}/proc-{p:05d}.idx")
+            if isinstance(idx, dict):  # round-15 layout
+                self.dat_nbytes[p] = idx.get("dat_nbytes")
+                idx = idx["chunks"]
             for c in idx:
                 c["proc"] = p
+                nb = self.dat_nbytes.get(p)
+                if nb is not None and c["offset"] + c["nbytes"] > nb:
+                    raise CheckpointCorrupt(
+                        self.step,
+                        f"proc-{p} chunk at {c['offset']} runs past the "
+                        f"stamped .dat size {nb} (truncated?)")
                 self.by_leaf.setdefault(c["leaf"], []).append(c)
         self._cache: dict = {}
+
+    def _json(self, key: str):
+        raw = self.store.get(key)
+        try:
+            return json.loads(raw)
+        except (ValueError, UnicodeDecodeError) as e:
+            raise CheckpointCorrupt(self.step, f"unreadable {key}: {e}")
 
     def leaf_meta(self, i: int, template_leaf):
         info = self.meta["leaves"][i]
@@ -503,6 +1009,17 @@ class _ShardedReader:
             raw = self.store.get_range(
                 f"{self.prefix}/proc-{c['proc']:05d}.dat",
                 c["offset"], c["nbytes"])
+            if len(raw) != c["nbytes"]:
+                raise CheckpointCorrupt(
+                    self.step,
+                    f"chunk at proc-{c['proc']}+{c['offset']}: got "
+                    f"{len(raw)} of {c['nbytes']} B (truncated)")
+            if self.verify and "crc" in c and _crc(raw) != c["crc"]:
+                raise CheckpointCorrupt(
+                    self.step,
+                    f"chunk at proc-{c['proc']}+{c['offset']}: crc "
+                    f"mismatch (idx {c['crc']:#010x}, "
+                    f"data {_crc(raw):#010x})")
             shape = tuple(b - a for a, b in zip(c["start"], c["stop"]))
             self._cache[key] = np.frombuffer(raw, dtype=dtype).reshape(shape)
         return self._cache[key]
@@ -514,8 +1031,8 @@ class _ShardedReader:
         chunks = self.by_leaf.get(leaf, [])
         if not box:  # scalar
             if not chunks:
-                raise FileNotFoundError(
-                    f"leaf {leaf} missing from checkpoint {self.prefix}")
+                raise CheckpointCorrupt(
+                    self.step, f"leaf {leaf} missing from {self.prefix}")
             return self._chunk_data(chunks[0], dtype).reshape(())
         out = np.empty(local_shape, dtype)
         want = 1
@@ -543,9 +1060,9 @@ class _ShardedReader:
                 vol *= hi - lo
             got += vol
         if got != want:
-            raise IOError(
-                f"checkpoint {self.prefix} leaf {leaf}: chunks cover "
-                f"{got}/{want} elements of the requested slice")
+            raise CheckpointCorrupt(
+                self.step, f"leaf {leaf}: chunks cover {got}/{want} "
+                           f"elements of the requested slice")
         return out
 
     def drop_cache(self):

@@ -132,7 +132,15 @@ class DilocoIsland:
                  leader_rechallenge: Optional[bool] = None):
         lcfg = config.local_sgd
         self.config = config
-        self.store = store
+        # Round 15: anchors/deltas ride the same replication tier as
+        # checkpoints — with config.checkpoint cache/peers set, every
+        # outer-step publish lands in the local cache and is pushed to
+        # peer replicas, so a rejoining island adopts the current anchor
+        # from the nearest live peer instead of the central store.
+        from serverless_learn_tpu.training.replicate import maybe_replicated
+
+        self.store = maybe_replicated(store,
+                                      getattr(config, "checkpoint", None))
         self.run = run_name
         self.inner_steps = inner_steps or lcfg.inner_steps
         self.outer_lr = outer_lr if outer_lr is not None else lcfg.outer_lr
@@ -435,3 +443,5 @@ class DilocoIsland:
 
     def stop(self):
         self.agent.stop()
+        if hasattr(self.store, "close"):
+            self.store.close()  # drain + stop the peer-push thread

@@ -43,6 +43,8 @@ from serverless_learn_tpu.telemetry import flight, get_registry, goodput
 from serverless_learn_tpu.telemetry import tracing as ttrace
 from serverless_learn_tpu.training.checkpoint import Checkpointer
 from serverless_learn_tpu.training.loop import make_source
+from serverless_learn_tpu.training.replicate import (maybe_replicated,
+                                                     serve_cache)
 from serverless_learn_tpu.training.train_step import build_trainer
 from serverless_learn_tpu.utils.metrics import log_json
 
@@ -89,8 +91,20 @@ class ElasticTrainer:
         # exactly the target sharding's bytes — a single-host world change
         # (e.g. fsdp 2 -> 4) no longer round-trips the full state through
         # one blob (r2 weak item).
+        # Round 15: the store is tiered per config.checkpoint — a
+        # worker-local cache makes the remesh restore a local read, peer
+        # replicas make a rejoin survive a slow or partitioned central
+        # store, and restores verify checksums with corrupt steps
+        # quarantined (falling back to the newest verified step).
+        store = maybe_replicated(store, config.checkpoint)
+        self._cache_server = None
+        if (config.checkpoint.serve_cache and config.checkpoint.cache_dir):
+            self._cache_server = serve_cache(
+                config.checkpoint.cache_dir,
+                port=config.checkpoint.serve_cache_port)
         self.ckpt = Checkpointer(store, name=name, async_save=False,
-                                 sharded=True)
+                                 sharded=True, keep=config.checkpoint.keep,
+                                 verify=config.checkpoint.verify)
         self.device_policy = device_policy
         # Default policy honors the CONFIGURED mesh: tp/pp/sp/ep stay fixed,
         # fsdp is a memory floor, dp stretches with the world (config.
@@ -243,6 +257,14 @@ class ElasticTrainer:
         source = None
         source_iter = None
         stripe = None
+        # Emergency save on the death path (round 15): note_state keeps
+        # a rate-limited HOST shadow of the newest state — the live
+        # state's buffers are donated into the next jitted step, so the
+        # dying handler can only serialize a host copy.
+        if self.config.checkpoint.emergency_save:
+            self.ckpt.arm_emergency(
+                min_interval_s=self.config.checkpoint
+                .emergency_min_interval_s)
         try:
             while True:
                 self._remesh.clear()
@@ -303,6 +325,7 @@ class ElasticTrainer:
                         shardings=trainer.state_shardings)
                 elif state is None:
                     state = trainer.init()
+                self.ckpt.note_state(state)
                 remesh_span.mark("restored")
                 step = int(jax.device_get(state.step))
                 self.transitions.append(
@@ -360,6 +383,7 @@ class ElasticTrainer:
                                 else "step"):
                             state, metrics = trainer.step(state, batch)
                             loss = float(jax.device_get(metrics["loss"]))
+                        self.ckpt.note_state(state)
                         first_step_on_mesh = False
                         losses.append(loss)
                         step += 1
@@ -400,6 +424,14 @@ class ElasticTrainer:
                 if step >= num_steps or self._stop.is_set():
                     return state, losses
         finally:
+            self.ckpt.close()  # disarms the emergency hook, drains uploads
+            if hasattr(self.ckpt.store, "close"):
+                self.ckpt.store.close()  # drain + stop the peer-push thread
+            if self._cache_server is not None:
+                try:
+                    self._cache_server.stop()
+                except Exception:
+                    pass
             if source is not None and hasattr(source, "close"):
                 source.close()
             if self._agent is not None:

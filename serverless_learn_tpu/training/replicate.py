@@ -1,0 +1,325 @@
+"""Peer state replication for fast rejoin (round 15).
+
+Every elastic remesh used to pay a full central-store round trip to
+restore the state it had JUST saved, and a rejoining worker always
+pulled from the (possibly distant, possibly partitioned) shard server.
+This module keeps the central store authoritative while adding two
+cheaper replicas of every checkpoint file:
+
+* a **worker-local cache** (a :class:`~serverless_learn_tpu.training.
+  checkpoint.LocalStore` directory): every ``put`` lands here first, so
+  the common remesh restore — "re-read the state I committed a moment
+  ago" — is a local disk read, not N ranged RPCs. The cache survives a
+  process crash (it's a directory), so a RESTARTED worker also rejoins
+  from local disk;
+* **peer replicas**: each commit is pushed, in commit order, to up to
+  ``fanout`` peer caches over the existing shard-server wire protocol
+  (each worker can serve its cache with :func:`serve_cache` — the
+  pure-Python protocol twin on an ephemeral port). A rejoining or
+  remeshing worker then restores from the nearest live peer's copy
+  instead of the central store.
+
+Reads stay verified: the Checkpointer consumes the replicas through
+``restore_sources()`` (cache → primary → peers) and CRC-checks whichever
+copy it loads, so a replica corrupted anywhere is healed by any intact
+copy of the same step before step-level fallback gives up ground
+(``training/checkpoint.py``).
+
+Pushes are strictly best-effort and ASYNCHRONOUS: a single daemon push
+thread drains a bounded FIFO queue (commit order preserved — a peer
+never sees a manifest before its blob), failures are counted
+(``slt_ckpt_replica_push_failures_total``), and a full queue drops the
+oldest entry rather than stalling the training thread.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from serverless_learn_tpu.telemetry import get_registry
+from serverless_learn_tpu.training.checkpoint import LocalStore
+
+
+def _default_peer_factory(addr: str):
+    from serverless_learn_tpu.training.checkpoint import ShardServerStore
+
+    return ShardServerStore(addr)
+
+
+class ReplicatedStore:
+    """Checkpoint store tiering: local cache + authoritative primary +
+    best-effort peer replicas, with the same put/get/list/delete surface
+    as LocalStore/ShardServerStore.
+
+    ``peers`` entries are either store objects (tests, in-process twins)
+    or ``host:port`` strings dialed lazily via ``peer_factory`` (default:
+    :class:`ShardServerStore`, i.e. a peer's :func:`serve_cache`
+    endpoint). Only the first ``fanout`` peers receive pushes; ALL peers
+    are candidates for restore reads.
+    """
+
+    _QUEUE_DEPTH = 256
+
+    def __init__(self, primary, cache: Optional[LocalStore] = None,
+                 peers: Sequence = (), fanout: int = 2,
+                 peer_factory: Optional[Callable] = None):
+        self.primary = primary
+        self.cache = cache
+        self._peer_specs = list(peers)
+        self._peer_factory = peer_factory or _default_peer_factory
+        self._peer_stores: List = [
+            None if isinstance(p, str) else p for p in self._peer_specs]
+        self.fanout = max(0, int(fanout))
+        self._q: "queue.Queue" = queue.Queue(maxsize=self._QUEUE_DEPTH)
+        self._push_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        reg = get_registry()
+        self._m_pushes = reg.counter(
+            "slt_ckpt_replica_pushes_total",
+            "checkpoint files pushed to peer replicas")
+        self._m_push_failures = reg.counter(
+            "slt_ckpt_replica_push_failures_total",
+            "peer pushes that failed or were dropped (best-effort)")
+
+    # -- peers --------------------------------------------------------------
+
+    def _peer(self, i: int):
+        p = self._peer_stores[i]
+        if p is None:
+            try:
+                p = self._peer_factory(self._peer_specs[i])
+            except (ConnectionError, OSError):
+                return None  # peer down; retried on the next use
+            self._peer_stores[i] = p
+        return p
+
+    def restore_sources(self) -> List[Tuple[str, object]]:
+        """(label, store) per replica, nearest first — the Checkpointer's
+        per-step read order."""
+        out: List[Tuple[str, object]] = []
+        if self.cache is not None:
+            out.append(("cache", self.cache))
+        out.append(("primary", self.primary))
+        for i, spec in enumerate(self._peer_specs):
+            p = self._peer(i)
+            if p is not None:
+                label = spec if isinstance(spec, str) else f"peer-{i}"
+                out.append((f"peer:{label}", p))
+        return out
+
+    # -- async peer push ----------------------------------------------------
+
+    def _enqueue(self, op: str, key: str, data: Optional[bytes]):
+        if self.fanout <= 0 or not self._peer_specs:
+            return
+        if self._push_thread is None:
+            self._push_thread = threading.Thread(
+                target=self._push_loop, daemon=True,
+                name=f"ckpt-replica-push-{id(self):x}")
+            self._push_thread.start()
+        try:
+            self._q.put_nowait((op, key, data))
+        except queue.Full:
+            # Never stall the training thread on a slow peer: drop the
+            # OLDEST entry (its step will be superseded) and count it.
+            self._m_push_failures.inc()
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                self._q.put_nowait((op, key, data))
+            except queue.Full:
+                pass
+
+    def _push_loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            op, key, data = item
+            for i in range(min(self.fanout, len(self._peer_specs))):
+                p = self._peer(i)
+                if p is None:
+                    self._m_push_failures.inc()
+                    continue
+                try:
+                    if op == "put":
+                        p.put(key, data)
+                    else:
+                        p.delete(key)
+                    self._m_pushes.inc()
+                except (ConnectionError, OSError):
+                    self._m_push_failures.inc()
+
+    def flush(self, timeout_s: float = 5.0):
+        """Best-effort wait until the push queue drains (tests, drain-on
+        -exit). Returns True when empty."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while not self._q.empty():
+            if _time.monotonic() > deadline:
+                return False
+            _time.sleep(0.005)
+        # queue empty != last item pushed; give the in-flight push a beat
+        _time.sleep(0.01)
+        return True
+
+    def close(self):
+        """Stop the push thread (pending pushes drain first). Leaves the
+        primary/cache/peer stores themselves open — this wrapper does not
+        own them."""
+        if self._push_thread is not None:
+            self._q.put(None)
+            self._push_thread.join(timeout=5.0)
+            self._push_thread = None
+
+    # -- store surface ------------------------------------------------------
+
+    def put(self, key: str, data: bytes):
+        # Local first (cheap, crash-persistent), peers next (async), the
+        # authoritative primary LAST — so when the primary is partitioned
+        # the replicas still carry the newest state for a rejoin, and the
+        # caller still sees the primary's failure.
+        if self.cache is not None:
+            self.cache.put(key, data)
+        self._enqueue("put", key, data)
+        self.primary.put(key, data)
+
+    def _absent(self) -> tuple:
+        from serverless_learn_tpu.control.client import KeyNotFound
+
+        return (FileNotFoundError, KeyNotFound)
+
+    def get(self, key: str) -> bytes:
+        absent = self._absent()
+        if key.endswith("/LATEST"):
+            # LATEST is the one MUTABLE key: the primary is the truth.
+            # Only when it is unreachable do the replicas vote — newest
+            # step wins (a lagging peer must not roll the run back).
+            try:
+                return self.primary.get(key)
+            except absent:
+                raise
+            except (ConnectionError, OSError) as e:
+                best = None
+                for _, src in self._replica_sources():
+                    try:
+                        data = src.get(key)
+                        step = int(json.loads(data)["step"])
+                    except Exception:
+                        continue
+                    if best is None or step > best[0]:
+                        best = (step, data)
+                if best is not None:
+                    return best[1]
+                raise e
+        if self.cache is not None:
+            try:
+                return self.cache.get(key)
+            except (FileNotFoundError, OSError):
+                pass
+        try:
+            data = self.primary.get(key)
+        except absent:
+            raise
+        except (ConnectionError, OSError) as e:
+            for _, src in self._replica_sources(skip_cache=True):
+                try:
+                    return src.get(key)
+                except Exception:
+                    continue
+            raise e
+        if self.cache is not None:
+            try:
+                self.cache.put(key, data)
+            except OSError:
+                pass
+        return data
+
+    def get_range(self, key: str, offset: int, length: int) -> bytes:
+        if self.cache is not None and self.cache.exists(key):
+            return self.cache.get_range(key, offset, length)
+        try:
+            return self.primary.get_range(key, offset, length)
+        except self._absent():
+            raise
+        except (ConnectionError, OSError) as e:
+            for _, src in self._replica_sources(skip_cache=True):
+                try:
+                    return src.get_range(key, offset, length)
+                except Exception:
+                    continue
+            raise e
+
+    def exists(self, key: str) -> bool:
+        if self.cache is not None and self.cache.exists(key):
+            return True
+        try:
+            return self.primary.exists(key)
+        except (ConnectionError, OSError):
+            for _, src in self._replica_sources(skip_cache=True):
+                try:
+                    if src.exists(key):
+                        return True
+                except Exception:
+                    continue
+            raise
+
+    def list(self, prefix: str):
+        try:
+            return self.primary.list(prefix)
+        except (ConnectionError, OSError):
+            # Primary unreachable: the union of the replicas' listings is
+            # the best available candidate set for a rejoin restore.
+            seen = {}
+            for _, src in self._replica_sources():
+                try:
+                    for k in src.list(prefix):
+                        seen[k] = True
+                except Exception:
+                    continue
+            return sorted(seen)
+
+    def delete(self, key: str):
+        if self.cache is not None:
+            try:
+                self.cache.delete(key)
+            except OSError:
+                pass
+        self._enqueue("delete", key, None)
+        self.primary.delete(key)
+
+    def _replica_sources(self, skip_cache: bool = False):
+        for label, src in self.restore_sources():
+            if label == "primary" or (skip_cache and label == "cache"):
+                continue
+            yield label, src
+
+
+def serve_cache(root: str, host: str = "127.0.0.1", port: int = 0):
+    """Serve a worker's local checkpoint cache to its peers over the
+    shard-server wire protocol (the in-process pure-Python twin). Returns
+    the running server; ``.addr`` is what goes into peers' config."""
+    from serverless_learn_tpu.control.py_daemons import PyShardServer
+
+    srv = PyShardServer(host=host, port=port, root=root)
+    srv.start()
+    return srv
+
+
+def maybe_replicated(store, cfg) -> object:
+    """Wrap ``store`` per ``config.CheckpointConfig`` — identity when no
+    cache and no peers are configured, so callers wire unconditionally."""
+    if cfg is None:
+        return store
+    peers = [p.strip() for p in (cfg.peers or "").split(",") if p.strip()]
+    if not cfg.cache_dir and not peers:
+        return store
+    cache = LocalStore(cfg.cache_dir) if cfg.cache_dir else None
+    return ReplicatedStore(store, cache=cache, peers=peers,
+                           fanout=cfg.replica_fanout)
