@@ -428,6 +428,11 @@ DEFAULT_MODULES = (
     # upload + emergency-save fields with flight's death path.
     "serverless_learn_tpu.training.replicate",
     "serverless_learn_tpu.training.checkpoint",
+    # round 16: DCN byte meters are written from the training thread AND
+    # the replica push thread; xray's last-summary handoff is written by
+    # capture threads and read by the exporter.
+    "serverless_learn_tpu.telemetry.dcn",
+    "serverless_learn_tpu.telemetry.xray",
 )
 
 

@@ -1211,12 +1211,13 @@ def cmd_doctor(args) -> int:
     endpoints = []
     for chunk in args.endpoints or []:
         endpoints.extend(e for e in chunk.split(",") if e.strip())
-    if not args.logs and not endpoints:
-        print("doctor needs event logs/flight dumps and/or --endpoints "
-              "(or --self-check)", file=sys.stderr)
+    if not args.logs and not endpoints and not args.xray:
+        print("doctor needs event logs/flight dumps, --endpoints and/or "
+              "--xray (or --self-check)", file=sys.stderr)
         return 2
     rep = doctor.diagnose(args.logs, endpoints,
-                          bench_history=args.bench_history, top=args.top)
+                          bench_history=args.bench_history, top=args.top,
+                          xray_dirs=args.xray or [])
     print(json.dumps(rep, indent=None if args.compact else 2))
     return 1 if rep["summary"]["critical_firing"] else 0
 
@@ -1281,6 +1282,45 @@ def cmd_profile(args) -> int:
         return 1
     print(json.dumps(rep, indent=2))
     return 0 if rep.get("ok") else 1
+
+
+def cmd_xray(args) -> int:
+    """Step-interior hardware attribution from an XLA device trace
+    (telemetry/xray.py): classify device events (compute / collective /
+    copy / host), compute exposed-collective and idle time per step,
+    roofline verdicts for costed ops, HBM watermarks — and one verdict
+    sentence naming where the step's hardware time went."""
+    from serverless_learn_tpu.telemetry import xray
+
+    if args.self_check:
+        rep = xray.self_check()
+        print(json.dumps(rep, indent=None if args.compact else 2))
+        return 0 if rep["ok"] else 1
+    if not args.captures:
+        print("xray needs capture dirs (profiler out_dirs / jax.profiler "
+              "logdirs) or --self-check", file=sys.stderr)
+        return 2
+    out = {}
+    ok = True
+    for path in args.captures:
+        try:
+            summary = xray.analyze_dir(path,
+                                       device_kind=args.device_kind)
+            if not args.full:
+                # The per-step list can be long on a dense capture; the
+                # default report keeps the first/last few.
+                steps = summary.get("steps") or {}
+                per = steps.get("per_step") or []
+                if len(per) > 2 * args.top:
+                    steps["per_step"] = per[:args.top] + per[-args.top:]
+                    steps["per_step_truncated"] = len(per)
+            out[path] = summary
+        except (FileNotFoundError, OSError, ValueError) as e:
+            out[path] = {"error": f"{type(e).__name__}: {e}"}
+            ok = False
+    print(json.dumps(out if len(out) > 1 else next(iter(out.values())),
+                     indent=None if args.compact else 2))
+    return 0 if ok else 1
 
 
 def cmd_bench(args) -> int:
@@ -1932,6 +1972,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="smoke-test the health engine: rules parse, a "
                          "healthy fixture stays quiet, a stalled counter "
                          "fires the watchdog; exit 0 on success (CI)")
+    dr.add_argument("--xray", action="append", metavar="CAPTURE_DIR",
+                    default=None,
+                    help="analyze these profiler capture dirs with "
+                         "`slt xray` and fold the hardware-attribution "
+                         "verdicts into the diagnosis")
     dr.set_defaults(fn=cmd_doctor)
 
     gp = sub.add_parser("goodput",
@@ -1967,6 +2012,33 @@ def build_parser() -> argparse.ArgumentParser:
     pf.add_argument("--seconds", type=float, default=3.0,
                     help="capture window length")
     pf.set_defaults(fn=cmd_profile)
+
+    xr = sub.add_parser("xray",
+                        help="step-interior hardware attribution from a "
+                             "jax.profiler capture: op taxonomy, exposed "
+                             "collectives per mesh axis, roofline "
+                             "verdicts, HBM watermarks, per-step "
+                             "breakdown")
+    xr.add_argument("captures", nargs="*", metavar="CAPTURE_DIR",
+                    help="profiler capture dirs (--profile-dir output, "
+                         "`slt profile` replies) or direct "
+                         "*.trace.json[.gz] files")
+    xr.add_argument("--device-kind", default=None,
+                    help="override the device kind for roofline peaks "
+                         "(default: capture-meta.json's stamp)")
+    xr.add_argument("--top", type=int, default=5,
+                    help="per-step rows kept from each end of a long "
+                         "capture (see --full)")
+    xr.add_argument("--full", action="store_true",
+                    help="keep every per-step row")
+    xr.add_argument("--compact", action="store_true",
+                    help="single-line JSON (for scripts)")
+    xr.add_argument("--self-check", action="store_true",
+                    help="CI smoke: the synthetic pipeline invariants "
+                         "hold exactly and the committed fixture capture "
+                         "re-analyzes to its committed summary; exit 1 "
+                         "on drift")
+    xr.set_defaults(fn=cmd_xray)
 
     bn = sub.add_parser("bench",
                         help="headline benchmark + perf regression gate "

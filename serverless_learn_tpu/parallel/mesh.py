@@ -37,7 +37,19 @@ def make_mesh(
         devices = jax.devices()
     config.validate(len(devices))
     dev_array = np.asarray(devices).reshape(config.shape)
-    return Mesh(dev_array, MeshConfig.AXIS_NAMES)
+    mesh = Mesh(dev_array, MeshConfig.AXIS_NAMES)
+    # Round 16: note the axis sizes for the profiler — capture-meta.json
+    # carries them so `slt xray` can put an axis NAME on a collective's
+    # replica groups ("exposed all-reduce on the dp axis"). Best-effort:
+    # telemetry must never fail a mesh build.
+    try:
+        from serverless_learn_tpu.telemetry import xray
+
+        xray.note_mesh_axes({a: int(s) for a, s in
+                             zip(mesh.axis_names, mesh.devices.shape)})
+    except Exception:
+        pass
+    return mesh
 
 
 def data_axes(mesh: Mesh) -> tuple:

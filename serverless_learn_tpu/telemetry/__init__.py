@@ -33,6 +33,17 @@ alert-triggered rate-limited captures stamped with the ledger snapshot),
 and the perf regression gate (``telemetry/benchgate.py``, ``slt bench
 --gate``) over ``bench_history.json``.
 
+PR 11 adds the hardware-attribution layer: `slt xray`
+(``telemetry/xray.py``) parses the device-op traces the profiler
+captures, classifies device events (compute / collective / copy / host),
+computes exposed-collective time per mesh axis, per-step breakdowns,
+roofline verdicts and HBM watermarks — stamped into every capture's
+``capture-meta.json``, served as ``/goodput``'s ``xray`` section,
+rendered in ``slt top``'s HW pane and folded into ``slt doctor``
+verdicts. ``telemetry/dcn.py`` adds per-consumer DCN byte accounting
+(``diloco`` / ``remesh`` / ``replica_push``) — the baseline the
+quantized-exchange work must beat.
+
 See the "Observability" section of ``docs/ARCHITECTURE.md`` for the metric
 naming scheme, endpoint formats, and the tracing data flow.
 """

@@ -40,6 +40,19 @@ DEFAULT_REL_THRESHOLD = 0.05
 # bench.py's headline series: the default gate scope.
 HEADLINE_METRIC = "resnet18_cifar_train_samples_per_sec_per_chip"
 
+# Hardware-attribution columns (round 16) ride the headline rows and
+# gate alongside the value: each is a fraction, judged with an ABSOLUTE
+# gap against the best comparable earlier row that carries it (rows
+# predating the column neither gate nor mask). exposed_comms_frac
+# regresses UP (collectives newly exposed); hw_util and
+# achieved_vs_roofline regress DOWN (the hardware got lazier even if
+# the analytic throughput held).
+ATTRIBUTION_COLUMNS = {
+    "exposed_comms_frac": ("min", 0.05),
+    "hw_util": ("max", 0.05),
+    "achieved_vs_roofline": ("max", 0.05),
+}
+
 
 def _better_for(metric) -> str:
     """Direction of goodness from the metric name: latency/step-time
@@ -83,8 +96,29 @@ def gate_entry(entry: dict, history: List[dict],
     row["best"] = best
     row["loss_rel"] = round(1 - entry["value"] / best, 4) if better == "max" \
         else round(entry["value"] / best - 1, 4)
-    row["ok"] = not worse
+    aux = _gate_attribution(entry, earlier)
+    if aux:
+        row["attribution"] = aux
+    row["ok"] = not worse and all(a["ok"] for a in aux)
     return row
+
+
+def _gate_attribution(entry: dict, earlier: List[dict]) -> List[dict]:
+    """Column-level checks for the round-16 attribution fields, against
+    the best comparable earlier row carrying each column."""
+    out = []
+    for col, (better_c, abs_gap) in ATTRIBUTION_COLUMNS.items():
+        v = entry.get(col)
+        prior = [h[col] for h in earlier
+                 if isinstance(h.get(col), (int, float))]
+        if not isinstance(v, (int, float)) or not prior:
+            continue
+        best_c = min(prior) if better_c == "min" else max(prior)
+        worse = (v > best_c + abs_gap if better_c == "min"
+                 else v < best_c - abs_gap)
+        out.append({"column": col, "value": v, "best": best_c,
+                    "threshold_abs": abs_gap, "ok": not worse})
+    return out
 
 
 def gate_history(history: List[dict],

@@ -1,0 +1,230 @@
+"""DCN byte accounting: who is putting bytes on the data-center network.
+
+The EQuARX-style quantized-exchange item (ROADMAP) promises "~4x fewer
+DCN bytes" — a claim nobody can verify without a per-consumer byte
+baseline. This module is that baseline: every DCN consumer records its
+transfers through one helper, yielding
+
+* ``slt_dcn_bytes_total{consumer=...,direction=tx|rx}`` — the byte
+  counters the before/after comparison reads;
+* ``slt_dcn_transfers_total{consumer=...}`` and
+  ``slt_dcn_transfer_seconds{consumer=...}`` — how many transfers and
+  their duration distribution;
+* ``slt_dcn_transfer_time_seconds_total{consumer=...}`` — cumulative
+  transfer wall-clock (the bandwidth denominator, scrape-derivable);
+* ``slt_dcn_effective_bandwidth_bytes_per_s{consumer=...}`` — cumulative
+  bytes / cumulative transfer seconds, the effective-bandwidth gauge the
+  `slt top` HW pane renders per consumer.
+
+The three instrumented consumers (round 16):
+
+* ``diloco`` — the DiLoCo outer-boundary delta PUT / anchor GET
+  (``training/diloco_dcn.py``);
+* ``remesh`` — elastic drain→save→restore state streaming through the
+  checkpoint store (``training/elastic.py``);
+* ``replica_push`` — ``ReplicatedStore``'s async peer checkpoint pushes
+  (``training/replicate.py``).
+
+:class:`InstrumentedStore` wraps any checkpoint-store-shaped object
+(put/get/get_range/list/exists/delete) and records data-bearing calls;
+metadata calls (exists/list/delete) are not byte-counted. Wrapping is
+transparent: unknown attributes delegate, and ``restore_sources()``
+re-wraps each replica so failover reads stay attributed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import List, Tuple
+
+from serverless_learn_tpu.telemetry.registry import (LATENCY_BUCKETS,
+                                                     get_registry)
+
+KNOWN_CONSUMERS = ("diloco", "remesh", "replica_push")
+
+_meters_lock = threading.Lock()
+# registry -> {consumer: _Meter}. WEAK keys: an id()-keyed cache would
+# let a freed test registry's recycled id hijack the global registry's
+# meters (observed — bytes silently landing in dead counters).
+_meters: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+class _Meter:
+    """Cached metric handles + cumulative state for one (registry,
+    consumer) pair. The cumulative pair lives here (not re-read from the
+    counters) so the bandwidth gauge is race-free without holding two
+    metric locks at once."""
+
+    def __init__(self, reg, consumer: str):
+        self.tx = reg.counter(
+            "slt_dcn_bytes_total",
+            "bytes moved over DCN, by consumer and direction",
+            consumer=consumer, direction="tx")
+        self.rx = reg.counter(
+            "slt_dcn_bytes_total",
+            "bytes moved over DCN, by consumer and direction",
+            consumer=consumer, direction="rx")
+        self.transfers = reg.counter(
+            "slt_dcn_transfers_total",
+            "DCN transfers, by consumer", consumer=consumer)
+        self.seconds = reg.counter(
+            "slt_dcn_transfer_time_seconds_total",
+            "cumulative DCN transfer wall-clock, by consumer",
+            consumer=consumer)
+        self.hist = reg.histogram(
+            "slt_dcn_transfer_seconds",
+            "per-transfer duration, by consumer",
+            buckets=LATENCY_BUCKETS, consumer=consumer)
+        self.bw = reg.gauge(
+            "slt_dcn_effective_bandwidth_bytes_per_s",
+            "cumulative bytes / cumulative transfer seconds, by consumer",
+            consumer=consumer)
+        self._lock = threading.Lock()
+        self._bytes = 0.0
+        self._seconds = 0.0
+
+    def record(self, direction: str, nbytes: int, seconds: float):
+        nbytes = max(0, int(nbytes))
+        seconds = max(0.0, float(seconds))
+        (self.tx if direction == "tx" else self.rx).inc(nbytes)
+        self.transfers.inc()
+        self.seconds.inc(seconds)
+        self.hist.observe(seconds)
+        with self._lock:
+            self._bytes += nbytes
+            self._seconds += seconds
+            bw = self._bytes / self._seconds if self._seconds > 0 else None
+        if bw is not None:
+            self.bw.set(bw)
+
+
+def meter(consumer: str, registry=None) -> _Meter:
+    reg = registry or get_registry()
+    with _meters_lock:
+        per_reg = _meters.get(reg)
+        if per_reg is None:
+            per_reg = {}
+            _meters[reg] = per_reg
+        m = per_reg.get(consumer)
+        if m is None:
+            m = _Meter(reg, consumer)
+            per_reg[consumer] = m
+        return m
+
+
+def record_transfer(consumer: str, direction: str, nbytes: int,
+                    seconds: float, registry=None):
+    """Record one DCN transfer. ``direction``: ``tx`` (this process sent
+    bytes) or ``rx`` (received)."""
+    if direction not in ("tx", "rx"):
+        raise ValueError(f"direction must be tx or rx, got {direction!r}")
+    meter(consumer, registry).record(direction, nbytes, seconds)
+
+
+def snapshot(registry=None) -> List[dict]:
+    """Per-consumer rollup rows from the registry (used by tests and the
+    `slt top --once` acceptance): ``{"consumer", "tx_bytes", "rx_bytes",
+    "transfers", "seconds", "bandwidth_bytes_per_s"}``."""
+    reg = registry or get_registry()
+    snap = reg.snapshot()
+    rows: dict = {}
+
+    def row(consumer: str) -> dict:
+        return rows.setdefault(consumer, {
+            "consumer": consumer, "tx_bytes": 0.0, "rx_bytes": 0.0,
+            "transfers": 0.0, "seconds": 0.0,
+            "bandwidth_bytes_per_s": None})
+
+    for series in (snap.get("slt_dcn_bytes_total") or {}).get("series", []):
+        lab = series["labels"]
+        key = "tx_bytes" if lab.get("direction") == "tx" else "rx_bytes"
+        row(lab.get("consumer", "?"))[key] += series["value"]
+    for series in (snap.get("slt_dcn_transfers_total") or {}
+                   ).get("series", []):
+        row(series["labels"].get("consumer", "?"))["transfers"] += \
+            series["value"]
+    for series in (snap.get("slt_dcn_transfer_time_seconds_total") or {}
+                   ).get("series", []):
+        row(series["labels"].get("consumer", "?"))["seconds"] += \
+            series["value"]
+    for series in (snap.get("slt_dcn_effective_bandwidth_bytes_per_s") or {}
+                   ).get("series", []):
+        row(series["labels"].get("consumer", "?"))[
+            "bandwidth_bytes_per_s"] = series["value"]
+    return sorted(rows.values(), key=lambda r: r["consumer"])
+
+
+class InstrumentedStore:
+    """Wrap a checkpoint-store-shaped object so data-bearing calls record
+    DCN transfers under ``consumer``. Metadata calls pass through
+    uncounted; unknown attributes delegate to the inner store."""
+
+    def __init__(self, inner, consumer: str, registry=None):
+        self._inner = inner
+        self._consumer = consumer
+        self._registry = registry
+
+    def _record(self, direction: str, nbytes: int, seconds: float):
+        try:
+            record_transfer(self._consumer, direction, nbytes, seconds,
+                            registry=self._registry)
+        except Exception:
+            pass  # accounting must never hurt the transfer it measures
+
+    # -- data-bearing calls -------------------------------------------------
+
+    def put(self, key: str, data: bytes):
+        t0 = time.monotonic()
+        out = self._inner.put(key, data)
+        self._record("tx", len(data or b""), time.monotonic() - t0)
+        return out
+
+    def get(self, key: str) -> bytes:
+        t0 = time.monotonic()
+        data = self._inner.get(key)
+        self._record("rx", len(data or b""), time.monotonic() - t0)
+        return data
+
+    def get_range(self, key: str, offset: int, length: int) -> bytes:
+        t0 = time.monotonic()
+        data = self._inner.get_range(key, offset, length)
+        self._record("rx", len(data or b""), time.monotonic() - t0)
+        return data
+
+    # -- metadata calls (uncounted) ----------------------------------------
+
+    def exists(self, key: str) -> bool:
+        return self._inner.exists(key)
+
+    def list(self, prefix: str):
+        return self._inner.list(prefix)
+
+    def delete(self, key: str):
+        return self._inner.delete(key)
+
+    def restore_sources(self) -> List[Tuple[str, object]]:
+        """Re-wrap each replica source so failover reads stay attributed
+        to this consumer; a store without tiering is its own source."""
+        inner_rs = getattr(self._inner, "restore_sources", None)
+        if inner_rs is None:
+            return [("primary", self)]
+        return [(label, InstrumentedStore(src, self._consumer,
+                                          self._registry))
+                for label, src in inner_rs()]
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def instrument_store(store, consumer: str, registry=None,
+                     enabled: bool = True):
+    """Wrap ``store`` for byte accounting; identity when disabled or
+    already wrapped for the same consumer (re-entrant wiring is safe)."""
+    if not enabled or store is None:
+        return store
+    if isinstance(store, InstrumentedStore) and \
+            store._consumer == consumer:
+        return store
+    return InstrumentedStore(store, consumer, registry=registry)

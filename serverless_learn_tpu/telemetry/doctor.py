@@ -261,9 +261,13 @@ def bench_regressions(history_path: str, rel_threshold: float = 0.05,
 
 
 def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
-             bench_history: Optional[str] = None, top: int = 10) -> dict:
+             bench_history: Optional[str] = None, top: int = 10,
+             xray_dirs: Sequence[str] = ()) -> dict:
     """Merge every source into one ranked diagnosis report (pure data —
-    the CLI prints it; tests assert on it)."""
+    the CLI prints it; tests assert on it). ``xray_dirs`` are profiler
+    capture directories to (re-)analyze with ``telemetry/xray.py``; a
+    ``capture-meta.json`` passed among ``paths`` contributes its stamped
+    xray summary without re-analysis."""
     collected = collect_files(paths)
     records = collected["records"]
     scrapes = scrape_alerts(endpoints)
@@ -402,6 +406,32 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
         verdict_bits.append(
             f"{len(emergencies)} emergency checkpoint save(s) on the "
             f"death path" + (f" (step(s) {steps_e})" if steps_e else ""))
+    # Step-interior hardware attribution (round 16): xray summaries —
+    # from capture-meta.json records in the event trail and from capture
+    # dirs handed to --xray — put a NAME on the training plateau ("step
+    # is 31% exposed all-reduce on the dp axis") straight from a device
+    # trace, where the ledger above can only say "step".
+    xray_rows: List[dict] = []
+    for rec in records:
+        if rec.get("event") == "profile_capture" and \
+                isinstance(rec.get("xray"), dict):
+            xray_rows.append({"source": rec.get("reason", "capture"),
+                              "summary": rec["xray"]})
+    if xray_dirs:
+        from serverless_learn_tpu.telemetry import xray as _xray
+
+        for d in xray_dirs:
+            try:
+                xray_rows.append({"source": d,
+                                  "summary": _xray.compact_summary(
+                                      _xray.analyze_dir(d))})
+            except Exception as e:
+                xray_rows.append({"source": d,
+                                  "error": f"{type(e).__name__}: {e}"})
+    for row in xray_rows:
+        verdict = (row.get("summary") or {}).get("verdict")
+        if verdict:
+            verdict_bits.append(f"xray[{row['source']}]: {verdict}")
     if bench and bench["regressions"]:
         verdict_bits.append(
             f"{len(bench['regressions'])} bench regression(s) vs history")
@@ -435,6 +465,7 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
         "alerts": ranked,
         "stragglers": stragglers,
         "goodput": goodput_by_node,
+        "xray": xray_rows,
         "flight_dumps": collected["dumps"],
         "bench": bench,
         "scrapes": [{k: v for k, v in s.items() if k != "payload"}

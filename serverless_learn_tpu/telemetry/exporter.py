@@ -146,8 +146,11 @@ class MetricsExporter:
 
     def _goodput(self) -> dict:
         """The /goodput body: the process ledger's report, MFU-weighted
-        when the trainer has published ``slt_train_mfu``."""
-        from serverless_learn_tpu.telemetry import goodput
+        when the trainer has published ``slt_train_mfu``, plus the
+        sub-step hardware breakdown from the newest xray'd capture
+        (round 16) — the ledger says where the run's wall-clock went,
+        the xray section says where the *step's* hardware time went."""
+        from serverless_learn_tpu.telemetry import goodput, xray
 
         try:
             mfu = None
@@ -157,7 +160,11 @@ class MetricsExporter:
                         if isinstance(s.get("value"), (int, float))]
                 if vals:
                     mfu = max(vals)
-            return dict(goodput.get_ledger().report(mfu=mfu), enabled=True)
+            rep = dict(goodput.get_ledger().report(mfu=mfu), enabled=True)
+            last = xray.get_last_summary()
+            if last:
+                rep["xray"] = xray.compact_summary(last)
+            return rep
         except Exception as e:
             return {"enabled": True,
                     "error": f"{type(e).__name__}: {e}"}

@@ -32,12 +32,15 @@ def parse_prometheus_text(text: str) -> dict:
     """Parse an exposition into
     {"types": {name: type}, "values": {name: summed value},
      "hists": {name: {"buckets": [...], "cumulative": [...],
-                      "sum": s, "count": c}}}.
-    Series are summed across labels — `slt top` shows per-endpoint rollups,
-    not per-label drilldowns."""
+                      "sum": s, "count": c}},
+     "labeled": {name: [(labels_dict, value), ...]}}.
+    Series are summed across labels — `slt top` shows per-endpoint rollups
+    — except "labeled", which keeps the per-label series for the panes
+    that genuinely drill down (the HW pane's per-consumer DCN rows)."""
     types: Dict[str, str] = {}
     values: Dict[str, float] = {}
     hists: Dict[str, dict] = {}
+    labeled: Dict[str, list] = {}
 
     def hist_for(name: str) -> dict:
         return hists.setdefault(
@@ -82,6 +85,8 @@ def parse_prometheus_text(text: str) -> dict:
                 break
         else:
             values[name] = values.get(name, 0.0) + value
+            if labels:
+                labeled.setdefault(name, []).append((labels, value))
     out_h = {}
     for name, h in hists.items():
         les = sorted(h["bucket_counts"])
@@ -89,7 +94,8 @@ def parse_prometheus_text(text: str) -> dict:
             "buckets": [le for le in les if le != float("inf")],
             "cumulative": [h["bucket_counts"][le] for le in les],
             "sum": h["sum"], "count": h["count"]}
-    return {"types": types, "values": values, "hists": out_h}
+    return {"types": types, "values": values, "hists": out_h,
+            "labeled": labeled}
 
 
 def _p(h: Optional[dict], q: float) -> Optional[float]:
@@ -106,6 +112,19 @@ def _num(x: Optional[float], nd: int = 1) -> str:
     if x is None:
         return "-"
     return f"{x:.{nd}f}" if abs(x) < 1e5 else f"{x:.3g}"
+
+
+def _bytes_rate(x: Optional[float]) -> str:
+    if x is None:
+        return "-"
+    for unit, div in (("GB/s", 1e9), ("MB/s", 1e6), ("kB/s", 1e3)):
+        if x >= div:
+            return f"{x / div:.1f}{unit}"
+    return f"{x:.0f}B/s"
+
+
+def _pct(x: Optional[float]) -> str:
+    return "-" if x is None else f"{x * 100:.0f}%"
 
 
 class EndpointState:
@@ -176,6 +195,11 @@ class EndpointState:
         if self.data is None:
             return None
         return self.data["hists"].get(name)
+
+    def labeled(self, name: str) -> List[Tuple[Dict[str, str], float]]:
+        if self.data is None:
+            return []
+        return self.data.get("labeled", {}).get(name, [])
 
 
 def render(states: List[EndpointState]) -> str:
@@ -325,6 +349,37 @@ def render(states: List[EndpointState]) -> str:
         lines.append("  GOODPUT")
         lines += _table(["endpoint", "goodput", "mfu-wtd", "total s",
                          "top badput"], goodput_rows)
+    # HW pane (round 16): the step-interior view — HBM watermarks,
+    # exposed-collective share and the xray verdict from the newest
+    # capture (/goodput's xray section), plus per-consumer effective DCN
+    # bandwidth straight from the slt_dcn_* series.
+    hw_rows: List[List[str]] = []
+    for st in states:
+        xr = (st.goodput or {}).get("xray") or {}
+        dcn = sorted(st.labeled("slt_dcn_effective_bandwidth_bytes_per_s"),
+                     key=lambda lv: lv[0].get("consumer", ""))
+        if not xr and not dcn:
+            continue
+        dcn_col = " ".join(
+            f"{lab.get('consumer', '?')}={_bytes_rate(v)}"
+            for lab, v in dcn) or "-"
+        hbm = xr.get("hbm") or {}
+        verdict = str(xr.get("verdict") or "-")
+        hw_rows.append([
+            st.addr,
+            f"{_pct(hbm.get('live_frac'))}/{_pct(hbm.get('peak_frac'))}",
+            _pct(xr.get("busy_frac")),
+            _pct(xr.get("exposed_comms_frac")),
+            _pct(xr.get("hbm_bound_frac")),
+            dcn_col,
+            verdict if len(verdict) <= 48 else verdict[:45] + "...",
+        ])
+    if hw_rows:
+        lines.append("")
+        lines.append("  HW")
+        lines += _table(["endpoint", "hbm live/peak", "busy",
+                         "exp comms", "hbm-bound", "dcn bw", "xray"],
+                        hw_rows)
     if alert_rows:
         lines.append("")
         lines.append("  ALERTS")

@@ -137,10 +137,15 @@ class DilocoIsland:
         # outer-step publish lands in the local cache and is pushed to
         # peer replicas, so a rejoining island adopts the current anchor
         # from the nearest live peer instead of the central store.
+        from serverless_learn_tpu.telemetry.dcn import instrument_store
         from serverless_learn_tpu.training.replicate import maybe_replicated
 
-        self.store = maybe_replicated(store,
-                                      getattr(config, "checkpoint", None))
+        # Round 16: every outer-boundary delta PUT / anchor GET is a DCN
+        # transfer — counted under consumer="diloco" so the quantized-
+        # exchange work has a byte baseline to beat (telemetry/dcn.py).
+        self.store = maybe_replicated(
+            instrument_store(store, "diloco"),
+            getattr(config, "checkpoint", None))
         self.run = run_name
         self.inner_steps = inner_steps or lcfg.inner_steps
         self.outer_lr = outer_lr if outer_lr is not None else lcfg.outer_lr

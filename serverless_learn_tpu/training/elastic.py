@@ -41,6 +41,7 @@ from serverless_learn_tpu.data.datasets import Prefetcher
 from serverless_learn_tpu.parallel.mesh import make_mesh
 from serverless_learn_tpu.telemetry import flight, get_registry, goodput
 from serverless_learn_tpu.telemetry import tracing as ttrace
+from serverless_learn_tpu.telemetry.dcn import instrument_store
 from serverless_learn_tpu.training.checkpoint import Checkpointer
 from serverless_learn_tpu.training.loop import make_source
 from serverless_learn_tpu.training.replicate import (maybe_replicated,
@@ -96,7 +97,12 @@ class ElasticTrainer:
         # replicas make a rejoin survive a slow or partitioned central
         # store, and restores verify checksums with corrupt steps
         # quarantined (falling back to the newest verified step).
-        store = maybe_replicated(store, config.checkpoint)
+        # Round 16: remesh state streaming (drain->save->restore through
+        # the checkpoint store) is a DCN consumer — byte-counted under
+        # consumer="remesh" (telemetry/dcn.py), so `slt top` can show what
+        # an epoch transition actually shipped.
+        store = maybe_replicated(instrument_store(store, "remesh"),
+                                 config.checkpoint)
         self._cache_server = None
         if (config.checkpoint.serve_cache and config.checkpoint.cache_dir):
             self._cache_server = serve_cache(

@@ -37,6 +37,7 @@ from __future__ import annotations
 import json
 import queue
 import threading
+import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from serverless_learn_tpu.telemetry import get_registry
@@ -136,6 +137,8 @@ class ReplicatedStore:
                 pass
 
     def _push_loop(self):
+        from serverless_learn_tpu.telemetry import dcn
+
         while True:
             item = self._q.get()
             if item is None:
@@ -146,12 +149,21 @@ class ReplicatedStore:
                 if p is None:
                     self._m_push_failures.inc()
                     continue
+                t0 = time.monotonic()
                 try:
                     if op == "put":
                         p.put(key, data)
                     else:
                         p.delete(key)
                     self._m_pushes.inc()
+                    if op == "put":
+                        # Round 16: peer pushes are the third DCN
+                        # consumer — byte-counted per transfer so the
+                        # replication tier's network cost is visible
+                        # next to diloco/remesh (telemetry/dcn.py).
+                        dcn.record_transfer(
+                            "replica_push", "tx", len(data or b""),
+                            time.monotonic() - t0)
                 except (ConnectionError, OSError):
                     self._m_push_failures.inc()
 

@@ -29,22 +29,60 @@ PEAK_TFLOPS_BF16 = {
     "TPU v6 lite": 918.0,  # v6e / Trillium
 }
 
+# device_kind -> peak HBM bandwidth, GB/s per chip (published specs; the
+# 819 GB/s v5e figure is the one docs/MFU_ANALYSIS.md already reasons
+# with). The roofline ridge point is peak_flops / peak_bw FLOPs/byte —
+# ops below it are HBM-bound no matter how good the kernel is.
+PEAK_HBM_GBPS = {
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,   # v5e
+    "TPU v5": 2765.0,       # v5p
+    "TPU v6 lite": 1640.0,  # v6e / Trillium
+}
+
+
+def _lookup_kind(table: dict, kind: str) -> Optional[float]:
+    for name, v in table.items():
+        if kind.startswith(name):
+            return v
+    return None
+
+
+def peak_flops_for_kind(kind: str) -> Optional[float]:
+    """Peak bf16 FLOP/s for a device_kind string (no jax import — the
+    xray analyzer runs on deviceless nodes against recorded captures)."""
+    tf = _lookup_kind(PEAK_TFLOPS_BF16, kind)
+    return tf * 1e12 if tf else None
+
+
+def peak_hbm_bytes_per_s_for_kind(kind: str) -> Optional[float]:
+    """Peak HBM bytes/s for a device_kind string, or None if unknown."""
+    gb = _lookup_kind(PEAK_HBM_GBPS, kind)
+    return gb * 1e9 if gb else None
+
 
 def peak_flops_per_chip(device=None) -> Optional[float]:
     """Peak bf16 FLOP/s for one chip, or None if unknown."""
     import jax
 
     kind = (device or jax.devices()[0]).device_kind
-    for name, tf in PEAK_TFLOPS_BF16.items():
-        if kind.startswith(name):
-            return tf * 1e12
-    return None
+    return peak_flops_for_kind(kind)
 
 
-def compiled_step_flops(step_fn, *args, n_devices: int = 1
-                        ) -> Optional[float]:
-    """Total FLOPs of one compiled call of ``step_fn(*args)`` across the
-    whole mesh. None when the backend doesn't expose a cost analysis.
+def peak_hbm_bytes_per_s(device=None) -> Optional[float]:
+    """Peak HBM bytes/s for one chip, or None if unknown."""
+    import jax
+
+    kind = (device or jax.devices()[0]).device_kind
+    return peak_hbm_bytes_per_s_for_kind(kind)
+
+
+def compiled_step_cost(step_fn, *args, n_devices: int = 1
+                       ) -> Optional[dict]:
+    """XLA's own compiled cost model for one call of ``step_fn(*args)``:
+    ``{"flops": F, "bytes_accessed": B}`` across the whole mesh (either
+    value may be absent when the backend doesn't report it). None when no
+    cost analysis is exposed at all.
 
     ``n_devices`` MUST be the mesh size the function is jitted over: under
     SPMD, ``cost_analysis()`` reports the per-shard partitioned module's
@@ -70,8 +108,23 @@ def compiled_step_flops(step_fn, *args, n_devices: int = 1
         analysis = analysis[0] if analysis else None
     if not isinstance(analysis, dict):
         return None
+    out = {}
     flops = analysis.get("flops")
-    return float(flops) * n_devices if flops else None
+    if flops:
+        out["flops"] = float(flops) * n_devices
+    # The key XLA emits is literally "bytes accessed" (space included).
+    nbytes = analysis.get("bytes accessed")
+    if nbytes:
+        out["bytes_accessed"] = float(nbytes) * n_devices
+    return out or None
+
+
+def compiled_step_flops(step_fn, *args, n_devices: int = 1
+                        ) -> Optional[float]:
+    """Total FLOPs of one compiled call of ``step_fn(*args)`` across the
+    whole mesh. None when the backend doesn't expose a cost analysis."""
+    cost = compiled_step_cost(step_fn, *args, n_devices=n_devices)
+    return cost.get("flops") if cost else None
 
 
 def mfu(flops_per_step: Optional[float], step_time_s: float,

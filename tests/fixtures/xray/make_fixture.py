@@ -1,0 +1,135 @@
+"""Regenerate the committed xray fixture capture.
+
+Runs a real tiny-model training loop (mlp_mnist, dp=8 virtual CPU
+devices) under ``telemetry/profiler.capture_session``, then *sanitizes*
+the capture for committing:
+
+* only trace metadata + device-op events are kept (host-side python
+  spans carry machine paths and are not what xray reads);
+* timestamps are rebased to t=0;
+* ``all-reduce`` events gain the ``replica_groups`` arg a TPU trace
+  carries (the real dp=8 group — the CPU runtime just doesn't stamp it),
+  so the fixture exercises mesh-axis recovery;
+* ``capture-meta.json`` keeps the real ledger snapshot, mesh axes and
+  device kind from the generating run.
+
+The matching ``expected_summary.json`` is the analyzer's output over the
+sanitized capture — ``slt xray --self-check`` fails on any drift.
+
+Usage (from the repo root):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tests/fixtures/xray/make_fixture.py
+"""
+
+import glob
+import gzip
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+FIXTURE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(FIXTURE)))
+sys.path.insert(0, ROOT)
+
+N_STEPS = 3
+BATCH = 1024
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from serverless_learn_tpu.config import (DataConfig, ExperimentConfig,
+                                             MeshConfig, OptimizerConfig,
+                                             TrainConfig)
+    from serverless_learn_tpu.data.datasets import SyntheticSource
+    from serverless_learn_tpu.telemetry import profiler, xray
+    from serverless_learn_tpu.telemetry.goodput import PhaseLedger
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    n_dev = len(jax.devices())
+    cfg = ExperimentConfig(
+        model="mlp_mnist",
+        mesh=MeshConfig(dp=n_dev),
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.1),
+        train=TrainConfig(batch_size=BATCH),
+        data=DataConfig(),
+    )
+    trainer = build_trainer(cfg)
+    state = trainer.init()
+    src = iter(SyntheticSource(trainer.bundle.make_batch, cfg.data,
+                               cfg.train.batch_size, seed=0))
+    batch = trainer.shard_batch(next(src))
+    ledger = PhaseLedger(emit=False)
+    ledger.ensure_started()
+    with ledger.phase("compile"):
+        state, m = trainer.step(state, batch)
+        float(jax.device_get(m["loss"]))
+    raw = tempfile.mkdtemp(prefix="slt-xray-fixture-")
+    with profiler.capture_session(raw):
+        for _ in range(N_STEPS):
+            with ledger.phase("step"):
+                state, m = trainer.step(state, batch)
+                float(jax.device_get(m["loss"]))
+    ledger_report = ledger.report()
+
+    src_trace = glob.glob(os.path.join(
+        raw, "plugins", "profile", "*", "*.trace.json.gz"))[0]
+    with gzip.open(src_trace) as f:
+        trace = json.load(f)
+
+    # -- sanitize ------------------------------------------------------------
+    keep = []
+    t0 = None
+    group = "{" + ",".join(str(i) for i in range(n_dev)) + "}"
+    for e in trace.get("traceEvents", []):
+        args = e.get("args") or {}
+        if e.get("ph") == "M":
+            keep.append(e)
+            continue
+        if e.get("ph") != "X" or "hlo_op" not in args:
+            continue
+        if t0 is None or e["ts"] < t0:
+            t0 = e["ts"]
+        if str(e.get("name", "")).startswith("all-reduce"):
+            e = dict(e, args=dict(
+                args, long_name=f"replica_groups={{{group}}}"))
+        keep.append(e)
+    for e in keep:
+        if "ts" in e and t0 is not None:
+            e["ts"] = round(e["ts"] - t0, 3)
+
+    out_dir = os.path.join(FIXTURE, "tiny-train")
+    shutil.rmtree(out_dir, ignore_errors=True)
+    run_dir = os.path.join(out_dir, "plugins", "profile", "fixture")
+    os.makedirs(run_dir)
+    with gzip.open(os.path.join(run_dir, "fixture.trace.json.gz"), "wt",
+                   compresslevel=9) as f:
+        json.dump({"displayTimeUnit": trace.get("displayTimeUnit", "ns"),
+                   "traceEvents": keep}, f)
+    mesh_axes = {a: int(s) for a, s in
+                 zip(trainer.mesh.axis_names, trainer.mesh.devices.shape)}
+    meta = {"event": "profile_capture", "reason": "fixture",
+            "seconds": None,
+            "device_kind": jax.devices()[0].device_kind,
+            "mesh_axes": mesh_axes,
+            "ledger_at_trigger": ledger_report,
+            "n_steps": N_STEPS, "batch_size": BATCH}
+    with open(os.path.join(out_dir, "capture-meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+
+    summary = xray.analyze_dir(out_dir)
+    with open(os.path.join(FIXTURE, "expected_summary.json"), "w") as f:
+        json.dump(summary, f, indent=1, sort_keys=True)
+    shutil.rmtree(raw, ignore_errors=True)
+    print(json.dumps({"events": len(keep),
+                      "steps": summary["steps"]["n"],
+                      "coverage": summary["coverage_frac"],
+                      "verdict": summary["verdict"]}, indent=1))
+
+
+if __name__ == "__main__":
+    main()
