@@ -433,6 +433,10 @@ DEFAULT_MODULES = (
     # capture threads and read by the exporter.
     "serverless_learn_tpu.telemetry.dcn",
     "serverless_learn_tpu.telemetry.xray",
+    # round 17: the numerics step ring + last-report handoff are written
+    # by the training thread's auditor and read by the health engine's
+    # sampler thread and the exporter's /numerics scrapes.
+    "serverless_learn_tpu.telemetry.numerics",
 )
 
 

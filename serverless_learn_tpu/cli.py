@@ -21,6 +21,7 @@ Here one typed CLI fronts everything:
     python -m serverless_learn_tpu trace        # cross-node timeline from span logs
     python -m serverless_learn_tpu doctor       # ranked cluster diagnosis
     python -m serverless_learn_tpu goodput      # goodput/badput accounting report
+    python -m serverless_learn_tpu numerics     # training-quality: fingerprint diff/bisect
     python -m serverless_learn_tpu profile      # trigger a device-trace capture
     python -m serverless_learn_tpu bench        # perf regression gate (--gate)
     python -m serverless_learn_tpu check        # project-aware static analysis
@@ -176,6 +177,14 @@ def _add_train_flags(p: argparse.ArgumentParser):
                         "— served at /alerts on the metrics endpoint, "
                         "flipping /healthz to 503 on critical (config "
                         "health.enabled=true does the same)")
+    p.add_argument("--numerics", action="store_true",
+                   help="enable training-quality observability: in-graph "
+                        "per-subtree tensor stats + fingerprints in the "
+                        "jitted step, cadence-gated host fetch (config "
+                        "numerics.cadence), NaN/Inf provenance on the "
+                        "first non-finite step, and loss-health alerts "
+                        "through --health (config numerics.enabled=true "
+                        "does the same)")
     p.add_argument("-v", "--verbose", action="store_true")
     # Multi-host: either serverless bootstrap via the native coordinator
     # (--world-size) or explicit topology (--num-processes/--process-id).
@@ -348,6 +357,11 @@ def cmd_train(args) -> int:
 
     _init_tracing_from_args(args)
     cfg = _config_from_args(args)
+    if getattr(args, "numerics", False) and not cfg.numerics.enabled:
+        import dataclasses as _dc
+
+        cfg = cfg.override(numerics=_dc.replace(cfg.numerics,
+                                                enabled=True))
     exporter = _start_metrics(args)
     health = _start_health(args, cfg, exporter=exporter)
 
@@ -405,9 +419,27 @@ def cmd_train(args) -> int:
                 if every and step % every == 0:
                     ckpt.save(state)
 
+        trainer = None
+        auditor = None
+        if cfg.numerics.enabled:
+            # Build the trainer here so the auditor can wire the
+            # checkpointer's note_state host shadow as its pre-donation
+            # provenance source (round 17); run_training reuses it.
+            from serverless_learn_tpu.training.audit import NumericsAuditor
+            from serverless_learn_tpu.training.train_step import (
+                build_trainer)
+
+            trainer = build_trainer(cfg)
+            auditor = NumericsAuditor(
+                cfg, bundle=trainer.bundle,
+                shadow_fn=ckpt.host_shadow if ckpt is not None else None)
         with _bracket_ctx():
-            state, meter = run_training(cfg, step_callback=callback,
-                                        verbose=args.verbose)
+            state, meter = run_training(cfg, trainer=trainer,
+                                        step_callback=callback,
+                                        verbose=args.verbose,
+                                        auditor=auditor)
+        if auditor is not None:
+            auditor.close()
         if ckpt is not None:
             ckpt.save(state)
             ckpt.wait()
@@ -1265,6 +1297,80 @@ def cmd_goodput(args) -> int:
     return 0
 
 
+def cmd_numerics(args) -> int:
+    """Training-quality observability (telemetry/numerics.py):
+
+    * ``slt numerics diff A B`` — bisect two recorded fingerprint trails
+      (``--events-log`` JSONL, a dedicated ``numerics.fingerprint_log``,
+      or a flight dump) to the FIRST step and the FIRST parameter
+      subtree that diverged. Exit 1 when they diverged — scriptable as
+      the parity gate ROADMAP items 1-2 need.
+    * ``slt numerics summary LOG...`` — per-run stat digest: audited
+      steps, grad-norm/update-ratio ranges, non-finite incidents with
+      their provenance (first bad layer), replica divergence.
+    * ``slt numerics --self-check`` — CI smoke: stat math exactness,
+      seeded-NaN naming, seeded-divergence bisection, detector firing.
+    """
+    from serverless_learn_tpu.telemetry import numerics
+
+    if args.self_check:
+        rep = numerics.self_check()
+        print(json.dumps(rep, indent=None if args.compact else 2))
+        return 0 if rep["ok"] else 1
+    if args.action == "diff":
+        if len(args.paths) != 2:
+            print("numerics diff needs exactly two fingerprint trails",
+                  file=sys.stderr)
+            return 2
+        rep = numerics.diff_fingerprint_logs(
+            numerics.load_records(args.paths[0]),
+            numerics.load_records(args.paths[1]),
+            rtol=args.rtol, atol=args.atol)
+        # The diff's own "a"/"b" carry the divergent digest values, so
+        # the trail labels get distinct keys.
+        rep = {"log_a": args.paths[0], "log_b": args.paths[1], **rep}
+        print(json.dumps(rep, indent=None if args.compact else 2))
+        return 1 if rep.get("diverged") else 0
+    if args.action == "summary":
+        if not args.paths:
+            print("numerics summary needs JSONL event logs",
+                  file=sys.stderr)
+            return 2
+        records = []
+        for path in args.paths:
+            records.extend(numerics.load_records(path))
+        stats = [r for r in records if r.get("event") == "numerics_stats"]
+        bad = [r for r in records
+               if r.get("event") == "numerics_nonfinite"]
+        out = {"records": len(records), "audited_steps": len(stats),
+               "steps": [r.get("step") for r in stats[:3]]
+               + (["..."] if len(stats) > 6 else [])
+               + [r.get("step") for r in stats[-3:]]
+               if stats else [],
+               "nonfinite_incidents": [
+                   {"step": r.get("step"), "first": r.get("first"),
+                    "bad_subtrees": r.get("bad_subtrees")}
+                   for r in bad]}
+        if stats:
+            gnorms = [r["grad_norm"] for r in stats
+                      if isinstance(r.get("grad_norm"), (int, float))]
+            ratios = [r["update_ratio"] for r in stats
+                      if isinstance(r.get("update_ratio"), (int, float))]
+            if gnorms:
+                out["grad_norm"] = {"min": round(min(gnorms), 6),
+                                    "max": round(max(gnorms), 6),
+                                    "last": round(gnorms[-1], 6)}
+            if ratios:
+                out["update_ratio"] = {"min": round(min(ratios), 9),
+                                       "max": round(max(ratios), 9),
+                                       "last": round(ratios[-1], 9)}
+        print(json.dumps(out, indent=None if args.compact else 2))
+        return 1 if bad else 0
+    print("numerics needs an action (diff | summary) or --self-check",
+          file=sys.stderr)
+    return 2
+
+
 def cmd_profile(args) -> int:
     """Trigger an on-demand device-trace capture on a live node through
     its metrics endpoint (/debug/profile — armed by --profile-dir on any
@@ -2002,6 +2108,31 @@ def build_parser() -> argparse.ArgumentParser:
                          "total, offline aggregation agrees; exit 0 on "
                          "success (CI)")
     gp.set_defaults(fn=cmd_goodput)
+
+    nm = sub.add_parser(
+        "numerics",
+        help="training-quality observability: fingerprint diff/bisect, "
+             "run summaries, self-check",
+        description="Bisect two recorded fingerprint trails to the first "
+                    "divergent step + parameter subtree (diff), digest a "
+                    "run's numerics trail (summary), or run the CI "
+                    "self-check. Producers: train with numerics.enabled "
+                    "(--numerics) writes numerics_stats/"
+                    "numerics_fingerprint records into --events-log and "
+                    "the optional numerics.fingerprint_log.")
+    nm.add_argument("action", nargs="?", choices=["diff", "summary"],
+                    help="diff: bisect two trails; summary: digest logs")
+    nm.add_argument("paths", nargs="*",
+                    help="JSONL trails (event logs, fingerprint logs, "
+                         "flight dumps)")
+    nm.add_argument("--rtol", type=float, default=1e-5,
+                    help="relative tolerance for digest agreement")
+    nm.add_argument("--atol", type=float, default=1e-6,
+                    help="absolute tolerance for digest agreement")
+    nm.add_argument("--self-check", action="store_true",
+                    help="run the numerics self-check (CI smoke)")
+    nm.add_argument("--compact", action="store_true")
+    nm.set_defaults(fn=cmd_numerics)
 
     pf = sub.add_parser("profile",
                         help="capture an on-demand jax.profiler device "

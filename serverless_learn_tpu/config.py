@@ -199,6 +199,41 @@ class LocalSGDConfig:
 
 
 @dataclass(frozen=True)
+class NumericsConfig:
+    """Training-quality observability knobs (``telemetry/numerics.py``,
+    ``training/audit.py``; round 17).
+
+    ``enabled`` adds in-graph per-subtree grad/param/update norms,
+    update-to-param ratios, non-finite flags and parameter fingerprints
+    to the jitted step (cheap reductions fused into the backward) and
+    fetches them to the host every ``cadence`` steps — numerics adds
+    ZERO per-step host syncs beyond the fetch cadence, and the fetch is
+    charged to a ``numerics`` ledger phase so `slt goodput` shows its
+    true overhead. When the non-finite flag trips, the auditor re-runs
+    a checked provenance sweep on pre-donation values (the checkpoint
+    host shadow when one is armed) and fires a critical
+    ``numerics.nonfinite`` alert naming the first bad layer.
+
+    ``inject_nan_step``/``inject_nan_subtree`` are the chaos knobs the
+    acceptance harness uses: scale the named parameter subtree's
+    gradient by NaN at exactly that step, so "`slt numerics` + `slt
+    doctor` name the faulting layer and step from telemetry alone" is a
+    runnable command, not a claim.
+    """
+
+    enabled: bool = False
+    cadence: int = 20             # host-fetch/emit every N steps
+    depth: int = 1                # subtree grouping depth (top-level=1)
+    fingerprint: bool = True      # per-step parameter fingerprints
+    fingerprint_log: str = ""     # JSONL path for fingerprint records
+    chunks: int = 4               # positional chunk sums per subtree
+    provenance: str = "sweep"     # "sweep" | "off" (NaN/Inf root-causing)
+    # ---- chaos / acceptance-harness fault injection ----
+    inject_nan_step: int = 0      # 0 = off; else poison grads at this step
+    inject_nan_subtree: str = ""  # "" = whole grad tree
+
+
+@dataclass(frozen=True)
 class ControlConfig:
     """Control-plane endpoints & intervals.
 
@@ -444,6 +479,15 @@ class HealthConfig:
     # to one capture per profile_cooldown_s.
     profile_on_critical_s: float = 3.0
     profile_cooldown_s: float = 600.0
+    # Training-quality detectors (round 17, telemetry/numerics.LossHealth
+    # over the numerics step ring): loss-spike z threshold (warning;
+    # > 2x escalates to critical), plateau window in optimizer steps with
+    # the minimum relative improvement that resets it, and the grad-norm
+    # explosion z (critical).
+    numerics_spike_z: float = 6.0
+    numerics_plateau_window: int = 200
+    numerics_plateau_min_rel: float = 1e-3
+    numerics_explode_z: float = 8.0
     slos: tuple = ()                 # SLO spec objects (see docstring)
 
 
@@ -462,6 +506,7 @@ class ExperimentConfig:
     fleet: FleetConfig = field(default_factory=FleetConfig)
     kv: KVCacheConfig = field(default_factory=KVCacheConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    numerics: NumericsConfig = field(default_factory=NumericsConfig)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
@@ -492,6 +537,7 @@ class ExperimentConfig:
             fleet=build(FleetConfig, raw.get("fleet")),
             kv=build(KVCacheConfig, raw.get("kv")),
             checkpoint=build(CheckpointConfig, raw.get("checkpoint")),
+            numerics=build(NumericsConfig, raw.get("numerics")),
         )
 
     def override(self, **kwargs: Any) -> "ExperimentConfig":

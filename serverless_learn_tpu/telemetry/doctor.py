@@ -406,6 +406,30 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
         verdict_bits.append(
             f"{len(emergencies)} emergency checkpoint save(s) on the "
             f"death path" + (f" (step(s) {steps_e})" if steps_e else ""))
+    # Training-quality numerics (round 17): a NaN/Inf incident names its
+    # faulting step and first bad layer from the event trail alone
+    # (`numerics_nonfinite` records from training/audit.py carry the
+    # provenance sweep's answer), and firing loss-health alerts get a
+    # verdict bit so "training is diverging" outranks its symptoms.
+    nonfinite_recs = [r for r in records
+                      if r.get("event") == "numerics_nonfinite"]
+    if nonfinite_recs:
+        first_rec = min(nonfinite_recs,
+                        key=lambda r: r.get("step") or 0)
+        layer = (first_rec.get("first")
+                 or ", ".join(first_rec.get("bad_subtrees") or [])
+                 or "unattributed")
+        verdict_bits.append(
+            f"non-finite values in training at step "
+            f"{first_rec.get('step')}: first bad layer {layer} "
+            f"({len(nonfinite_recs)} incident record(s))")
+    numerics_firing = [a for a in alerts
+                       if str(a.get("alert", "")).startswith("numerics.")
+                       and a.get("state") == "firing"
+                       and a.get("alert") != "numerics.nonfinite"]
+    for a in numerics_firing[:2]:
+        verdict_bits.append(
+            f"training quality: {a.get('alert')} — {a.get('message')}")
     # Step-interior hardware attribution (round 16): xray summaries —
     # from capture-meta.json records in the event trail and from capture
     # dirs handed to --xray — put a NAME on the training plateau ("step

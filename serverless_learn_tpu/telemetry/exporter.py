@@ -9,6 +9,9 @@
     GET /goodput                   the run ledger's goodput/badput report
                                    (telemetry/goodput.py; MFU-weighted
                                    when the trainer publishes an MFU gauge)
+    GET /numerics                  training-quality stats: the numerics
+                                   auditor's newest per-subtree summary
+                                   + recent step records (round 17)
     GET /debug/profile?seconds=N   capture a jax.profiler device trace
                                    (armed by --profile-dir on ANY role)
 
@@ -97,6 +100,8 @@ class MetricsExporter:
                         self._reply_json(200, exporter._alerts())
                     elif path == "/goodput":
                         self._reply_json(200, exporter._goodput())
+                    elif path == "/numerics":
+                        self._reply_json(200, exporter._numerics())
                     elif path == "/debug/profile":
                         code, obj = exporter._profile(
                             parse_qs(url.query),
@@ -167,6 +172,21 @@ class MetricsExporter:
             return rep
         except Exception as e:
             return {"enabled": True,
+                    "error": f"{type(e).__name__}: {e}"}
+
+    # -- numerics ----------------------------------------------------------
+
+    def _numerics(self) -> dict:
+        """The /numerics body (round 17): the auditor's newest
+        host-fetched summary plus the recent per-step record ring —
+        floats only by construction (the auditor never parks device
+        references where a scrape could reach them)."""
+        from serverless_learn_tpu.telemetry import numerics
+
+        try:
+            return numerics.endpoint_payload()
+        except Exception as e:
+            return {"enabled": False,
                     "error": f"{type(e).__name__}: {e}"}
 
     # -- on-demand device profiling ---------------------------------------

@@ -515,6 +515,15 @@ class HealthEngine:
         self._event_last: Dict[str, Optional[float]] = {
             key: None for key, *_ in _EVENT_RULES}
         self._anchor_lag_prev: Optional[float] = None
+        # Training-quality detectors (round 17): an engine-owned
+        # LossHealth instance fed from the numerics step ring
+        # (telemetry/numerics.note_step — the same publish/score split
+        # DiLoCo round records use). Created lazily on the first tick
+        # that sees the numerics module loaded: numerics imports jax,
+        # and this engine (doctor --self-check included) must stay
+        # runnable on jax-free nodes.
+        self._loss_health = None
+        self._numerics_seen: Optional[int] = None
         self._alerts: Dict[tuple, Alert] = {}
         self._prev: Optional[dict] = None  # last flattened sample
         self._prev_t: Optional[float] = None
@@ -818,6 +827,9 @@ class HealthEngine:
             else:
                 self._calm(now, "diloco.anchor_lag")
 
+        # ---- numerics: training-quality detectors (round 17) ----
+        self._numerics_tick_locked(now)
+
         # ---- structural: DiLoCo stragglers ----
         scores = score_stragglers(
             recent_rounds(self.config.straggler_window_rounds),
@@ -839,6 +851,59 @@ class HealthEngine:
         self._prev, self._prev_t = sample, now
         self._last_sample = sample
         self.ticks += 1
+
+    def _numerics_tick_locked(self, now: float):
+        """Caller holds ``_lock`` (the `_locked` convention —
+        invoked from ``_tick_locked``). Feed new numerics step records
+        (training/audit.py publishes
+        them via numerics.note_step) through the loss-health detectors
+        and translate findings into typed alerts. Records are consumed
+        once, in step order; a tick with no new records leaves the
+        alert state untouched (idle is not calm)."""
+        import sys
+
+        # The ring can only hold records if some producer already
+        # imported numerics; gating on that keeps this engine (and
+        # doctor --self-check) from paying — or requiring — a jax
+        # import on jax-free nodes.
+        if "serverless_learn_tpu.telemetry.numerics" not in sys.modules:
+            return
+        from serverless_learn_tpu.telemetry import numerics as _numerics
+
+        if self._loss_health is None:
+            self._loss_health = _numerics.LossHealth(
+                spike_z=self.config.numerics_spike_z,
+                plateau_window=self.config.numerics_plateau_window,
+                plateau_min_rel=self.config.numerics_plateau_min_rel,
+                explode_z=self.config.numerics_explode_z)
+        recs = [r for r in _numerics.recent_steps(128)
+                if isinstance(r.get("step"), int)
+                and (self._numerics_seen is None
+                     or r["step"] > self._numerics_seen)]
+        if not recs:
+            return
+        latest: Dict[str, Optional[dict]] = {}
+        for rec in sorted(recs, key=lambda r: r["step"]):
+            self._numerics_seen = rec["step"]
+            verdicts = self._loss_health.update(
+                rec["step"], rec.get("loss"), rec.get("grad_norm"))
+            if rec.get("nonfinite"):
+                first = rec.get("first")
+                verdicts["nonfinite"] = {
+                    "severity": "critical",
+                    "value": float(rec["nonfinite"]), "threshold": 0.0,
+                    "message": f"non-finite values at step {rec['step']}"
+                               + (f" — first bad layer: {first}"
+                                  if first else "")}
+            latest.update(verdicts)
+        for det, finding in latest.items():
+            name = f"numerics.{'nonfinite' if det == 'nonfinite' else det}"
+            if finding is None:
+                self._calm(now, name)
+            else:
+                self._fire(now, name, finding["severity"], "numerics",
+                           finding["message"], value=finding["value"],
+                           threshold=finding["threshold"])
 
     def _extract(self, kind: str, metric: str, sample: dict,
                  prev: Optional[dict], dt: Optional[float],

@@ -287,6 +287,9 @@ def render(states: List[EndpointState]) -> str:
                 _num(st.val("slt_ckpt_last_step"), 0),
                 "-" if corrupt is None else _num(corrupt, 0),
             ])
+        if st.val("slt_numerics_last_step") is not None \
+                or st.val("slt_numerics_replica_divergence") is not None:
+            roles += 1  # NUMERICS pane rendered below
         if roles == 0:
             other_rows.append(f"  {st.addr:<22} up (no slt_ metrics yet)")
     if infer_rows:
@@ -349,6 +352,33 @@ def render(states: List[EndpointState]) -> str:
         lines.append("  GOODPUT")
         lines += _table(["endpoint", "goodput", "mfu-wtd", "total s",
                          "top badput"], goodput_rows)
+    # NUMERICS pane (round 17): training quality at a glance — newest
+    # audited step, grad norm, update-to-param ratio, non-finite
+    # incidents, and the cross-replica divergence gauge when a gossip/
+    # DiLoCo run is publishing one. Endpoints without the auditor
+    # (slt_numerics_last_step absent) skip the pane.
+    numerics_rows: List[List[str]] = []
+    for st in states:
+        if st.val("slt_numerics_last_step") is None \
+                and st.val("slt_numerics_replica_divergence") is None:
+            continue
+        nonf = st.val("slt_numerics_nonfinite_total")
+        div = st.val("slt_numerics_replica_divergence")
+        numerics_rows.append([
+            st.addr,
+            _num(st.val("slt_numerics_last_step"), 0),
+            _num(st.val("slt_numerics_grad_norm"), 4),
+            _num(st.val("slt_numerics_update_ratio"), 6),
+            "-" if div is None else _num(div, 6),
+            "-" if nonf is None else _num(nonf, 0),
+            _num(st.val("slt_numerics_fetches_total"), 0),
+        ])
+    if numerics_rows:
+        lines.append("")
+        lines.append("  NUMERICS")
+        lines += _table(["endpoint", "step", "grad norm", "upd/param",
+                        "replica div", "nonfinite", "fetches"],
+                        numerics_rows)
     # HW pane (round 16): the step-interior view — HBM watermarks,
     # exposed-collective share and the xray verdict from the newest
     # capture (/goodput's xray section), plus per-consumer effective DCN

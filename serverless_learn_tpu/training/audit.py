@@ -1,0 +1,285 @@
+"""Numerics auditor: wires in-graph tensor stats into the training loop.
+
+The jitted step computes the per-subtree stats (``telemetry/numerics.
+step_summary`` — fused reductions, free of host syncs); this module owns
+the HOST side of the contract:
+
+* **Cadence-gated fetch.** ``on_step`` receives the step's device-side
+  numerics tree every step but ``jax.device_get``s it only every
+  ``numerics.cadence`` steps (and on the final step), charged to a
+  ``numerics`` goodput phase — the acceptance bound is < 2% of wall at
+  the default cadence, and ``slt_numerics_fetches_total`` counts the
+  actual host syncs so tests can assert the cadence held.
+* **Emission.** Each fetch updates the SLT002-catalogued gauges, appends
+  a ``numerics_stats`` JSONL record (fingerprint section included) to
+  the event trail and the optional dedicated fingerprint log, publishes
+  to the numerics step ring (the health engine's detector feed and the
+  ``/numerics`` endpoint) and the flight ring.
+* **Non-finite provenance.** When ``nonfinite_total`` trips, the auditor
+  re-runs a checked ``capture_intermediates`` sweep to name the first
+  bad layer, emits a ``numerics_nonfinite`` record, bumps the critical
+  ``slt_numerics_nonfinite_total`` counter (the health engine's event
+  rule fires ``numerics.nonfinite``) and writes a flight dump.
+
+**Donation discipline** (the round-15 hazard, audited here by design):
+the auditor NEVER retains device references across ``on_step`` calls —
+everything it keeps is host floats. The provenance sweep prefers the
+checkpointer's ``note_state`` host shadow (pre-donation by
+construction); falling back to the live post-step state is safe only
+because ``on_step`` runs synchronously between steps, before the state
+is donated into the next one, and the sweep device_gets before
+returning. ``tests/test_numerics.py`` pins both properties.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from serverless_learn_tpu.config import ExperimentConfig
+from serverless_learn_tpu.telemetry import flight, goodput
+from serverless_learn_tpu.telemetry import numerics
+from serverless_learn_tpu.telemetry import tracing as ttrace
+from serverless_learn_tpu.telemetry.registry import get_registry
+
+
+class NumericsAuditor:
+    """Host-side numerics pipeline for one training run."""
+
+    def __init__(self, config: ExperimentConfig, registry=None,
+                 bundle=None, shadow_fn: Optional[Callable] = None,
+                 emit: Optional[Callable[[dict], None]] = None):
+        ncfg = config.numerics
+        self.config = config
+        self.cadence = max(1, int(ncfg.cadence))
+        self.depth = max(1, int(ncfg.depth))
+        self.provenance_mode = ncfg.provenance
+        self.bundle = bundle
+        # shadow_fn() -> (host_state, step) | (None, None): the
+        # pre-donation state source for provenance — normally the
+        # checkpointer's note_state host shadow (Checkpointer.host_shadow).
+        self.shadow_fn = shadow_fn
+        self._emit = emit
+        reg = registry or get_registry()
+        self._m_fetches = reg.counter(
+            "slt_numerics_fetches_total",
+            "cadence-gated device->host numerics fetches")
+        self._m_nonfinite = reg.counter(
+            "slt_numerics_nonfinite_total",
+            "steps whose in-graph non-finite flag tripped")
+        self._m_last_step = reg.gauge(
+            "slt_numerics_last_step", "newest step with fetched stats")
+        self._m_grad = reg.gauge("slt_numerics_grad_norm")
+        self._m_param = reg.gauge("slt_numerics_param_norm")
+        self._m_ratio = reg.gauge(
+            "slt_numerics_update_ratio",
+            "global update L2 / param L2 per fetched step")
+        self._reg = reg
+        self._fp_log = None
+        if ncfg.fingerprint_log:
+            from serverless_learn_tpu.telemetry.registry import JsonlEventLog
+
+            self._fp_log = JsonlEventLog(ncfg.fingerprint_log)
+        self.fetches = 0
+        self.nonfinite_steps: list = []
+        self.last_provenance: Optional[dict] = None
+        self._dumped = False
+
+    # -- per-step hook -----------------------------------------------------
+
+    def on_step(self, step: int, num_tree, metrics: Dict[str, float],
+                state=None, batch=None, final: bool = False):
+        """Called after every optimizer step with the step's device-side
+        numerics tree. Fetches at the cadence (always on ``final``);
+        otherwise drops the reference immediately — no device buffer
+        survives this frame."""
+        if num_tree is None:
+            return
+        # A non-finite loss/grad-norm in the ALREADY-fetched per-step
+        # metrics forces a fetch this step — that is how the incident
+        # record names the faulting step exactly, not the next cadence
+        # boundary (by which the NaN has propagated into every subtree
+        # and provenance could only shrug). Zero extra host syncs: the
+        # loop device_gets those metrics every step regardless.
+        forced = not self.nonfinite_steps and any(
+            isinstance(v, float) and not math.isfinite(v)
+            for v in (metrics.get("loss"), metrics.get("grad_norm")))
+        # Only the FIRST incident forces an off-cadence fetch: past it
+        # every downstream step is non-finite too, and re-root-causing
+        # each one would turn one incident into a record flood.
+        if not final and not forced and step % self.cadence:
+            return
+        with goodput.phase("numerics"):
+            host = {k: float(v) for k, v in
+                    jax.device_get(num_tree).items()}
+        self.fetches += 1
+        self._m_fetches.inc()
+        self._m_last_step.set(step)
+        self._m_grad.set(host.get("grad_norm", 0.0))
+        self._m_param.set(host.get("param_norm", 0.0))
+        self._m_ratio.set(host.get("update_ratio", 0.0))
+        # Per-subtree gauges: bounded cardinality (depth-1 subtrees are
+        # the model's top-level modules), labeled like the DCN meters.
+        for key, val in host.items():
+            if key.startswith("grad/") and key.endswith("/l2"):
+                self._reg.gauge("slt_numerics_subtree_grad_l2",
+                                subtree=key.split("/")[1]).set(val)
+            elif key.startswith("ratio/"):
+                self._reg.gauge("slt_numerics_subtree_update_ratio",
+                                subtree=key.split("/")[1]).set(val)
+        record = self._record(step, host, metrics)
+        self._emit_record(record)
+        if self._fp_log is not None and "fp" in record:
+            self._fp_log.emit({"event": "numerics_fingerprint",
+                               "step": step, "fp": record["fp"]})
+        numerics.note_step({"step": step,
+                            "loss": metrics.get("loss"),
+                            "grad_norm": host.get("grad_norm"),
+                            "update_ratio": host.get("update_ratio"),
+                            "nonfinite": int(host.get("nonfinite_total",
+                                                      0.0))})
+        numerics.set_last_report(
+            {"step": step, "fetched_unix_s": round(time.time(), 3),
+             **{k: v for k, v in host.items() if "/" not in k},
+             "subtrees": record.get("subtrees", {})})
+        flight.record({"event": "numerics_stats", "step": step,
+                       "grad_norm": host.get("grad_norm"),
+                       "update_ratio": host.get("update_ratio"),
+                       "nonfinite": int(host.get("nonfinite_total", 0.0))})
+        if host.get("nonfinite_total", 0.0) > 0:
+            self._on_nonfinite(step, host, state, batch)
+
+    # -- record shaping ----------------------------------------------------
+
+    def _record(self, step: int, host: Dict[str, float],
+                metrics: Dict[str, float]) -> dict:
+        subs: Dict[str, dict] = {}
+        fp: Dict[str, dict] = {}
+        for key, val in host.items():
+            parts = key.split("/")
+            if len(parts) == 3 and parts[0] == "fp":
+                fp.setdefault(parts[1], {})[parts[2]] = round(val, 9)
+            elif len(parts) == 3:
+                subs.setdefault(parts[1], {})[
+                    f"{parts[0]}_{parts[2]}"] = round(val, 9)
+            elif len(parts) == 2 and parts[0] == "ratio":
+                subs.setdefault(parts[1], {})["update_ratio"] = round(val, 9)
+        rec = {"event": "numerics_stats", "step": step,
+               "loss": metrics.get("loss"),
+               "grad_norm": round(host.get("grad_norm", 0.0), 9),
+               "param_norm": round(host.get("param_norm", 0.0), 9),
+               "update_norm": round(host.get("update_norm", 0.0), 9),
+               "update_ratio": round(host.get("update_ratio", 0.0), 9),
+               "nonfinite": int(host.get("nonfinite_total", 0.0)),
+               "subtrees": subs}
+        if fp:
+            rec["fp"] = fp
+        return rec
+
+    def _emit_record(self, rec: dict):
+        if self._emit is not None:
+            try:
+                self._emit(rec)
+            except Exception:
+                pass
+            return
+        ttrace.emit_event(rec)
+
+    # -- non-finite incident path ------------------------------------------
+
+    def _bad_subtrees(self, host: Dict[str, float]) -> list:
+        bad = []
+        for key, val in host.items():
+            parts = key.split("/")
+            if (len(parts) == 3 and parts[2] == "nonfinite" and val > 0):
+                bad.append(f"{parts[0]}:{parts[1]}")
+        return sorted(set(bad))
+
+    def _on_nonfinite(self, step: int, host: Dict[str, float],
+                      state, batch):
+        """The in-graph flag tripped: root-cause it NOW, synchronously,
+        while every value we need is still pre-donation."""
+        first_incident = not self.nonfinite_steps
+        self._m_nonfinite.inc()
+        self.nonfinite_steps.append(step)
+        prov: Optional[dict] = None
+        source = None
+        if (first_incident and self.provenance_mode != "off"
+                and self.bundle is not None):
+            params, model_state = None, None
+            if self.shadow_fn is not None:
+                try:
+                    shadow, _ = self.shadow_fn()
+                except Exception:
+                    shadow = None
+                if shadow is not None:
+                    params = getattr(shadow, "params", None)
+                    model_state = getattr(shadow, "model_state", None)
+                    source = "host_shadow"
+            if params is None and state is not None:
+                # Live post-step state: safe only because this frame runs
+                # between steps (pre-donation); the sweep device_gets
+                # before returning and nothing device-side is retained.
+                params = state.params
+                model_state = getattr(state, "model_state", None)
+                source = "live_state"
+            if params is not None:
+                host_batch = (jax.device_get(batch)
+                              if batch is not None else None)
+                prov = numerics.nonfinite_provenance(
+                    getattr(self.bundle, "module", None),
+                    jax.device_get(params), host_batch,
+                    model_state=(jax.device_get(model_state)
+                                 if model_state else None),
+                    depth=self.depth)
+                prov["source"] = source
+        first = (prov or {}).get("first")
+        rec = {"event": "numerics_nonfinite", "step": step,
+               "first": first,
+               "bad_subtrees": self._bad_subtrees(host),
+               "nonfinite": int(host.get("nonfinite_total", 0.0))}
+        if prov is not None:
+            rec["provenance"] = {
+                k: prov.get(k) for k in
+                ("first", "kind", "param", "intermediates", "source")
+                if prov.get(k) is not None}
+        self.last_provenance = prov
+        self._emit_record(rec)
+        flight.record(rec)
+        numerics.note_step({"step": step, "loss": float("nan"),
+                            "nonfinite": int(host.get("nonfinite_total",
+                                                      0.0)),
+                            "first": first})
+        if not self._dumped:
+            # One dump per run: the incident forensics; the health
+            # engine's critical numerics.nonfinite alert adds its own
+            # (rate-limited) dump when it fires.
+            self._dumped = True
+            flight.maybe_dump(f"numerics:nonfinite:{first or 'unknown'}")
+
+    def close(self):
+        if self._fp_log is not None:
+            self._fp_log.close()
+
+
+def inject_nan(grads, step, inject_step: int, subtree: str = "",
+               depth: int = 1):
+    """Chaos knob (jit-safe): scale ``subtree``'s gradient leaves (all
+    leaves when empty) by NaN at exactly ``inject_step`` — the seeded
+    fault the acceptance harness root-causes from telemetry alone."""
+    import jax.numpy as jnp
+
+    bad = jnp.where(step == inject_step, jnp.float32(np.nan),
+                    jnp.float32(1.0))
+    flat = jax.tree_util.tree_flatten_with_path(grads)
+    poisoned = []
+    for path, leaf in flat[0]:
+        name = numerics._subtree_name(path, depth)
+        if not subtree or name == subtree:
+            leaf = (leaf * bad).astype(leaf.dtype)
+        poisoned.append(leaf)
+    return jax.tree_util.tree_unflatten(flat[1], poisoned)

@@ -536,6 +536,14 @@ class Checkpointer:
                                  if hasattr(host, "step") else 0)
         self._emg_shadow_t = now
 
+    def host_shadow(self):
+        """(host_state, step) of the newest note_state shadow, or
+        (None, None). Round 17: the numerics auditor's provenance sweep
+        reads PRE-DONATION values from here — the live state's device
+        buffers may already be donated into the next jitted step by the
+        time a non-finite incident is being root-caused."""
+        return self._emg_shadow, self._emg_shadow_step
+
     def disarm_emergency(self):
         self._emg_fn = None
         self._emg_armed = False

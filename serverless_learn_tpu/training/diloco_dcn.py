@@ -85,6 +85,13 @@ def _unpack(blob: bytes, template):
         template, serialization.msgpack_restore(blob))
 
 
+def _host_norm(tree) -> float:
+    """L2 over a host f32 tree (numpy; no device round-trip)."""
+    return float(np.sqrt(sum(
+        float(np.square(np.asarray(l, np.float64)).sum())
+        for l in jax.tree_util.tree_leaves(tree))))
+
+
 def _nesterov_step(anchor, grad, trace, lr: float, mu: float):
     """optax.sgd(lr, momentum=mu, nesterov=True) on host trees:
     trace' = g + mu * trace; update = -lr * (g + mu * trace');
@@ -181,6 +188,17 @@ class DilocoIsland:
         self._m_round_wait = reg.histogram(
             "slt_diloco_round_wait_seconds",
             "outer-boundary wait from delta post to anchor availability")
+        # Round 17 numerics ledgers: this island's outer-delta L2 per
+        # round (a diverging island shows up as a delta norm detaching
+        # from the fleet's) and, when leading, how far the anchor moved
+        # — the EQuARX quantized-exchange acceptance ("same loss curve")
+        # reads these two trails plus the fingerprint diff.
+        self._m_delta_norm = reg.gauge(
+            "slt_diloco_delta_norm",
+            "L2 of this island's last posted outer delta")
+        self._m_anchor_drift = reg.gauge(
+            "slt_diloco_anchor_drift",
+            "L2 of the last led outer step's anchor movement")
         if self.inner_steps < 1:
             raise ValueError(f"inner_steps must be >= 1, "
                              f"got {self.inner_steps}")
@@ -309,6 +327,7 @@ class DilocoIsland:
                     ledger.phase("diloco_round_wait"):
                 delta = jax.tree_util.tree_map(
                     lambda a, p: a - p, anchor, _to_f32_host(state.params))
+                self._m_delta_norm.set(_host_norm(delta))
                 self.store.put(
                     self._k(f"round-{rnd}",
                             f"delta-{self.agent.worker_id}"),
@@ -418,8 +437,6 @@ class DilocoIsland:
             mw = getattr(self, "_m_round_wait", None)
             if mw is not None:
                 mw.observe(waited_s)
-        _health.note_round(rec)
-        _ttrace.emit_event(rec)
         deltas = [_unpack(self.store.get(
             self._k(f"round-{rnd}", f"delta-{i}")), template)
             for i in posted]
@@ -429,6 +446,8 @@ class DilocoIsland:
             # ShardServerStore swallows IOError into an empty list).
             # Publish the anchor UNCHANGED — liveness over progress; the
             # posted deltas, if any exist, are simply skipped this round.
+            _health.note_round(rec)
+            _ttrace.emit_event(rec)
             self._publish(rnd + 1, anchor, trace, self.report.steps_done)
             return
         n = float(len(deltas))
@@ -436,6 +455,22 @@ class DilocoIsland:
             lambda *ls: np.add.reduce(ls) / n, *deltas)
         new_anchor, new_trace = _nesterov_step(
             anchor, grad, trace, self.outer_lr, self.outer_momentum)
+        # Round 17 numerics ledger: per-worker delta norms (a diverging
+        # island's delta detaches from the fleet's long before the loss
+        # moves) and the anchor drift this outer step applied — stamped
+        # into the same round record the straggler scorer reads, so
+        # `slt doctor` and the quantized-exchange acceptance see one
+        # trail.
+        rec["delta_norms"] = {str(i): round(_host_norm(d), 6)
+                              for i, d in zip(posted, deltas)}
+        drift = _host_norm(jax.tree_util.tree_map(
+            lambda a, b: a - b, new_anchor, anchor))
+        rec["anchor_drift"] = round(drift, 6)
+        m_drift = getattr(self, "_m_anchor_drift", None)
+        if m_drift is not None:
+            m_drift.set(drift)
+        _health.note_round(rec)
+        _ttrace.emit_event(rec)
         self._publish(rnd + 1, new_anchor, new_trace,
                       self.report.steps_done)
 

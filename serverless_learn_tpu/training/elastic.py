@@ -334,6 +334,25 @@ class ElasticTrainer:
                 self.ckpt.note_state(state)
                 remesh_span.mark("restored")
                 step = int(jax.device_get(state.step))
+                if self.config.numerics.enabled:
+                    # Round 17: fingerprint the restored params at every
+                    # world formation — `slt numerics diff` can then
+                    # prove a remesh/restore was value-preserving (or
+                    # bisect which subtree a corrupt restore mangled)
+                    # straight from two event trails.
+                    from serverless_learn_tpu.telemetry import (
+                        numerics as _numerics)
+
+                    ncfg = self.config.numerics
+                    fp = {k: {f: round(float(v), 9)
+                              for f, v in d.items()}
+                          for k, d in jax.device_get(_numerics.fingerprint(
+                              state.params, depth=ncfg.depth,
+                              chunks=ncfg.chunks)).items()}
+                    ttrace.emit_event({"event": "numerics_fingerprint",
+                                       "step": step, "epoch": epoch,
+                                       "reason": "remesh_restore",
+                                       "fp": fp})
                 self.transitions.append(
                     EpochTransition(epoch=epoch, step=step,
                                     n_devices=len(devices),

@@ -78,18 +78,11 @@ def _mean_float_leaves(tree):
     return jax.tree_util.tree_map(mix, tree)
 
 
-@jax.jit
-def replica_divergence(params) -> jax.Array:
-    """Max over leaves of max |p_r - mean_r p| — 0 iff replicas agree.
-
-    Jitted into ONE program: leaves are dp-sharded [R, ...], so each mean
-    is a cross-device reduction — dispatched eagerly op-by-op, a large
-    stateful model (ResNet batch_stats) serializes dozens of collectives
-    on the CPU test backend and trips XLA:CPU's hardcoded 40 s
-    collective-rendezvous abort."""
-    leaves = jax.tree_util.tree_leaves(params)
-    divs = [jnp.max(jnp.abs(l - l.mean(0, keepdims=True))) for l in leaves]
-    return jnp.max(jnp.stack([jnp.asarray(d, jnp.float32) for d in divs]))
+# Round 17: one implementation for every cross-replica divergence
+# consumer — this gauge, the numerics fingerprint path, and `slt
+# numerics`'s live compares all share telemetry/numerics.py.
+from serverless_learn_tpu.telemetry.numerics import (  # noqa: E402
+    replica_divergence)
 
 
 class LocalSGDTrainer:
@@ -238,8 +231,25 @@ class LocalSGDTrainer:
             model_state=stacked_shardings(abstract.model_state,
                                           lenient=True),
         )
-        self.init_fn = jax.jit(init_raw, static_argnums=(0,),
-                               out_shardings=self.state_shardings)
+        # Two-stage init (round 17 un-xfail): under this image's jax
+        # (threefry_partitionable=False), jitting the random init with
+        # fsdp/tp-sharded out_shardings lets XLA's SPMD partitioner
+        # lower the threefry counters shard-locally — each shard draws
+        # DIFFERENT random bits, so the initial parameters depended on
+        # the mesh layout. That (not training drift) is what failed
+        # test_sharded_replicas_match_single_chip[fsdp-*]: the sharded
+        # and single-chip runs started from different models. Compute
+        # the init once without sharded out_shardings (sharding-
+        # invariant bits), then reshard device-to-device.
+        init_unsharded = jax.jit(init_raw, static_argnums=(0,))
+        st_shardings = self.state_shardings
+
+        def init_sharded(seed):
+            state = init_unsharded(seed)
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s), state, st_shardings)
+
+        self.init_fn = init_sharded
 
         def one_replica(params, mstate, opt_state, batch, rng):
             def loss_fn(p):
@@ -423,6 +433,15 @@ def run_local_sgd(config: ExperimentConfig, checkpointer=None,
                             n_chips=trainer.mesh.size)
     meter.start()
     last_saved = None
+    from serverless_learn_tpu.telemetry import get_registry
+    from serverless_learn_tpu.telemetry import numerics as _numerics
+
+    # Round 17: the divergence gauge rides the numerics catalog — one
+    # name, one implementation, whether the producer is gossip, DiLoCo
+    # or the exact trainer's parity harness.
+    m_div = get_registry().gauge(
+        "slt_numerics_replica_divergence",
+        "max |p_r - mean_r p| across dp replicas, sampled at log_every")
     try:
         for t in range(start, config.train.num_steps):
             state, step_losses = trainer.inner_step(state, next(prefetch))
@@ -431,12 +450,20 @@ def run_local_sgd(config: ExperimentConfig, checkpointer=None,
             synced = (t + 1) % trainer.inner_steps == 0
             if synced:
                 state = trainer.outer_sync(state)
-            if verbose and (t + 1) % config.train.log_every == 0:
-                log_json({"step": t + 1, "loss": round(loss, 5),
-                          "samples_per_sec": round(stats.samples_per_sec, 1),
-                          "outer_synced": synced,
-                          "replica_divergence": round(float(jax.device_get(
-                              replica_divergence(state.params))), 6)})
+            if (t + 1) % config.train.log_every == 0:
+                div = float(jax.device_get(
+                    replica_divergence(state.params)))
+                m_div.set(div)
+                _numerics.note_step({"step": t + 1, "loss": loss,
+                                     "replica_divergence": round(div, 9),
+                                     "nonfinite": 0 if np.isfinite(loss)
+                                     else 1})
+                if verbose:
+                    log_json({"step": t + 1, "loss": round(loss, 5),
+                              "samples_per_sec":
+                              round(stats.samples_per_sec, 1),
+                              "outer_synced": synced,
+                              "replica_divergence": round(div, 6)})
             if (checkpointer is not None and config.train.checkpoint_every
                     and (t + 1) % config.train.checkpoint_every == 0):
                 checkpointer.save(state, step=t + 1)

@@ -161,13 +161,21 @@ def run_training(
     source=None,
     step_callback: Optional[Callable] = None,
     verbose: bool = False,
+    auditor=None,
 ):
     """Run ``config.train.num_steps`` steps; returns (state, meter).
 
     ``step_callback(step, state, stats)`` runs after each step — the hook used
-    by checkpointing and the elastic controller.
+    by checkpointing and the elastic controller. ``auditor`` (or config
+    ``numerics.enabled``) attaches the numerics auditor: the jitted step
+    emits in-graph tensor stats and the auditor fetches/emits them at the
+    configured cadence (``training/audit.py``).
     """
     trainer = trainer or build_trainer(config)
+    if auditor is None and config.numerics.enabled:
+        from serverless_learn_tpu.training.audit import NumericsAuditor
+
+        auditor = NumericsAuditor(config, bundle=trainer.bundle)
     if state is None:
         state = trainer.init()
     created_source = source is None
@@ -225,10 +233,19 @@ def run_training(
                                                      annotate_device=False), \
                     ledger.phase(phase_name):
                 state, metrics = trainer.step(state, batch)
+                # The numerics sub-tree is NOT part of the per-step
+                # fetch: the auditor device_gets it only at its cadence
+                # (charged to its own "numerics" ledger phase below).
+                num_tree = (metrics.pop("numerics", None)
+                            if isinstance(metrics, dict) else None)
                 # Block on the metrics (small) so step timing is honest;
                 # params stay on device.
                 metrics = {k: float(v)
                            for k, v in jax.device_get(metrics).items()}
+            if auditor is not None:
+                auditor.on_step(i + 1, num_tree, metrics,
+                                state=state, batch=batch,
+                                final=i + 1 == config.train.num_steps)
             stats = meter.record(i + 1, metrics)
             flight.record({"event": "train_step", "step": i + 1,
                            "step_time_s": round(stats.step_time_s, 5),

@@ -259,16 +259,27 @@ def build_trainer(
         return grads, mstate, losses.sum() / w_sum, metrics
 
     donate = (0,) if config.train.donate_state else ()
+    ncfg = config.numerics
 
     @partial(jax.jit, donate_argnums=donate,
              in_shardings=(state_shardings, b_shardings),
              out_shardings=(state_shardings, replicated(mesh)))
     def step_fn(state: TrainState, batch):
+        from serverless_learn_tpu.telemetry import numerics as _numerics
+
         rng = jax.random.fold_in(jax.random.PRNGKey(config.train.seed),
                                  state.step)
         t_params = trainable_of(state.params)
         grads, new_model_state, loss, metrics = grads_and_aux(
             t_params, state.params, state.model_state, batch, rng)
+        if ncfg.inject_nan_step:
+            # Chaos knob (round 17): poison the named subtree's gradient
+            # at exactly one step, so the NaN-provenance acceptance test
+            # has a seeded, layer-attributable fault.
+            from serverless_learn_tpu.training.audit import inject_nan
+
+            grads = inject_nan(grads, state.step + 1, ncfg.inject_nan_step,
+                               ncfg.inject_nan_subtree, ncfg.depth)
         updates, new_opt = tx.update(grads, state.opt_state, t_params)
         new_t = jax.tree_util.tree_map(
             lambda p, u: (p + u.astype(p.dtype)), t_params, updates)
@@ -284,9 +295,17 @@ def build_trainer(
             # averaged loss is exact, so derive perplexity from it.
             metrics["perplexity"] = jnp.exp(loss)
         metrics["loss"] = loss
-        metrics["grad_norm"] = jnp.sqrt(sum(
-            jnp.sum(jnp.square(g.astype(jnp.float32)))
-            for g in jax.tree_util.tree_leaves(grads)))
+        metrics["grad_norm"] = _numerics.global_norm(grads)
+        if ncfg.enabled:
+            # In-graph numerics (round 17): per-subtree grad/param/update
+            # norms, update-to-param ratios, non-finite flags and
+            # parameter fingerprints as fused scalar reductions — the
+            # loop pops this sub-dict BEFORE its per-step device_get and
+            # hands it to the auditor, which fetches it only at the
+            # configured cadence (zero extra per-step host syncs).
+            metrics["numerics"] = _numerics.step_summary(
+                new_t, grads, updates, loss=loss, depth=ncfg.depth,
+                chunks=ncfg.chunks, with_fingerprint=ncfg.fingerprint)
         new_state = TrainState(step=state.step + 1, params=new_params,
                                opt_state=new_opt, model_state=new_model_state)
         return new_state, metrics

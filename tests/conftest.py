@@ -243,6 +243,11 @@ SLOW_TESTS = {
     "tests/test_telemetry.py::test_continuous_cancellation_retires_slot",
     "tests/test_telemetry.py::test_warm_compiles_admit_buckets_deterministically",
     "tests/test_telemetry.py::test_top_once_covers_trainer_and_inference",
+    # round 17 (numerics: real-trainer fingerprint runs + the cadence/
+    # overhead acceptance run; the stat/detector/provenance units stay
+    # fast)
+    "tests/test_numerics.py::test_fingerprint_bisection_finds_seeded_divergence",
+    "tests/test_numerics.py::test_numerics_cadence_and_overhead_acceptance",
 }
 
 
