@@ -158,6 +158,23 @@ class TrainConfig:
     grad_accum: int = 1
     eval_every: int = 0  # 0 => no in-loop eval
     eval_steps: int = 10  # batches per eval pass
+    # ZeRO-style update sharding over the dp axis (training/zero.py;
+    # round 18). 0 = replicated update (the pre-round-18 behavior);
+    # 1 = optimizer state + the update computation shard 1/dp per
+    # replica (params re-assembled by an all-gather after the update);
+    # 2 = additionally keep the post-backward gradient tree dp-sharded —
+    # the full-gradient psum becomes a reduce-scatter into the owned
+    # slice and no replica materializes the whole gradient tree.
+    # Inert when the formed mesh has dp == 1 (e.g. the llama8b config at
+    # its fsdp memory floor); elastic worlds re-partition on remesh.
+    zero_stage: int = 0
+    # Dtype of the cross-replica gradient exchange ("float32"/"f32" |
+    # "bfloat16"/"bf16"). bf16 halves the reduce-scatter bytes (first
+    # bite of the EQuARX quantized-exchange item) at the cost of
+    # rounding the summed gradient to 8 mantissa bits — error-feedback
+    # and stochastic rounding are deliberately NOT applied, so the
+    # default stays f32 and bf16 is an explicit, measured opt-in.
+    grad_reduce_dtype: str = "float32"
 
 
 @dataclass(frozen=True)

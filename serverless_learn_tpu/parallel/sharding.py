@@ -180,6 +180,40 @@ def _drop_indivisible(spec: P, shape, mesh: Mesh) -> P:
     return P(*out)
 
 
+def compose_axis(spec: P, shape, mesh: Mesh, axis: str) -> P:
+    """Compose a mesh ``axis`` into ``spec`` on the first dimension it
+    divides, MAJOR to the dim's existing axes (the axis slice is a
+    contiguous block of the existing layout, so un-composing it is a
+    pure concatenation).
+
+    The ZeRO update-sharding primitive (``training/zero.py``): an
+    optimizer-state or gradient leaf whose rule spec says ``P('fsdp',
+    'tp')`` becomes ``P(('dp', 'fsdp'), 'tp')`` when dim 0 divides by
+    ``dp * fsdp``, else the composition walks the remaining dims and
+    finally gives up — a leaf no dim of which divides (a ``(10,)`` head
+    bias on an 8-wide axis, a scalar count) stays on its base spec,
+    which is always correct, merely unsharded. Specs already naming the
+    axis are returned unchanged."""
+    size = mesh.shape.get(axis, 1)
+    if size <= 1:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for d, entry in enumerate(entries):
+        names = (() if entry is None
+                 else entry if isinstance(entry, tuple) else (entry,))
+        if axis in names:
+            return spec
+        prod = 1
+        for n in names:
+            prod *= mesh.shape.get(n, 1)
+        if shape[d] > 0 and shape[d] % (prod * size) == 0:
+            entries[d] = (axis, *names) if names else axis
+            while entries and entries[-1] is None:
+                entries.pop()
+            return P(*entries)
+    return spec
+
+
 def shardings_for_tree(
     tree: Any,
     mesh: Mesh,

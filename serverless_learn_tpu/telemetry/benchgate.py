@@ -41,16 +41,21 @@ DEFAULT_REL_THRESHOLD = 0.05
 HEADLINE_METRIC = "resnet18_cifar_train_samples_per_sec_per_chip"
 
 # Hardware-attribution columns (round 16) ride the headline rows and
-# gate alongside the value: each is a fraction, judged with an ABSOLUTE
-# gap against the best comparable earlier row that carries it (rows
-# predating the column neither gate nor mask). exposed_comms_frac
-# regresses UP (collectives newly exposed); hw_util and
-# achieved_vs_roofline regress DOWN (the hardware got lazier even if
-# the analytic throughput held).
+# gate alongside the value: each is judged against the best comparable
+# earlier row that carries it (rows predating the column neither gate
+# nor mask). Fractions use an ABSOLUTE gap; byte/second columns (round
+# 18, the ZeRO layout accounting) a RELATIVE one — a third tuple slot,
+# defaulting to "abs". exposed_comms_frac regresses UP (collectives
+# newly exposed); hw_util and achieved_vs_roofline regress DOWN (the
+# hardware got lazier even if the analytic throughput held);
+# opt_state_bytes_per_chip regresses UP (the ZeRO memory win quietly
+# un-sharding would show here first).
 ATTRIBUTION_COLUMNS = {
     "exposed_comms_frac": ("min", 0.05),
     "hw_util": ("max", 0.05),
     "achieved_vs_roofline": ("max", 0.05),
+    "opt_state_bytes_per_chip": ("min", 0.10, "rel"),
+    "grad_reduce_scatter_s": ("min", 0.50, "rel"),
 }
 
 
@@ -104,20 +109,24 @@ def gate_entry(entry: dict, history: List[dict],
 
 
 def _gate_attribution(entry: dict, earlier: List[dict]) -> List[dict]:
-    """Column-level checks for the round-16 attribution fields, against
-    the best comparable earlier row carrying each column."""
+    """Column-level checks for the round-16/18 attribution fields,
+    against the best comparable earlier row carrying each column."""
     out = []
-    for col, (better_c, abs_gap) in ATTRIBUTION_COLUMNS.items():
+    for col, spec in ATTRIBUTION_COLUMNS.items():
+        better_c, gap = spec[0], spec[1]
+        kind = spec[2] if len(spec) > 2 else "abs"
         v = entry.get(col)
         prior = [h[col] for h in earlier
                  if isinstance(h.get(col), (int, float))]
         if not isinstance(v, (int, float)) or not prior:
             continue
         best_c = min(prior) if better_c == "min" else max(prior)
-        worse = (v > best_c + abs_gap if better_c == "min"
-                 else v < best_c - abs_gap)
-        out.append({"column": col, "value": v, "best": best_c,
-                    "threshold_abs": abs_gap, "ok": not worse})
+        margin = gap if kind == "abs" else abs(best_c) * gap
+        worse = (v > best_c + margin if better_c == "min"
+                 else v < best_c - margin)
+        row = {"column": col, "value": v, "best": best_c, "ok": not worse}
+        row["threshold_abs" if kind == "abs" else "threshold_rel"] = gap
+        out.append(row)
     return out
 
 

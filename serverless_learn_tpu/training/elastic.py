@@ -333,6 +333,16 @@ class ElasticTrainer:
                     state = trainer.init()
                 self.ckpt.note_state(state)
                 remesh_span.mark("restored")
+                # ZeRO (round 18): the trainer's shardings already carry
+                # the new world's dp composition, so the restore above
+                # re-partitioned dp-sharded optimizer state to the new
+                # dp size (a replicated pre-ZeRO checkpoint restores
+                # into the sharded layout the same way). Re-stamp the
+                # per-chip byte gauge so the memory win tracks worlds.
+                from serverless_learn_tpu.training.zero import (
+                    publish_opt_state_gauge)
+
+                publish_opt_state_gauge(state.opt_state)
                 step = int(jax.device_get(state.step))
                 if self.config.numerics.enabled:
                     # Round 17: fingerprint the restored params at every

@@ -208,6 +208,12 @@ def run_training(
               "microbatches per step").set(config.train.grad_accum)
     reg.gauge("slt_train_batch_size").set(config.train.batch_size)
     reg.gauge("slt_train_n_chips").set(trainer.mesh.size)
+    reg.gauge("slt_train_zero_stage").set(config.train.zero_stage)
+    # Per-chip resident opt-state bytes: the ZeRO memory claim as a
+    # scraped number (shrinks ~1/dp at zero_stage >= 1), not a doc claim.
+    from serverless_learn_tpu.training.zero import publish_opt_state_gauge
+
+    publish_opt_state_gauge(state.opt_state, registry=reg)
     last_batch = None
     # Goodput accounting: the run ledger's t0 pins the total-time
     # denominator; every wait below lands in a named phase ("step" is the

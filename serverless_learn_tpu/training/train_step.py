@@ -8,6 +8,11 @@ and XLA inserts the gradient ``psum`` (and any FSDP all-gathers /
 reduce-scatters, TP all-reduces) as ICI collectives derived from the sharding
 annotations. Gradient traffic over gRPC: zero bytes, by construction —
 BASELINE.md's north-star requirement.
+
+Round 18: ``train.zero_stage`` shards the optimizer state and the weight
+update over the ``dp`` axis (``training/zero.py`` — ZeRO-1/2 via the same
+annotation-first machinery): reduce-scatter in, update on 1/dp slices,
+one all-gather out, overlap left to XLA's latency-hiding scheduler.
 """
 
 from __future__ import annotations
@@ -156,6 +161,19 @@ def build_trainer(
             model_state=model_state,
         )
 
+    # ZeRO update sharding (round 18, training/zero.py): with
+    # train.zero_stage >= 1 the optimizer state (and the update
+    # computation) shards 1/dp per replica instead of replicating — the
+    # per-chip memory win and the dp-collective restructuring
+    # (reduce-scatter in, all-gather out) ride the SAME annotation-first
+    # machinery as fsdp/tp; no step-code fork.
+    from serverless_learn_tpu.training import zero as zero_mod
+
+    zero_stage = zero_mod.validate_zero_stage(config.train.zero_stage)
+    grad_reduce_dtype = zero_mod.normalize_grad_reduce_dtype(
+        config.train.grad_reduce_dtype)
+    zero_on = zero_stage >= 1 and mesh.shape[zero_mod.UPDATE_AXIS] > 1
+
     # Resolve state shardings from abstract shapes, then materialize the real
     # state directly into its sharded layout (no host round-trip).
     abstract = jax.eval_shape(init_raw, 0)
@@ -165,10 +183,24 @@ def build_trainer(
         # divisible_only: optimizer leaves match param PATHS but not
         # necessarily param shapes (adafactor's factored stats, counts) —
         # non-dividing rule axes drop to replicated instead of crashing.
-        opt_state=shardings_for_tree(abstract.opt_state, mesh, rules,
-                                     divisible_only=True),
+        # Under ZeRO the dp axis is additionally composed into every
+        # leaf that divides; tx.init then materializes straight into the
+        # dp-sharded layout through the jitted init's out_shardings.
+        opt_state=(zero_mod.zero_shardings_for_tree(abstract.opt_state,
+                                                    mesh, rules)
+                   if zero_on else
+                   shardings_for_tree(abstract.opt_state, mesh, rules,
+                                      divisible_only=True)),
         model_state=shardings_for_tree(abstract.model_state, mesh, rules),
     )
+    # dp-composed shardings for gradient/update leaves (trainable-tree
+    # shaped): the update constraint (stage >= 1) makes GSPMD compute the
+    # optimizer chain on 1/dp slices and all-gather the updated params;
+    # the grads constraint (stage 2) turns the gradient psum into a
+    # reduce-scatter into the owned slice.
+    update_shardings = (zero_mod.zero_shardings_for_tree(
+        jax.eval_shape(trainable_of, abstract.params), mesh, rules)
+        if zero_on else None)
     init_jit = jax.jit(init_raw, static_argnums=(0,),
                        out_shardings=state_shardings)
 
@@ -280,7 +312,29 @@ def build_trainer(
 
             grads = inject_nan(grads, state.step + 1, ncfg.inject_nan_step,
                                ncfg.inject_nan_subtree, ncfg.depth)
+        if grad_reduce_dtype != "float32":
+            # bf16 gradient exchange: round the reduced gradient to the
+            # exchange dtype (halves the reduce-scatter bytes on the
+            # wire; numerically this IS the precision the update sees,
+            # so the bf16 loss-curve-parity test measures the real
+            # cost). No error feedback by design — see TrainConfig.
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.bfloat16).astype(g.dtype), grads)
+        if zero_on and zero_stage >= 2:
+            # Stage 2: the gradient tree itself lives dp-sharded — the
+            # dp psum becomes a reduce-scatter into the owned slice.
+            # Applied HERE, after the grad-accum scan, never inside it:
+            # microbatches accumulate locally and the step pays ONE
+            # cross-replica reduce (pinned by test_grad_accum_eval's
+            # jaxpr audit).
+            grads = jax.lax.with_sharding_constraint(grads,
+                                                     update_shardings)
         updates, new_opt = tx.update(grads, state.opt_state, t_params)
+        if zero_on:
+            # Stage 1+: the update computation runs on 1/dp slices; the
+            # replicated new params below force the one all-gather.
+            updates = jax.lax.with_sharding_constraint(updates,
+                                                       update_shardings)
         new_t = jax.tree_util.tree_map(
             lambda p, u: (p + u.astype(p.dtype)), t_params, updates)
         new_params = (overlay(state.params, new_t)

@@ -248,6 +248,10 @@ SLOW_TESTS = {
     # fast)
     "tests/test_numerics.py::test_fingerprint_bisection_finds_seeded_divergence",
     "tests/test_numerics.py::test_numerics_cadence_and_overhead_acceptance",
+    # round 18 (ZeRO: the adafactor parity variant pays a second pair of
+    # trainer compiles; the adamw variant and the mlp parity/layout/
+    # checkpoint/elastic tests stay in the fast tier)
+    "tests/test_optimizers.py::test_zero1_update_matches_replicated[adafactor]",
 }
 
 

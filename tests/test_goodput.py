@@ -402,3 +402,43 @@ def test_train_run_records_goodput():
     assert "data_wait" in ph                    # Prefetcher consumer wait
     summed = sum(p["seconds"] for p in ph.values())
     assert abs(summed - rep["total_s"]) <= 0.01 * rep["total_s"]
+
+
+# -- ZeRO layout columns gate (round 18) --------------------------------------
+
+
+def test_gate_holds_opt_state_bytes_column():
+    """opt_state_bytes_per_chip regresses UP with a RELATIVE gap: a row
+    whose opt state quietly un-sharded (8x the bytes) fails even when
+    throughput held; rows predating the column neither gate nor mask."""
+    old = _hist_row(100.0)  # pre-column row: must not mask
+    good = _hist_row(100.0, opt_state_bytes_per_chip=670_000,
+                     grad_reduce_scatter_s=0.004)
+    ok = benchgate.gate_entry(
+        _hist_row(101.0, opt_state_bytes_per_chip=700_000,
+                  grad_reduce_scatter_s=0.005), [old, good])
+    assert ok["ok"] is True, ok
+    bad = benchgate.gate_entry(
+        _hist_row(101.0, opt_state_bytes_per_chip=5_360_000), [old, good])
+    assert bad["ok"] is False
+    assert any(c["column"] == "opt_state_bytes_per_chip" and not c["ok"]
+               for c in bad["attribution"])
+    # A row without the new columns gates only on value + round-16 cols.
+    legacy = benchgate.gate_entry(_hist_row(100.5), [old, good])
+    assert legacy["ok"] is True, legacy
+
+
+def test_zero_fixture_history_passes_gate():
+    """CI acceptance twin: the committed ZeRO-column fixture history
+    (the `bench --gate --dry-run --history tests/fixtures/zero/...` CI
+    step) must stay gate-clean."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "fixtures", "zero",
+        "bench_history_zero.json")
+    rep = benchgate.run_gate(path)
+    assert rep["ok"] is True, rep["regressions"]
+    checks = rep["checks"][0]
+    cols = {c["column"] for c in checks.get("attribution", [])}
+    assert "opt_state_bytes_per_chip" in cols, checks

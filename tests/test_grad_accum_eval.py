@@ -166,3 +166,66 @@ def test_run_eval_streams_from_shard_server(devices, tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=5)
+
+
+# -- ZeRO x grad-accum (round 18) ---------------------------------------------
+
+
+def test_zero2_grad_accum_matches_whole_batch(devices):
+    """accum=4 under ZeRO-2 must still reproduce the replicated accum=1
+    update (fp32, SGD, MLP): sharding the reduce changes layout, never
+    the accumulated math."""
+    base = _cfg(model_overrides={"dtype": jnp.float32})
+    p1, m1 = _one_step(base)
+    p4, m4 = _one_step(base.override(
+        train=TrainConfig(batch_size=32, num_steps=3, grad_accum=4,
+                          zero_stage=2)))
+    np.testing.assert_allclose(m1["loss"], m4["loss"], rtol=1e-5)
+    np.testing.assert_allclose(m1["grad_norm"], m4["grad_norm"], rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def _collect_constraints(jaxpr, inside_scan=False, acc=None):
+    """All sharding_constraint specs in a jaxpr, split by whether they
+    sit inside a scan body (recursing through every sub-jaxpr)."""
+    if acc is None:
+        acc = {"in_scan": [], "outside": []}
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "sharding_constraint":
+            acc["in_scan" if inside_scan else "outside"].append(
+                str(eqn.params.get("sharding")))
+        for v in eqn.params.values():
+            sub = getattr(v, "jaxpr", v if hasattr(v, "eqns") else None)
+            if sub is not None and hasattr(sub, "eqns"):
+                _collect_constraints(
+                    sub, inside_scan or eqn.primitive.name == "scan", acc)
+    return acc
+
+
+def test_zero_reduce_scatter_once_per_step_not_per_microbatch(devices):
+    """The regression audit ISSUE 13 asks for: under ZeRO-2 + grad_accum
+    the microbatch scan must accumulate LOCALLY — the dp-sharding
+    constraint that becomes the reduce-scatter is applied exactly once,
+    after the scan, never inside its body (a constraint in the body
+    would force one cross-replica collective per microbatch)."""
+    cfg = _cfg(model_overrides={"dtype": jnp.float32}).override(
+        train=TrainConfig(batch_size=32, num_steps=1, grad_accum=4,
+                          zero_stage=2))
+    trainer = build_trainer(cfg)
+    state = trainer.init()
+    src = SyntheticSource(trainer.bundle.make_batch, cfg.data, 32, seed=7)
+    batch = trainer.shard_batch(next(iter(src)))
+    jaxpr = jax.make_jaxpr(trainer.step_fn)(state, batch)
+    cons = _collect_constraints(jaxpr.jaxpr)
+    assert cons["in_scan"] == [], \
+        f"dp collective forced inside the accum scan: {cons['in_scan']}"
+    # The grads/updates constraints exist and sit outside the scan: at
+    # least the microbatch input constraints plus dp-sharded grad specs
+    # whose leading entry IS the dp axis (the batch constraints shard
+    # dim 0 over the scan axis — spec starts with None).
+    dp_grads = [s for s in cons["outside"]
+                if "PartitionSpec('dp'" in s or 'PartitionSpec("dp"' in s]
+    assert len(dp_grads) >= 2, cons["outside"]

@@ -75,3 +75,52 @@ def test_sharded_placement_distributes_bytes(devices):
     shardings = shardings_for_tree(tree, mesh)
     arr = jax.device_put(w, shardings["wi"]["kernel"])
     assert {s.data.shape for s in arr.addressable_shards} == {(8, 16)}
+
+
+# -- ZeRO dp-axis composition (round 18) --------------------------------------
+
+
+def test_compose_axis_into_empty_and_composed_specs(devices):
+    from serverless_learn_tpu.parallel.sharding import compose_axis
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    # empty spec: dp lands on dim 0
+    assert compose_axis(P(), (16, 8), mesh, "dp") == P("dp")
+    # composed MAJOR to an existing fsdp entry when dim0 divides dp*fsdp
+    assert compose_axis(P("fsdp", "tp"), (16, 8), mesh, "dp") == \
+        P(("dp", "fsdp"), "tp")
+    # dim0 full (16 % (2*2) == 0 but pretend it's 6): falls to dim 1
+    assert compose_axis(P("fsdp", None), (6, 8), mesh, "dp") == \
+        P("fsdp", "dp")
+    # nothing divides: base spec unchanged (replicated is always correct)
+    assert compose_axis(P(), (5, 3), mesh, "dp") == P()
+    # scalar: unchanged
+    assert compose_axis(P(), (), mesh, "dp") == P()
+    # already carries the axis: unchanged
+    assert compose_axis(P("dp"), (16,), mesh, "dp") == P("dp")
+    # inert on a dp=1 mesh
+    mesh1 = make_mesh(MeshConfig(fsdp=8))
+    assert compose_axis(P("fsdp"), (16, 8), mesh1, "dp") == P("fsdp")
+
+
+def test_zero_specs_shard_opt_leaves_but_not_indivisible(devices):
+    """Opt-state-like trees: param-shaped leaves gain dp; factored /
+    placeholder / indivisible leaves keep their (divisible-only) base."""
+    from serverless_learn_tpu.training.zero import zero_specs_for_tree
+
+    mesh = make_mesh(MeshConfig(dp=8))
+    tree = {
+        "dense_0": {"kernel": jnp.zeros((784, 64)), "bias": jnp.zeros((64,))},
+        "head": {"kernel": jnp.zeros((64, 10)), "bias": jnp.zeros((10,))},
+        "count": jnp.zeros((), jnp.int32),
+        "v_placeholder": jnp.zeros((1,)),
+    }
+    specs = zero_specs_for_tree(tree, mesh)
+    assert specs["dense_0"]["kernel"] == P("dp")
+    assert specs["dense_0"]["bias"] == P("dp")
+    # (64, 10): dim0 divides 8 even though dim1 (10) does not
+    assert specs["head"]["kernel"] == P("dp")
+    # nothing divides: replicated
+    assert specs["head"]["bias"] == P()
+    assert specs["count"] == P()
+    assert specs["v_placeholder"] == P()

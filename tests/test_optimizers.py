@@ -126,3 +126,40 @@ def test_adafactor_trains_on_tp_sharded_mesh(devices):
                                seed=0))
     state, m = trainer.step(state, trainer.shard_batch(next(src)))
     assert np.isfinite(float(jax.device_get(m["loss"])))
+
+
+# -- ZeRO update sharding across optimizer families (round 18) ----------------
+
+
+@pytest.mark.parametrize("name", ["adamw", "adafactor"])
+def test_zero1_update_matches_replicated(devices, name):
+    """Sharding the optimizer state/update over dp must not change any
+    family's math — adamw (the dense-state case) and adafactor (whose
+    factored stats exercise the indivisible-leaf fallback)."""
+    from serverless_learn_tpu.config import DataConfig as DC
+    from serverless_learn_tpu.telemetry.numerics import compare_trees
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    def cfg(stage):
+        return ExperimentConfig(
+            model="mlp_mnist", mesh=MeshConfig(dp=8),
+            optimizer=OptimizerConfig(name=name, learning_rate=1e-3),
+            train=TrainConfig(batch_size=32, zero_stage=stage),
+            data=DC(), model_overrides={"dtype": jnp.float32})
+
+    t0, t1 = build_trainer(cfg(0)), build_trainer(cfg(1))
+    s0, s1 = t0.init(), t1.init()
+    src = SyntheticSource(t0.bundle.make_batch, DC(), 32, seed=17)
+    for b, _ in zip(iter(src), range(2)):
+        s0, _ = t0.step(s0, t0.shard_batch(b))
+        s1, _ = t1.step(s1, t1.shard_batch(b))
+    cmp = compare_trees(jax.device_get(s0.params), jax.device_get(s1.params))
+    if name == "adamw":
+        # Element-wise state: reduce-scatter + all-gather re-associates
+        # the same summands — ulp-tight.
+        assert max(c["max_ulp"] for c in cmp.values()) <= 8, cmp
+    else:
+        # Adafactor's factored stats REDUCE over the sharded dim, so the
+        # cross-device accumulation order genuinely re-associates; the
+        # parity bound is a float tolerance, not ulp identity.
+        assert max(c["max_abs_err"] for c in cmp.values()) <= 1e-6, cmp
