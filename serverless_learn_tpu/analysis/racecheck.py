@@ -437,6 +437,10 @@ DEFAULT_MODULES = (
     # by the training thread's auditor and read by the health engine's
     # sampler thread and the exporter's /numerics scrapes.
     "serverless_learn_tpu.telemetry.numerics",
+    # round 19: the herd harness is single-threaded by design (one event
+    # heap); instrumenting it keeps that property honest if anyone adds
+    # a worker thread later.
+    "serverless_learn_tpu.training.herd",
 )
 
 

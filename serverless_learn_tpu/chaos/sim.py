@@ -248,6 +248,13 @@ class ChaosSim:
         elif f.op == "delay":
             self._extra_delay = f.s or 0.0
             self._extra_jitter = f.jitter or 0.0
+            if f.duration:
+                # 'for' auto-inverse (plan.py contract) — this was the
+                # one windowed op that never scheduled its inverse, so a
+                # {"op": "delay", "for": N} quietly lagged links forever.
+                self._push(self.now + f.duration, self._apply_fault,
+                           Fault(at=self.now + f.duration, op="delay",
+                                 s=0.0))
         elif f.op == "pause":
             targets = self._select(f, alive)
             for nid in targets:

@@ -1625,6 +1625,83 @@ def cmd_chaos(args) -> int:
         print(json.dumps(rep, indent=None if args.compact else 2))
         return 0 if rep["ok"] else 1
 
+    if args.mode == "herd":
+        # Vmapped many-client DiLoCo herd (training/herd.py): N real
+        # tiny-model workers, non-IID shards, speed skew, FaultPlan
+        # churn on the gossip simulator's event heap, quorum
+        # participation + delta quarantine. Needs jax (the one chaos
+        # mode that does) — imported here so run/soak/fleet stay
+        # jax-free.
+        from serverless_learn_tpu.training.herd import (HerdSim, HerdSpec,
+                                                        run_smoke)
+
+        if args.smoke:
+            import tempfile
+
+            events_log, smoke_tmp = args.events_log, None
+            if not events_log:
+                fd, smoke_tmp = tempfile.mkstemp(
+                    prefix="slt-herd-smoke-", suffix=".jsonl")
+                os.close(fd)
+                events_log = smoke_tmp
+            workers = args.workers or 48
+            rep = run_smoke(workers=workers, seed=args.seed,
+                            events_log=events_log)
+            # Doctor must name the quarantined worker and the partial
+            # participation from the events log ALONE.
+            from serverless_learn_tpu.telemetry.doctor import diagnose
+
+            verdict = diagnose(paths=[events_log])["summary"]["verdict"]
+            rep["doctor_verdict"] = verdict
+            poisoned = str(workers - 3)
+            if "quarantin" not in verdict or poisoned not in verdict:
+                rep["ok"] = False
+                rep["violations"].append(
+                    f"doctor failed to name quarantined worker "
+                    f"{poisoned} from the events log")
+            if "participation" not in verdict:
+                rep["ok"] = False
+                rep["violations"].append(
+                    "doctor failed to name the partial participation")
+            if smoke_tmp is not None:
+                try:
+                    os.remove(smoke_tmp)
+                except OSError:
+                    pass
+        else:
+            plan = None
+            if args.plan:
+                try:
+                    with open(args.plan) as f:
+                        plan = FaultPlan.from_json(f.read())
+                except (OSError, ValueError) as e:
+                    print(f"bad fault plan: {e}", file=sys.stderr)
+                    return 2
+            try:
+                spec = HerdSpec(
+                    n_workers=args.workers or 256, rounds=args.rounds,
+                    inner_steps=args.inner_steps,
+                    quorum_fraction=args.quorum,
+                    late_policy=args.late_policy,
+                    poison_worker=args.poison_worker,
+                    poison_round=args.poison_round)
+                sim = HerdSim(spec, seed=args.seed, plan=plan,
+                              events_log=args.events_log)
+            except ValueError as e:
+                print(f"bad herd spec: {e}", file=sys.stderr)
+                return 2
+            rep = sim.run(args.duration)
+        if not args.full:
+            rep = dict(rep)
+            rep["faults_injected"] = len(rep["faults_injected"])
+            det = [v for v in rep["detection_periods"].values()
+                   if v is not None]
+            rep["detection_periods"] = {
+                "n": len(rep["detection_periods"]),
+                "max": max(det) if det else None}
+        print(json.dumps(rep, indent=None if args.compact else 2))
+        return 0 if rep["ok"] else 1
+
     if args.mode == "fleet":
         # Real-socket fleet chaos (chaos/fleet.py): stub replicas behind
         # TcpChaosProxy, a live router, open-loop load, REAL seconds.
@@ -2247,7 +2324,8 @@ def build_parser() -> argparse.ArgumentParser:
                         help="fault-injection chaos harness: run a "
                              "FaultPlan (or a seeded random soak) against "
                              "N simulated gossip members on virtual time")
-    ch.add_argument("mode", choices=["run", "soak", "fleet", "recover"],
+    ch.add_argument("mode",
+                    choices=["run", "soak", "fleet", "recover", "herd"],
                     help="run: execute --plan on the gossip simulator; "
                          "soak: seeded random schedule of kills/"
                          "partitions/stragglers; fleet: execute --plan "
@@ -2255,7 +2333,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "router + stub replicas through TcpChaosProxy; "
                          "recover: kill/corrupt/partition the REAL "
                          "checkpoint stack and assert bounded RPO + "
-                         "measured RTO per incident")
+                         "measured RTO per incident; herd: N vmapped "
+                         "DiLoCo workers running REAL tiny-model inner "
+                         "steps under churn, speed skew, quorum "
+                         "participation and delta quarantine")
     ch.add_argument("--plan", metavar="FILE.json",
                     help="FaultPlan (chaos/plan.py DSL); required for run")
     ch.add_argument("--nodes", type=int, default=50,
@@ -2293,7 +2374,30 @@ def build_parser() -> argparse.ArgumentParser:
                          "corrupt, partition), assert the RPO bound, "
                          "and require `slt doctor` to name every "
                          "recovery + the corruption from the events "
-                         "log alone")
+                         "log alone; herd: small-N seeded proof — "
+                         "mid-round kill + poisoned worker, assert "
+                         "byte-identical same-seed reports and doctor "
+                         "naming the quarantined worker")
+    ch.add_argument("--workers", type=int, default=0,
+                    help="herd: vmapped client count (0 = 256, or 48 "
+                         "with --smoke)")
+    ch.add_argument("--rounds", type=int, default=5,
+                    help="herd: outer rounds to run")
+    ch.add_argument("--inner-steps", type=int, default=4,
+                    help="herd: local steps per worker per round")
+    ch.add_argument("--quorum", type=float, default=1.0,
+                    help="herd: live-view fraction that closes a round "
+                         "(1.0 = wait for everyone or the timeout)")
+    ch.add_argument("--late-policy", choices=["drop", "discount"],
+                    default="drop",
+                    help="herd: stragglers' late deltas are dropped or "
+                         "staleness-discounted onto the anchor")
+    ch.add_argument("--poison-worker", type=int, default=-1,
+                    help="herd: inject a NaN delta from this worker "
+                         "(the quarantine drill; -1 = off)")
+    ch.add_argument("--poison-round", type=int, default=-1,
+                    help="herd: round at which --poison-worker emits "
+                         "the NaN delta")
     ch.set_defaults(fn=cmd_chaos)
 
     tp = sub.add_parser("top", help="live cluster telemetry: poll /metrics "

@@ -213,6 +213,25 @@ class LocalSGDConfig:
     mix_rate: float = 0.5  # gossip mix toward the partner (reference rate)
     outer_lr: float = 0.7  # DiLoCo outer SGD learning rate
     outer_momentum: float = 0.9
+    # ---- DiLoCo degradation policy (round 19, training/diloco_dcn.py) ----
+    # "full": the leader waits for every live island's delta (or the round
+    # timeout) — the historic behavior. "quorum": the leader closes the
+    # outer round as soon as quorum_fraction of the live islands have
+    # delivered; stragglers' late deltas are handled per late_policy.
+    participation: str = "full"  # "full" | "quorum"
+    quorum_fraction: float = 1.0  # live-island fraction that closes a round
+    # Late deltas (posted after their round closed): "drop" discards them
+    # (counted); "discount" applies each as a stale plain-SGD update on the
+    # next led anchor with weight staleness_discount ** rounds_late.
+    late_policy: str = "drop"  # "drop" | "discount"
+    staleness_discount: float = 0.25
+    # Leader-side delta sanity gate: non-finite deltas are ALWAYS
+    # quarantined (never averaged into the anchor); with >= gate_min_peers
+    # finite deltas in a round, a delta whose L2 exceeds
+    # median + outlier_factor * MAD is quarantined as a norm outlier.
+    delta_gate: bool = True
+    outlier_factor: float = 12.0
+    gate_min_peers: int = 4
 
 
 @dataclass(frozen=True)

@@ -430,6 +430,36 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
     for a in numerics_firing[:2]:
         verdict_bits.append(
             f"training quality: {a.get('alert')} — {a.get('message')}")
+    # DiLoCo delta quarantine (round 19): the leader's sanity gate names
+    # every worker whose delta it rejected (non-finite or norm outlier)
+    # in labeled diloco.delta_quarantined alert events — the verdict
+    # points at the sick WORKER, from the events log alone.
+    def _q_worker(a: dict) -> str:
+        return str((a.get("labels") or {}).get("worker")
+                   or a.get("node") or "?")
+
+    q_alerts = [a for a in alerts
+                if a.get("alert") == "diloco.delta_quarantined"]
+    q_firing = sorted({_q_worker(a) for a in q_alerts
+                       if a.get("state") == "firing"})
+    q_resolved = sorted({_q_worker(a) for a in q_alerts
+                         if a.get("state") != "firing"} - set(q_firing))
+    if q_firing:
+        verdict_bits.append(
+            f"quarantined DiLoCo delta(s) from worker(s): "
+            f"{', '.join(q_firing)} — excluded from the outer average")
+    if q_resolved:
+        verdict_bits.append(
+            f"DiLoCo worker(s) {', '.join(q_resolved)} had delta(s) "
+            f"quarantined, then posted clean and were readmitted")
+    # Partial participation (round 19): quorum-policy rounds record the
+    # accepted-delta fraction; surface it when any round closed short.
+    parts = [r.get("participation") for r in round_recs
+             if isinstance(r.get("participation"), (int, float))]
+    if parts and min(parts) < 1.0:
+        verdict_bits.append(
+            f"partial DiLoCo participation over {len(parts)} round(s): "
+            f"mean {sum(parts) / len(parts):.0%}, min {min(parts):.0%}")
     # Step-interior hardware attribution (round 16): xray summaries —
     # from capture-meta.json records in the event trail and from capture
     # dirs handed to --xray — put a NAME on the training plateau ("step

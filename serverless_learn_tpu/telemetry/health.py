@@ -239,8 +239,17 @@ def score_stragglers(rounds: List[dict], factor: float = 4.0,
     rounds seen — one slow round is noise, a pattern is a straggler."""
     stats: Dict[str, dict] = {}
     for rec in rounds:
-        arrivals = {str(k): float(v)
-                    for k, v in (rec.get("arrivals_s") or {}).items()}
+        # Tolerant of degenerate records (round 19): zero recorded
+        # arrivals (a quorum/timeout round that closed empty), workers
+        # that never report (live but absent from arrivals_s for every
+        # round — the "missing" path must not KeyError), and non-numeric
+        # arrival values from a torn JSONL line.
+        arrivals = {}
+        for k, v in (rec.get("arrivals_s") or {}).items():
+            try:
+                arrivals[str(k)] = float(v)
+            except (TypeError, ValueError):
+                continue
         live = [str(w) for w in (rec.get("live") or arrivals.keys())]
         if not live:
             continue
@@ -261,7 +270,7 @@ def score_stragglers(rounds: List[dict], factor: float = 4.0,
     out: Dict[str, dict] = {}
     for wid, st in stats.items():
         bad = st["late"] + st["missing"]
-        score = bad / st["rounds_seen"]
+        score = bad / max(st["rounds_seen"], 1)
         out[wid] = {
             "rounds_seen": st["rounds_seen"], "late": st["late"],
             "missing": st["missing"], "score": round(score, 4),
@@ -467,6 +476,13 @@ _EVENT_RULES = (
     ("ckpt_corrupt", "slt_ckpt_corrupt_total", "critical"),
     ("ckpt_emergency_save", "slt_ckpt_emergency_saves_total", "warning"),
     ("recovery", "slt_recovery_incidents_total", "warning"),
+    # Round 19: the DiLoCo leader's delta sanity gate. The island also
+    # emits a labeled per-worker diloco.delta_quarantined alert event
+    # directly (like the router's fleet.replica_dead); this rule makes
+    # the same incidents visible to a health engine sampling the
+    # island's registry (/alerts, slt top).
+    ("diloco_delta_quarantined", "slt_diloco_quarantined_total",
+     "warning"),
 )
 
 

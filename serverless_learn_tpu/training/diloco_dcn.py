@@ -55,9 +55,10 @@ DiLoCo recipe).
 from __future__ import annotations
 
 import json
+import math
 import time
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional, Set
 
 import jax
 import numpy as np
@@ -122,10 +123,17 @@ class DilocoIsland:
     lets each island stream distinct data keyed by its worker id.
     """
 
-    # Class-level default so harness-style construction (``__new__`` +
+    # Class-level defaults so harness-style construction (``__new__`` +
     # manual attributes, as the liveness tests do) keeps the historic
-    # challenge-enabled behavior.
+    # behavior: challenge enabled, wait-for-all participation, gate on.
     leader_rechallenge = True
+    participation = "full"
+    quorum_fraction = 1.0
+    late_policy = "drop"
+    staleness_discount = 0.25
+    delta_gate = True
+    outlier_factor = 12.0
+    gate_min_peers = 4
 
     def __init__(self, config: ExperimentConfig, store, coordinator_addr:
                  str, run_name: str, mesh=None,
@@ -136,7 +144,14 @@ class DilocoIsland:
                  source_factory: Optional[Callable] = None,
                  init_timeout_s: float = 30.0,
                  liveness_factor: float = 3.0, registry=None,
-                 leader_rechallenge: Optional[bool] = None):
+                 leader_rechallenge: Optional[bool] = None,
+                 participation: Optional[str] = None,
+                 quorum_fraction: Optional[float] = None,
+                 late_policy: Optional[str] = None,
+                 staleness_discount: Optional[float] = None,
+                 delta_gate: Optional[bool] = None,
+                 outlier_factor: Optional[float] = None,
+                 gate_min_peers: Optional[int] = None):
         lcfg = config.local_sgd
         self.config = config
         # Round 15: anchors/deltas ride the same replication tier as
@@ -175,6 +190,37 @@ class DilocoIsland:
                 config, "membership", None) is None or \
                 config.membership.leader_rechallenge
         self.leader_rechallenge = bool(leader_rechallenge)
+
+        # Round 19: participation policy + leader-side delta sanity gate
+        # (ctor overrides win; otherwise LocalSGDConfig).
+        def _pick(v, name, default):
+            return v if v is not None else getattr(lcfg, name, default)
+
+        self.participation = _pick(participation, "participation", "full")
+        if self.participation not in ("full", "quorum"):
+            raise ValueError(f"participation must be 'full' or 'quorum', "
+                             f"got {self.participation!r}")
+        self.quorum_fraction = float(
+            _pick(quorum_fraction, "quorum_fraction", 1.0))
+        if not 0.0 < self.quorum_fraction <= 1.0:
+            raise ValueError("quorum_fraction must be in (0, 1]")
+        self.late_policy = _pick(late_policy, "late_policy", "drop")
+        if self.late_policy not in ("drop", "discount"):
+            raise ValueError(f"late_policy must be 'drop' or 'discount', "
+                             f"got {self.late_policy!r}")
+        self.staleness_discount = float(
+            _pick(staleness_discount, "staleness_discount", 0.25))
+        self.delta_gate = bool(_pick(delta_gate, "delta_gate", True))
+        self.outlier_factor = float(
+            _pick(outlier_factor, "outlier_factor", 12.0))
+        self.gate_min_peers = int(_pick(gate_min_peers, "gate_min_peers", 4))
+        # Leader-side memory for the late-delta path: what each led round
+        # had posted at close time (so NEW keys later are "late"), and
+        # which workers currently have a firing quarantine alert (so a
+        # clean delta resolves it). Best-effort across leadership
+        # migration — a new leader simply has no owed set to check.
+        self._posted_at_close: Dict[int, Set[int]] = {}
+        self._quarantine_firing: Set[int] = set()
         reg = registry or get_registry()
         self._m_rounds = reg.counter("slt_diloco_rounds_total")
         self._m_led = reg.counter("slt_diloco_led_rounds_total")
@@ -199,6 +245,16 @@ class DilocoIsland:
         self._m_anchor_drift = reg.gauge(
             "slt_diloco_anchor_drift",
             "L2 of the last led outer step's anchor movement")
+        # Round 19: participation policy + delta quarantine ledgers.
+        self._m_participation = reg.gauge(
+            "slt_diloco_participation",
+            "accepted-delta fraction of live islands in the last led round")
+        self._m_quarantined = reg.counter(
+            "slt_diloco_quarantined_total",
+            "worker deltas rejected by the leader's sanity gate")
+        self._m_late = reg.counter(
+            "slt_diloco_late_deltas_total",
+            "straggler deltas that arrived after their round closed")
         if self.inner_steps < 1:
             raise ValueError(f"inner_steps must be >= 1, "
                              f"got {self.inner_steps}")
@@ -402,7 +458,19 @@ class DilocoIsland:
                 for p in posted:
                     arrivals.setdefault(p, now_off)
                 waiting_on = [i for i in live if i not in posted]
-                if challenge or not waiting_on \
+                # Round 19 participation policy: under "quorum" the
+                # leader closes as soon as quorum_fraction of the live
+                # islands have delivered — stragglers' deltas become
+                # "late" and are handled per late_policy next round.
+                quorum_met = False
+                if self.participation == "quorum" and live:
+                    # epsilon guards float ceil: 0.67 * 3 = 2.01 must
+                    # need 2 islands, not 3.
+                    need = max(1, math.ceil(
+                        self.quorum_fraction * len(live) - 1e-9))
+                    quorum_met = sum(
+                        1 for i in live if i in posted) >= need
+                if challenge or not waiting_on or quorum_met \
                         or time.monotonic() > deadline:
                     self.report.led_rounds += 1
                     self._m_led.inc()
@@ -414,6 +482,136 @@ class DilocoIsland:
         mw = getattr(self, "_m_round_wait", None)
         if mw is not None:
             mw.observe(time.monotonic() - t_wait0)
+        return anchor
+
+    # -- leader-side delta sanity gate (round 19) --------------------------
+
+    @staticmethod
+    def _nonfinite_count(tree) -> int:
+        """NaN/Inf count over a host delta tree, through the shared
+        ``telemetry/numerics.tree_stats`` implementation."""
+        from serverless_learn_tpu.telemetry.numerics import tree_stats
+
+        return int(sum(int(st["nonfinite"])
+                       for st in tree_stats(tree, depth=1).values()))
+
+    def _quarantine_alert(self, wid: int, rnd: int, reason: str,
+                          value: float, threshold: float):
+        from serverless_learn_tpu.telemetry import tracing as _ttrace
+
+        m = getattr(self, "_m_quarantined", None)
+        if m is not None:
+            m.inc()
+        if not hasattr(self, "_quarantine_firing"):
+            self._quarantine_firing = set()
+        self._quarantine_firing.add(wid)
+        t = round(time.time(), 3)
+        _ttrace.emit_event({
+            "event": "alert", "state": "firing", "severity": "critical",
+            "alert": "diloco.delta_quarantined", "detector": "diloco",
+            "node": f"worker-{wid}",
+            "labels": {"worker": str(wid), "run": self.run},
+            "count": 1, "first_fired_unix_s": t, "last_fired_unix_s": t,
+            "value": round(float(value), 6),
+            "threshold": round(float(threshold), 6),
+            "message": f"round {rnd}: delta from worker {wid} quarantined "
+                       f"({reason}) — excluded from the outer average"})
+
+    def _quarantine_resolve(self, wid: int, rnd: int):
+        if wid not in getattr(self, "_quarantine_firing", ()):
+            return
+        from serverless_learn_tpu.telemetry import tracing as _ttrace
+
+        self._quarantine_firing.discard(wid)
+        t = round(time.time(), 3)
+        _ttrace.emit_event({
+            "event": "alert", "state": "resolved", "severity": "critical",
+            "alert": "diloco.delta_quarantined", "detector": "diloco",
+            "node": f"worker-{wid}",
+            "labels": {"worker": str(wid), "run": self.run},
+            "last_fired_unix_s": t, "resolved_unix_s": t,
+            "message": f"worker {wid} posted a clean delta in round "
+                       f"{rnd}; readmitted"})
+
+    def _gate_deltas(self, rnd: int, posted: List[int], deltas: List):
+        """Split (wid, delta) pairs into accepted / quarantined.
+        Non-finite deltas are always rejected; with >= gate_min_peers
+        finite deltas, L2 outliers beyond median + outlier_factor * MAD
+        are rejected too. Returns (accepted pairs, {wid: reason})."""
+        if not self.delta_gate:
+            return list(zip(posted, deltas)), {}
+        quarantined: dict = {}
+        finite = []
+        for wid, d in zip(posted, deltas):
+            bad = self._nonfinite_count(d)
+            if bad:
+                quarantined[wid] = "nonfinite"
+                self._quarantine_alert(wid, rnd, "nonfinite",
+                                       float(bad), 0.0)
+            else:
+                finite.append((wid, d, _host_norm(d)))
+        if len(finite) >= self.gate_min_peers:
+            norms = np.array([nrm for _, _, nrm in finite], np.float64)
+            med = float(np.median(norms))
+            mad = float(np.median(np.abs(norms - med)))
+            # Spread floor 10% of the median: heterogeneous (non-IID)
+            # islands produce legitimately unequal delta norms; the
+            # gate is for sick workers, not slow or skewed ones.
+            cut = med + self.outlier_factor * max(mad, 0.1 * abs(med),
+                                                  1e-9)
+            kept = []
+            for wid, d, nrm in finite:
+                if nrm > cut:
+                    quarantined[wid] = "norm_outlier"
+                    self._quarantine_alert(wid, rnd, "norm_outlier",
+                                           nrm, cut)
+                else:
+                    kept.append((wid, d, nrm))
+            finite = kept
+        return [(wid, d) for wid, d, _ in finite], quarantined
+
+    def _apply_late_deltas(self, rnd: int, anchor, template):
+        """Deltas for round ``rnd - 1`` that appeared AFTER that round
+        closed (the quorum policy's stragglers). "drop" counts them;
+        "discount" applies each as a stale plain-SGD update on the
+        current anchor with weight outer_lr * staleness_discount — the
+        momentum trace is deliberately untouched (a stale gradient must
+        not steer it). Best-effort across leadership migration: a new
+        leader has no close-time memory and treats nothing as late."""
+        from serverless_learn_tpu.telemetry import tracing as _ttrace
+
+        prev_posted = getattr(self, "_posted_at_close", {}).get(rnd - 1)
+        if prev_posted is None:
+            return anchor
+        late_ids = [i for i in self._deltas_for(rnd - 1)
+                    if i not in prev_posted]
+        for wid in late_ids:
+            m = getattr(self, "_m_late", None)
+            if m is not None:
+                m.inc()
+            record = {"event": "diloco_late_delta", "run": self.run,
+                      "worker": wid, "round": rnd - 1,
+                      "t_unix_s": round(time.time(), 3)}
+            if self.late_policy == "discount":
+                try:
+                    d = _unpack(self.store.get(
+                        self._k(f"round-{rnd - 1}", f"delta-{wid}")),
+                        template)
+                except (OSError, ValueError):
+                    continue
+                if self._nonfinite_count(d):
+                    self._quarantine_alert(wid, rnd - 1, "nonfinite",
+                                           1.0, 0.0)
+                    record["action"] = "quarantined"
+                else:
+                    weight = self.outer_lr * self.staleness_discount
+                    anchor = jax.tree_util.tree_map(
+                        lambda a, x: a - weight * x, anchor, d)
+                    record["action"] = "discounted"
+                    record["weight"] = round(weight, 6)
+            else:
+                record["action"] = "dropped"
+            _ttrace.emit_event(record)
         return anchor
 
     def _lead(self, rnd: int, posted: List[int], anchor, trace, template,
@@ -440,19 +638,36 @@ class DilocoIsland:
         deltas = [_unpack(self.store.get(
             self._k(f"round-{rnd}", f"delta-{i}")), template)
             for i in posted]
-        if not deltas:
-            # Reachable: the round deadline can fire while a transient
-            # manifest RPC failure makes _deltas_for return [] (the
-            # ShardServerStore swallows IOError into an empty list).
-            # Publish the anchor UNCHANGED — liveness over progress; the
-            # posted deltas, if any exist, are simply skipped this round.
+        # Stragglers from the previous led round first (round 19): their
+        # late deltas are dropped or staleness-discounted per policy.
+        anchor = self._apply_late_deltas(rnd, anchor, template)
+        accepted, quarantined = self._gate_deltas(rnd, posted, deltas)
+        n_live = max(len(rec["live"]), 1)
+        participation = round(len(accepted) / n_live, 4)
+        rec["participation"] = participation
+        if quarantined:
+            rec["quarantined"] = {str(w): r
+                                  for w, r in sorted(quarantined.items())}
+        m_part = getattr(self, "_m_participation", None)
+        if m_part is not None:
+            m_part.set(participation)
+        self._posted_at_close = {rnd: set(posted)}
+        if not accepted:
+            # Nothing usable this round — either a transient manifest
+            # RPC failure made _deltas_for return [] at the deadline, or
+            # the gate rejected every delta. Publish the anchor
+            # UNCHANGED — liveness over progress; a poisoned round must
+            # not destroy the anchor.
             _health.note_round(rec)
             _ttrace.emit_event(rec)
             self._publish(rnd + 1, anchor, trace, self.report.steps_done)
             return
-        n = float(len(deltas))
+        for wid, _ in accepted:
+            self._quarantine_resolve(wid, rnd)
+        n = float(len(accepted))
         grad = jax.tree_util.tree_map(
-            lambda *ls: np.add.reduce(ls) / n, *deltas)
+            lambda *ls: np.add.reduce(ls) / n,
+            *[d for _, d in accepted])
         new_anchor, new_trace = _nesterov_step(
             anchor, grad, trace, self.outer_lr, self.outer_momentum)
         # Round 17 numerics ledger: per-worker delta norms (a diverging
@@ -462,7 +677,7 @@ class DilocoIsland:
         # `slt doctor` and the quantized-exchange acceptance see one
         # trail.
         rec["delta_norms"] = {str(i): round(_host_norm(d), 6)
-                              for i, d in zip(posted, deltas)}
+                              for i, d in accepted}
         drift = _host_norm(jax.tree_util.tree_map(
             lambda a, b: a - b, new_anchor, anchor))
         rec["anchor_drift"] = round(drift, 6)

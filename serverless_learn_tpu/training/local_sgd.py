@@ -29,6 +29,15 @@ Elasticity note: because replicas only meet at outer syncs, membership
 changes (the elastic controller re-meshing, ``training/elastic.py``) only
 need to land on outer-sync boundaries — the same property the reference's
 gossip bought with its tolerance of stale peers.
+
+Degradation note (round 19): inside ONE SPMD world every replica steps in
+the same jit, so "participation" is all-or-nothing here. The cross-process
+descendant (``training/diloco_dcn.py``) is where the round-19
+``LocalSGDConfig`` policy fields (``participation``/``quorum_fraction``/
+``late_policy``/``delta_gate``) take effect — quorum round closes, late-
+delta handling and the leader-side delta quarantine gate; and
+``training/herd.py`` validates those policies at 256+ vmapped workers
+under churn.
 """
 
 from __future__ import annotations

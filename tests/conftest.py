@@ -219,6 +219,9 @@ SLOW_TESTS = {
     "tests/test_diloco_dcn.py::test_leader_crash_hands_over",
     "tests/test_diloco_dcn.py::test_late_joiner_adopts_current_anchor",
     "tests/test_diloco_dcn.py::test_islands_are_sharded_worlds",
+    # round 19 (real-daemon DiLoCo quorum integration; the jit-free gate
+    # units and the vmapped herd acceptance stay fast)
+    "tests/test_diloco_dcn.py::test_quorum_closes_round_without_straggler",
     "tests/test_speculative.py::test_cross_draft_is_exact",
     "tests/test_speculative.py::test_self_draft_is_exact_and_fully_accepted",
     "tests/test_speculative.py::test_unequal_prompts_exact",

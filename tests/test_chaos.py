@@ -184,6 +184,74 @@ def test_quorum_loss_safe_pauses_training():
 
 
 # ---------------------------------------------------------------------------
+# FaultPlan edge cases the herd leans on (round 19)
+# ---------------------------------------------------------------------------
+
+
+def test_random_soak_same_seed_identical_plans():
+    """random_soak is the herd/soak schedule generator — two same-seed
+    RNGs must yield byte-identical plans, different seeds must not."""
+    import random
+
+    def plan(seed):
+        return FaultPlan.random_soak(30, 60.0, random.Random(f"s-{seed}"))
+
+    assert plan(9) == plan(9)
+    assert plan(9).faults  # non-trivial schedule
+    assert plan(9) != plan(10)
+    # and the generated plan re-validates through the strict parser
+    as_json = json.dumps({"faults": [
+        {k: v for k, v in {
+            "at": f.at, "op": f.op, "node": f.node, "frac": f.frac,
+            "count": f.count, "for": f.duration, "split": f.split,
+            "rate": f.rate}.items() if v is not None}
+        for f in plan(9).faults]})
+    assert FaultPlan.from_json(as_json).faults
+
+
+def test_pause_window_on_node_that_dies_mid_window():
+    """'for'-windowed pause on a node that is KILLED inside the window,
+    then restarted: the restart must clear the stale pause (a zombie
+    paused_until would silently mute the reborn node), and the whole
+    scenario stays deterministic."""
+    plan = FaultPlan.from_obj([
+        {"at": 3.0, "op": "pause", "node": "node-4", "for": 6.0},
+        {"at": 5.0, "op": "kill", "node": "node-4"},
+        {"at": 12.0, "op": "restart", "node": "node-4"}])
+
+    def run():
+        rep = ChaosSim(12, seed=8, plan=plan).run()
+        rep.pop("wall_time_s")
+        return rep
+
+    rep = run()
+    assert rep["ok"], rep["violations"]
+    assert rep["killed_live"] == []  # restarted => alive at the end
+    assert rep == run()  # deterministic through the pause+kill overlap
+    sim = ChaosSim(12, seed=8, plan=plan)
+    sim.run()
+    assert sim.hosts["node-4"].paused_until < 0  # restart cleared it
+
+
+def test_delay_for_schedules_auto_inverse():
+    """plan.py documents 'for' auto-inverse for every windowed op; delay
+    was the one op that never scheduled its inverse, quietly lagging
+    links forever. Regression: after the window, the extra delay and
+    jitter are gone and the inverse shows up in the injection record."""
+    plan = FaultPlan.from_obj([
+        {"at": 2.0, "op": "delay", "s": 0.05, "jitter": 0.02,
+         "for": 4.0}])
+    sim = ChaosSim(10, seed=1, plan=plan)
+    rep = sim.run(duration_s=20.0)
+    assert rep["ok"], rep["violations"]
+    assert sim._extra_delay == 0.0
+    assert sim._extra_jitter == 0.0
+    delays = [f for f in sim.injected if f["op"] == "delay"]
+    assert len(delays) == 2  # the fault and its auto-inverse
+    assert delays[1]["t_virtual_s"] == pytest.approx(6.0)
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 

@@ -358,6 +358,163 @@ def test_islands_are_sharded_worlds(coordinator, devices):
         assert all(np.isfinite(l) for l in rep.losses)
 
 
+@pytest.fixture()
+def clean_rounds():
+    """The leader's round records land in the process-global health
+    ring (health.note_round); scrub it so engine tests elsewhere don't
+    score this test's fabricated stragglers."""
+    from serverless_learn_tpu.telemetry import health
+
+    health.clear_rounds()
+    yield
+    health.clear_rounds()
+
+
+def _gate_island(tmp_path, run="gate", **attrs):
+    """Harness-style island (``__new__`` + manual attributes, the
+    test_telemetry liveness idiom): enough surface to drive ``_lead``
+    without a coordinator or a trainer."""
+    from serverless_learn_tpu.training import diloco_dcn as dd
+
+    isl = dd.DilocoIsland.__new__(dd.DilocoIsland)
+    isl.store = LocalStore(str(tmp_path))
+    isl.run = run
+    isl.outer_lr, isl.outer_momentum = 1.0, 0.0
+    isl.report = dd.IslandReport()
+
+    class FakeAgent:
+        worker_id = 0
+
+    isl.agent = FakeAgent()
+    for k, v in attrs.items():
+        setattr(isl, k, v)
+    return isl
+
+
+def test_leader_gate_quarantines_poisoned_delta(tmp_path, clean_rounds):
+    """ISSUE-19 satellite: one poisoned (NaN) worker cannot destroy the
+    round — the leader averages only the clean delta, and when EVERY
+    delta is poisoned the anchor is republished unchanged."""
+    from serverless_learn_tpu.telemetry import health
+    from serverless_learn_tpu.training import diloco_dcn as dd
+
+    isl = _gate_island(tmp_path)
+    template = {"w": np.zeros((4,), np.float32)}
+    anchor = {"w": np.ones((4,), np.float32)}
+    trace = {"w": np.zeros((4,), np.float32)}
+    isl.store.put("diloco-gate/round-0/delta-1",
+                  dd._pack({"w": np.full((4,), 0.1, np.float32)}))
+    isl.store.put("diloco-gate/round-0/delta-2",
+                  dd._pack({"w": np.full((4,), np.nan, np.float32)}))
+    health.clear_rounds()
+    isl._lead(0, [1, 2], anchor, trace, template, live=[1, 2])
+    pub = dd._unpack(isl.store.get("diloco-gate/round-1/anchor"),
+                     {"params": template, "trace": template})
+    # lr=1, mu=0: anchor - mean(accepted) = 1 - 0.1 — the NaN delta is
+    # fully excluded, not folded in at weight 0.
+    np.testing.assert_allclose(pub["params"]["w"], 0.9, rtol=1e-6)
+    assert np.isfinite(pub["params"]["w"]).all()
+    rec = health.recent_rounds()[-1]
+    assert rec["quarantined"] == {"2": "nonfinite"}
+    assert rec["participation"] == 0.5
+    assert list(rec["delta_norms"]) == ["1"]
+
+    # Round 1: ONLY the poisoned worker posts — the anchor must come
+    # through unchanged (liveness over progress).
+    isl.store.put("diloco-gate/round-1/delta-2",
+                  dd._pack({"w": np.full((4,), np.nan, np.float32)}))
+    anchor1 = pub["params"]
+    isl._lead(1, [2], anchor1, pub["trace"], template, live=[2])
+    pub2 = dd._unpack(isl.store.get("diloco-gate/round-2/anchor"),
+                      {"params": template, "trace": template})
+    np.testing.assert_allclose(pub2["params"]["w"], anchor1["w"])
+    assert health.recent_rounds()[-1]["participation"] == 0.0
+
+
+def test_leader_gate_rejects_norm_outlier(tmp_path, clean_rounds):
+    """The outlier arm: five in-family deltas plus one at 1000x their
+    scale — only the outlier is excluded."""
+    from serverless_learn_tpu.telemetry import health
+    from serverless_learn_tpu.training import diloco_dcn as dd
+
+    isl = _gate_island(tmp_path, run="outlier")
+    template = {"w": np.zeros((8,), np.float32)}
+    anchor = {"w": np.ones((8,), np.float32)}
+    trace = {"w": np.zeros((8,), np.float32)}
+    rng = np.random.default_rng(0)
+    posted = []
+    for wid in range(1, 6):
+        isl.store.put(f"diloco-outlier/round-0/delta-{wid}", dd._pack(
+            {"w": (0.1 * rng.standard_normal(8)).astype(np.float32)}))
+        posted.append(wid)
+    isl.store.put("diloco-outlier/round-0/delta-6",
+                  dd._pack({"w": np.full((8,), 100.0, np.float32)}))
+    posted.append(6)
+    health.clear_rounds()
+    isl._lead(0, posted, anchor, trace, template, live=posted)
+    rec = health.recent_rounds()[-1]
+    assert rec["quarantined"] == {"6": "norm_outlier"}
+    assert rec["participation"] == round(5 / 6, 4)
+    pub = dd._unpack(isl.store.get("diloco-outlier/round-1/anchor"),
+                     {"params": template, "trace": template})
+    assert np.abs(pub["params"]["w"]).max() < 10.0  # 100x never averaged
+
+
+def test_gate_disabled_folds_nan(tmp_path, clean_rounds):
+    """Negative control: delta_gate=False restores the pre-round-19
+    behavior — the NaN reaches the anchor. This is exactly what the
+    gate exists to prevent."""
+    from serverless_learn_tpu.training import diloco_dcn as dd
+
+    isl = _gate_island(tmp_path, run="nogate", delta_gate=False)
+    template = {"w": np.zeros((2,), np.float32)}
+    anchor = {"w": np.ones((2,), np.float32)}
+    trace = {"w": np.zeros((2,), np.float32)}
+    isl.store.put("diloco-nogate/round-0/delta-1",
+                  dd._pack({"w": np.full((2,), np.nan, np.float32)}))
+    isl._lead(0, [1], anchor, trace, template, live=[1])
+    pub = dd._unpack(isl.store.get("diloco-nogate/round-1/anchor"),
+                     {"params": template, "trace": template})
+    assert not np.isfinite(pub["params"]["w"]).any()
+
+
+def test_quorum_closes_round_without_straggler(coordinator, devices, clean_rounds):
+    """participation='quorum' at 2/3: the leader closes each round once
+    two islands delivered instead of waiting out the slow third; the
+    straggler still completes every round (it adopts each anchor late),
+    and the round records show partial participation."""
+    from serverless_learn_tpu.telemetry import health
+
+    rounds = 3
+    with tempfile.TemporaryDirectory() as root:
+        store = LocalStore(root)
+        islands = [_island(_cfg(), store, coordinator, "quorum", i,
+                           participation="quorum", quorum_fraction=0.6,
+                           round_timeout_s=60.0)
+                   for i in range(3)]
+        victim = max(islands, key=lambda i: i.agent.worker_id)
+
+        def slow_source(wid, _inner=victim.source_factory):
+            src = _inner(wid)
+
+            def gen():
+                while True:
+                    time.sleep(0.25)
+                    yield next(src)
+
+            return gen()
+
+        victim.source_factory = slow_source
+        health.clear_rounds()
+        reports = _run_threads(islands, rounds)
+    for rep in reports:
+        assert rep.rounds_done == rounds, rep
+    # A 60s round timeout with a slow third island: only the quorum
+    # close explains finishing, and the leader recorded the shortfall.
+    recs = health.recent_rounds()
+    assert any(r.get("participation", 1.0) < 1.0 for r in recs), recs
+
+
 def test_late_joiner_adopts_current_anchor(coordinator, devices):
     """An island started after round 1 joins at the CURRENT round (not 0)
     and contributes deltas from there on."""

@@ -176,6 +176,45 @@ def test_score_stragglers_fabricated_three_worker_rounds():
     assert score_stragglers(noise)["2"]["flagged"] is False
 
 
+def test_score_stragglers_degenerate_rounds():
+    """Round-19 satellite: rounds with ZERO recorded arrivals (a quorum
+    or timeout round that closed empty), workers that never report at
+    all, empty live lists and torn non-numeric arrival values must
+    score without div-by-zero or KeyError."""
+    rounds = [
+        {"round": 0, "live": [1, 2], "arrivals_s": {}},
+        {"round": 1, "live": [1, 2], "arrivals_s": {"1": 0.1}},
+        {"round": 2, "live": [], "arrivals_s": {}},  # skipped entirely
+        {"round": 3, "live": [1, 2],
+         "arrivals_s": {"1": "garbage", "2": 0.2}},
+        {"round": 4},  # no live, no arrivals at all
+    ]
+    out = score_stragglers(rounds, min_rounds=2)
+    assert set(out) == {"1", "2"}
+    # worker 2 never reported in rounds 0/1, reported in round 3
+    assert out["2"]["rounds_seen"] == 3
+    assert out["2"]["missing"] == 2
+    assert out["2"]["flagged"] is True  # 2/3 bad >= 0.5
+    # worker 1's garbage arrival counts as missing, not a crash
+    assert out["1"]["missing"] == 2  # round 0 (empty) + round 3 (torn)
+    assert out["1"]["mean_lag_s"] == 0.0
+    # a worker that NEVER appears anywhere simply has no entry
+    assert "7" not in out
+    # all-empty input
+    assert score_stragglers([]) == {}
+    assert score_stragglers([{"round": 0}]) == {}
+
+
+def test_quarantine_event_rule_registered():
+    """The leader's quarantine counter feeds the generic event-rule
+    alert family, so a health engine sampling the island's registry
+    surfaces event.diloco_delta_quarantined on /alerts."""
+    from serverless_learn_tpu.telemetry.health import _EVENT_RULES
+
+    assert ("diloco_delta_quarantined", "slt_diloco_quarantined_total",
+            "warning") in _EVENT_RULES
+
+
 # -- engine ticks (fast, fake clock) -----------------------------------------
 
 def _engine(reg, sink, **cfg_kw):

@@ -1,0 +1,223 @@
+"""`slt herd` (round 19): the vmapped many-client DiLoCo harness.
+
+What the tests pin:
+
+* the ISSUE-19 acceptance: 256 vmapped clients with non-IID shards and
+  speed skew, a FaultPlan killing >20% of the herd mid-round, quorum-0.8
+  participation — byte-identical same-seed reports, the poisoned
+  worker's NaN delta quarantined (never reaching the anchor), and
+  `slt doctor` naming the quarantined worker + partial participation
+  from the events log alone, with membership agreement (real SWIM
+  gossip) asserted with training in the loop;
+* loss parity of partial (quorum 0.8) vs full participation under
+  heterogeneity — the degradation policy's "safe to run degraded" claim;
+* the norm-outlier arm of the quarantine gate + readmission;
+* late-delta policies (drop vs staleness-discount);
+* churn: a killed-and-restarted worker rejoins with fresh inner
+  optimizer state and contributes deltas again;
+* the `slt chaos herd` CLI incl. `--smoke`.
+"""
+
+import json
+
+import pytest
+
+from serverless_learn_tpu.chaos.plan import FaultPlan
+from serverless_learn_tpu.training.herd import (HerdSim, HerdSpec,
+                                                parity_specs, run_smoke)
+
+ACCEPT_SPEC = HerdSpec(
+    n_workers=256, rounds=5, inner_steps=2, batch_size=4, features=(16,),
+    quorum_fraction=0.8, round_timeout_s=1.0, speed_skew=0.5,
+    poison_worker=200, poison_round=2)
+
+# Kill 21% of the herd while round 0's deltas are in flight (round 0
+# starts at bootstrap_s=2.0; arrivals land from ~2.05 on).
+ACCEPT_PLAN = [{"at": 2.08, "op": "kill", "frac": 0.21}]
+
+
+def _load_events(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+def test_herd_acceptance_churn_determinism_quarantine(tmp_path):
+    """The ISSUE-19 acceptance scenario, end to end."""
+    from serverless_learn_tpu.telemetry import doctor
+
+    events = str(tmp_path / "herd-events.jsonl")
+
+    def run(log=None):
+        rep = HerdSim(ACCEPT_SPEC, seed=3,
+                      plan=FaultPlan.from_obj(ACCEPT_PLAN),
+                      events_log=log).run(duration_s=45.0)
+        rep.pop("wall_time_s")
+        return rep
+
+    rep = run(events)
+    assert rep["ok"], rep["violations"]
+    herd = rep["herd"]
+    # >= 20% of 256 workers killed mid-round, and the run still
+    # completed every scheduled round at quorum.
+    assert len(rep["killed_live"]) >= 52
+    assert herd["rounds_completed"] == 5
+    assert herd["committed_step"] == 10
+    # real membership agreement WITH training in the loop
+    assert rep["converged"], rep["violations"]
+    assert rep["dissemination_periods"] <= rep["convergence_bound_periods"]
+    # quorum 0.8 closed rounds short of full participation
+    assert all(0.5 <= p <= 1.0 for p in herd["participation"])
+    assert herd["mean_participation"] < 1.0
+    # the poisoned worker was quarantined and the anchor stayed finite
+    assert "200" in herd["quarantined"]
+    assert herd["quarantined"]["200"]["reason"] == "nonfinite"
+    assert 2 in herd["quarantined"]["200"]["rounds"]
+    assert herd["anchor_finite"]
+    # training learned through all of it
+    assert herd["final_eval_loss"] < herd["init_eval_loss"] - 0.2
+
+    # byte-identical same-seed reports (the debuggability contract)
+    assert json.dumps(rep, sort_keys=True) == \
+        json.dumps(run(), sort_keys=True)
+
+    # doctor, fed ONLY the events log, names the quarantined worker and
+    # the partial participation
+    verdict = doctor.diagnose([events], bench_history="/nonexistent"
+                              )["summary"]["verdict"]
+    assert "quarantin" in verdict and "200" in verdict, verdict
+    assert "participation" in verdict, verdict
+    # and the per-round records score stragglers (slow workers missed
+    # quorum repeatedly under speed skew)
+    d = doctor.diagnose([events], bench_history="/nonexistent")
+    assert any(s["flagged"] for s in d["stragglers"].values())
+    # ground truth for every kill is in the same log
+    recs = _load_events(events)
+    kills = [r for r in recs if r.get("event") == "fault_injected"
+             and r.get("op") == "kill"]
+    assert kills and len(kills[0]["nodes"]) >= 52
+
+
+def test_partial_participation_loss_parity():
+    """Quorum 0.8 under speed skew must land within tolerance of full
+    participation — partial participation degrades wall-clock waits,
+    not the model."""
+    part_spec, full_spec = parity_specs(256, 0.8)
+    rp = HerdSim(part_spec, seed=7).run(duration_s=14.0)
+    rf = HerdSim(full_spec, seed=7).run(duration_s=14.0)
+    hp, hf = rp["herd"], rf["herd"]
+    assert not [v for v in rp["violations"]], rp["violations"]
+    assert not [v for v in rf["violations"]], rf["violations"]
+    assert hp["rounds_completed"] == part_spec.rounds
+    assert hf["rounds_completed"] == full_spec.rounds
+    assert hp["mean_participation"] < hf["mean_participation"]
+    init = hp["init_eval_loss"]
+    assert hf["init_eval_loss"] == init  # same seed => same init
+    # both learn, and partial tracks full within 5% of the init scale
+    assert hp["final_eval_loss"] < init - 0.25
+    assert hf["final_eval_loss"] < init - 0.25
+    assert abs(hp["final_eval_loss"] - hf["final_eval_loss"]) \
+        < 0.05 * init, (hp["final_eval_loss"], hf["final_eval_loss"])
+
+
+def test_norm_outlier_quarantined_then_readmitted(tmp_path):
+    """A finite but wildly out-of-family delta (scaled 1000x) trips the
+    outlier arm of the gate; the worker's next clean round resolves the
+    alert (readmission)."""
+    events = str(tmp_path / "outlier.jsonl")
+    spec = HerdSpec(n_workers=24, rounds=3, inner_steps=2, batch_size=4,
+                    features=(16,), round_timeout_s=2.0,
+                    scale_worker=5, scale_round=1)
+    rep = HerdSim(spec, seed=1, events_log=events).run(duration_s=20.0)
+    assert rep["ok"], rep["violations"]
+    q = rep["herd"]["quarantined"]
+    assert q == {"5": {"rounds": [1], "reason": "norm_outlier"}}
+    assert rep["herd"]["anchor_finite"]
+    alerts = [r for r in _load_events(events)
+              if r.get("alert") == "diloco.delta_quarantined"]
+    states = [a["state"] for a in alerts]
+    assert "firing" in states and "resolved" in states, alerts
+
+
+def test_late_delta_policies_drop_vs_discount():
+    """Heavy speed skew + a tight quorum strands stragglers past the
+    close; 'drop' discards their deltas, 'discount' folds them in as
+    stale discounted updates — the two runs must actually diverge."""
+    base = HerdSpec(n_workers=16, rounds=3, inner_steps=2, batch_size=4,
+                    features=(16,), quorum_fraction=0.5,
+                    speed_skew=1.0, round_timeout_s=4.0)
+    import dataclasses
+
+    drop = HerdSim(base, seed=2).run(duration_s=25.0)
+    disc = HerdSim(dataclasses.replace(base, late_policy="discount"),
+                   seed=2).run(duration_s=25.0)
+    assert drop["herd"]["late_deltas"]["dropped"] > 0
+    assert drop["herd"]["late_deltas"]["discounted"] == 0
+    assert disc["herd"]["late_deltas"]["discounted"] > 0
+    # the discounted stale updates moved the anchor
+    assert drop["herd"]["final_eval_loss"] != disc["herd"]["final_eval_loss"]
+
+
+def test_restarted_worker_rejoins_and_contributes(tmp_path):
+    """Kill one worker mid-run, restart it two rounds later: it must
+    post deltas again (with reset inner optimizer state) and the herd
+    report must stay clean."""
+    events = str(tmp_path / "rejoin.jsonl")
+    spec = HerdSpec(n_workers=12, rounds=8, inner_steps=2, batch_size=4,
+                    features=(16,), round_timeout_s=2.0,
+                    base_step_s=0.2, quorum_fraction=0.8)
+    plan = FaultPlan.from_obj([
+        {"at": 2.5, "op": "kill", "node": "node-5"},
+        {"at": 4.5, "op": "restart", "node": "node-5"}])
+    rep = HerdSim(spec, seed=6, plan=plan,
+                  events_log=events).run(duration_s=40.0)
+    assert rep["ok"], rep["violations"]
+    rounds = [r for r in _load_events(events)
+              if r.get("event") == "diloco_round"]
+    posted_by_round = {r["round"]: r["posted"] for r in rounds}
+    gone = [r for r, posted in posted_by_round.items() if 5 not in posted]
+    back = [r for r, posted in posted_by_round.items() if 5 in posted]
+    assert gone, "worker 5 was never absent despite the kill"
+    assert back and max(back) > min(gone), \
+        "worker 5 never contributed after its restart"
+
+
+def test_spec_validation():
+    for bad in (dict(n_workers=1), dict(quorum_fraction=0.0),
+                dict(quorum_fraction=1.5), dict(late_policy="maybe"),
+                dict(rounds=0)):
+        with pytest.raises(ValueError):
+            HerdSpec(**bad).validate()
+
+
+def test_run_smoke_is_self_contained(tmp_path):
+    """The CI smoke: determinism + quarantine asserted inside, events
+    written for the CLI's doctor half."""
+    events = str(tmp_path / "smoke.jsonl")
+    rep = run_smoke(workers=24, seed=0, events_log=events)
+    assert rep["ok"], rep["violations"]
+    assert rep["deterministic"]
+    assert "21" in rep["herd"]["quarantined"]  # workers - 3
+    assert any(r.get("alert") == "diloco.delta_quarantined"
+               for r in _load_events(events))
+
+
+def test_herd_cli_run_and_smoke(tmp_path, capsys):
+    from serverless_learn_tpu.cli import main
+
+    rc = main(["chaos", "herd", "--workers", "16", "--rounds", "2",
+               "--inner-steps", "2", "--quorum", "0.75", "--seed", "1",
+               "--duration", "20", "--compact"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["ok"]
+    assert out["herd"]["rounds_completed"] == 2
+
+    rc = main(["chaos", "herd", "--workers", "16", "--quorum", "1.5"])
+    assert rc == 2
+    assert "bad herd spec" in capsys.readouterr().err
+
+    rc = main(["chaos", "herd", "--smoke", "--workers", "24",
+               "--seed", "0", "--compact"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["ok"], out.get("violations")
+    assert out["deterministic"]
+    assert "quarantin" in out["doctor_verdict"]
