@@ -441,6 +441,10 @@ DEFAULT_MODULES = (
     # heap); instrumenting it keeps that property honest if anyone adds
     # a worker thread later.
     "serverless_learn_tpu.training.herd",
+    # round 20: ErrorFeedback carries per-sender residual state that the
+    # delta path mutates every round; islands are single-threaded per
+    # instance, and instrumentation keeps that assumption honest.
+    "serverless_learn_tpu.training.wire_codec",
 )
 
 

@@ -1633,7 +1633,49 @@ def cmd_chaos(args) -> int:
         # mode that does) — imported here so run/soak/fleet stay
         # jax-free.
         from serverless_learn_tpu.training.herd import (HerdSim, HerdSpec,
-                                                        run_smoke)
+                                                        run_smoke,
+                                                        run_wire_ab)
+
+        if args.wire_ab:
+            # Round 20: quantized-vs-f32 loss parity under churn, with a
+            # no-error-feedback negative control (training/wire_codec.py
+            # through the vmapped herd). Exit 1 unless parity holds AND
+            # wire bytes shrink >= 3.5x.
+            dtype = args.wire_dtype or "int8"
+            if dtype in ("f32", "float32"):
+                print("--wire-ab compares a quantized leg against f32; "
+                      "pass --wire-dtype int8|fp8", file=sys.stderr)
+                return 2
+            try:
+                rep = run_wire_ab(workers=args.workers or 48,
+                                  seed=args.seed, wire_dtype=dtype)
+            except ValueError as e:
+                print(f"bad wire A/B: {e}", file=sys.stderr)
+                return 2
+            if args.record and args.history:
+                from serverless_learn_tpu.utils.benchlog import record
+
+                for leg, wire in (("f32", "float32"),
+                                  ("quant", rep["wire_dtype"])):
+                    wait = rep["mean_round_wait_s"][leg]
+                    if wait is None:
+                        continue
+                    record({
+                        "metric": "herd_diloco_round_wait_ms",
+                        "value": round(wait * 1e3, 2),
+                        "unit": "virtual ms/round",
+                        "device_kind": "herd-sim-cpu",
+                        "batch_per_chip": 4,
+                        "wire_dtype": wire,
+                        "workers": rep["workers"],
+                        "diloco_round_wait_s": wait,
+                        "dcn_bytes_per_round":
+                            rep["bytes_per_round"][leg],
+                    }, args.history, better="min",
+                        key_fields=("metric", "device_kind",
+                                    "batch_per_chip"))
+            print(json.dumps(rep, indent=None if args.compact else 2))
+            return 0 if rep["ok"] else 1
 
         if args.smoke:
             import tempfile
@@ -1684,7 +1726,8 @@ def cmd_chaos(args) -> int:
                     quorum_fraction=args.quorum,
                     late_policy=args.late_policy,
                     poison_worker=args.poison_worker,
-                    poison_round=args.poison_round)
+                    poison_round=args.poison_round,
+                    wire_dtype=args.wire_dtype or "float32")
                 sim = HerdSim(spec, seed=args.seed, plan=plan,
                               events_log=args.events_log)
             except ValueError as e:
@@ -2398,6 +2441,24 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument("--poison-round", type=int, default=-1,
                     help="herd: round at which --poison-worker emits "
                          "the NaN delta")
+    ch.add_argument("--wire-dtype", choices=["f32", "int8", "fp8"],
+                    default=None,
+                    help="herd: wire encoding of the simulated delta/"
+                         "anchor exchange (training/wire_codec.py; "
+                         "default f32 = uncompressed)")
+    ch.add_argument("--wire-ab", action="store_true",
+                    help="herd: seeded quantized-vs-f32 loss-parity A/B "
+                         "under churn (quorum 0.8, mid-round 20% kill) "
+                         "with a no-error-feedback negative control; "
+                         "exit 1 unless parity holds and wire bytes "
+                         "shrink >= 3.5x")
+    ch.add_argument("--record", action="store_true",
+                    help="herd --wire-ab: append round-wait/DCN-bytes "
+                         "rows (per leg) to --history for "
+                         "`slt bench --gate`")
+    ch.add_argument("--history", metavar="PATH", default=None,
+                    help="herd --wire-ab: bench history file for "
+                         "--record")
     ch.set_defaults(fn=cmd_chaos)
 
     tp = sub.add_parser("top", help="live cluster telemetry: poll /metrics "

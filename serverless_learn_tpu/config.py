@@ -232,6 +232,38 @@ class LocalSGDConfig:
     delta_gate: bool = True
     outlier_factor: float = 12.0
     gate_min_peers: int = 4
+    # ---- quantized DCN exchange (round 20, training/wire_codec.py) ----
+    # Wire encoding for outer-boundary delta pushes and anchor
+    # broadcasts: "float32"/"f32" (uncompressed, the historic bytes),
+    # "int8" (blockwise, ~4x fewer bytes) or "fp8" (e4m3, where the
+    # runtime supports it). Decoding is self-describing, so islands can
+    # migrate dtypes without a flag day; checkpoint/replica persistence
+    # is never wire-coded (its CRC machinery needs byte identity).
+    wire_dtype: str = "float32"
+    wire_block: int = 128          # values per quantization block
+    # Per-island error feedback: carry each round's quantization
+    # residual into the next round's delta before quantizing, so the
+    # leader's outer Nesterov step sees an unbiased long-run signal.
+    wire_error_feedback: bool = True
+
+
+@dataclass(frozen=True)
+class ElasticConfig:
+    """Elastic-trainer knobs (``training/elastic.py``; round 20).
+
+    ``remesh_wire_dtype`` selects the wire encoding of the remesh
+    drain→save→remesh→restore state stream: ``float32`` keeps the
+    historic bit-exact checkpoint save per epoch transition; ``int8`` /
+    ``fp8`` stream a blockwise-quantized transient blob instead (~4x
+    fewer DCN bytes per world change, value-preserving within codec
+    tolerance — the ``numerics_fingerprint reason=remesh_restore``
+    trail proves it per transition). Durable checkpoints (final save,
+    emergency save, ``checkpoint_every``) stay full-precision and
+    CRC-verified regardless.
+    """
+
+    remesh_wire_dtype: str = "float32"  # float32 | int8 | fp8
+    remesh_wire_block: int = 128
 
 
 @dataclass(frozen=True)
@@ -543,6 +575,7 @@ class ExperimentConfig:
     kv: KVCacheConfig = field(default_factory=KVCacheConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     numerics: NumericsConfig = field(default_factory=NumericsConfig)
+    elastic: ElasticConfig = field(default_factory=ElasticConfig)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
@@ -574,6 +607,7 @@ class ExperimentConfig:
             kv=build(KVCacheConfig, raw.get("kv")),
             checkpoint=build(CheckpointConfig, raw.get("checkpoint")),
             numerics=build(NumericsConfig, raw.get("numerics")),
+            elastic=build(ElasticConfig, raw.get("elastic")),
         )
 
     def override(self, **kwargs: Any) -> "ExperimentConfig":

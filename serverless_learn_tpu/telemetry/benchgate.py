@@ -56,6 +56,12 @@ ATTRIBUTION_COLUMNS = {
     "achieved_vs_roofline": ("max", 0.05),
     "opt_state_bytes_per_chip": ("min", 0.10, "rel"),
     "grad_reduce_scatter_s": ("min", 0.50, "rel"),
+    # Quantized DCN exchange (round 20): the outer-boundary wait and the
+    # bytes each round ships both regress UP — the wire codec quietly
+    # disengaging (ratio collapsing to ~1.0) shows in dcn_bytes_per_round
+    # first, long before a loss curve could.
+    "diloco_round_wait_s": ("min", 0.25, "rel"),
+    "dcn_bytes_per_round": ("min", 0.10, "rel"),
 }
 
 

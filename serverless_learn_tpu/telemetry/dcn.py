@@ -81,9 +81,27 @@ class _Meter:
             "slt_dcn_effective_bandwidth_bytes_per_s",
             "cumulative bytes / cumulative transfer seconds, by consumer",
             consumer=consumer)
+        # Round 20 (quantized exchange): logical bytes are what the
+        # transfer would have moved at full precision; wire bytes are
+        # what actually moved. Their cumulative quotient is the
+        # compression-ratio gauge `slt doctor` reads to catch "quantized
+        # exchange enabled but ratio ~1.0" misconfigurations.
+        self.logical_tx = reg.counter(
+            "slt_dcn_logical_bytes_total",
+            "full-precision bytes the transfers represent, by consumer "
+            "and direction", consumer=consumer, direction="tx")
+        self.logical_rx = reg.counter(
+            "slt_dcn_logical_bytes_total",
+            "full-precision bytes the transfers represent, by consumer "
+            "and direction", consumer=consumer, direction="rx")
+        self.ratio = reg.gauge(
+            "slt_dcn_compression_ratio",
+            "cumulative logical / wire bytes, by consumer (~1.0 means "
+            "the wire codec is off or not engaging)", consumer=consumer)
         self._lock = threading.Lock()
         self._bytes = 0.0
         self._seconds = 0.0
+        self._logical = 0.0
 
     def record(self, direction: str, nbytes: int, seconds: float):
         nbytes = max(0, int(nbytes))
@@ -96,8 +114,21 @@ class _Meter:
             self._bytes += nbytes
             self._seconds += seconds
             bw = self._bytes / self._seconds if self._seconds > 0 else None
+            ratio = self._logical / self._bytes if self._bytes > 0 else None
         if bw is not None:
             self.bw.set(bw)
+        if ratio is not None and self._logical > 0:
+            self.ratio.set(ratio)
+
+    def record_logical(self, direction: str, nbytes: int):
+        nbytes = max(0, int(nbytes))
+        (self.logical_tx if direction == "tx"
+         else self.logical_rx).inc(nbytes)
+        with self._lock:
+            self._logical += nbytes
+            ratio = self._logical / self._bytes if self._bytes > 0 else None
+        if ratio is not None:
+            self.ratio.set(ratio)
 
 
 def meter(consumer: str, registry=None) -> _Meter:
@@ -123,6 +154,17 @@ def record_transfer(consumer: str, direction: str, nbytes: int,
     meter(consumer, registry).record(direction, nbytes, seconds)
 
 
+def record_logical(consumer: str, direction: str, nbytes: int,
+                   registry=None):
+    """Record the FULL-PRECISION byte size a transfer represents (round
+    20). Wire-codec call sites pair this with the actual wire bytes the
+    :class:`InstrumentedStore` already counts; the cumulative quotient
+    feeds the per-consumer ``slt_dcn_compression_ratio`` gauge."""
+    if direction not in ("tx", "rx"):
+        raise ValueError(f"direction must be tx or rx, got {direction!r}")
+    meter(consumer, registry).record_logical(direction, nbytes)
+
+
 def snapshot(registry=None) -> List[dict]:
     """Per-consumer rollup rows from the registry (used by tests and the
     `slt top --once` acceptance): ``{"consumer", "tx_bytes", "rx_bytes",
@@ -134,6 +176,7 @@ def snapshot(registry=None) -> List[dict]:
     def row(consumer: str) -> dict:
         return rows.setdefault(consumer, {
             "consumer": consumer, "tx_bytes": 0.0, "rx_bytes": 0.0,
+            "logical_bytes": 0.0, "compression_ratio": None,
             "transfers": 0.0, "seconds": 0.0,
             "bandwidth_bytes_per_s": None})
 
@@ -153,6 +196,14 @@ def snapshot(registry=None) -> List[dict]:
                    ).get("series", []):
         row(series["labels"].get("consumer", "?"))[
             "bandwidth_bytes_per_s"] = series["value"]
+    for series in (snap.get("slt_dcn_logical_bytes_total") or {}
+                   ).get("series", []):
+        row(series["labels"].get("consumer", "?"))["logical_bytes"] += \
+            series["value"]
+    for series in (snap.get("slt_dcn_compression_ratio") or {}
+                   ).get("series", []):
+        row(series["labels"].get("consumer", "?"))[
+            "compression_ratio"] = series["value"]
     return sorted(rows.values(), key=lambda r: r["consumer"])
 
 

@@ -460,6 +460,45 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
         verdict_bits.append(
             f"partial DiLoCo participation over {len(parts)} round(s): "
             f"mean {sum(parts) / len(parts):.0%}, min {min(parts):.0%}")
+    # Quantized DCN exchange (round 20): every wire-codec transfer leaves
+    # a dcn_wire record pairing logical (full-precision) bytes with the
+    # bytes that actually moved. A consumer configured for int8/fp8 whose
+    # cumulative ratio sits at ~1.0 is MISCONFIGURED — the codec is not
+    # engaging (non-finite fallbacks every round, or an f32 peer
+    # publishing the anchors) — and the verdict names it from the
+    # telemetry alone.
+    wire_by_consumer: dict = {}
+    for r in records:
+        if r.get("event") != "dcn_wire":
+            continue
+        agg = wire_by_consumer.setdefault(
+            str(r.get("consumer", "?")),
+            {"logical": 0.0, "wire": 0.0, "n": 0, "dtypes": set(),
+             "fallbacks": 0})
+        agg["logical"] += float(r.get("logical_bytes") or 0)
+        agg["wire"] += float(r.get("wire_bytes") or 0)
+        agg["n"] += 1
+        agg["dtypes"].add(str(r.get("wire_dtype", "float32")))
+        if r.get("fallback"):
+            agg["fallbacks"] += 1
+    for consumer, agg in sorted(wire_by_consumer.items()):
+        quant = agg["dtypes"] - {"float32", "f32"}
+        if not quant or agg["wire"] <= 0:
+            continue
+        ratio = agg["logical"] / agg["wire"]
+        if ratio < 1.5:
+            bit = (f"quantized exchange misconfigured for {consumer}: "
+                   f"wire dtype {'/'.join(sorted(quant))} configured but "
+                   f"compression ratio ~{ratio:.2f}x over {agg['n']} "
+                   f"transfer(s) — the codec is not engaging")
+            if agg["fallbacks"]:
+                bit += (f" ({agg['fallbacks']} non-finite fallback(s) "
+                        f"shipped uncompressed)")
+            verdict_bits.append(bit)
+        else:
+            verdict_bits.append(
+                f"quantized DCN exchange ({consumer}): {ratio:.1f}x "
+                f"fewer bytes over {agg['n']} transfer(s)")
     # Step-interior hardware attribution (round 16): xray summaries —
     # from capture-meta.json records in the event trail and from capture
     # dirs handed to --xray — put a NAME on the training plateau ("step

@@ -196,6 +196,11 @@ class EndpointState:
             return None
         return self.data["hists"].get(name)
 
+    def hist_prev(self, name: str) -> Optional[dict]:
+        if self.prev is None:
+            return None
+        return self.prev["hists"].get(name)
+
     def labeled(self, name: str) -> List[Tuple[Dict[str, str], float]]:
         if self.data is None:
             return []
@@ -290,6 +295,10 @@ def render(states: List[EndpointState]) -> str:
         if st.val("slt_numerics_last_step") is not None \
                 or st.val("slt_numerics_replica_divergence") is not None:
             roles += 1  # NUMERICS pane rendered below
+        if st.val("slt_dcn_compression_ratio") is not None or \
+                (st.hist("slt_diloco_round_wait_seconds")
+                 or {}).get("count"):
+            roles += 1  # DILOCO/DCN pane rendered below
         if roles == 0:
             other_rows.append(f"  {st.addr:<22} up (no slt_ metrics yet)")
     if infer_rows:
@@ -379,6 +388,46 @@ def render(states: List[EndpointState]) -> str:
         lines += _table(["endpoint", "step", "grad norm", "upd/param",
                         "replica div", "nonfinite", "fetches"],
                         numerics_rows)
+    # DILOCO/DCN pane (round 20): the quantized-exchange view — outer
+    # rounds, participation, round-wait percentiles with a poll-to-poll
+    # trend, and the per-consumer compression ratio (logical/wire bytes;
+    # ~1.00x with a quantized dtype configured is the misconfiguration
+    # `slt doctor` names).
+    diloco_rows: List[List[str]] = []
+    for st in states:
+        ratios = sorted(st.labeled("slt_dcn_compression_ratio"),
+                        key=lambda lv: lv[0].get("consumer", ""))
+        rw = st.hist("slt_diloco_round_wait_seconds")
+        if not ratios and not (rw and rw["count"]):
+            continue
+        ratio_col = " ".join(
+            f"{lab.get('consumer', '?')}={v:.2f}x"
+            for lab, v in ratios) or "-"
+        p95 = _p(rw, 0.95)
+        prev95 = _p(st.hist_prev("slt_diloco_round_wait_seconds"), 0.95)
+        if p95 is None or prev95 is None:
+            trend = "-"
+        elif p95 > prev95 * 1.05:
+            trend = "up"
+        elif p95 < prev95 * 0.95:
+            trend = "down"
+        else:
+            trend = "flat"
+        diloco_rows.append([
+            st.addr,
+            _num(st.val("slt_diloco_rounds_total"), 0),
+            _num(st.val("slt_diloco_participation"), 2),
+            _ms(_p(rw, 0.5)) + "/" + _ms(p95),
+            trend,
+            _num(st.val("slt_diloco_quarantined_total") or 0, 0),
+            ratio_col,
+        ])
+    if diloco_rows:
+        lines.append("")
+        lines.append("  DILOCO/DCN")
+        lines += _table(["endpoint", "rounds", "part",
+                         "rwait p50/p95 ms", "trend", "quar",
+                         "compression"], diloco_rows)
     # HW pane (round 16): the step-interior view — HBM watermarks,
     # exposed-collective share and the xray verdict from the newest
     # capture (/goodput's xray section), plus per-consumer effective DCN

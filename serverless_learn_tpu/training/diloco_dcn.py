@@ -68,6 +68,7 @@ from serverless_learn_tpu.config import ExperimentConfig
 from serverless_learn_tpu.control.client import WorkerAgent
 from serverless_learn_tpu.telemetry import get_registry
 from serverless_learn_tpu.telemetry import tracing as ttrace
+from serverless_learn_tpu.training import wire_codec
 from serverless_learn_tpu.training.train_step import build_trainer
 
 
@@ -82,8 +83,11 @@ def _pack(tree) -> bytes:
 
 
 def _unpack(blob: bytes, template):
-    return serialization.from_state_dict(
-        template, serialization.msgpack_restore(blob))
+    # Round 20: the blob may be a blockwise-quantized wire payload
+    # (local_sgd.wire_dtype int8/fp8) or the historic bare state dict —
+    # decode() sniffs the self-describing header, so mixed-dtype fleets
+    # and rejoins across a dtype migration interoperate.
+    return wire_codec.decode(blob, template=template)
 
 
 def _host_norm(tree) -> float:
@@ -134,6 +138,9 @@ class DilocoIsland:
     delta_gate = True
     outlier_factor = 12.0
     gate_min_peers = 4
+    wire_dtype = "float32"
+    wire_block = 128
+    wire_error_feedback = True
 
     def __init__(self, config: ExperimentConfig, store, coordinator_addr:
                  str, run_name: str, mesh=None,
@@ -151,7 +158,10 @@ class DilocoIsland:
                  staleness_discount: Optional[float] = None,
                  delta_gate: Optional[bool] = None,
                  outlier_factor: Optional[float] = None,
-                 gate_min_peers: Optional[int] = None):
+                 gate_min_peers: Optional[int] = None,
+                 wire_dtype: Optional[str] = None,
+                 wire_block: Optional[int] = None,
+                 wire_error_feedback: Optional[bool] = None):
         lcfg = config.local_sgd
         self.config = config
         # Round 15: anchors/deltas ride the same replication tier as
@@ -214,6 +224,21 @@ class DilocoIsland:
         self.outlier_factor = float(
             _pick(outlier_factor, "outlier_factor", 12.0))
         self.gate_min_peers = int(_pick(gate_min_peers, "gate_min_peers", 4))
+        # Round 20 quantized exchange: wire dtype is validated at
+        # construction (an unsupported fp8 runtime fails HERE, not three
+        # rounds in), and the two error-feedback carries — one for this
+        # island's delta stream, one for its led anchor publishes — are
+        # per-island state. Leadership migration loses the anchor carry
+        # (best-effort, like the late-delta memory); the delta carry is
+        # strictly local and survives every round.
+        self.wire_dtype = wire_codec.require_supported(
+            _pick(wire_dtype, "wire_dtype", "float32"))
+        self.wire_block = int(_pick(wire_block, "wire_block", 128))
+        if self.wire_block < 1:
+            raise ValueError(f"wire_block must be >= 1, "
+                             f"got {self.wire_block}")
+        self.wire_error_feedback = bool(
+            _pick(wire_error_feedback, "wire_error_feedback", True))
         # Leader-side memory for the late-delta path: what each led round
         # had posted at close time (so NEW keys later are "late"), and
         # which workers currently have a firing quarantine alert (so a
@@ -255,6 +280,12 @@ class DilocoIsland:
         self._m_late = reg.counter(
             "slt_diloco_late_deltas_total",
             "straggler deltas that arrived after their round closed")
+        # Round 20: anchor publishes that reused an already-serialized
+        # blob (one serialize, N sends — republished anchors and
+        # double-publishes skip the msgpack/quantize pass entirely).
+        self._m_pack_saved = reg.counter(
+            "slt_diloco_anchor_pack_saved_total",
+            "anchor publishes served from the packed-blob cache")
         if self.inner_steps < 1:
             raise ValueError(f"inner_steps must be >= 1, "
                              f"got {self.inner_steps}")
@@ -291,17 +322,101 @@ class DilocoIsland:
         return sorted(p.worker_id for p in peers
                       if p.name == f"diloco:{self.run}")
 
+    # -- wire codec (round 20) ---------------------------------------------
+
+    def _wire_quantized(self) -> bool:
+        return getattr(self, "wire_dtype", "float32") != "float32"
+
+    def _wire_ef(self, attr: str) -> "wire_codec.ErrorFeedback":
+        ef = getattr(self, attr, None)
+        if ef is None:
+            ef = wire_codec.ErrorFeedback(
+                self.wire_dtype, getattr(self, "wire_block", 128),
+                enabled=getattr(self, "wire_error_feedback", True))
+            setattr(self, attr, ef)
+        return ef
+
+    def _note_wire(self, direction: str, tree, wire_bytes: int,
+                   rnd: Optional[int] = None, kind: str = "",
+                   fallback: str = ""):
+        """Pair the store's wire-byte count with the logical
+        (full-precision) bytes this transfer represents, and leave a
+        ``dcn_wire`` event in the trail so `slt doctor` can judge the
+        codec from telemetry alone."""
+        from serverless_learn_tpu.telemetry import dcn
+
+        logical = wire_codec.logical_nbytes(tree)
+        try:
+            dcn.record_logical("diloco", direction, logical)
+        except Exception:
+            pass  # accounting must never hurt the exchange it measures
+        rec = {"event": "dcn_wire", "consumer": "diloco",
+               "direction": direction, "kind": kind,
+               "wire_dtype": getattr(self, "wire_dtype", "float32"),
+               "logical_bytes": int(logical),
+               "wire_bytes": int(wire_bytes),
+               "run": getattr(self, "run", "?"),
+               "t_unix_s": round(time.time(), 3)}
+        if rnd is not None:
+            rec["round"] = rnd
+        if fallback:
+            rec["fallback"] = fallback
+        ttrace.emit_event(rec)
+
+    def _encode_delta(self, rnd: int, delta) -> bytes:
+        """This island's outgoing delta: quantized with per-island error
+        feedback under int8/fp8; a non-finite delta is shipped
+        UNCOMPRESSED (typed codec refusal) so the leader's quarantine
+        gate sees the NaN instead of a scale-poisoned block."""
+        fallback = ""
+        if self._wire_quantized():
+            try:
+                blob = self._wire_ef("_delta_ef").encode(delta)
+            except wire_codec.NonFiniteError:
+                blob = _pack(delta)
+                fallback = "nonfinite"
+        else:
+            blob = _pack(delta)
+        self._note_wire("tx", delta, len(blob), rnd, kind="delta",
+                        fallback=fallback)
+        return blob
+
     # -- protocol ----------------------------------------------------------
 
     def _publish(self, rnd: int, anchor, trace, step: int):
-        self.store.put(self._k(f"round-{rnd}", "anchor"),
-                       _pack({"params": anchor, "trace": trace}))
+        payload = {"params": anchor, "trace": trace}
+        key = tuple(map(id, jax.tree_util.tree_leaves(payload)))
+        cached = getattr(self, "_pack_cache", None)
+        if cached is not None and cached[0] == key:
+            # Republishing an unchanged anchor (all-quarantined round,
+            # double-publish after a challenge): one serialize, N sends.
+            blob = cached[1]
+            m = getattr(self, "_m_pack_saved", None)
+            if m is not None:
+                m.inc()
+        elif self._wire_quantized():
+            try:
+                blob = self._wire_ef("_anchor_ef").encode(payload)
+            except wire_codec.NonFiniteError:
+                blob = _pack(payload)  # gate keeps anchors finite; belt
+        else:
+            blob = _pack(payload)
+        self._pack_cache = (key, blob)
+        self._note_wire("tx", payload, len(blob), rnd, kind="anchor")
+        self.store.put(self._k(f"round-{rnd}", "anchor"), blob)
         self.store.put(self._k("LATEST"),
                        json.dumps({"round": rnd, "step": step}).encode())
 
     def _fetch_anchor(self, rnd: int, template):
         blob = self.store.get(self._k(f"round-{rnd}", "anchor"))
-        return _unpack(blob, {"params": template, "trace": template})
+        pub = _unpack(blob, {"params": template, "trace": template})
+        self._note_wire("rx", pub, len(blob), rnd, kind="anchor")
+        # Seed the packed-blob cache with THIS anchor's bytes: if this
+        # island leads an all-quarantined round next, it republishes the
+        # identical tree and reuses these bytes instead of re-packing.
+        self._pack_cache = (
+            tuple(map(id, jax.tree_util.tree_leaves(pub))), blob)
+        return pub
 
     def _deltas_for(self, rnd: int) -> List[int]:
         # Directory-style prefix: LocalStore.list walks a directory;
@@ -387,7 +502,7 @@ class DilocoIsland:
                 self.store.put(
                     self._k(f"round-{rnd}",
                             f"delta-{self.agent.worker_id}"),
-                    _pack(delta))
+                    self._encode_delta(rnd, delta))
                 rspan.mark("delta_posted")
                 self._await_next_anchor(rnd, anchor, pub["trace"], params_t)
                 if self._aborted():  # crashed while waiting: no next anchor
@@ -635,9 +750,15 @@ class DilocoIsland:
             mw = getattr(self, "_m_round_wait", None)
             if mw is not None:
                 mw.observe(waited_s)
-        deltas = [_unpack(self.store.get(
-            self._k(f"round-{rnd}", f"delta-{i}")), template)
-            for i in posted]
+        # The gate below operates on the DEQUANTIZED deltas — a bad
+        # quantization block surfaces as NaN/outlier here and trips the
+        # same quarantine alert a sick worker would (round 20).
+        deltas = []
+        for i in posted:
+            blob = self.store.get(self._k(f"round-{rnd}", f"delta-{i}"))
+            d = _unpack(blob, template)
+            self._note_wire("rx", d, len(blob), rnd, kind="delta")
+            deltas.append(d)
         # Stragglers from the previous led round first (round 19): their
         # late deltas are dropped or staleness-discounted per policy.
         anchor = self._apply_late_deltas(rnd, anchor, template)

@@ -98,6 +98,14 @@ class HerdSpec:
     scale_worker: int = -1
     scale_round: int = -1
     scale_factor: float = 1000.0
+    # Wire codec (round 20, training/wire_codec.py): per-worker deltas
+    # and the anchor broadcast ride a simulated blockwise-quantized wire
+    # — the quantizer runs UNDER the client vmap, and error_feedback
+    # carries each worker's residual into its next round's delta, the
+    # property the int8-vs-f32 A/B (run_wire_ab) exists to prove.
+    wire_dtype: str = "float32"  # float32 | int8 | fp8
+    wire_block: int = 128
+    error_feedback: bool = True
     bootstrap_s: float = 2.0     # gossip settle time before round 0
     # Start from an ESTABLISHED membership (every node knows every
     # node, the state of a fleet that has been up for a while) instead
@@ -117,6 +125,11 @@ class HerdSpec:
             raise ValueError("late_policy must be 'drop' or 'discount'")
         if self.rounds < 1 or self.inner_steps < 1:
             raise ValueError("rounds and inner_steps must be >= 1")
+        from serverless_learn_tpu.training import wire_codec
+
+        wire_codec.normalize_dtype(self.wire_dtype)  # ValueError if bad
+        if self.wire_block < 1:
+            raise ValueError("wire_block must be >= 1")
 
 
 # -- compiled kernels ---------------------------------------------------------
@@ -132,7 +145,8 @@ def _kernel_key(spec: HerdSpec) -> tuple:
     return (spec.n_workers, spec.inner_steps, spec.batch_size,
             tuple(spec.features), spec.num_classes, spec.input_dim,
             spec.inner_lr, spec.inner_momentum,
-            spec.outer_lr, spec.outer_momentum)
+            spec.outer_lr, spec.outer_momentum,
+            spec.wire_dtype, spec.wire_block, spec.error_feedback)
 
 
 def _kernels(spec: HerdSpec) -> dict:
@@ -148,9 +162,16 @@ def _kernels(spec: HerdSpec) -> dict:
     from serverless_learn_tpu.models.registry import get_model
     from serverless_learn_tpu.telemetry.numerics import (global_norm,
                                                          tree_stats)
+    from serverless_learn_tpu.training import wire_codec
 
     n, steps, batch = spec.n_workers, spec.inner_steps, spec.batch_size
     dim, classes = spec.input_dim, spec.num_classes
+    wire = wire_codec.require_supported(spec.wire_dtype)
+    quantized = wire != "float32"
+    ef = spec.error_feedback
+
+    def fq(tree):
+        return wire_codec.tree_fake_quantize(tree, wire, spec.wire_block)
     bundle = get_model("mlp_mnist", features=tuple(spec.features),
                        num_classes=classes, image_shape=(dim, 1, 1))
     tx = optax.sgd(spec.inner_lr, momentum=spec.inner_momentum)
@@ -176,10 +197,14 @@ def _kernels(spec: HerdSpec) -> dict:
 
     @jax.jit
     def inner(anchor, opt_states, shifts, proj, base_key, delta_scale,
-              alive, reset, round_idx):
+              alive, reset, round_idx, residual):
         """One round's inner phase for ALL workers: vmap over clients of
-        a lax.scan over inner steps. Returns the stacked deltas plus the
-        per-worker gate stats (through telemetry/numerics.tree_stats)."""
+        a lax.scan over inner steps. Returns the stacked WIRE deltas —
+        what the leader would dequantize, with the quantizer itself run
+        under the client vmap — plus the per-worker gate stats (computed
+        on the dequantized values, so a bad quantization block trips the
+        same quarantine a sick worker would) and the updated per-worker
+        error-feedback residual."""
 
         def per_worker(wid, opt, shift, rst):
             opt = tmap(lambda o: jnp.where(rst, jnp.zeros_like(o), o), opt)
@@ -207,18 +232,35 @@ def _kernels(spec: HerdSpec) -> dict:
 
         deltas, new_opts, mean_loss = jax.vmap(per_worker)(
             jnp.arange(n), opt_states, shifts, reset)
-        # Chaos injection AFTER the real compute, BEFORE the gate stats:
-        # a NaN (or huge) scale poisons the delta exactly as a sick
-        # worker would, and the gate must catch it downstream.
+        # Chaos injection AFTER the real compute, BEFORE the wire: a NaN
+        # (or huge) scale poisons the delta exactly as a sick worker
+        # would, and the gate must catch it downstream.
         deltas = tmap(lambda l: l * _bcast(delta_scale, l), deltas)
         # Dead workers neither trained nor keep this round's opt state.
         new_opts = tmap(lambda nw, old: jnp.where(_bcast(alive, nw),
                                                   nw, old),
                         new_opts, opt_states)
-        stats = jax.vmap(lambda d: tree_stats(d, depth=1))(deltas)
+        if quantized:
+            # A restarted worker lost its residual carry with the rest
+            # of its inner state.
+            residual = tmap(lambda r: jnp.where(_bcast(reset, r), 0.0, r),
+                            residual)
+            send = (tmap(jnp.add, deltas, residual) if ef else deltas)
+            wired = jax.vmap(fq)(send)
+        else:
+            send, wired = deltas, deltas
+        stats = jax.vmap(lambda d: tree_stats(d, depth=1))(wired)
         nonfinite = sum(st["nonfinite"] for st in stats.values())
-        l2 = jax.vmap(global_norm)(deltas)
-        return deltas, new_opts, mean_loss, l2, nonfinite
+        l2 = jax.vmap(global_norm)(wired)
+        if quantized and ef:
+            # Absorb this round's quantization error — but never a NaN
+            # (a poisoned delta must not poison every later round), and
+            # never for a dead worker (it sent nothing).
+            ok = alive & (nonfinite == 0)
+            residual = tmap(lambda s, w, r: jnp.where(_bcast(ok, s),
+                                                      s - w, r),
+                            send, wired, residual)
+        return wired, new_opts, mean_loss, l2, nonfinite, residual
 
     @jax.jit
     def outer(anchor, trace, deltas, weights):
@@ -248,6 +290,19 @@ def _kernels(spec: HerdSpec) -> dict:
                     anchor, d)
 
     @jax.jit
+    def wire_anchor(anchor, resid):
+        """The leader's anchor broadcast through the same wire: publish
+        the quantized anchor (every worker — the leader included — adopts
+        the DEQUANTIZED tree, so all islands hold bit-identical anchors),
+        with a leader-side error-feedback carry."""
+        if not quantized:
+            return anchor, resid
+        send = tmap(jnp.add, anchor, resid) if ef else anchor
+        wired = fq(send)
+        new_resid = tmap(jnp.subtract, send, wired) if ef else resid
+        return wired, new_resid
+
+    @jax.jit
     def eval_loss(anchor, shifts, proj, base_key):
         """Anchor loss on a fixed mixture batch drawn from EVERY shard —
         the global objective under non-IID data."""
@@ -260,7 +315,8 @@ def _kernels(spec: HerdSpec) -> dict:
         return loss
 
     kit = {"init": init, "inner": inner, "outer": outer,
-           "late_apply": late_apply, "eval_loss": eval_loss}
+           "late_apply": late_apply, "eval_loss": eval_loss,
+           "wire_anchor": wire_anchor}
     _KERNEL_CACHE[key] = kit
     return kit
 
@@ -322,6 +378,30 @@ class HerdSim(ChaosSim):
         self.k = _kernels(spec)
         (self.anchor, self.trace, self.opt_states, self._proj,
          self._shifts, self._base_key) = self.k["init"](seed)
+        # Wire codec state + byte ledger (round 20): per-worker error-
+        # feedback residuals ride the same stacked layout as the opt
+        # states; the byte ledger prices each round the way the real
+        # protocol pays it — one delta PUT per delivery, one anchor PUT
+        # plus one GET per live worker.
+        import jax
+        import jax.numpy as jnp
+
+        from serverless_learn_tpu.training import wire_codec
+
+        self._wire = wire_codec.normalize_dtype(spec.wire_dtype)
+        self.residual = jax.tree_util.tree_map(
+            lambda p: jnp.zeros((self.n,) + p.shape, jnp.float32),
+            self.anchor)
+        self.anchor_resid = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, jnp.float32), self.anchor)
+        self._delta_logical = wire_codec.logical_nbytes(self.anchor)
+        self._delta_wire = wire_codec.wire_nbytes(
+            self.anchor, self._wire, spec.wire_block)
+        # the anchor publish carries params + outer momentum trace
+        self._anchor_logical = 2 * self._delta_logical
+        self._anchor_wire = 2 * self._delta_wire
+        self.wire_logical_bytes = 0
+        self.wire_bytes = 0
         # Per-worker virtual step time: seeded lognormal speed skew.
         rng = np.random.default_rng([seed, 0x4E4D])
         self.step_times = spec.base_step_s * np.exp(
@@ -334,6 +414,7 @@ class HerdSim(ChaosSim):
         self._quarantine_log: Dict[int, dict] = {}
         self.participation: List[float] = []
         self.round_losses: List[float] = []
+        self.round_waits: List[float] = []
         self.late_dropped = 0
         self.late_discounted = 0
         self.skipped_rounds = 0
@@ -400,9 +481,10 @@ class HerdSim(ChaosSim):
             scale[spec.scale_worker] = spec.scale_factor
         if spec.poison_worker >= 0 and r == spec.poison_round:
             scale[spec.poison_worker] = np.nan
-        deltas, self.opt_states, losses, l2, nonfinite = self.k["inner"](
+        (deltas, self.opt_states, losses, l2, nonfinite,
+         self.residual) = self.k["inner"](
             self.anchor, self.opt_states, self._shifts, self._proj,
-            self._base_key, scale, alive, reset, r)
+            self._base_key, scale, alive, reset, r, self.residual)
         import jax
 
         losses, l2, nonfinite = (np.asarray(jax.device_get(losses)),
@@ -534,14 +616,37 @@ class HerdSim(ChaosSim):
             self.anchor, self.trace, drift = self.k["outer"](
                 self.anchor, self.trace, cur.deltas, jnp.asarray(w))
             drift = float(jax.device_get(drift))
+            # The broadcast rides the same wire as the deltas: every
+            # worker (the next leader included) adopts the DEQUANTIZED
+            # anchor, with a leader-side error-feedback carry. A skipped
+            # round republishes the previous round's bytes unchanged —
+            # no re-quantization (matching diloco_dcn's packed-blob
+            # reuse).
+            self.anchor, self.anchor_resid = self.k["wire_anchor"](
+                self.anchor, self.anchor_resid)
             self.committed_step += spec.inner_steps
             self.completed_rounds += 1
         else:
             drift = 0.0
             self.paused_rounds += 1
             self.skipped_rounds += 1
+        # Byte ledger: one delta PUT per delivery, one anchor PUT plus
+        # one anchor GET per live worker — the real protocol's shape.
+        r_logical = (len(cur.delivered) * self._delta_logical
+                     + (1 + len(cur.view)) * self._anchor_logical)
+        r_wire = (len(cur.delivered) * self._delta_wire
+                  + (1 + len(cur.view)) * self._anchor_wire)
+        self.wire_logical_bytes += r_logical
+        self.wire_bytes += r_wire
+        self._emit({"event": "dcn_wire", "consumer": "diloco",
+                    "direction": "tx", "kind": "herd_round",
+                    "wire_dtype": self._wire,
+                    "logical_bytes": int(r_logical),
+                    "wire_bytes": int(r_wire), "round": cur.idx,
+                    "t_unix_s": round(SIM_EPOCH + self.now, 3)})
         part = round(len(finite) / max(len(cur.view), 1), 4)
         self.participation.append(part)
+        self.round_waits.append(round(self.now - cur.t0, 4))
         loss = float(np.mean([cur.losses[i] for i in sorted(cur.delivered)]
                              )) if cur.delivered else float("nan")
         self.round_losses.append(round(loss, 6))
@@ -655,9 +760,23 @@ class HerdSim(ChaosSim):
             "late_deltas": {"dropped": self.late_dropped,
                             "discounted": self.late_discounted},
             "round_losses": list(self.round_losses),
+            "round_waits_s": list(self.round_waits),
             "init_eval_loss": round(self._init_eval, 6),
             "final_eval_loss": round(final_eval, 6),
             "anchor_finite": anchor_bad == 0,
+            "wire": {
+                "dtype": self._wire,
+                "block": spec.wire_block,
+                "error_feedback": bool(spec.error_feedback),
+                "logical_bytes": int(self.wire_logical_bytes),
+                "wire_bytes": int(self.wire_bytes),
+                "compression_ratio": (
+                    round(self.wire_logical_bytes / self.wire_bytes, 4)
+                    if self.wire_bytes else None),
+                "bytes_per_round": (
+                    int(self.wire_bytes / max(len(self.participation), 1))
+                    if self.participation else 0),
+            },
         }
         return rep
 
@@ -714,3 +833,109 @@ def parity_specs(workers: int = 256, quorum: float = 0.8
                     round_timeout_s=1.0)
     return replace(base, quorum_fraction=quorum), \
         replace(base, quorum_fraction=1.0)
+
+
+def wire_parity_specs(workers: int = 256, quorum: float = 0.8,
+                      wire_dtype: str = "int8"
+                      ) -> Tuple[HerdSpec, HerdSpec]:
+    """The quantized-vs-f32 A/B pair (round 20): same seed ⇒ same init,
+    shards, speed skew and fault schedule; ONLY the wire encoding
+    differs, so a final-loss gap is attributable to the codec alone."""
+    base = HerdSpec(n_workers=workers, rounds=5, inner_steps=2,
+                    batch_size=4, features=(16,), speed_skew=0.5,
+                    round_timeout_s=1.0, quorum_fraction=quorum)
+    return replace(base, wire_dtype=wire_dtype), base
+
+
+def run_wire_ab(workers: int = 48, seed: int = 0,
+                wire_dtype: str = "int8", kill_frac: float = 0.2,
+                events_log: Optional[str] = None) -> dict:
+    """Int8(/fp8)-vs-f32 loss-parity proof under churn (quorum 0.8, a
+    mid-round kill of ``kill_frac`` of the herd), with a no-error-
+    feedback negative control. Checks, on one seed:
+
+    * every leg's harness invariants hold;
+    * the quantized-with-feedback leg's final eval loss lands within 5%
+      of the f32 leg's, on the init-loss scale (the EQuARX claim);
+    * wire bytes shrink >= 3.5x;
+    * the negative control: either dropping error feedback measurably
+      WORSENS parity (the feedback term matters — the typical small-herd
+      outcome, e.g. the 24-worker CI smoke), or both gaps sit below a
+      0.05%-of-init noise floor (documented equivalence: with hundreds of
+      workers, per-round quantization noise already cancels in the
+      cross-worker average, so the single-stream bias EF removes is
+      invisible in one seed's final loss — the codec-level proof is
+      tests/test_wire_codec.py::test_error_feedback_unbiases_the_stream).
+      A feedback leg that is both worse than the control AND above the
+      noise floor fails: the carry would be hurting, not helping.
+    """
+    quant_spec, f32_spec = wire_parity_specs(workers, 0.8, wire_dtype)
+    noef_spec = replace(quant_spec, error_feedback=False)
+    plan = smoke_plan(f32_spec, kill_frac)
+
+    def leg(spec, log=None):
+        rep = HerdSim(spec, seed=seed, plan=plan, events_log=log).run()
+        rep.pop("wall_time_s", None)
+        return rep
+
+    rf = leg(f32_spec)
+    rq = leg(quant_spec, events_log)
+    rn = leg(noef_spec)
+    init = rf["herd"]["init_eval_loss"]
+    ef_gap = abs(rq["herd"]["final_eval_loss"]
+                 - rf["herd"]["final_eval_loss"])
+    noef_gap = abs(rn["herd"]["final_eval_loss"]
+                   - rf["herd"]["final_eval_loss"])
+    ratio = (rf["herd"]["wire"]["wire_bytes"]
+             / max(rq["herd"]["wire"]["wire_bytes"], 1))
+    violations = []
+    for name, rep in (("f32", rf), ("quant", rq), ("quant-noef", rn)):
+        if not rep["ok"]:
+            violations.append(f"{name} leg: {rep['violations']}")
+    if not ef_gap < 0.05 * init:
+        violations.append(
+            f"quantized leg diverged: |{rq['herd']['final_eval_loss']} "
+            f"- {rf['herd']['final_eval_loss']}| = {ef_gap:.6f} >= 5% "
+            f"of init {init}")
+    if ratio < 3.5:
+        violations.append(
+            f"wire bytes shrank only {ratio:.2f}x (< 3.5x)")
+    noise_floor = 0.0005 * init
+    if ef_gap <= noef_gap + 1e-9:
+        feedback_verdict = "matters" if noef_gap > noise_floor \
+            else "equivalent_below_noise_floor"
+    elif ef_gap <= noise_floor:
+        feedback_verdict = "equivalent_below_noise_floor"
+    else:
+        feedback_verdict = "hurts"
+        violations.append(
+            f"error feedback HURT parity ({ef_gap:.6f} with vs "
+            f"{noef_gap:.6f} without, noise floor {noise_floor:.6f}) — "
+            f"the feedback term is broken")
+    return {
+        "ok": not violations, "violations": violations,
+        "feedback_verdict": feedback_verdict,
+        "workers": workers, "seed": seed, "wire_dtype": wire_dtype,
+        "quorum_fraction": quant_spec.quorum_fraction,
+        "killed_frac": kill_frac,
+        "init_eval_loss": init,
+        "final_eval_loss": {
+            "f32": rf["herd"]["final_eval_loss"],
+            "quant": rq["herd"]["final_eval_loss"],
+            "quant_no_feedback": rn["herd"]["final_eval_loss"]},
+        "parity_gap": {"with_feedback": round(ef_gap, 6),
+                       "without_feedback": round(noef_gap, 6)},
+        "bytes": {"f32": rf["herd"]["wire"]["wire_bytes"],
+                  "quant": rq["herd"]["wire"]["wire_bytes"],
+                  "ratio": round(ratio, 3)},
+        "bytes_per_round": {
+            "f32": rf["herd"]["wire"]["bytes_per_round"],
+            "quant": rq["herd"]["wire"]["bytes_per_round"]},
+        "mean_round_wait_s": {
+            "f32": _mean_wait(rf), "quant": _mean_wait(rq)},
+    }
+
+
+def _mean_wait(rep: dict) -> Optional[float]:
+    waits = rep.get("herd", {}).get("round_waits_s") or []
+    return round(float(np.mean(waits)), 4) if waits else None

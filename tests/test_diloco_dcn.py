@@ -515,6 +515,145 @@ def test_quorum_closes_round_without_straggler(coordinator, devices, clean_round
     assert any(r.get("participation", 1.0) < 1.0 for r in recs), recs
 
 
+def _dcn_diloco_bytes():
+    """Cumulative (wire, logical) diloco byte counters from the global
+    registry — tests assert on DELTAS around a leg."""
+    from serverless_learn_tpu.telemetry import get_registry
+
+    snap = get_registry().snapshot()
+    wire = logical = 0.0
+    for name, key in (("slt_dcn_bytes_total", "wire"),
+                      ("slt_dcn_logical_bytes_total", "logical")):
+        for series in (snap.get(name) or {}).get("series", []):
+            if series["labels"].get("consumer") == "diloco":
+                if key == "wire":
+                    wire += series["value"]
+                else:
+                    logical += series["value"]
+    return wire, logical
+
+
+def test_quantized_wire_shrinks_bytes_and_preserves_training(
+        coordinator, devices):
+    """Round 20 acceptance on REAL islands: the int8 leg moves >= 3.5x
+    fewer store bytes than the f32 leg for the same protocol traffic
+    (measured both by a counting store and by the
+    slt_dcn_bytes_total{consumer=diloco} deltas), and lands on params
+    within quantization tolerance of the f32 leg's — identical data, so
+    the wire codec is the only difference."""
+    import itertools
+
+    rounds = 3
+    cfg = _cfg()
+    batch = _fixed_batch(cfg, 300)
+
+    def leg(root, run, **kw):
+        store = CountingStore(root)
+        isl = _island(cfg, store, coordinator, run, 0, inner_steps=2, **kw)
+        isl.source_factory = lambda wid: itertools.repeat(batch)
+        w0, l0 = _dcn_diloco_bytes()
+        rep = isl.run_rounds(rounds)
+        w1, l1 = _dcn_diloco_bytes()
+        assert rep.rounds_done == rounds
+        assert all(np.isfinite(l) for l in rep.losses)
+        return isl.final_params, store, (w1 - w0, l1 - l0)
+
+    with tempfile.TemporaryDirectory() as root:
+        p32, s32, (wire32, logical32) = leg(root + "/a", "wf32")
+        p8, s8, (wire8, logical8) = leg(root + "/b", "wint8",
+                                        wire_dtype="int8")
+    # >= 3.5x fewer bytes on the wire, same logical bytes represented
+    assert s32.put_bytes > 3.5 * s8.put_bytes, (s32.put_bytes,
+                                                s8.put_bytes)
+    assert s32.get_bytes > 3.5 * s8.get_bytes, (s32.get_bytes,
+                                                s8.get_bytes)
+    assert wire32 > 3.5 * wire8, (wire32, wire8)
+    assert abs(logical32 - logical8) < 0.01 * logical32
+    # same training signal within codec tolerance: the two trajectories
+    # stay globally close (the rounds compound tiny per-round errors)
+    # and score the SAME data within 5% of the init-loss scale — the
+    # repo's standard parity bar.
+    sq = sum(float(np.square(a - b).sum()) for a, b in zip(
+        jax.tree_util.tree_leaves(p32), jax.tree_util.tree_leaves(p8)))
+    norm = sum(float(np.square(a).sum())
+               for a in jax.tree_util.tree_leaves(p32))
+    assert np.sqrt(sq / norm) < 0.02, np.sqrt(sq / norm)
+
+    from serverless_learn_tpu.models.registry import get_model
+    from serverless_learn_tpu.training.train_step import build_trainer
+
+    bundle = get_model(cfg.model, **cfg.model_overrides)
+    tr = build_trainer(cfg, mesh=make_mesh(cfg.mesh,
+                                           devices=[jax.devices()[0]]))
+    init = float(jax.device_get(bundle.loss_fn(
+        jax.device_get(tr.init().params), batch)[0]))
+    l32 = float(jax.device_get(bundle.loss_fn(p32, batch)[0]))
+    l8 = float(jax.device_get(bundle.loss_fn(p8, batch)[0]))
+    assert abs(l32 - l8) < 0.05 * init, (l32, l8, init)
+
+
+def test_quantized_anchor_publish_reuses_packed_blob(tmp_path,
+                                                     clean_rounds):
+    """Satellite: a republished-unchanged anchor (all deltas
+    quarantined) reuses the blob fetched for that round — one serialize,
+    N sends — and the saved serialization is counted."""
+    from serverless_learn_tpu.training import diloco_dcn as dd
+
+    class Counter:
+        n = 0
+
+        def inc(self, v=1):
+            self.n += v
+
+    isl = _gate_island(tmp_path, run="reuse")
+    isl._m_pack_saved = Counter()
+    template = {"w": np.zeros((4,), np.float32)}
+    anchor = {"w": np.ones((4,), np.float32)}
+    trace = {"w": np.zeros((4,), np.float32)}
+    isl._publish(0, anchor, trace, 0)
+    blob0 = isl.store.get("diloco-reuse/round-0/anchor")
+    pub = isl._fetch_anchor(0, template)  # seeds the packed-blob cache
+    # only a poisoned delta posts: the anchor republishes UNCHANGED
+    isl.store.put("diloco-reuse/round-0/delta-1",
+                  dd._pack({"w": np.full((4,), np.nan, np.float32)}))
+    isl._lead(0, [1], pub["params"], pub["trace"], template, live=[1])
+    assert isl._m_pack_saved.n == 1
+    assert isl.store.get("diloco-reuse/round-1/anchor") == blob0
+
+
+def test_nonfinite_delta_ships_uncompressed_and_is_quarantined(
+        tmp_path, clean_rounds):
+    """The codec REFUSES NaN (typed error); the island falls back to the
+    uncompressed encoding so the leader's gate still sees the NaN and
+    quarantines the worker — quarantine semantics survive quantization."""
+    from serverless_learn_tpu.telemetry import health
+    from serverless_learn_tpu.training import diloco_dcn as dd
+    from serverless_learn_tpu.training import wire_codec as wc
+
+    isl = _gate_island(tmp_path, run="wq", wire_dtype="int8")
+    template = {"w": np.zeros((4,), np.float32)}
+    bad = {"w": np.full((4,), np.nan, np.float32)}
+    blob = isl._encode_delta(0, bad)
+    assert wc.blob_dtype(blob) == "float32"  # the fallback, not int8
+    assert np.isnan(dd._unpack(blob, template)["w"]).all()
+    good = {"w": np.full((4,), 0.25, np.float32)}
+    gblob = isl._encode_delta(0, good)
+    assert wc.blob_dtype(gblob) == "int8"
+    # end to end through the gate: quantized clean delta accepted at its
+    # dequantized value, NaN worker quarantined
+    isl.store.put("diloco-wq/round-0/delta-1", gblob)
+    isl.store.put("diloco-wq/round-0/delta-2", blob)
+    anchor = {"w": np.ones((4,), np.float32)}
+    trace = {"w": np.zeros((4,), np.float32)}
+    health.clear_rounds()
+    isl._lead(0, [1, 2], anchor, trace, template, live=[1, 2])
+    rec = health.recent_rounds()[-1]
+    assert rec["quarantined"] == {"2": "nonfinite"}
+    pub = dd._unpack(isl.store.get("diloco-wq/round-1/anchor"),
+                     {"params": template, "trace": template})
+    np.testing.assert_allclose(pub["params"]["w"], 0.75, atol=0.01)
+
+
 def test_late_joiner_adopts_current_anchor(coordinator, devices):
     """An island started after round 1 joins at the CURRENT round (not 0)
     and contributes deltas from there on."""

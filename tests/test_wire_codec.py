@@ -1,0 +1,203 @@
+"""Blockwise int8/fp8 wire codec (round 20, training/wire_codec.py).
+
+Property-style pins on the codec itself (the integration tests live with
+their consumers: test_diloco_dcn.py, test_elastic_mesh.py, test_herd.py):
+
+* round-trip error bounded per block by the block max times the q-step;
+* exact for zeros, deterministic half-to-even on ties, byte-identical
+  re-encodes;
+* NaN/Inf refused with the TYPED error (quarantine semantics depend on
+  the refusal — a silently flushed NaN would make the leader's gate
+  cosmetic);
+* the in-graph fake-quantize path equals the host path bit-for-bit, and
+  vmap-over-clients equals a python loop (the herd's determinism
+  contract);
+* error feedback drives the long-run mean error far below the
+  feedback-free control;
+* integer leaves ride verbatim; legacy (uncompressed state-dict) blobs
+  still decode — mixed-dtype fleets interoperate.
+"""
+
+import numpy as np
+import pytest
+from flax import serialization
+
+from serverless_learn_tpu.training import wire_codec as wc
+
+RNG = np.random.default_rng(7)
+
+
+def _rand_tree(scale=1.0):
+    return {"dense": {"kernel":
+                      (scale * RNG.standard_normal((129, 7))
+                       ).astype(np.float32),
+                      "bias": np.zeros((5,), np.float32)},
+            "count": np.int32(9)}
+
+
+@pytest.mark.parametrize("dtype", ["int8", "fp8"])
+@pytest.mark.parametrize("block", [32, 128, 256])
+def test_roundtrip_error_bounded_by_block_max_qstep(dtype, block):
+    if dtype == "fp8" and not wc.fp8_supported():
+        pytest.skip("no fp8 runtime")
+    x = (RNG.standard_normal(1000) * np.geomspace(1e-3, 10, 1000)
+         ).astype(np.float32)
+    q, scales = wc.quantize_array(x, dtype, block)
+    back = wc.dequantize_array(q, scales, dtype, x.shape, np.float32,
+                               block)
+    # per-BLOCK bound: |err| <= amax_b * qstep (qstep = 1/127 int8;
+    # fp8-e4m3 has 3 mantissa bits -> rel step 1/8 of the scale window)
+    qstep = 1.0 / 127 if dtype == "int8" else 1.0 / 8
+    nblocks = len(scales)
+    for b in range(nblocks):
+        blk = x[b * block:(b + 1) * block]
+        err = np.abs(back[b * block:(b + 1) * block] - blk)
+        assert err.max() <= np.abs(blk).max() * qstep + 1e-12, (b, dtype)
+
+
+def test_zeros_exact_and_ties_round_half_even():
+    x = np.zeros(300, np.float32)
+    q, s = wc.quantize_array(x, "int8", 128)
+    assert (s == 0).all()
+    assert (wc.dequantize_array(q, s, "int8", x.shape, np.float32, 128)
+            == 0).all()
+    # scale pins to 1.0 (amax 127); 63.5 and 62.5 are exact ties
+    x = np.array([127.0, 63.5, 62.5, -63.5], np.float32)
+    q, s = wc.quantize_array(x, "int8", 4)
+    np.testing.assert_array_equal(q.view(np.int8), [127, 64, 62, -64])
+
+
+def test_reencode_is_byte_identical():
+    tree = _rand_tree()
+    assert wc.encode(tree, "int8", 128) == wc.encode(tree, "int8", 128)
+
+
+def test_nonfinite_rejected_with_typed_error():
+    for bad in (np.nan, np.inf, -np.inf):
+        tree = {"w": np.array([1.0, bad, 2.0], np.float32)}
+        with pytest.raises(wc.NonFiniteError) as ei:
+            wc.encode(tree, "int8")
+        assert isinstance(ei.value, ValueError)  # typed, catchable
+        assert "w" in ei.value.path
+    # the f32 wire wrapping refuses nothing (it IS the fallback)
+    wc.encode({"w": np.array([np.nan], np.float32)}, "f32")
+
+
+def test_integer_leaves_and_template_mapping_exact():
+    tree = _rand_tree()
+    out = wc.decode(wc.encode(tree, "int8"), template=tree)
+    assert out["count"] == tree["count"]
+    assert out["count"].dtype == np.int32
+    assert out["dense"]["kernel"].shape == (129, 7)
+    assert out["dense"]["kernel"].dtype == np.float32
+
+
+def test_decoded_twin_matches_receiver_decode():
+    """encode_with_decoded's sender-side twin (the error-feedback base)
+    must equal what a receiver decodes from the bytes — bit for bit."""
+    tree = _rand_tree()
+    for dtype in ("int8", "fp8") if wc.fp8_supported() else ("int8",):
+        blob, dec = wc.encode_with_decoded(tree, dtype, 64)
+        rt = wc.decode(blob, template=tree)
+        np.testing.assert_array_equal(rt["dense"]["kernel"],
+                                      dec["dense"]["kernel"])
+
+
+def test_host_equals_in_graph_path():
+    """int8: the host and in-graph paths agree bit-for-bit (same
+    half-even rounding). fp8: XLA's f32->f8e4m3 convert rounds borderline
+    values differently from ml_dtypes' direct cast (double-rounding in
+    its lowering), so the paths agree only to one fp8 quantization step
+    — acceptable because no value stream ever crosses paths (real
+    islands are host-only, the herd sim is graph-only)."""
+    import jax.numpy as jnp
+
+    x = RNG.standard_normal(500).astype(np.float32)
+    q, s = wc.quantize_array(x, "int8", 64)
+    host = wc.dequantize_array(q, s, "int8", x.shape, np.float32, 64)
+    graph = np.asarray(wc.fake_quantize(jnp.asarray(x), "int8", 64))
+    np.testing.assert_array_equal(host, graph)
+    if wc.fp8_supported():
+        q, s = wc.quantize_array(x, "fp8", 64)
+        host = wc.dequantize_array(q, s, "fp8", x.shape, np.float32, 64)
+        graph = np.asarray(wc.fake_quantize(jnp.asarray(x), "fp8", 64))
+        # one fp8 spacing at the top of the scale window is
+        # scale * 448 / 8; allow two of them for the borderline cases
+        tol = np.repeat(s, 64)[:500] * (2 * 448.0 / 8)
+        assert (np.abs(host - graph) <= tol + 1e-12).all()
+
+
+def test_vmap_equals_loop():
+    import jax
+    import jax.numpy as jnp
+
+    xs = jnp.asarray(RNG.standard_normal((6, 85)).astype(np.float32))
+    v = jax.vmap(lambda a: wc.fake_quantize(a, "int8", 32))(xs)
+    loop = jnp.stack([wc.fake_quantize(xs[i], "int8", 32)
+                      for i in range(xs.shape[0])])
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(loop))
+
+
+def test_fake_quantize_propagates_nan_per_block():
+    """The in-graph path can't raise: a block touched by NaN decodes as
+    all-NaN (the quarantine gate reads dequantized values), and every
+    other block stays clean."""
+    import jax.numpy as jnp
+
+    x = np.ones(64, np.float32)
+    x[3] = np.nan
+    out = np.asarray(wc.fake_quantize(jnp.asarray(x), "int8", 32))
+    assert np.isnan(out[:32]).all()
+    assert np.array_equal(out[32:], np.ones(32, np.float32))
+
+
+def test_error_feedback_unbiases_the_stream():
+    x = {"w": (0.01 * RNG.standard_normal(2000)).astype(np.float32)}
+    ef = wc.ErrorFeedback("int8", 128)
+    ctl = wc.ErrorFeedback("int8", 128, enabled=False)
+    acc_ef = np.zeros(2000, np.float64)
+    acc_ctl = np.zeros(2000, np.float64)
+    for _ in range(40):
+        acc_ef += wc.decode(ef.encode(x), template=x)["w"]
+        acc_ctl += wc.decode(ctl.encode(x), template=x)["w"]
+    err_ef = np.abs(acc_ef / 40 - x["w"]).max()
+    err_ctl = np.abs(acc_ctl / 40 - x["w"]).max()
+    assert err_ef < 0.2 * err_ctl, (err_ef, err_ctl)
+
+
+def test_error_feedback_residual_survives_nonfinite_refusal():
+    ef = wc.ErrorFeedback("int8", 128)
+    x = {"w": RNG.standard_normal(300).astype(np.float32)}
+    ef.encode(x)
+    resid = {k: v.copy() for k, v in ef.residual.items()}
+    with pytest.raises(wc.NonFiniteError):
+        ef.encode({"w": np.full(300, np.nan, np.float32)})
+    np.testing.assert_array_equal(ef.residual["w"], resid["w"])
+    assert np.isfinite(ef.residual["w"]).all()
+
+
+def test_legacy_blob_decodes_and_wire_bytes_shrink():
+    tree = {"w": RNG.standard_normal((64, 32)).astype(np.float32)}
+    legacy = serialization.msgpack_serialize(
+        serialization.to_state_dict(tree))
+    out = wc.decode(legacy, template=tree)
+    np.testing.assert_array_equal(out["w"], tree["w"])
+    assert wc.blob_dtype(legacy) == "float32"
+    blob = wc.encode(tree, "int8", 128)
+    assert wc.blob_dtype(blob) == "int8"
+    assert len(legacy) > 3.5 * len(blob), (len(legacy), len(blob))
+    # and the metadata estimators agree with reality within framing slop
+    assert abs(wc.wire_nbytes(tree, "int8", 128) - len(blob)) < 0.1 * \
+        len(blob)
+    assert abs(wc.logical_nbytes(tree) - len(legacy)) < 0.1 * len(legacy)
+
+
+def test_dtype_normalization_and_gating():
+    assert wc.normalize_dtype("f32") == "float32"
+    assert wc.normalize_dtype("INT8") == "int8"
+    assert wc.normalize_dtype("fp8_e4m3") == "fp8"
+    with pytest.raises(ValueError):
+        wc.normalize_dtype("int4")
+    if not wc.fp8_supported():
+        with pytest.raises(wc.WireCodecError):
+            wc.require_supported("fp8")
