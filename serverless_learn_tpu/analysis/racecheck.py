@@ -445,6 +445,11 @@ DEFAULT_MODULES = (
     # delta path mutates every round; islands are single-threaded per
     # instance, and instrumentation keeps that assumption honest.
     "serverless_learn_tpu.training.wire_codec",
+    # round 21: BoundaryEvents is the one waterfall piece shared across
+    # threads (prefill/decode/harvest all note into it, requests read it
+    # at attribution time); RequestWaterfall itself is request-owned and
+    # instrumentation keeps that ownership discipline honest.
+    "serverless_learn_tpu.telemetry.waterfall",
 )
 
 

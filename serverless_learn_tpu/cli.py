@@ -854,6 +854,18 @@ def cmd_loadgen(args) -> int:
     --smoke runs the self-contained 2-replica kill/restart proof (CI)."""
     from serverless_learn_tpu.fleet import loadgen
 
+    if args.waterfall_smoke:
+        # Round-21 acceptance run: a seeded continuous-engine workload
+        # whose preemption (pool overflow) and mid-decode compile
+        # (outgrown warm shapes) are injected BY CONSTRUCTION; exit 0
+        # iff the waterfalls name both causes on the right requests,
+        # the decompositions sum, the ledger overhead stays <2% and
+        # `slt doctor` names the dominant stall cause from JSONL alone.
+        rep = loadgen.run_waterfall_smoke(
+            seed=args.seed,
+            history_path=args.history if args.record else None)
+        print(json.dumps(rep, indent=None if args.compact else 2))
+        return 0 if rep["ok"] else 1
     if args.kv_smoke:
         # Round-13 serving headline: same seeded shared-prefix workload
         # at the same offered load vs the paged and monolithic engines;
@@ -1427,6 +1439,48 @@ def cmd_xray(args) -> int:
     print(json.dumps(out if len(out) > 1 else next(iter(out.values())),
                      indent=None if args.compact else 2))
     return 0 if ok else 1
+
+
+def cmd_waterfall(args) -> int:
+    """Per-request lifecycle waterfalls (telemetry/waterfall.py): merge
+    engine request-span records (each carrying the per-request ledger)
+    with router ``waterfall_hop`` records by trace_id, then print the
+    percentile decompositions — TTFT p99 = queue + admit + compile +
+    prefill, ITL p99 with the stall-cause breakdown — plus phase bars
+    for the slowest requests. Exit 1 when a decomposition invariant is
+    violated (the ledger itself is lying)."""
+    from serverless_learn_tpu.telemetry import waterfall
+
+    if args.self_check:
+        rep = waterfall.self_check(fixture_path=args.fixture)
+        print(json.dumps(rep, indent=None if args.compact else 2))
+        return 0 if rep["ok"] else 1
+    if not args.paths:
+        print("waterfall needs engine/router event logs (--events-log "
+              "JSONL, flight-recorder dumps, or dirs of them) or "
+              "--self-check", file=sys.stderr)
+        return 2
+    try:
+        rep = waterfall.report(args.paths, top=args.top)
+    except (FileNotFoundError, OSError, ValueError) as e:
+        print(f"waterfall: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    if args.bench_history:
+        from serverless_learn_tpu.utils.benchlog import record
+
+        for row in waterfall.bench_rows(rep["summary"],
+                                        device_kind=args.device_kind):
+            record(row, args.bench_history, better="min",
+                   rel_threshold=0.25,
+                   key_fields=("metric", "device_kind"))
+    if args.json:
+        print(json.dumps(rep, indent=None if args.compact else 2))
+    else:
+        print(waterfall.render(rep))
+    inv = rep.get("summary", {}).get("invariants") or {}
+    bad = (inv.get("ttft_decomp_bad") or 0) + (inv.get("stall_sum_bad")
+                                               or 0)
+    return 1 if bad else 0
 
 
 def cmd_bench(args) -> int:
@@ -2008,6 +2062,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="self-contained CI proof: 2-replica stub fleet, "
                          "open-loop load, one replica killed + restarted "
                          "mid-run; exit 0 iff zero failed requests")
+    lg.add_argument("--waterfall-smoke", action="store_true",
+                    help="request-waterfall acceptance run: seeded "
+                         "continuous-engine workload with injected "
+                         "preemption + forced new-bucket compile; exit 0 "
+                         "iff both causes land on the correct requests, "
+                         "TTFT/stall decompositions sum and the ledger "
+                         "overhead stays under 2%% of decode wall-clock; "
+                         "--record appends serve_itl/ttft rows")
     lg.add_argument("--kv-smoke", action="store_true",
                     help="paged-KV serving headline: seeded shared-prefix "
                          "+ long-prompt workload at fixed offered load vs "
@@ -2290,6 +2352,38 @@ def build_parser() -> argparse.ArgumentParser:
                          "re-analyzes to its committed summary; exit 1 "
                          "on drift")
     xr.set_defaults(fn=cmd_xray)
+
+    wf = sub.add_parser("waterfall",
+                        help="per-request lifecycle waterfalls from "
+                             "engine+router event logs: TTFT/ITL "
+                             "percentile decompositions, stall-cause "
+                             "attribution, hedge provenance, phase bars")
+    wf.add_argument("paths", nargs="*", metavar="EVENTS",
+                    help="JSONL event logs (--events-log output, flight "
+                         "dumps) or directories of them; engine and "
+                         "router logs merge by trace_id")
+    wf.add_argument("--top", type=int, default=10,
+                    help="slowest requests to render as phase bars")
+    wf.add_argument("--json", action="store_true",
+                    help="full JSON report instead of the rendering")
+    wf.add_argument("--compact", action="store_true",
+                    help="single-line JSON (for scripts)")
+    wf.add_argument("--device-kind", default="cpu",
+                    help="device-kind stamp for --bench-history rows")
+    wf.add_argument("--bench-history", metavar="FILE", default=None,
+                    help="append serve_itl_p99_ms / serve_ttft_p99_ms "
+                         "rows (with decomposition attribution columns) "
+                         "to this bench history for `slt bench --gate`")
+    wf.add_argument("--fixture", metavar="FILE", default=None,
+                    help="committed fixture JSONL for --self-check "
+                         "(default: the embedded synthetic records)")
+    wf.add_argument("--self-check", action="store_true",
+                    help="CI smoke: synthetic+fixture records survive "
+                         "read->merge->summarize with every invariant "
+                         "(TTFT decomposition, stall sums, hedge "
+                         "provenance, reserved spec_verify phase) "
+                         "intact; exit 1 on drift")
+    wf.set_defaults(fn=cmd_waterfall)
 
     bn = sub.add_parser("bench",
                         help="headline benchmark + perf regression gate "

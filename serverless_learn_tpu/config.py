@@ -446,6 +446,31 @@ class KVCacheConfig:
 
 
 @dataclass(frozen=True)
+class WaterfallConfig:
+    """Per-request waterfall ledger knobs (``telemetry/waterfall.py``,
+    threaded through ``inference/continuous.py`` and
+    ``inference/batching.py``).
+
+    A decode gap counts as a STALL when it exceeds the request's EWMA
+    inter-token baseline by ``stall_mult``x AND by at least
+    ``min_stall_s`` — both bounds, so a 0.1 ms engine doesn't flag
+    micro-jitter and a 100 ms engine doesn't need retuning. Attribution
+    intersects the gap with the engine's boundary-event ring
+    (``events_window`` entries); per-request storage is bounded by
+    ``max_stall_events`` / ``max_gap_samples`` so the ledger stays
+    compact at any request length.
+    """
+
+    enabled: bool = True
+    ewma_alpha: float = 0.3        # decode-ITL baseline smoothing
+    stall_mult: float = 2.0        # gap > mult * baseline => stall
+    min_stall_s: float = 0.002     # ... and exceeds baseline by this
+    max_stall_events: int = 64     # attributed stall entries kept/request
+    max_gap_samples: int = 256     # raw decode gaps kept/request
+    events_window: int = 256       # engine boundary-event ring size
+
+
+@dataclass(frozen=True)
 class FleetConfig:
     """Serving-fleet knobs (``fleet/``): the front-door router
     (``slt route``), replica self-registration (``serve --fleet``) and the
@@ -573,6 +598,7 @@ class ExperimentConfig:
     membership: MembershipConfig = field(default_factory=MembershipConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
     kv: KVCacheConfig = field(default_factory=KVCacheConfig)
+    waterfall: WaterfallConfig = field(default_factory=WaterfallConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     numerics: NumericsConfig = field(default_factory=NumericsConfig)
     elastic: ElasticConfig = field(default_factory=ElasticConfig)
@@ -605,6 +631,7 @@ class ExperimentConfig:
             membership=build(MembershipConfig, raw.get("membership")),
             fleet=build(FleetConfig, raw.get("fleet")),
             kv=build(KVCacheConfig, raw.get("kv")),
+            waterfall=build(WaterfallConfig, raw.get("waterfall")),
             checkpoint=build(CheckpointConfig, raw.get("checkpoint")),
             numerics=build(NumericsConfig, raw.get("numerics")),
             elastic=build(ElasticConfig, raw.get("elastic")),

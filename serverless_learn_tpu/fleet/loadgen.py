@@ -566,6 +566,192 @@ def run_kv_smoke(seed: int = 0, rate_rps: float = 10.0,
     return rep
 
 
+def run_waterfall_smoke(seed: int = 0, events_path: Optional[str] = None,
+                        history_path: Optional[str] = None) -> dict:
+    """The waterfall acceptance proof (round 21), measured not asserted:
+    a real paged continuous engine under a seeded 3-request workload with
+    two faults INJECTED by construction — a forced new-bucket XLA compile
+    (one request's prompt bucket is deliberately left unwarmed) and a
+    KV-exhaustion preemption (the block pool is sized so the late arrival
+    cannot prefill until a decoding request is evicted). The engine's
+    JSONL event log alone must then tell the whole story:
+
+    * the per-token decode traces attribute ITL stalls to BOTH injected
+      causes, on the CORRECT requests (compile/preempt charged to the
+      requests that were decoding, never to the late arrival that caused
+      them);
+    * every TTFT decomposition sums to its measured TTFT within 5% and
+      every stall's cause breakdown sums to its gap;
+    * ``slt doctor`` names the dominant stall cause from the JSONL alone;
+    * the ledger's self-accounted overhead stays under 2% of decode
+      wall-clock.
+
+    Rows (``serve_itl_p99_ms`` with ``prefill_interference_frac``,
+    ``serve_ttft_p99_ms`` with the decomposition columns) land in bench
+    history via ``history_path``, gated by ``slt bench --gate --metric
+    serve_``."""
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from serverless_learn_tpu.config import KVCacheConfig, WaterfallConfig
+    from serverless_learn_tpu.inference.continuous import (
+        ContinuousBatchingEngine)
+    from serverless_learn_tpu.models.registry import get_model
+    from serverless_learn_tpu.telemetry import doctor as doctor_mod
+    from serverless_learn_tpu.telemetry import waterfall as wf_mod
+    from serverless_learn_tpu.telemetry.registry import (JsonlEventLog,
+                                                         MetricsRegistry)
+    from serverless_learn_tpu.telemetry.tracing import new_context
+
+    bundle = get_model("llama_tiny", dtype=jnp.float32,
+                       param_dtype=jnp.float32, max_seq_len=256)
+    module = bundle.module
+    params = module.init(jax.random.PRNGKey(seed),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+
+    own_tmp = events_path is None
+    if own_tmp:
+        fd, events_path = tempfile.mkstemp(suffix=".jsonl",
+                                           prefix="slt-waterfall-")
+        os.close(fd)
+    log = JsonlEventLog(events_path)
+    registry = MetricsRegistry()
+    # Both faults are injected BY CONSTRUCTION, not by timing:
+    # * Pool sizing forces preemption: each decoder grows to 212 tokens
+    #   = 14 blocks, so two of them need 28 against the 18-block pool —
+    #   decode-time growth MUST evict the youngest residency mid-stream
+    #   (kv_exhausted -> preempt -> re-admission, all on the ledger).
+    # * Warm-shape scope forces a mid-decode compile: only the
+    #   (32, 48)-workload buckets are compiled up front, so the decoders
+    #   hit an unwarmed (nb, W) decode bucket the moment their page
+    #   count outgrows the warmed width — while their token gaps are
+    #   being traced.
+    kv = KVCacheConfig(paged=True, block_size=16, num_blocks=18,
+                       prefix_cache=False, prefill_chunk=32,
+                       prefill_budget=64)
+    eng = ContinuousBatchingEngine(module, params, max_slots=4,
+                                   chunk_size=8, registry=registry,
+                                   event_log=log, kv=kv,
+                                   waterfall=WaterfallConfig())
+    rng = random.Random(f"waterfall-{seed}")
+    decoder_prompt = [rng.randrange(1, 100) for _ in range(32)]
+    intruder_prompt = [rng.randrange(1, 100) for _ in range(72)]
+    eng.warm_shapes([(32, 48)], batch_sizes=(1, 2))
+    traces = {name: new_context() for name in ("dec0", "dec1", "intr")}
+    results: Dict[str, dict] = {}
+
+    def fire(name, prompt, max_new, delay_s):
+        if delay_s > 0:
+            time.sleep(delay_s)
+        results[name] = eng.submit(prompt, max_new=max_new,
+                                   temperature=0.0, top_k=1, eos_id=None,
+                                   seed=seed, timeout_s=300.0,
+                                   trace=traces[name])
+
+    threads = [
+        threading.Thread(target=fire, args=("dec0", decoder_prompt,
+                                            180, 0.0)),
+        threading.Thread(target=fire, args=("dec1", decoder_prompt,
+                                            180, 0.0)),
+        # A short interactive request arriving mid-stream: its 72-token
+        # prompt prefills through chunked-prefill while the decoders
+        # decode (prefill_steal markers on their gaps) and its own
+        # unwarmed buckets charge a compile phase to ITS TTFT.
+        threading.Thread(target=fire, args=("intr", intruder_prompt,
+                                            8, 0.05)),
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300.0)
+    finally:
+        eng.stop()
+        log.close()
+
+    rep = wf_mod.report([events_path], top=10)
+    summary = rep["summary"]
+    by_trace = {traces[n].trace_id: n for n in traces}
+    stalls_by_req: Dict[str, Dict[str, float]] = {}
+    victims: List[str] = []
+    for r in rep["slowest"]:
+        name = by_trace.get(r.get("trace_id"))
+        if name and r.get("waterfall"):
+            stalls_by_req[name] = r["waterfall"].get("stall_s") or {}
+            if "preempt" in (r.get("marks_s") or {}):
+                victims.append(name)
+    checks = []
+
+    def check(name, ok, detail):
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+
+    check("requests_complete",
+          all("error" not in (results.get(n) or {"error": "missing"})
+              for n in traces) and len(stalls_by_req) == 3,
+          {n: sorted(stalls_by_req.get(n, {})) for n in traces})
+    # The intruder's compile must be charged to the requests that were
+    # DECODING through it (their inter-token gaps), while for the
+    # intruder itself compile is a TTFT phase, not an ITL stall.
+    decoder_stalls = set(stalls_by_req.get("dec0", {})) \
+        | set(stalls_by_req.get("dec1", {}))
+    check("compile_attributed_to_decoders",
+          "compile" in decoder_stalls,
+          f"decoder stall causes: {sorted(decoder_stalls)}, intruder: "
+          f"{sorted(stalls_by_req.get('intr', {}))}")
+    # Every victim that was mid-DECODE when evicted must carry the
+    # preempt cause on a gap; a victim evicted before its first decode
+    # token shows the cost in its (re-prefilled) TTFT instead, so it is
+    # excluded — but at least one victim must name the cause.
+    traced_victims = [v for v in victims if stalls_by_req.get(v)]
+    check("preempt_attributed_to_victim",
+          eng.preemptions > 0 and len(traced_victims) > 0
+          and all("preempt" in stalls_by_req[v] for v in traced_victims),
+          f"preemptions={eng.preemptions}, victim(s)={victims}, "
+          f"victim causes: "
+          f"{[sorted(stalls_by_req.get(v, {})) for v in victims]}")
+    inv = summary.get("invariants") or {}
+    check("ttft_decomposition",
+          not inv.get("ttft_decomp_bad"),
+          f"{inv.get('ttft_decomp_bad', 0)} request(s) whose "
+          f"queue+admit+compile+prefill missed TTFT by >5%")
+    check("stall_sums", not inv.get("stall_sum_bad"),
+          f"{inv.get('stall_sum_bad', 0)} stall(s) whose cause "
+          f"breakdown missed the gap by >2%")
+    overhead = summary.get("ledger_overhead_frac")
+    check("ledger_overhead",
+          overhead is not None and overhead < 0.02,
+          f"ledger overhead {overhead} of decode wall-clock "
+          f"(bound 0.02)")
+    verdict = doctor_mod.diagnose(paths=[events_path])[
+        "summary"]["verdict"]
+    dom = summary.get("dominant_stall_cause")
+    check("doctor_names_dominant_cause",
+          "decode stalls on" in verdict and dom is not None
+          and f"dominant cause {dom}" in verdict,
+          verdict[:200])
+    rows = wf_mod.bench_rows(summary, device_kind="serve-cpu")
+    check("bench_rows",
+          {r["metric"] for r in rows}
+          >= {"serve_itl_p99_ms", "serve_ttft_p99_ms"}
+          and any("prefill_interference_frac" in r for r in rows),
+          [r["metric"] for r in rows])
+    if history_path:
+        from serverless_learn_tpu.utils.benchlog import record
+
+        for row in rows:
+            record(row, history_path, better="min", rel_threshold=0.25,
+                   key_fields=("metric", "device_kind"))
+    out = {"ok": all(c["ok"] for c in checks), "checks": checks,
+           "summary": summary, "bench_rows": rows,
+           "events_path": None if own_tmp else events_path}
+    if own_tmp:
+        os.unlink(events_path)
+    return out
+
+
 # -- the CI smoke ------------------------------------------------------------
 
 

@@ -208,6 +208,10 @@ class FleetRouter:
             "client-observed latency through the router")
         self._h_upstream = reg.histogram(
             "slt_router_upstream_seconds", "one forward attempt's latency")
+        self._m_hedge_wasted = reg.counter(
+            "slt_router_hedge_wasted_seconds_total",
+            "upstream seconds burned by losing hedge attempts (duplicate "
+            "work the race discarded)")
 
         for addr in replicas:
             self.add_replica(addr, static=True)
@@ -520,7 +524,8 @@ class FleetRouter:
                     f"replica {r.addr} ejected after "
                     f"{r.consec_errors} consecutive errors "
                     f"({r.last_error})", r.addr)
-            out.put((r, None, f"{type(e).__name__}: {e}"))
+            out.put((r, None, f"{type(e).__name__}: {e}",
+                     self.clock() - t0))
             return
         dt = self.clock() - t0
         breaker.record_success()
@@ -536,7 +541,7 @@ class FleetRouter:
                 if r.state == Replica.EJECTED:
                     r.state = Replica.HEALTHY
                     self._refresh_gauges_locked()
-        out.put((r, rep, None))
+        out.put((r, rep, None, dt))
 
     def _launch(self, r: Replica, req: dict, out: "queue.Queue"):
         with self._lock:
@@ -565,7 +570,15 @@ class FleetRouter:
 
     def handle(self, req: dict) -> dict:
         """One request end-to-end: admission (shed), pick, forward with
-        hedging/failover, exactly one reply."""
+        hedging/failover, exactly one reply. Every request also leaves a
+        ``waterfall_hop`` record (round 21): the router parses-or-mints
+        the W3C traceparent, forwards it so the engine's request span
+        shares the trace_id, and stamps hop provenance (queue wait, shed,
+        replica picked, hedge winner/loser + wasted seconds, retries) so
+        ``slt waterfall`` can merge both sides into one timeline."""
+        from serverless_learn_tpu.telemetry.tracing import (
+            new_context, node_name, parse_traceparent)
+
         t_start = self.clock()
         priority = req.pop("priority", 1)
         session = req.pop("session", None)
@@ -573,6 +586,12 @@ class FleetRouter:
             priority = int(priority)
         except (TypeError, ValueError):
             priority = 1
+        ctx = parse_traceparent(req.get("traceparent")) or new_context()
+        req["traceparent"] = ctx.traceparent()
+        hop = {"event": "waterfall_hop", "trace_id": ctx.trace_id,
+               "node": node_name(), "t_unix_s": time.time(),
+               "shed": False, "hedged": False, "retries": 0,
+               "queue_wait_s": 0.0}
 
         # ---- admission: bounded queue with brownout shedding ----
         cap = max(1, self.cfg.max_inflight)
@@ -590,11 +609,13 @@ class FleetRouter:
                     # rejecting it instantly is what keeps the queue
                     # short for traffic that matters.
                     self._m_shed.inc()
+                    self._emit_hop(hop, t_start, shed=True)
                     return _overload_reply(
                         f"brownout at {self._inflight}/{cap} in flight")
                 remaining = deadline - self.clock()
                 if remaining <= 0:
                     self._m_shed.inc()
+                    self._emit_hop(hop, t_start, shed=True)
                     return _overload_reply(
                         f"queue full ({cap} in flight, waited "
                         f"{self.cfg.queue_timeout_s:g}s)")
@@ -610,13 +631,15 @@ class FleetRouter:
                 self._g_inflight.set(self._inflight)
                 self._adm_cv.notify()
             self._m_shed.inc()
+            self._emit_hop(hop, t_start, shed=True)
             return _overload_reply(
                 f"fleet KV pool pressure (free frac < "
                 f"{self.cfg.kv_shed_free_frac:g})")
+        hop["queue_wait_s"] = round(self.clock() - t_start, 6)
         self._h_queue_wait.observe(self.clock() - t_start)
         self._m_requests.inc()
         try:
-            rep = self._dispatch(req, session)
+            rep = self._dispatch(req, session, hop)
         finally:
             with self._adm_cv:
                 self._inflight -= 1
@@ -626,9 +649,53 @@ class FleetRouter:
             self._m_errors.inc()
         else:
             self._h_latency.observe(self.clock() - t_start)
+        self._emit_hop(hop, t_start,
+                       shed=bool(rep.get("code") == "overloaded"))
         return rep
 
-    def _dispatch(self, req: dict, session: Optional[str]) -> dict:
+    def _emit_hop(self, hop: dict, t_start: float, shed: bool = False):
+        """Finish + emit one ``waterfall_hop`` record. When losing hedge
+        attempts are still in flight the emission is deferred to the
+        drain thread so the record carries their wasted/cancel seconds."""
+        hop["total_s"] = round(self.clock() - t_start, 6)
+        if shed:
+            hop["shed"] = True
+        drain = hop.pop("_drain", None)
+        if drain is not None:
+            t = threading.Thread(target=self._drain_losers,
+                                 args=(hop,) + drain, daemon=True)
+            t.start()
+            return
+        self._emit(hop)
+
+    def _drain_losers(self, hop: dict, out: "queue.Queue", pending: int,
+                      t_win: float):
+        """Wait for the losing hedge attempt(s) to land, charge their
+        duplicate upstream seconds, then emit the completed hop record.
+        ``hedge_cancel_s`` is how long past the winner the loser kept
+        running — the latency cost of not having true cancellation."""
+        wasted = 0.0
+        cancel = None
+        deadline = self.clock() + self.cfg.upstream_timeout_s + 1.0
+        for _ in range(pending):
+            try:
+                r, rep, err, dt = out.get(
+                    timeout=max(0.0, deadline - self.clock()))
+            except queue.Empty:
+                break
+            wasted += dt
+            lag = max(0.0, self.clock() - t_win)
+            cancel = lag if cancel is None else max(cancel, lag)
+            hop.setdefault("hedge_loser", r.addr)
+        if wasted > 0.0:
+            self._m_hedge_wasted.inc(wasted)
+        hop["hedge_wasted_s"] = round(wasted, 6)
+        if cancel is not None:
+            hop["hedge_cancel_s"] = round(cancel, 6)
+        self._emit(hop)
+
+    def _dispatch(self, req: dict, session: Optional[str],
+                  hop: Optional[dict] = None) -> dict:
         hedgeable = self.cfg.hedge and self._idempotent(req)
         req = {k: v for k, v in req.items() if k != "idempotent"}
         candidates = self._candidates()
@@ -636,8 +703,11 @@ class FleetRouter:
             self._m_shed.inc()
             return _overload_reply("no healthy replicas")
         primary = self._pick(candidates, session)
+        if hop is not None:
+            hop["primary"] = primary.addr
         out: "queue.Queue" = queue.Queue()
         tried = {primary.addr}
+        launched = [primary.addr]
         self._launch(primary, req, out)
         pending = 1
         hedged = False
@@ -649,24 +719,41 @@ class FleetRouter:
             if hedgeable and not hedged:
                 timeout = max(0.0, hedge_at - self.clock())
             try:
-                r, rep, err = out.get(timeout=timeout)
+                r, rep, err, _dt = out.get(timeout=timeout)
             except queue.Empty:
                 # Hedge: the primary is slow, race one more replica.
                 hedge = self._pick(self._candidates(), None, exclude=tried)
                 hedged = True
+                if hop is not None:
+                    hop["hedged"] = True
                 if hedge is not None:
                     tried.add(hedge.addr)
+                    launched.append(hedge.addr)
                     self._m_hedges.inc()
                     self._launch(hedge, req, out)
                     pending += 1
                 continue
             pending -= 1
+            launched.remove(r.addr)
             if rep is not None:
                 if hedged and r.addr != primary.addr:
                     self._m_hedge_wins.inc()
+                if hop is not None:
+                    hop["replica"] = r.addr
+                    hop["retries"] = retries
+                    if hedged:
+                        hop["hedge_winner"] = r.addr
+                        if launched:
+                            hop["hedge_loser"] = launched[0]
+                    if pending:
+                        # Hand the still-running loser(s) to the drain
+                        # thread (started by _emit_hop) so the hop record
+                        # ships with their wasted/cancel seconds.
+                        hop["_drain"] = (out, pending, self.clock())
                 # Losing attempts keep running on their daemon threads;
-                # their replies land in `out`, which nothing reads — the
-                # client gets exactly this one completion.
+                # their replies land in `out`, which the drain thread
+                # reads for provenance — the client still gets exactly
+                # this one completion.
                 return rep
             last_err = err
             if pending:
@@ -675,11 +762,14 @@ class FleetRouter:
                 nxt = self._pick(self._candidates(), None, exclude=tried)
                 if nxt is not None:
                     tried.add(nxt.addr)
+                    launched.append(nxt.addr)
                     retries += 1
                     self._m_retries.inc()
                     self._launch(nxt, req, out)
                     pending += 1
                     continue
+            if hop is not None:
+                hop["retries"] = retries
             return {"error": f"upstream failed after {len(tried)} "
                              f"replica(s): {last_err}",
                     "code": "upstream_unavailable"}

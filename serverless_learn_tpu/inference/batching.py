@@ -50,6 +50,9 @@ import numpy as np
 from serverless_learn_tpu.inference.generate import generate, init_cache
 from serverless_learn_tpu.telemetry import (RATE_BUCKETS, SIZE_BUCKETS,
                                             Span, get_registry, goodput)
+from serverless_learn_tpu.telemetry import flight
+from serverless_learn_tpu.telemetry.tracing import node_name
+from serverless_learn_tpu.telemetry.waterfall import RequestWaterfall
 
 
 def _bucket(n: int, floor: int = 8) -> int:
@@ -76,6 +79,7 @@ class _Pending:
     result: Optional[dict] = None
     group_key: tuple = ()  # set by the engine (includes padded shapes)
     span: Optional[Span] = None  # request trace: submit/admit/done
+    wf: Optional[RequestWaterfall] = None  # round-21 reduced ledger
 
 
 def _shape_buckets(prompt_len: int, max_new: int,
@@ -99,7 +103,8 @@ class BatchingEngine:
     """Owns the device; coalesces submitted requests into batched decodes."""
 
     def __init__(self, module, params, max_batch: int = 8,
-                 batch_wait_ms: float = 3.0, registry=None, kv=None):
+                 batch_wait_ms: float = 3.0, registry=None, kv=None,
+                 event_log=None, waterfall=None):
         self.module = module
         self.params = params
         self.max_batch = max_batch
@@ -115,6 +120,16 @@ class BatchingEngine:
         self.kv = kv
         self._paged = bool(kv is not None and kv.paged)
         self._paged_modules: dict = {}
+        # Round 21: this engine emits request spans too (it never did
+        # before — only the continuous engine's showed up in `slt
+        # trace`), each carrying a REDUCED waterfall: run-to-completion
+        # groups have no decode trace, so the ledger is queue/admit/
+        # compile/generate with TTFT == latency by construction.
+        self.event_log = event_log
+        if waterfall is None:
+            from serverless_learn_tpu.config import WaterfallConfig
+            waterfall = WaterfallConfig()
+        self.waterfall = waterfall
         reg = registry or get_registry()
         self.registry = reg
         lbl = {"engine": "static"}
@@ -192,6 +207,8 @@ class BatchingEngine:
         p.span = (Span("request", trace_id=trace.trace_id,
                        parent_id=trace.span_id)
                   if trace is not None else Span("request"))
+        if self.waterfall.enabled:
+            p.wf = RequestWaterfall(engine="static")
         self._m_requests.inc()
         self._q.put(p)
         if not p.done.wait(timeout_s):
@@ -199,6 +216,16 @@ class BatchingEngine:
         return p.result
 
     # -- dispatcher --------------------------------------------------------
+
+    def _emit_span(self, span) -> None:
+        """Span record -> the JSONL event log + flight ring (same sink
+        discipline as the continuous engine, so `slt waterfall` merges
+        both engines' records from the same files)."""
+        rec = span.to_event()
+        rec.setdefault("node", node_name())
+        if self.event_log is not None:
+            self.event_log.emit(rec)
+        flight.record(rec)
 
     def _dispatch_loop(self):
         while not self._stop.is_set():
@@ -272,6 +299,7 @@ class BatchingEngine:
         module, cache = self.module, None
         if self._paged:
             module, cache = self._paged_group(batch_bucket)
+        t_g0 = time.perf_counter()
         with goodput.phase("compile" if new_shape else "decode"):
             tokens = generate(
                 module, self.params, jnp.asarray(prompts), new_bucket,
@@ -279,6 +307,7 @@ class BatchingEngine:
                 eos_id=first.eos_id, rng=jax.random.PRNGKey(first.seed),
                 prompt_lengths=jnp.asarray(lengths), cache=cache)
             new = np.asarray(jax.device_get(tokens))[:, prompt_bucket:]
+        t_g1 = time.perf_counter()
         self.batches_run += 1
         self.requests_batched += n
         for i, p in enumerate(group):
@@ -295,6 +324,18 @@ class BatchingEngine:
                     self._m_latency.observe(lat)
                     if lat > 0:
                         self._m_tps.observe(p.max_new / lat)
+                if p.wf is not None:
+                    # Reduced static ledger: a cold group charges the
+                    # whole generate wall to "compile" (the jit is not
+                    # separable from the run here); warm groups show it
+                    # as the "generate" phase. No decode trace — tokens
+                    # land together, TTFT == latency by construction.
+                    if new_shape:
+                        p.wf.note_compile(t_g0, t_g1)
+                    p.span.meta["waterfall"] = p.wf.finalize(p.span)
+                p.span.meta["max_new"] = p.max_new
+                p.span.meta["batch_size"] = n
+                self._emit_span(p.span)
             p.done.set()
 
     def _paged_group(self, batch_bucket: int):

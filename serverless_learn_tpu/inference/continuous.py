@@ -85,7 +85,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from serverless_learn_tpu.config import KVCacheConfig
+from serverless_learn_tpu.config import KVCacheConfig, WaterfallConfig
 from serverless_learn_tpu.inference import kvcache
 from serverless_learn_tpu.inference.batching import PROMPT_BUCKETS, _bucket
 from serverless_learn_tpu.inference.generate import init_cache
@@ -95,6 +95,8 @@ from serverless_learn_tpu.telemetry import (RATE_BUCKETS, SIZE_BUCKETS,
                                             Span, TraceContext, get_registry)
 from serverless_learn_tpu.telemetry import flight, goodput
 from serverless_learn_tpu.telemetry.tracing import node_name
+from serverless_learn_tpu.telemetry.waterfall import (BoundaryEvents,
+                                                      RequestWaterfall)
 
 
 def _wbucket(n: int) -> int:
@@ -157,6 +159,8 @@ class _Request:
     # instead of decoding an abandoned request to its full budget.
     cancelled: bool = False
     span: Optional[Span] = None  # request trace: submit/admit/first/done
+    wf: Optional[RequestWaterfall] = None  # round-21 lifecycle ledger
+    preempt_t: float = 0.0  # perf_counter at preemption (0 = not preempted)
     # ---- paged-mode scheduling state ----
     prefilling: bool = False   # mid chunked prefill (not yet decodable)
     prefill_pos: int = 0       # prompt tokens written (incl. shared prefix)
@@ -172,7 +176,8 @@ class ContinuousBatchingEngine:
     def __init__(self, module, params, max_slots: int = 8,
                  chunk_size: int = 32, pipeline_depth: int = 2,
                  max_top_k: int = 64, registry=None, event_log=None,
-                 kv: Optional[KVCacheConfig] = None):
+                 kv: Optional[KVCacheConfig] = None,
+                 waterfall: Optional[WaterfallConfig] = None):
         self.module = module
         self.params = params
         self.max_slots = max_slots
@@ -244,6 +249,15 @@ class ContinuousBatchingEngine:
         # arrival timing; 1 in normal service.
         self._min_admit = 1
         self.event_log = event_log
+        # ---- per-request waterfall ledger (round 21) ----
+        self.waterfall = waterfall if waterfall is not None \
+            else WaterfallConfig()
+        self._wf_events = BoundaryEvents(
+            window=self.waterfall.events_window)
+        self._wf_stall_m: Dict[str, object] = {}  # cause -> counter child
+        self._wf_decode_total = 0.0  # decode wall across finished requests
+        self._wf_steal_total = 0.0   # prefill_steal stall across same
+        self._last_decode_rows: tuple = ()  # compaction detection
         reg = registry or get_registry()
         self.registry = reg
         lbl = {"engine": "continuous"}
@@ -303,6 +317,17 @@ class ContinuousBatchingEngine:
         if self._paged:
             self._m_kv_total.set(self._pool.num_blocks)
             self._m_kv_in_use.set(0)
+        # Waterfall-fed serving attribution (round 21): harvest-granular
+        # inter-token latency, plus the prefill-interference share of
+        # decode wall-clock (chunked prefill's documented cost, finally
+        # measured instead of bounded).
+        self._m_itl = reg.histogram(
+            "slt_decode_itl_seconds",
+            "inter-token latency from the per-request decode trace", **lbl)
+        self._m_prefill_interf = reg.gauge(
+            "slt_prefill_interference_frac",
+            "fraction of decode wall-clock stalled by interleaved prefill "
+            "(waterfall prefill_steal attribution)", **lbl)
         # Dispatcher liveness stamp for the health engine: a wedged
         # dispatcher (poisoned device state, hung transfer) stops
         # advancing this while slots stay occupied — exactly the state
@@ -554,6 +579,7 @@ class ContinuousBatchingEngine:
                           parent_id=trace.span_id)
         else:
             r.span = Span("request")
+        r.wf = self._new_waterfall()
         self._m_requests.inc()
         self._m_prompt_tokens.observe(len(prompt))
         self._q.put(r)
@@ -588,6 +614,26 @@ class ContinuousBatchingEngine:
             self.event_log.emit(rec)
         flight.record(rec)
 
+    def _new_waterfall(self) -> Optional[RequestWaterfall]:
+        if not self.waterfall.enabled:
+            return None
+        w = self.waterfall
+        return RequestWaterfall(
+            engine="continuous", ewma_alpha=w.ewma_alpha,
+            stall_mult=w.stall_mult, min_stall_s=w.min_stall_s,
+            max_stall_events=w.max_stall_events,
+            max_gap_samples=w.max_gap_samples)
+
+    def _stall_counter(self, cause: str):
+        c = self._wf_stall_m.get(cause)
+        if c is None:
+            c = self.registry.counter(
+                "slt_decode_stall_seconds_total",
+                "decode stall seconds by attributed boundary-event cause",
+                cause=cause, engine="continuous")
+            self._wf_stall_m[cause] = c
+        return c
+
     def _cancel(self, r: _Request):
         """Retire an abandoned request: its submitter already returned."""
         r.finished = True
@@ -614,6 +660,11 @@ class ContinuousBatchingEngine:
         r.admit_seq = self._admit_counter
         self._admit_counter += 1
         self._slots[sid] = r
+        if r.wf is not None and r.preempt_t > 0.0:
+            # Close this request's preempt -> re-admission window; its
+            # next decode gap attributes to "preempt" through it.
+            r.wf.note_event("preempt", r.preempt_t, time.perf_counter())
+            r.preempt_t = 0.0
         if r.span is not None:
             r.span.mark("admit")
             wait = r.span.between(None, "admit")
@@ -663,6 +714,7 @@ class ContinuousBatchingEngine:
         # — that wall-clock is "compile" badput, not admission work.
         new_bucket = (nb, pb) not in self._admit_jits
         fn = self._admit_jit(nb, pb)
+        t_j0 = time.perf_counter()
         with goodput.phase("compile" if new_bucket else "admit"):
             self._state, tok0 = fn(self.params, self._state,
                                    jnp.asarray(prompts),
@@ -670,6 +722,12 @@ class ContinuousBatchingEngine:
                                    jnp.asarray(slot_ids), jnp.asarray(temp),
                                    jnp.asarray(topk), jnp.asarray(eos),
                                    jnp.asarray(seed))
+        if new_bucket:
+            t_j1 = time.perf_counter()
+            self._wf_events.note("compile", t_j0, t_j1)
+            for r in batch:
+                if r.wf is not None:
+                    r.wf.note_compile(t_j0, t_j1)
         try:
             tok0.copy_to_host_async()  # overlap the tunnel RTT (see chunk)
         except (AttributeError, RuntimeError):
@@ -721,6 +779,7 @@ class ContinuousBatchingEngine:
         shaped alert event so `slt doctor` can name the incident from
         telemetry alone (blocks exhausted -> admit_wait badput)."""
         self._m_kv_blocked.inc()
+        self._wf_events.note("kv_exhausted", time.perf_counter())
         now = time.time()
         if self._kv_alert_firing and now - self._last_kv_alert < 5.0:
             return
@@ -786,6 +845,10 @@ class ContinuousBatchingEngine:
         r.chunks_dispatched = 0
         r.tokens = []
         r.gen += 1  # in-flight futures from the old residency are void
+        r.preempt_t = time.perf_counter()
+        # Marker for EVERY in-flight decode trace: a preemption pauses
+        # the whole boundary, not just the victim.
+        self._wf_events.note("preempt", r.preempt_t)
         if r.span is not None:
             r.span.mark("preempt")
         staged.insert(0, r)
@@ -805,6 +868,7 @@ class ContinuousBatchingEngine:
         for _ in range(n):
             r = staged[0]
             sid = free[admitted]
+            t_a0 = time.perf_counter()
             L = len(r.prompt)
             pos0, shared, donor = 0, [], None
             if self._trie is not None:
@@ -844,6 +908,9 @@ class ContinuousBatchingEngine:
             staged.pop(0)
             r.prefilling = True
             r.prefill_pos = pos0
+            if r.wf is not None:
+                # Host-side admission work: trie lookup + page alloc.
+                r.wf.note_admit(t_a0, time.perf_counter())
             self._note_admitted(r, sid)
             if pos0 > 0:
                 self._m_kv_hits.inc()
@@ -920,6 +987,7 @@ class ContinuousBatchingEngine:
         key = (nb, T, W)
         new_bucket = key not in self._prefill_jits
         fn = self._paged_prefill_jit(nb, T, W)
+        t_j0 = time.perf_counter()
         with goodput.phase("compile" if new_bucket else "prefill"):
             self._state["pages"], self._state["vecs"], tok0 = fn(
                 self.params, self._state["pages"], self._state["vecs"],
@@ -929,8 +997,29 @@ class ContinuousBatchingEngine:
                 jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(eos),
                 jnp.asarray(seed), jnp.asarray(cow_src),
                 jnp.asarray(cow_dst))
+        t_j1 = time.perf_counter()
+        # Boundary events: in-flight decode traces see this window as a
+        # prefill-budget steal (or a new-bucket compile, which dominates
+        # whatever prefill rode along in it). Compile is an INTERVAL —
+        # the jit call blocks the dispatcher for the full compile wall.
+        # A warmed chunk is a 0-width MARKER: the call above only
+        # DISPATCHES (the device work lands asynchronously inside the
+        # victims' gap), so the marker claims the gap's residual rather
+        # than the meaninglessly-small dispatch interval.
+        if new_bucket:
+            self._wf_events.note("compile", t_j0, t_j1)
+        else:
+            self._wf_events.note("prefill_steal", t_j0)
         snapshot = []
         for i, (sid, r, tk) in enumerate(batch):
+            if r.wf is not None:
+                # First chunk starts at the prefix-cache hit position.
+                hit = r.prefill_pos if not r.wf.prefill_chunks else 0
+                r.wf.note_prefill_chunk(t_j0, t_j1, int(tk),
+                                        prefix_hit_tokens=hit,
+                                        compiled=new_bucket)
+                if new_bucket:
+                    r.wf.note_compile(t_j0, t_j1)
             r.prefill_pos += tk
             if fin[i]:
                 r.prefilling = False
@@ -993,10 +1082,22 @@ class ContinuousBatchingEngine:
         key = (nb, W)
         new_bucket = key not in self._chunk_jits
         fn = self._paged_chunk_jit(nb, W)
+        rows_now = tuple(rows)
+        if self._last_decode_rows and rows_now != self._last_decode_rows \
+                and not new_bucket:
+            # The live batch re-packed (retire/preempt/admit changed the
+            # row set): the host-side rebuild above is "compaction" time
+            # on in-flight decode traces. A bucket change is charged as
+            # compile instead — that's the dominant cost.
+            self._wf_events.note("compaction", time.perf_counter())
+        self._last_decode_rows = rows_now
+        t_j0 = time.perf_counter()
         with goodput.phase("compile" if new_bucket else "decode"):
             self._state["pages"], self._state["vecs"], toks = fn(
                 self.params, self._state["pages"], self._state["vecs"],
                 jnp.asarray(tbl_rows), jnp.asarray(live_arr))
+        if new_bucket:
+            self._wf_events.note("compile", t_j0, time.perf_counter())
         self.chunks_run += 1
         self._m_chunks.inc()
         self.decoded_rows_total += len(rows)
@@ -1016,7 +1117,13 @@ class ContinuousBatchingEngine:
 
     def _harvest(self, fut) -> None:
         kind, toks, snapshot = fut
+        t_h0 = time.perf_counter()
         arr = np.asarray(jax.device_get(toks))  # blocks; overlaps in-flight
+        t_now = time.perf_counter()
+        if t_now - t_h0 > 1e-4:
+            # The dispatcher sat blocked in this device_get: tokens of
+            # LATER in-flight futures stall behind it (harvest drain).
+            self._wf_events.note("harvest_drain", t_h0, t_now)
         if kind == "admit":
             arr = arr[:, None]  # [nb] -> [nb, 1], rows indexed by snapshot
             pairs = [(sid, r, arr[i]) for i, (sid, r)
@@ -1046,15 +1153,33 @@ class ContinuousBatchingEngine:
                     else:
                         self._slots[sid] = None
                 continue
-            if r.span is not None and "first_token" not in r.span.marks:
+            first = r.span is not None \
+                and "first_token" not in r.span.marks
+            if first:
                 r.span.mark("first_token")
                 ttft = r.span.between(None, "first_token")
                 if ttft is not None:
                     self._m_ttft.observe(ttft)
+            n_before = len(r.tokens)
             for t in row:
                 r.tokens.append(int(t))
                 if len(r.tokens) >= r.max_new:
                     break
+            if r.wf is not None:
+                if first:
+                    # Tokens delivered WITH the first one share its
+                    # arrival instant; the decode trace starts here.
+                    r.wf.first_token(t_now)
+                else:
+                    out = r.wf.note_decode(t_now, len(r.tokens) - n_before,
+                                           self._wf_events)
+                    if out is not None:
+                        itl_s, causes = out
+                        for _ in range(len(r.tokens) - n_before):
+                            self._m_itl.observe(itl_s)
+                        if causes:
+                            for cause, v in causes.items():
+                                self._stall_counter(cause).inc(v)
             # Retire on EOS exactly as generate fills: the EOS token is
             # kept, the remainder of the budget fills with EOS — the
             # static engine returned that fill too, so replies match.
@@ -1081,6 +1206,15 @@ class ContinuousBatchingEngine:
                         self._m_per_tok.observe(decode / (r.max_new - 1))
                     r.span.meta["max_new"] = r.max_new
                     r.span.meta["batch_size"] = r.peak_batch
+                    if r.wf is not None:
+                        r.span.meta["waterfall"] = r.wf.finalize(r.span)
+                        if decode is not None and decode > 0:
+                            self._wf_decode_total += decode
+                            self._wf_steal_total += \
+                                r.wf.stall_totals.get("prefill_steal", 0.0)
+                            self._m_prefill_interf.set(
+                                self._wf_steal_total
+                                / self._wf_decode_total)
                     self._emit_span(r.span)
                 if self._slots[sid] is r:
                     if self._paged:

@@ -44,7 +44,8 @@ class GenerationServer:
                  engine: str = "continuous", chunk_size: int = 32,
                  registry=None, metrics_port: Optional[int] = None,
                  event_log_path: Optional[str] = None,
-                 profile_dir: Optional[str] = None, kv=None):
+                 profile_dir: Optional[str] = None, kv=None,
+                 waterfall=None):
         from serverless_learn_tpu.config import KVCacheConfig
         from serverless_learn_tpu.telemetry import (JsonlEventLog,
                                                     get_registry)
@@ -74,7 +75,8 @@ class GenerationServer:
 
             self.engine = ContinuousBatchingEngine(
                 module, params, max_slots=max_batch, chunk_size=chunk_size,
-                registry=self.registry, event_log=self.event_log, kv=kv)
+                registry=self.registry, event_log=self.event_log, kv=kv,
+                waterfall=waterfall)
         elif engine == "static":
             # Round-4 group coalescer, kept for comparison benches.
             from serverless_learn_tpu.inference.batching import (
@@ -83,7 +85,9 @@ class GenerationServer:
             self.engine = BatchingEngine(module, params,
                                          max_batch=max_batch,
                                          batch_wait_ms=batch_wait_ms,
-                                         registry=self.registry, kv=kv)
+                                         registry=self.registry, kv=kv,
+                                         event_log=self.event_log,
+                                         waterfall=waterfall)
         else:
             raise ValueError(f"unknown engine {engine!r}: "
                              "expected 'continuous' or 'static'")

@@ -225,9 +225,24 @@ def speculative_generate(
             if hits.size:
                 new[b, hits[0]:] = eos_id
     rounds = int(jax.device_get(rounds))
+    accepted_np = np.asarray(jax.device_get(accepted), np.float64)
     drafted = np.asarray(jax.device_get(drafted), np.float64)
-    acc = float(np.mean(np.asarray(jax.device_get(accepted))
-                        / np.maximum(drafted, 1)))
+    acc = float(np.mean(accepted_np / np.maximum(drafted, 1)))
+    # Draft economics on the wire (round 21): the accept rate is the
+    # single knob that decides whether the draft model pays for itself,
+    # and the token counters let `slt top` derive it over any window.
+    from serverless_learn_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    reg.gauge("slt_spec_accept_rate",
+              "mean accepted-draft fraction of the last speculative "
+              "generate call").set(acc)
+    reg.counter("slt_spec_draft_tokens_total",
+                "tokens proposed by the draft model").inc(
+                    float(drafted.sum()))
+    reg.counter("slt_spec_verified_tokens_total",
+                "draft tokens accepted by the target verify pass").inc(
+                    float(accepted_np.sum()))
     tokens = np.concatenate([np.asarray(jax.device_get(prompt)), new],
                             axis=1)
     return jnp.asarray(tokens), {"acceptance": acc, "rounds": rounds}

@@ -67,14 +67,17 @@ from serverless_learn_tpu.telemetry.tracing import (TraceContext,
                                                     current_context,
                                                     init_tracing,
                                                     parse_traceparent)
+from serverless_learn_tpu.telemetry.waterfall import (BoundaryEvents,
+                                                      RequestWaterfall)
 
 __all__ = [
     "LATENCY_BUCKETS", "RATE_BUCKETS", "SIZE_BUCKETS",
-    "Alert", "Counter", "Gauge", "HealthEngine", "Histogram",
-    "JsonlEventLog", "MetricsRegistry", "MetricsExporter", "PhaseLedger",
-    "Span", "TraceContext", "current_context", "fetch_text", "get_ledger",
-    "get_registry", "init_tracing", "parse_traceparent", "phase",
-    "publish_rpc_stats", "score_stragglers",
+    "Alert", "BoundaryEvents", "Counter", "Gauge", "HealthEngine",
+    "Histogram", "JsonlEventLog", "MetricsRegistry", "MetricsExporter",
+    "PhaseLedger", "RequestWaterfall", "Span", "TraceContext",
+    "current_context", "fetch_text", "get_ledger", "get_registry",
+    "init_tracing", "parse_traceparent", "phase", "publish_rpc_stats",
+    "score_stragglers",
 ]
 
 

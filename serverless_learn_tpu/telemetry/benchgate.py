@@ -62,6 +62,11 @@ ATTRIBUTION_COLUMNS = {
     # first, long before a loss curve could.
     "diloco_round_wait_s": ("min", 0.25, "rel"),
     "dcn_bytes_per_round": ("min", 0.10, "rel"),
+    # Request waterfalls (round 21): the fraction of decode wall-clock
+    # stalled by interleaved prefill rides the serve_itl_p99_ms rows —
+    # it regresses UP (chunked prefill stealing more decode time) and
+    # is the first place a prefill-budget misconfiguration shows.
+    "prefill_interference_frac": ("min", 0.10),
 }
 
 

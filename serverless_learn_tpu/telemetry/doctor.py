@@ -499,6 +499,39 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
             verdict_bits.append(
                 f"quantized DCN exchange ({consumer}): {ratio:.1f}x "
                 f"fewer bytes over {agg['n']} transfer(s)")
+    # Request waterfalls (round 21): request-span records carry the
+    # per-request decode ledger (telemetry/waterfall.py) — aggregate its
+    # attributed stall seconds per node so the verdict NAMES the
+    # dominant cause ("decode stalls on n0: compile, 63% of 1.2s") from
+    # the JSONL alone, no live scrape or `slt waterfall` run needed.
+    wf_stalls: Dict[str, Dict[str, float]] = {}
+    wf_reqs: Dict[str, int] = {}
+    for rec in records:
+        if rec.get("event") != "span" or not isinstance(
+                rec.get("waterfall"), dict):
+            continue
+        node = rec.get("node") or "?"
+        wf_reqs[node] = wf_reqs.get(node, 0) + 1
+        per = wf_stalls.setdefault(node, {})
+        for cause, v in (rec["waterfall"].get("stall_s") or {}).items():
+            per[cause] = per.get(cause, 0.0) + float(v)
+    waterfall_rows: List[dict] = []
+    for node in sorted(wf_stalls):
+        per = wf_stalls[node]
+        total = sum(per.values())
+        if total <= 0.0:
+            continue
+        dom = max(per, key=per.get)
+        waterfall_rows.append(
+            {"node": node, "requests": wf_reqs.get(node, 0),
+             "stall_s": {c: round(v, 6) for c, v in sorted(
+                 per.items(), key=lambda kv: -kv[1])},
+             "dominant_cause": dom})
+        if total >= 0.05:
+            verdict_bits.append(
+                f"decode stalls on {node}: dominant cause {dom} "
+                f"({per[dom] / total * 100:.0f}% of {total:.3f}s over "
+                f"{wf_reqs.get(node, 0)} request(s))")
     # Step-interior hardware attribution (round 16): xray summaries —
     # from capture-meta.json records in the event trail and from capture
     # dirs handed to --xray — put a NAME on the training plateau ("step
@@ -558,6 +591,7 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
         "alerts": ranked,
         "stragglers": stragglers,
         "goodput": goodput_by_node,
+        "waterfall": waterfall_rows,
         "xray": xray_rows,
         "flight_dumps": collected["dumps"],
         "bench": bench,

@@ -12,6 +12,10 @@
     GET /numerics                  training-quality stats: the numerics
                                    auditor's newest per-subtree summary
                                    + recent step records (round 17)
+    GET /stalls                    the waterfall ledger's live rollup:
+                                   ITL percentiles, per-cause decode
+                                   stall totals, prefill interference,
+                                   speculative accept rate (round 21)
     GET /debug/profile?seconds=N   capture a jax.profiler device trace
                                    (armed by --profile-dir on ANY role)
 
@@ -42,8 +46,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
-from serverless_learn_tpu.telemetry.registry import (MetricsRegistry,
-                                                     get_registry)
+from serverless_learn_tpu.telemetry.registry import (
+    MetricsRegistry, get_registry, percentile_from_buckets)
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 # Kept as the endpoint's documented bound; the value lives with the
@@ -102,6 +106,8 @@ class MetricsExporter:
                         self._reply_json(200, exporter._goodput())
                     elif path == "/numerics":
                         self._reply_json(200, exporter._numerics())
+                    elif path == "/stalls":
+                        self._reply_json(200, exporter._stalls())
                     elif path == "/debug/profile":
                         code, obj = exporter._profile(
                             parse_qs(url.query),
@@ -185,6 +191,54 @@ class MetricsExporter:
 
         try:
             return numerics.endpoint_payload()
+        except Exception as e:
+            return {"enabled": False,
+                    "error": f"{type(e).__name__}: {e}"}
+
+    # -- decode stalls ------------------------------------------------------
+
+    def _stalls(self) -> dict:
+        """The /stalls body (round 21): what the waterfall ledger has
+        aggregated in THIS process — ITL percentiles from the decode
+        trace, decode-stall seconds by attributed cause (worst first),
+        the prefill-interference gauge, and the speculative-decoding
+        accept rate when a draft model is running. `slt waterfall` gives
+        the same decomposition per request from the event logs; this is
+        the always-on fleet-scrapable rollup."""
+        try:
+            snap = self.registry.snapshot()
+            itl = None
+            fam = snap.get("slt_decode_itl_seconds")
+            if fam and fam.get("series"):
+                s = fam["series"][0]
+                itl = {"count": s.get("count"),
+                       "mean_s": (s["sum"] / s["count"]
+                                  if s.get("count") else None),
+                       "p50_s": percentile_from_buckets(
+                           s["buckets"], s["cumulative"], 0.50),
+                       "p95_s": percentile_from_buckets(
+                           s["buckets"], s["cumulative"], 0.95),
+                       "p99_s": percentile_from_buckets(
+                           s["buckets"], s["cumulative"], 0.99)}
+            stalls = {}
+            fam = snap.get("slt_decode_stall_seconds_total")
+            for s in (fam or {}).get("series", []):
+                cause = s.get("labels", {}).get("cause", "?")
+                stalls[cause] = stalls.get(cause, 0.0) + float(
+                    s.get("value") or 0.0)
+            stalls = dict(sorted(stalls.items(), key=lambda kv: -kv[1]))
+
+            def _gauge(name):
+                f = snap.get(name)
+                if f and f.get("series"):
+                    return f["series"][0].get("value")
+                return None
+
+            return {"enabled": itl is not None or bool(stalls),
+                    "itl": itl, "stall_s": stalls,
+                    "prefill_interference_frac": _gauge(
+                        "slt_prefill_interference_frac"),
+                    "spec_accept_rate": _gauge("slt_spec_accept_rate")}
         except Exception as e:
             return {"enabled": False,
                     "error": f"{type(e).__name__}: {e}"}

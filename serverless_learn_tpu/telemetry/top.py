@@ -428,6 +428,39 @@ def render(states: List[EndpointState]) -> str:
         lines += _table(["endpoint", "rounds", "part",
                          "rwait p50/p95 ms", "trend", "quar",
                          "compression"], diloco_rows)
+    # ITL/STALLS pane (round 21): the waterfall ledger's live view —
+    # inter-token latency percentiles from the per-request decode trace,
+    # the per-cause stall totals (worst first), prefill interference,
+    # and the speculative accept rate when a draft model is running.
+    # Endpoints without the ledger (slt_decode_itl_seconds absent) skip
+    # the pane.
+    itl_rows: List[List[str]] = []
+    for st in states:
+        ih = st.hist("slt_decode_itl_seconds")
+        if not (ih and ih.get("count")):
+            continue
+        stalls = sorted(st.labeled("slt_decode_stall_seconds_total"),
+                        key=lambda lv: -lv[1])
+        stall_col = " ".join(
+            f"{lab.get('cause', '?')}={v:.2f}s"
+            for lab, v in stalls[:3] if v > 0) or "-"
+        interf = st.val("slt_prefill_interference_frac")
+        acc = st.val("slt_spec_accept_rate")
+        itl_rows.append([
+            st.addr,
+            _ms(_p(ih, 0.5)) + "/" + _ms(_p(ih, 0.95)) + "/"
+            + _ms(_p(ih, 0.99)),
+            _num(ih.get("count"), 0),
+            stall_col,
+            "-" if interf is None else _pct(interf),
+            "-" if acc is None else _pct(acc),
+        ])
+    if itl_rows:
+        lines.append("")
+        lines.append("  ITL/STALLS")
+        lines += _table(["endpoint", "itl p50/p95/p99 ms", "gaps",
+                         "top stalls", "prefill interf", "spec acc"],
+                        itl_rows)
     # HW pane (round 16): the step-interior view — HBM watermarks,
     # exposed-collective share and the xray verdict from the newest
     # capture (/goodput's xray section), plus per-consumer effective DCN
