@@ -450,6 +450,11 @@ DEFAULT_MODULES = (
     # at attribution time); RequestWaterfall itself is request-owned and
     # instrumentation keeps that ownership discipline honest.
     "serverless_learn_tpu.telemetry.waterfall",
+    # round 22: fleetscope itself is pure log analysis (no shared
+    # state), but instrumenting it keeps that purity honest — the
+    # replay simulator must never grow hidden module-level caches that
+    # two concurrent reports could tear.
+    "serverless_learn_tpu.telemetry.fleetscope",
 )
 
 

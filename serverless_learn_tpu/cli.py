@@ -866,6 +866,18 @@ def cmd_loadgen(args) -> int:
             history_path=args.history if args.record else None)
         print(json.dumps(rep, indent=None if args.compact else 2))
         return 0 if rep["ok"] else 1
+    if args.fleetscope_smoke:
+        # Round-22 acceptance run: the redundancy is injected BY
+        # CONSTRUCTION (one stub replica pre-warmed with the shared
+        # prefix, least-loaded spreading the rest); exit 0 iff the
+        # router's live counters + route_decision stream account it,
+        # digests snapshot, and prefix-aware replay strictly beats the
+        # recorded picks with byte-identical same-log reports.
+        rep = loadgen.run_fleetscope_smoke(
+            seed=args.seed,
+            history_path=args.history if args.record else None)
+        print(json.dumps(rep, indent=None if args.compact else 2))
+        return 0 if rep["ok"] else 1
     if args.kv_smoke:
         # Round-13 serving headline: same seeded shared-prefix workload
         # at the same offered load vs the paged and monolithic engines;
@@ -1483,6 +1495,47 @@ def cmd_waterfall(args) -> int:
     return 1 if bad else 0
 
 
+def cmd_fleetscope(args) -> int:
+    """Fleet-wide KV/prefix redundancy accounting + counterfactual
+    routing replay (telemetry/fleetscope.py): merge router
+    ``route_decision`` events, ``fleet_digest`` snapshots and the
+    round-21 request waterfalls, then print the redundancy accounting
+    (redundant-prefill fraction, residency-spread histogram, affinity
+    effectiveness) and the deterministic policy replay — recorded vs
+    least-loaded vs prefix-aware vs prefill/decode split — with the
+    TTFT-p99 bound and prefill-compute savings."""
+    from serverless_learn_tpu.telemetry import fleetscope
+
+    if args.self_check:
+        rep = fleetscope.self_check(fixture_path=args.fixture)
+        print(json.dumps(rep, indent=None if args.compact else 2))
+        return 0 if rep["ok"] else 1
+    if not args.paths:
+        print("fleetscope needs router event logs (--events-log JSONL "
+              "with route_decision records, or dirs of them) or "
+              "--self-check", file=sys.stderr)
+        return 2
+    try:
+        rep = fleetscope.report(args.paths)
+    except (FileNotFoundError, OSError, ValueError) as e:
+        print(f"fleetscope: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    if args.bench_history:
+        from serverless_learn_tpu.utils.benchlog import record
+
+        for row in fleetscope.bench_rows(rep,
+                                         device_kind=args.device_kind):
+            record(row, args.bench_history, better="min",
+                   rel_threshold=0.25,
+                   key_fields=("metric", "device_kind"))
+    if args.json:
+        print(json.dumps(rep, sort_keys=True,
+                         indent=None if args.compact else 2))
+    else:
+        print(fleetscope.render(rep))
+    return 0 if rep["summary"]["primary_decisions"] > 0 else 1
+
+
 def cmd_bench(args) -> int:
     """Headline benchmark + the perf regression gate. `--gate` compares
     against bench_history.json with the noise-aware threshold
@@ -2070,6 +2123,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "TTFT/stall decompositions sum and the ledger "
                          "overhead stays under 2%% of decode wall-clock; "
                          "--record appends serve_itl/ttft rows")
+    lg.add_argument("--fleetscope-smoke", action="store_true",
+                    help="fleet-redundancy acceptance run: 3 stub "
+                         "replicas with real paged prefix caches, one "
+                         "pre-warmed with the shared prefix, prefix-heavy "
+                         "closed-loop load through a real router; exit 0 "
+                         "iff live redundancy counters fire, fleet_digest "
+                         "snapshots appear, prefix-aware replay beats the "
+                         "recorded stream strictly, and same-log reports "
+                         "are byte-identical; --record appends the "
+                         "fleetscope_smoke_p99_ms row with redundancy "
+                         "attribution columns")
     lg.add_argument("--kv-smoke", action="store_true",
                     help="paged-KV serving headline: seeded shared-prefix "
                          "+ long-prompt workload at fixed offered load vs "
@@ -2384,6 +2448,38 @@ def build_parser() -> argparse.ArgumentParser:
                          "provenance, reserved spec_verify phase) "
                          "intact; exit 1 on drift")
     wf.set_defaults(fn=cmd_waterfall)
+
+    fsc = sub.add_parser("fleetscope",
+                         help="fleet-wide KV/prefix redundancy accounting"
+                              " + counterfactual routing replay from "
+                              "router route_decision event logs")
+    fsc.add_argument("paths", nargs="*", metavar="EVENTS",
+                     help="JSONL event logs (router --events-log output) "
+                          "or directories of them; route_decision, "
+                          "fleet_digest and request-span records merge")
+    fsc.add_argument("--json", action="store_true",
+                     help="full JSON report (sorted keys — byte-identical"
+                          " for identical logs) instead of the rendering")
+    fsc.add_argument("--compact", action="store_true",
+                     help="single-line JSON (for scripts)")
+    fsc.add_argument("--device-kind", default="cpu",
+                     help="device-kind stamp for --bench-history rows")
+    fsc.add_argument("--bench-history", metavar="FILE", default=None,
+                     help="append the fleetscope_ttft_p99_ms row (with "
+                          "fleet_redundant_prefill_frac / "
+                          "fleet_prefix_dup_factor attribution columns) "
+                          "to this bench history for `slt bench --gate`")
+    fsc.add_argument("--fixture", metavar="FILE", default=None,
+                     help="committed fixture JSONL for --self-check "
+                          "(default: the embedded synthetic records)")
+    fsc.add_argument("--self-check", action="store_true",
+                     help="CI smoke: the fabricated 3-replica fixture "
+                          "survives read->account->replay with exact "
+                          "redundancy accounting, strict prefix-aware "
+                          "improvement, byte-identical reports and a "
+                          "TTFT bound below the recorded p99; exit 1 on "
+                          "drift")
+    fsc.set_defaults(fn=cmd_fleetscope)
 
     bn = sub.add_parser("bench",
                         help="headline benchmark + perf regression gate "

@@ -443,6 +443,16 @@ class KVCacheConfig:
     # Max blocks the prefix trie may pin after their owners retire
     # (0 = auto: num_blocks // 4). LRU-evicted under pool pressure.
     prefix_cache_blocks: int = 0
+    # ---- fleetscope digests (round 22) ----
+    # kv_stats' prefix_hit_rate is windowed over the last N lookups so
+    # router picking tracks traffic shifts (the lifetime average rides
+    # along under prefix_hit_rate_lifetime).
+    prefix_hit_window: int = 256
+    # Resident-prefix digest caps shipped on replica pings: hottest
+    # prefixes reported, and max chain hashes per digest (shallow-first,
+    # so a truncated digest under-counts redundancy, never inflates it).
+    digest_top_k: int = 8
+    digest_hashes: int = 64
 
 
 @dataclass(frozen=True)

@@ -752,6 +752,167 @@ def run_waterfall_smoke(seed: int = 0, events_path: Optional[str] = None,
     return out
 
 
+def run_fleetscope_smoke(seed: int = 0, n_requests: int = 48,
+                         concurrency: int = 6, prefix_len: int = 128,
+                         events_path: Optional[str] = None,
+                         history_path: Optional[str] = None) -> dict:
+    """The fleetscope acceptance proof (round 22), measured not
+    asserted: a 3-replica stub fleet whose engines own REAL paged prefix
+    caches (:class:`KVStubEngine`), a prefix-heavy seeded workload, and
+    the redundancy injected BY CONSTRUCTION — one replica is pre-warmed
+    with the shared system prefix directly (bypassing the router), so
+    when least-loaded routing then spreads the measured phase across the
+    fleet, every pick that lands elsewhere re-prefills tokens that are
+    provably resident one hop away. The router's JSONL event log alone
+    must then tell the whole story:
+
+    * live accounting: ``slt_fleet_redundant_prefill_tokens_total`` > 0
+      and the route_decision stream carries candidate provenance;
+    * ``fleet_digest`` snapshots appear as ping digests change;
+    * counterfactual replay: prefix-aware picks report STRICTLY fewer
+      redundant tokens than the recorded least-loaded stream;
+    * determinism: two reports over the same log are byte-identical.
+
+    The client p99 row lands in bench history carrying
+    ``fleet_redundant_prefill_frac`` + ``fleet_prefix_dup_factor`` as
+    attribution columns, gated by ``slt bench --gate``."""
+    import os
+    import tempfile
+
+    from serverless_learn_tpu.config import FleetConfig
+    from serverless_learn_tpu.fleet.router import FleetRouter
+    from serverless_learn_tpu.fleet.testing import KVStubEngine, stub_server
+    from serverless_learn_tpu.telemetry import fleetscope as fs_mod
+    from serverless_learn_tpu.telemetry.registry import (JsonlEventLog,
+                                                         MetricsRegistry)
+
+    own_tmp = events_path is None
+    if own_tmp:
+        fd, events_path = tempfile.mkstemp(suffix=".jsonl",
+                                           prefix="slt-fleetscope-")
+        os.close(fd)
+    log = JsonlEventLog(events_path)
+    registry = MetricsRegistry()
+    servers = [stub_server(engine=KVStubEngine(
+        num_blocks=256, block_size=16, latency_s=0.01))
+        for _ in range(3)]
+    probe_s = 0.05
+    cfg = FleetConfig(max_inflight=256, health_interval_s=probe_s,
+                      dead_after_probes=5, hedge_min_delay_s=5.0)
+    router = FleetRouter(config=cfg, host="127.0.0.1", port=0,
+                         replicas=tuple(s.addr for s in servers),
+                         registry=registry, emit=log.emit).start()
+    rng = random.Random(f"fleetscope-{seed}")
+    prefix = [rng.randrange(1, 100) for _ in range(prefix_len)]
+
+    def make(i: int) -> dict:
+        req = {"prompt": list(prefix)
+               + [rng.randrange(1, 100) for _ in range(16)],
+               "max_new_tokens": 4, "seed": rng.randrange(997)}
+        if i % 3 == 0:
+            req["session"] = f"sess-{i % 4}"
+        return req
+
+    try:
+        # Injected redundancy: ONE replica (and only one) holds the
+        # shared prefix before any routed traffic — sent direct, so the
+        # router's decision stream stays purely the measured phase.
+        _one_request(servers[0].addr,
+                     {"prompt": list(prefix), "max_new_tokens": 1},
+                     timeout_s=10.0)
+        time.sleep(probe_s * 4)  # let pings carry the digest in
+        out = run_closed_loop(router.addr, concurrency, n_requests,
+                              seed=seed, make_request=make,
+                              timeout_s=20.0)
+        time.sleep(probe_s * 4)  # final digests -> dup-factor gauge
+    finally:
+        router.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        log.close()
+
+    snap = registry.snapshot()
+
+    def _val(name):
+        fam = snap.get(name) or {}
+        return sum(s.get("value", 0) for s in fam.get("series", []))
+
+    rep = fs_mod.report([events_path])
+    rep2 = fs_mod.report([events_path])
+    summary = rep["summary"]
+    base = rep["replay"]["recorded"]
+    pa = rep["replay"]["prefix_aware"]
+    checks = []
+
+    def check(name, ok, detail):
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+
+    check("no_hard_failures", out["hard_failures"] == 0
+          and out["ok"] == out["sent"] and out["sent"] == n_requests,
+          {k: out[k] for k in ("sent", "ok", "shed", "hard_failures")})
+    check("decision_stream",
+          summary["primary_decisions"] == n_requests,
+          f"{summary['primary_decisions']} primary decisions for "
+          f"{n_requests} requests")
+    check("live_redundancy_counter",
+          _val("slt_fleet_redundant_prefill_tokens_total") > 0,
+          f"slt_fleet_redundant_prefill_tokens_total="
+          f"{_val('slt_fleet_redundant_prefill_tokens_total')}")
+    check("recorded_redundancy_nonzero",
+          summary["redundant_prefill_frac"] > 0.0,
+          f"redundant frac {summary['redundant_prefill_frac']} "
+          f"({summary['redundant_prefill_tokens']} of "
+          f"{summary['routed_prompt_tokens']} tokens)")
+    check("digest_snapshots",
+          bool(summary.get("digests")),
+          f"fleet_digest replicas: {sorted(summary.get('digests') or ())}")
+    check("picks_spread", len(base["picks"]) >= 2,
+          f"recorded picks across {len(base['picks'])} replicas")
+    check("prefix_aware_strictly_lower",
+          pa["redundant_prefill_tokens"]
+          < base["redundant_prefill_tokens"],
+          f"prefix_aware {pa['redundant_prefill_tokens']} < recorded "
+          f"{base['redundant_prefill_tokens']} redundant tokens")
+    check("byte_identical_reports",
+          json.dumps(rep, sort_keys=True)
+          == json.dumps(rep2, sort_keys=True),
+          "same-log reports byte-identical")
+    rows = []
+    if out.get("p99_ms") is not None:
+        rows.append({
+            "metric": "fleetscope_smoke_p99_ms", "value": out["p99_ms"],
+            "unit": "ms", "device_kind": "fleet-stub",
+            "concurrency": concurrency,
+            "fleet_redundant_prefill_frac":
+                summary["redundant_prefill_frac"],
+            "fleet_prefix_dup_factor": summary["prefix_dup_factor"],
+            "prefix_aware_redundant_tokens":
+                pa["redundant_prefill_tokens"]})
+    if history_path:
+        from serverless_learn_tpu.utils.benchlog import record
+
+        for row in rows:
+            record(row, history_path, better="min", rel_threshold=0.5,
+                   key_fields=("metric", "device_kind"))
+    result = {"ok": all(c["ok"] for c in checks), "checks": checks,
+              "client": out, "summary": summary,
+              "replay": rep["replay"], "bench_rows": rows,
+              "router": {
+                  "redundant_prefill_tokens_total":
+                      _val("slt_fleet_redundant_prefill_tokens_total"),
+                  "routed_prompt_tokens_total":
+                      _val("slt_fleet_routed_prompt_tokens_total"),
+                  "prefix_dup_factor":
+                      _val("slt_fleet_prefix_dup_factor")},
+              "events_path": None if own_tmp else events_path}
+    if own_tmp:
+        os.unlink(events_path)
+    return result
+
+
 # -- the CI smoke ------------------------------------------------------------
 
 

@@ -49,6 +49,7 @@ Replica state machine (docs/ARCHITECTURE.md has the full table)::
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import json
 import queue
@@ -99,6 +100,12 @@ class Replica:
         # replica reports them; monolithic replicas never do.
         self.kv_free_frac: Optional[float] = None
         self.prefix_hit_rate: Optional[float] = None
+        # Resident-prefix digest from the ping (round 22): the chain
+        # hashes of the replica's PrefixTrie nodes, intersected against
+        # each routed prompt for fleet-wide redundancy accounting.
+        self.digest_hashes: frozenset = frozenset()
+        self.digest_block_size: int = 0
+        self.digest_top: List[dict] = []
 
     def note_latency(self, s: float, keep: int = 128):
         self.latencies.append(s)
@@ -212,6 +219,25 @@ class FleetRouter:
             "slt_router_hedge_wasted_seconds_total",
             "upstream seconds burned by losing hedge attempts (duplicate "
             "work the race discarded)")
+        # ---- fleetscope redundancy accounting (round 22) ----
+        self._m_redundant_tokens = reg.counter(
+            "slt_fleet_redundant_prefill_tokens_total",
+            "prompt tokens the picked replica will prefill while already "
+            "resident in another eligible replica's prefix cache")
+        self._m_prompt_tokens = reg.counter(
+            "slt_fleet_routed_prompt_tokens_total",
+            "prompt tokens routed (the redundancy fraction's denominator)")
+        self._g_redundant_frac = reg.gauge(
+            "slt_fleet_redundant_prefill_frac",
+            "running fraction of routed prompt tokens re-prefilled while "
+            "resident elsewhere in the fleet")
+        self._g_dup_factor = reg.gauge(
+            "slt_fleet_prefix_dup_factor",
+            "mean replicas holding each fleet-resident prefix chunk "
+            "(1.0 = no duplication; 0 when no digests reported)")
+        self._decision_seq = 0
+        self._redundant_tokens_sum = 0
+        self._prompt_tokens_sum = 0
 
         for addr in replicas:
             self.add_replica(addr, static=True)
@@ -337,6 +363,22 @@ class FleetRouter:
                                  f"replica {r.addr} answering again",
                                  r.addr)
         self._g_kv_free.set(self._kv_pressure())
+        self._g_dup_factor.set(round(self._prefix_dup_factor(), 4))
+
+    def _prefix_dup_factor(self) -> float:
+        """Mean number of replicas holding each prefix chunk resident
+        anywhere in the fleet (from the ping digests). 1.0 means every
+        cached prefix lives on exactly one replica; 2.0 means the
+        average chunk burns double its KV memory fleet-wide."""
+        with self._lock:
+            sets = [r.digest_hashes for r in self._replicas.values()
+                    if r.digest_hashes]
+        if not sets:
+            return 0.0
+        counts: collections.Counter = collections.Counter()
+        for s in sets:
+            counts.update(s)
+        return sum(counts.values()) / len(counts)
 
     def _kv_pressure(self) -> float:
         """Min free KV-block fraction across the eligible set; 1.0 when
@@ -360,10 +402,39 @@ class FleetRouter:
                 # Under _lock like every other Replica-field mutation:
                 # _pick/_kv_pressure read these mid-iteration and a torn
                 # probe write could shed on a half-updated fraction.
+                changed = None
                 with self._lock:
                     r.kv_free_frac = (kv.get("blocks_free", 0)
                                       / max(kv["blocks_total"], 1))
                     r.prefix_hit_rate = kv.get("prefix_hit_rate")
+                    dg = kv.get("prefix_digest")
+                    if isinstance(dg, dict):
+                        new = frozenset(
+                            h for h in (dg.get("hashes") or ())
+                            if isinstance(h, str))
+                        if new != r.digest_hashes:
+                            changed = dg
+                        r.digest_hashes = new
+                        r.digest_block_size = int(
+                            dg.get("block_size") or 0)
+                        r.digest_top = list(dg.get("top") or ())
+                if changed is not None:
+                    # fleet_digest snapshot for slt fleetscope/doctor —
+                    # only when the resident set actually moved, so a
+                    # quiet fleet costs zero event volume.
+                    try:
+                        self._emit({
+                            "event": "fleet_digest", "replica": r.addr,
+                            "t_unix_s": time.time(),
+                            "block_size": int(
+                                changed.get("block_size") or 0),
+                            "blocks": int(changed.get("blocks") or 0),
+                            "hashes": sorted(
+                                h for h in (changed.get("hashes") or ())
+                                if isinstance(h, str)),
+                            "top": list(changed.get("top") or ())})
+                    except Exception:
+                        pass
         except (OSError, ValueError) as e:
             return False, False, f"{type(e).__name__}: {e}"
         if r.metrics_addr:
@@ -609,12 +680,18 @@ class FleetRouter:
                     # rejecting it instantly is what keeps the queue
                     # short for traffic that matters.
                     self._m_shed.inc()
+                    self._note_decision(req, [], None, session, hop,
+                                        reason="shed_brownout",
+                                        account=False)
                     self._emit_hop(hop, t_start, shed=True)
                     return _overload_reply(
                         f"brownout at {self._inflight}/{cap} in flight")
                 remaining = deadline - self.clock()
                 if remaining <= 0:
                     self._m_shed.inc()
+                    self._note_decision(req, [], None, session, hop,
+                                        reason="shed_queue_full",
+                                        account=False)
                     self._emit_hop(hop, t_start, shed=True)
                     return _overload_reply(
                         f"queue full ({cap} in flight, waited "
@@ -631,6 +708,8 @@ class FleetRouter:
                 self._g_inflight.set(self._inflight)
                 self._adm_cv.notify()
             self._m_shed.inc()
+            self._note_decision(req, [], None, session, hop,
+                                reason="shed_kv_pressure", account=False)
             self._emit_hop(hop, t_start, shed=True)
             return _overload_reply(
                 f"fleet KV pool pressure (free frac < "
@@ -694,6 +773,106 @@ class FleetRouter:
             hop["hedge_cancel_s"] = round(cancel, 6)
         self._emit(hop)
 
+    # -- route-decision provenance (round 22) --------------------------------
+
+    # Prompt chunks hashed per decision — bounds the per-request hashing
+    # cost and the event size for pathological prompts.
+    _PROMPT_HASH_CAP = 128
+
+    def _new_decision_id(self, trace_id: str) -> str:
+        with self._lock:
+            self._decision_seq += 1
+            seq = self._decision_seq
+        return f"{trace_id[:16]}-{seq}"
+
+    def _note_decision(self, req: dict, candidates: List[Replica],
+                       pick: Optional[Replica], session: Optional[str],
+                       hop: Optional[dict], reason: str,
+                       account: bool = True, parent: Optional[str] = None,
+                       exclude=frozenset()) -> Optional[str]:
+        """Emit one structured ``route_decision`` record and (for primary
+        picks) account fleet-wide redundant prefill.
+
+        The record carries the full candidate set with per-replica scores
+        (load, KV pressure bucket, windowed prefix hit rate, resident
+        prompt tokens per the ping digests) plus the prompt's chain
+        hashes — everything ``slt fleetscope`` needs to re-score the
+        decision under a counterfactual policy offline. ``redundant
+        prefill`` for a decision is the prompt tokens the PICK must
+        prefill that some other eligible replica already holds resident:
+        ``max(0, best_other_resident - pick_resident)``. Digests are
+        probe-lagged and truncated shallow-first, so the accounting
+        UNDER-counts; it never fabricates redundancy."""
+        from serverless_learn_tpu.inference.kvcache import chunk_hashes
+
+        trace_id = hop.get("trace_id", "") if hop else ""
+        did = parent or self._new_decision_id(trace_id)
+        prompt = req.get("prompt")
+        n_prompt = len(prompt) if isinstance(prompt, (list, tuple)) else 0
+        with self._lock:
+            bs = next((r.digest_block_size for r in candidates
+                       if r.digest_block_size), 0)
+            hxs: List[str] = []
+            if bs and n_prompt:
+                hxs = chunk_hashes(
+                    prompt[:bs * self._PROMPT_HASH_CAP], bs)
+            cand_rows = []
+            resident: Dict[str, int] = {}
+            for r in candidates:
+                run = 0
+                if hxs and r.digest_hashes:
+                    for h in hxs:
+                        if h not in r.digest_hashes:
+                            break
+                        run += 1
+                resident[r.addr] = run * bs
+                cand_rows.append({
+                    "addr": r.addr, "state": r.state,
+                    "inflight": r.inflight,
+                    "kv_pressure_bucket": (
+                        None if r.kv_free_frac is None else
+                        int((1.0 - max(0.0, min(1.0, r.kv_free_frac)))
+                            * 5.0)),
+                    "prefix_hit_rate": r.prefix_hit_rate,
+                    "resident_tokens": run * bs,
+                    "eligible": r.addr not in exclude})
+        spread = sum(1 for v in resident.values() if v > 0)
+        red = 0
+        if account and pick is not None and n_prompt:
+            best_other = max(
+                (v for a, v in resident.items() if a != pick.addr),
+                default=0)
+            red = max(0, min(best_other, n_prompt)
+                      - resident.get(pick.addr, 0))
+            with self._lock:
+                self._prompt_tokens_sum += n_prompt
+                self._redundant_tokens_sum += red
+                frac = (self._redundant_tokens_sum
+                        / max(1, self._prompt_tokens_sum))
+            self._m_prompt_tokens.inc(n_prompt)
+            if red:
+                self._m_redundant_tokens.inc(red)
+            self._g_redundant_frac.set(round(frac, 4))
+        rec = {"event": "route_decision", "decision_id": did,
+               "trace_id": trace_id, "t_unix_s": time.time(),
+               "reason": reason, "session": bool(session),
+               "pick": pick.addr if pick is not None else None,
+               "prompt_tokens": n_prompt, "block_size": bs,
+               "prompt_hashes": hxs,
+               "redundant_prefill_tokens": red,
+               "resident_replicas": spread,
+               "candidates": cand_rows}
+        try:
+            self._emit(rec)
+        except Exception:
+            pass
+        if hop is not None and parent is None:
+            # Waterfall<->router join: the hop record names the decision
+            # that picked its replica, so `slt waterfall` renders WHY.
+            hop["decision_id"] = did
+            hop["pick_reason"] = reason
+        return did
+
     def _dispatch(self, req: dict, session: Optional[str],
                   hop: Optional[dict] = None) -> dict:
         hedgeable = self.cfg.hedge and self._idempotent(req)
@@ -701,10 +880,15 @@ class FleetRouter:
         candidates = self._candidates()
         if not candidates:
             self._m_shed.inc()
+            self._note_decision(req, [], None, session, hop,
+                                reason="shed_no_replicas", account=False)
             return _overload_reply("no healthy replicas")
         primary = self._pick(candidates, session)
         if hop is not None:
             hop["primary"] = primary.addr
+        did = self._note_decision(
+            req, candidates, primary, session, hop,
+            reason="session_affinity" if session else "least_loaded")
         out: "queue.Queue" = queue.Queue()
         tried = {primary.addr}
         launched = [primary.addr]
@@ -722,11 +906,16 @@ class FleetRouter:
                 r, rep, err, _dt = out.get(timeout=timeout)
             except queue.Empty:
                 # Hedge: the primary is slow, race one more replica.
-                hedge = self._pick(self._candidates(), None, exclude=tried)
+                cands = self._candidates()
+                hedge = self._pick(cands, None, exclude=tried)
                 hedged = True
                 if hop is not None:
                     hop["hedged"] = True
                 if hedge is not None:
+                    self._note_decision(
+                        req, cands, hedge, None, hop, reason="hedge",
+                        account=False, parent=f"{did}.h",
+                        exclude=frozenset(tried))
                     tried.add(hedge.addr)
                     launched.append(hedge.addr)
                     self._m_hedges.inc()
@@ -759,8 +948,13 @@ class FleetRouter:
             if pending:
                 continue  # the race partner may still answer
             if retries < self.cfg.max_retries:
-                nxt = self._pick(self._candidates(), None, exclude=tried)
+                cands = self._candidates()
+                nxt = self._pick(cands, None, exclude=tried)
                 if nxt is not None:
+                    self._note_decision(
+                        req, cands, nxt, None, hop, reason="retry",
+                        account=False, parent=f"{did}.r{retries + 1}",
+                        exclude=frozenset(tried))
                     tried.add(nxt.addr)
                     launched.append(nxt.addr)
                     retries += 1

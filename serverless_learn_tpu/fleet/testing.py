@@ -76,6 +76,61 @@ class StubEngine:
         pass
 
 
+class KVStubEngine(StubEngine):
+    """StubEngine plus a REAL paged prefix cache (round 22).
+
+    Prompts run through an actual :class:`BlockPool` + :class:`PrefixTrie`
+    — the same allocator/trie the continuous engine owns — so
+    ``kv_stats()`` pings carry REAL resident-prefix digests and windowed
+    hit rates, and the router's fleet-wide redundancy accounting is
+    exercised end to end with zero jax imports. Generation stays the
+    deterministic StubEngine reply.
+    """
+
+    def __init__(self, num_blocks: int = 256, block_size: int = 16,
+                 hit_window: int = 64, **kw):
+        super().__init__(**kw)
+        from serverless_learn_tpu.inference.kvcache import (BlockPool,
+                                                            PrefixTrie)
+
+        self._pool = BlockPool(num_blocks, block_size)
+        self._trie = PrefixTrie(self._pool, max_blocks=num_blocks // 2,
+                                hit_window=hit_window)
+
+    def submit(self, prompt, max_new, temperature=0.0, top_k=0,
+               eos_id=None, seed=0, trace=None):
+        with self._lock:
+            hit = self._trie.lookup(prompt)
+            need = (len(prompt) // self._trie.block_size
+                    - len(hit.blocks))
+            if need > 0 and self._pool.free_blocks >= need:
+                fresh = self._pool.alloc(need)
+                # Matched nodes keep their existing trie references; the
+                # fresh blocks pass ownership to the trie (register
+                # increfs the new nodes, then the "request" retires).
+                self._trie.register(prompt, list(hit.blocks) + fresh)
+                self._pool.decref(fresh)
+        return super().submit(prompt, max_new, temperature=temperature,
+                              top_k=top_k, eos_id=eos_id, seed=seed,
+                              trace=trace)
+
+    def kv_stats(self) -> dict:
+        with self._lock:
+            lookups = self._trie.lookups
+            hits = self._trie.hits
+            return {"paged": True,
+                    "block_size": self._trie.block_size,
+                    "blocks_total": self._pool.num_blocks,
+                    "blocks_free": self._pool.free_blocks,
+                    "prefix_hit_rate": round(
+                        self._trie.window_hit_rate(), 4),
+                    "prefix_hit_rate_lifetime": (
+                        round(hits / lookups, 4) if lookups else 0.0),
+                    "prefix_blocks_cached": self._trie.blocks_held,
+                    "preemptions": 0,
+                    "prefix_digest": self._trie.digest()}
+
+
 def stub_server(port: int = 0, latency_s=0.0, fail: bool = False,
                 host: str = "127.0.0.1", registry=None,
                 conn_timeout_s: float = 30.0,

@@ -208,7 +208,8 @@ class ContinuousBatchingEngine:
             self._pool = BlockPool(num_blocks, ps)
             self._trie = (PrefixTrie(
                 self._pool,
-                max_blocks=kv.prefix_cache_blocks or num_blocks // 4)
+                max_blocks=kv.prefix_cache_blocks or num_blocks // 4,
+                hit_window=kv.prefix_hit_window)
                 if kv.prefix_cache else None)
             self._pmod = kvcache.paged_module(module, ps, num_blocks)
             self.prefill_chunk = kv.prefill_chunk or max_seq
@@ -1334,7 +1335,8 @@ class ContinuousBatchingEngine:
                     self._pool = BlockPool(self._pool.num_blocks, self._ps)
                     if self._trie is not None:
                         self._trie = PrefixTrie(
-                            self._pool, max_blocks=self._trie.max_blocks)
+                            self._pool, max_blocks=self._trie.max_blocks,
+                            hit_window=self.kv.prefix_hit_window)
                     self._tbl[:] = self._pool.sentinel
                     self._slot_pages = [[] for _ in range(self.max_slots)]
                     self._pending_cow.clear()
@@ -1345,20 +1347,32 @@ class ContinuousBatchingEngine:
     def kv_stats(self) -> Optional[dict]:
         """Paged-pool pressure for the serving wire's admin ping: the
         router's least-loaded picking and brownout shedding read this
-        (memory pressure, not just queue depth)."""
+        (memory pressure, not just queue depth). ``prefix_hit_rate`` is
+        WINDOWED over the last ``kv.prefix_hit_window`` lookups (round
+        22) so picking tracks traffic shifts; the lifetime average rides
+        along for dashboards. ``prefix_digest`` carries the resident-
+        prefix chain hashes the router's fleet-wide redundancy
+        accounting intersects against."""
         if not self._paged:
             return None
         total = self._pool.num_blocks
         lookups = self._trie.lookups if self._trie is not None else 0
         hits = self._trie.hits if self._trie is not None else 0
-        return {"paged": True, "block_size": self._ps,
-                "blocks_total": total,
-                "blocks_free": self._pool.free_blocks,
-                "prefix_hit_rate": (round(hits / lookups, 4)
-                                    if lookups else 0.0),
-                "prefix_blocks_cached": (self._trie.blocks_held
-                                         if self._trie is not None else 0),
-                "preemptions": self.preemptions}
+        out = {"paged": True, "block_size": self._ps,
+               "blocks_total": total,
+               "blocks_free": self._pool.free_blocks,
+               "prefix_hit_rate": (round(self._trie.window_hit_rate(), 4)
+                                   if self._trie is not None else 0.0),
+               "prefix_hit_rate_lifetime": (round(hits / lookups, 4)
+                                            if lookups else 0.0),
+               "prefix_blocks_cached": (self._trie.blocks_held
+                                        if self._trie is not None else 0),
+               "preemptions": self.preemptions}
+        if self._trie is not None:
+            out["prefix_digest"] = self._trie.digest(
+                top_k=self.kv.digest_top_k,
+                max_hashes=self.kv.digest_hashes)
+        return out
 
     def warm_shapes(self, workloads, batch_sizes=None) -> int:
         """Deterministically pre-compile every paged compile bucket the

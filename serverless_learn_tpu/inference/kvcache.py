@@ -40,7 +40,10 @@ generation appends strictly past the prompt, and the boundary
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import hashlib
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
@@ -66,6 +69,32 @@ def pages_for(n_tokens: int, block_size: int) -> int:
     if n_tokens <= 0:
         return 0
     return -(-n_tokens // block_size)
+
+
+# Round-22 fleetscope digest scheme. A chunk's hash is chained through
+# its whole ancestry (h_i = blake2b(h_{i-1} || chunk_i tokens), 64-bit),
+# so one hash names one exact token PREFIX — two replicas report the
+# same hash iff they hold KV for the same leading tokens, and the router
+# can intersect prompt hashes with ping digests without shipping tokens
+# over the wire. 64 bits keeps ping payloads small; with n resident
+# chunks fleet-wide the collision probability is ~n^2/2^65 (n=10^6 =>
+# ~3e-8), and a collision only ever OVER-counts redundancy by one chunk.
+_DIGEST_SEED = b"slt-prefix-digest-v1"
+
+
+def chunk_hashes(tokens: Sequence[int], block_size: int) -> List[str]:
+    """Chained 64-bit hashes (16 hex chars) of each FULL leading
+    ``block_size``-token chunk of ``tokens``. Position i's hash commits
+    to chunks [0, i] — the prefix, not just the chunk."""
+    out: List[str] = []
+    prev = _DIGEST_SEED
+    bs = block_size
+    for i in range(0, len(tokens) - len(tokens) % bs, bs):
+        chunk = b",".join(str(int(t)).encode() for t in tokens[i:i + bs])
+        hx = hashlib.blake2b(prev + b"|" + chunk, digest_size=8).hexdigest()
+        out.append(hx)
+        prev = bytes.fromhex(hx)
+    return out
 
 
 class BlockPool:
@@ -152,13 +181,20 @@ class PrefixHit:
 
 
 class _Node:
-    __slots__ = ("key", "block", "children", "stamp")
+    __slots__ = ("key", "block", "children", "stamp", "hash", "hits",
+                 "hit_t")
 
-    def __init__(self, key: Tuple[int, ...], block: int, stamp: int):
+    def __init__(self, key: Tuple[int, ...], block: int, stamp: int,
+                 hash_: str = ""):
         self.key = key
         self.block = block
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.stamp = stamp
+        # Fleetscope provenance: the chain hash naming this node's exact
+        # token prefix, lookup-hit count and last-hit wall time.
+        self.hash = hash_
+        self.hits = 0
+        self.hit_t = time.monotonic()
 
 
 class PrefixTrie:
@@ -171,7 +207,8 @@ class PrefixTrie:
     device when its last user retires.
     """
 
-    def __init__(self, pool: BlockPool, max_blocks: int = 0):
+    def __init__(self, pool: BlockPool, max_blocks: int = 0,
+                 hit_window: int = 256):
         self.pool = pool
         self.block_size = pool.block_size
         self.max_blocks = max_blocks  # 0 = unbounded (pool pressure evicts)
@@ -180,6 +217,11 @@ class PrefixTrie:
         self._count = 0
         self.hits = 0
         self.lookups = 0
+        # Last-N lookup outcomes: the router picks on this WINDOWED rate
+        # (lifetime hits/lookups goes inert as uptime grows — a traffic
+        # shift at hour 10 barely moves a 10-hour average).
+        self._window: collections.deque = collections.deque(
+            maxlen=max(1, hit_window))
 
     @property
     def blocks_held(self) -> int:
@@ -219,8 +261,15 @@ class PrefixTrie:
                     n += 1
                 if n > cow_tokens:
                     cow_src, cow_tokens = child.block, n
-        if blocks or cow_tokens:
+        hit = bool(blocks or cow_tokens)
+        if hit:
             self.hits += 1
+        self._window.append(1 if hit else 0)
+        if blocks:
+            # Hot-prefix stats live on the DEEPEST matched node: one
+            # lookup = one hit against its longest resident prefix.
+            node.hits += 1
+            node.hit_t = time.monotonic()
         return PrefixHit(blocks=blocks, tokens_matched=matched,
                          cow_src=cow_src, cow_tokens=cow_tokens)
 
@@ -234,12 +283,13 @@ class PrefixTrie:
         now = self._tick()
         node = self._root
         created = 0
+        hxs = chunk_hashes(tokens, self.block_size)
         for i, chunk in enumerate(self._chunks(tokens)):
             if i >= len(blocks):
                 break
             child = node.children.get(chunk)
             if child is None:
-                child = _Node(chunk, int(blocks[i]), now)
+                child = _Node(chunk, int(blocks[i]), now, hash_=hxs[i])
                 node.children[chunk] = child
                 self.pool.incref([child.block])
                 self._count += 1
@@ -249,6 +299,46 @@ class PrefixTrie:
         if self.max_blocks > 0 and self._count > self.max_blocks:
             self.release(self._count - self.max_blocks)
         return created
+
+    def window_hit_rate(self) -> float:
+        """Hit rate over the last ``hit_window`` lookups (0.0 when no
+        lookup has happened yet)."""
+        if not self._window:
+            return 0.0
+        return sum(self._window) / len(self._window)
+
+    def digest(self, top_k: int = 8, max_hashes: int = 64) -> dict:
+        """Compact resident-prefix digest for replica pings (round 22).
+
+        ``hashes``: chain hashes (:func:`chunk_hashes` scheme) of up to
+        ``max_hashes`` resident nodes, shallow-first (BFS) so the cap
+        drops the DEEPEST chunks first — a truncated digest makes the
+        router UNDER-count redundancy, never fabricate it. ``top``: the
+        ``top_k`` hottest resident prefixes by lookup hits, each with
+        its resident token count and last-hit age. Deterministic for a
+        given registration/lookup history: children walk in sorted key
+        order, so insertion order never leaks into the digest.
+        """
+        now = time.monotonic()
+        hashes: List[str] = []
+        nodes: List[Tuple[_Node, int]] = []
+        q = collections.deque([(self._root, 0)])
+        while q:
+            node, depth = q.popleft()
+            for key in sorted(node.children):
+                child = node.children[key]
+                nodes.append((child, depth + 1))
+                if len(hashes) < max_hashes:
+                    hashes.append(child.hash)
+                q.append((child, depth + 1))
+        hot = sorted(nodes,
+                     key=lambda nd: (-nd[0].hits, -nd[1], nd[0].hash))
+        top = [{"hash": n.hash, "tokens": d * self.block_size,
+                "hits": n.hits,
+                "age_s": round(max(0.0, now - n.hit_t), 3)}
+               for n, d in hot[:top_k] if n.hits > 0]
+        return {"block_size": self.block_size, "blocks": self._count,
+                "hashes": hashes, "top": top}
 
     def _leaves(self) -> List[Tuple[_Node, _Node, Tuple[int, ...]]]:
         out = []

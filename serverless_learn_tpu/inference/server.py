@@ -160,9 +160,12 @@ class GenerationServer:
         if op == "ping":
             rep = {"ok": True, "draining": self.draining,
                    "requests_served": self.requests_served}
-            # Paged engines report KV pool pressure + prefix hit rate so
-            # the fleet router's picking/shedding can weigh MEMORY, not
-            # just queue depth (fleet/router.py).
+            # Paged engines report KV pool pressure, the windowed prefix
+            # hit rate AND the resident-prefix digest so the fleet
+            # router's picking/shedding can weigh MEMORY (not just queue
+            # depth) and its fleetscope accounting can intersect each
+            # routed prompt against what is already resident fleet-wide
+            # (fleet/router.py, telemetry/fleetscope.py).
             kv_stats = getattr(self.engine, "kv_stats", None)
             if callable(kv_stats):
                 kv = kv_stats()

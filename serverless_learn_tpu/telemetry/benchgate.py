@@ -67,6 +67,15 @@ ATTRIBUTION_COLUMNS = {
     # it regresses UP (chunked prefill stealing more decode time) and
     # is the first place a prefill-budget misconfiguration shows.
     "prefill_interference_frac": ("min", 0.10),
+    # Fleetscope (round 22): fleet-wide prefix redundancy rides the
+    # fleetscope_*_p99_ms rows. Both regress UP — the fraction of routed
+    # prompt tokens re-prefilled while resident elsewhere, and the mean
+    # replica count holding each fleet-resident chunk (affinity/digest
+    # plumbing quietly breaking shows here before any latency does).
+    # Standalone fraction rows would gate better=max (_better_for keys
+    # off *_ms) — the wrong direction — hence attribution columns.
+    "fleet_redundant_prefill_frac": ("min", 0.10),
+    "fleet_prefix_dup_factor": ("min", 0.75),
 }
 
 

@@ -532,6 +532,27 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
                 f"decode stalls on {node}: dominant cause {dom} "
                 f"({per[dom] / total * 100:.0f}% of {total:.3f}s over "
                 f"{wf_reqs.get(node, 0)} request(s))")
+    # Fleet prefix redundancy (round 22): route_decision records carry
+    # the router's per-pick accounting (telemetry/fleetscope.py) — when
+    # a meaningful share of routed prompt tokens were re-prefilled
+    # while resident on another replica, the verdict NAMES the routing
+    # opportunity from the JSONL alone, no replay run needed.
+    fleetscope_row: Optional[dict] = None
+    if any(r.get("event") == "route_decision" for r in records):
+        from serverless_learn_tpu.telemetry import fleetscope as _fs
+
+        fsum = _fs.summarize(records)
+        fleetscope_row = fsum
+        frac = fsum.get("redundant_prefill_frac") or 0.0
+        red = fsum.get("redundant_prefill_tokens") or 0
+        if fsum.get("primary_decisions") and frac >= 0.10 and red >= 128:
+            verdict_bits.append(
+                f"fleet prefix redundancy: {frac * 100:.0f}% of routed "
+                f"prompt tokens ({red}) re-prefilled while resident on "
+                f"another replica (dup factor "
+                f"{fsum.get('prefix_dup_factor', 0.0):.2f}) — "
+                f"prefix-aware routing would reclaim them "
+                f"(see `slt fleetscope`)")
     # Step-interior hardware attribution (round 16): xray summaries —
     # from capture-meta.json records in the event trail and from capture
     # dirs handed to --xray — put a NAME on the training plateau ("step
@@ -592,6 +613,7 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
         "stragglers": stragglers,
         "goodput": goodput_by_node,
         "waterfall": waterfall_rows,
+        "fleetscope": fleetscope_row,
         "xray": xray_rows,
         "flight_dumps": collected["dumps"],
         "bench": bench,

@@ -108,6 +108,8 @@ class MetricsExporter:
                         self._reply_json(200, exporter._numerics())
                     elif path == "/stalls":
                         self._reply_json(200, exporter._stalls())
+                    elif path == "/fleetscope":
+                        self._reply_json(200, exporter._fleetscope())
                     elif path == "/debug/profile":
                         code, obj = exporter._profile(
                             parse_qs(url.query),
@@ -239,6 +241,41 @@ class MetricsExporter:
                     "prefill_interference_frac": _gauge(
                         "slt_prefill_interference_frac"),
                     "spec_accept_rate": _gauge("slt_spec_accept_rate")}
+        except Exception as e:
+            return {"enabled": False,
+                    "error": f"{type(e).__name__}: {e}"}
+
+    # -- fleet redundancy ---------------------------------------------------
+
+    def _fleetscope(self) -> dict:
+        """The /fleetscope body (round 22): the router's live fleet
+        prefix-redundancy rollup from THIS process's registry — routed
+        vs redundant prompt-token counters, the redundancy fraction and
+        the digest duplication factor, plus the shed/hedge decision
+        counters for context. `slt fleetscope` gives the full
+        accounting + counterfactual replay from the event logs; this is
+        the always-on fleet-scrapable rollup."""
+        try:
+            snap = self.registry.snapshot()
+
+            def _val(name):
+                fam = snap.get(name)
+                if not fam or not fam.get("series"):
+                    return None
+                return sum(float(s.get("value") or 0.0)
+                           for s in fam["series"])
+
+            routed = _val("slt_fleet_routed_prompt_tokens_total")
+            redundant = _val("slt_fleet_redundant_prefill_tokens_total")
+            return {"enabled": routed is not None,
+                    "routed_prompt_tokens": routed,
+                    "redundant_prefill_tokens": redundant,
+                    "redundant_prefill_frac": _val(
+                        "slt_fleet_redundant_prefill_frac"),
+                    "prefix_dup_factor": _val(
+                        "slt_fleet_prefix_dup_factor"),
+                    "hedges": _val("slt_router_hedges_total"),
+                    "sheds": _val("slt_router_shed_total")}
         except Exception as e:
             return {"enabled": False,
                     "error": f"{type(e).__name__}: {e}"}

@@ -1,0 +1,588 @@
+"""Fleet-wide KV/prefix redundancy accounting + counterfactual routing
+replay (round 22): `slt fleetscope`.
+
+Round 21 made ONE request's lifecycle legible; the fleet itself stayed
+opaque — every replica is a KV island, and the router's picks left no
+record of why. This module is the analysis half of the round-22
+observability layer:
+
+* **Inputs** (all from the existing JSONL events log — no new sink):
+  ``route_decision`` records (fleet/router.py — the candidate set with
+  per-replica load/KV scores, digest-derived resident prompt tokens,
+  the pick and its reason, plus the prompt's chain hashes),
+  ``fleet_digest`` snapshots (emitted when a replica's ping digest
+  changes), and the round-21 request-span waterfalls (for observed TTFT
+  and prefill seconds-per-token).
+* **Accounting**: fleet redundant-prefill fraction (prompt tokens the
+  pick re-prefilled while resident on another eligible replica),
+  per-prefix replica-residency spread histogram, and session-affinity
+  effectiveness (how often affinity landed on the prefix-best replica).
+* **Counterfactual replay**: re-score the RECORDED decision stream
+  under alternative policies offline. The simulator replays decisions
+  in log order against simulated per-replica resident-hash sets (a pick
+  makes the prompt's chunks resident on that replica — the engine
+  registers prefix blocks after prefill), so every policy is scored by
+  the SAME rules and the deltas are attributable to the policy alone.
+  Policies: ``recorded`` (the picks the router actually made),
+  ``least_loaded`` (min recorded in-flight), ``prefix_aware`` (longest
+  simulated resident run wins), ``prefill_decode_split`` (prefix-aware
+  within a dedicated prefill half of the fleet — the ROADMAP
+  disaggregation candidate). The TTFT-p99 bound scales each decision's
+  extra resident tokens by the waterfall-observed prefill
+  seconds-per-token — a linear-prefill assumption, stated, not hidden.
+
+Determinism contract: the report is a pure function of the logs — no
+wall clock, no randomness, sorted iteration everywhere — so same
+seed/logs produce byte-identical reports (``--self-check`` proves it).
+
+Replay assumptions (also in docs/ARCHITECTURE.md): residency is
+simulated, not measured — no eviction modeling (optimistic for small
+pools) and instant residency after a pick (optimistic by at most one
+probe interval); digests are truncated shallow-first at the source, so
+both the recorded accounting and the replay UNDER-count redundancy.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from serverless_learn_tpu.telemetry.waterfall import read_records
+
+SCHEMA_VERSION = 1
+
+POLICIES = ("recorded", "least_loaded", "prefix_aware",
+            "prefill_decode_split")
+
+
+def _percentile(sorted_vals: Sequence[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def primary_decisions(records: Sequence[dict]) -> List[dict]:
+    """The replayable decision stream: primary picks only (hedge/retry
+    decisions carry a dotted parent id and re-route the SAME request;
+    shed decisions picked nobody), in deterministic log order."""
+    out = [d for d in records
+           if d.get("event") == "route_decision"
+           and d.get("pick")
+           and "." not in str(d.get("decision_id") or "")
+           and not str(d.get("reason") or "").startswith("shed")]
+    out.sort(key=lambda d: (float(d.get("t_unix_s") or 0.0),
+                            str(d.get("decision_id") or "")))
+    return out
+
+
+def summarize(records: Sequence[dict]) -> dict:
+    """Recorded-stream accounting: redundancy fraction, duplication/
+    spread histogram, pick-reason mix, affinity effectiveness, and the
+    latest digest snapshot per replica."""
+    decisions = [r for r in records if r.get("event") == "route_decision"]
+    prim = primary_decisions(records)
+    prompt_tok = sum(int(d.get("prompt_tokens") or 0) for d in prim)
+    red_tok = sum(int(d.get("redundant_prefill_tokens") or 0)
+                  for d in prim)
+    reasons: Dict[str, int] = {}
+    for d in decisions:
+        r = str(d.get("reason") or "?")
+        reasons[r] = reasons.get(r, 0) + 1
+    spread_hist: Dict[str, int] = {}
+    dup_n = dup_sum = 0
+    affine = affine_best = 0
+    picks: Dict[str, int] = {}
+    for d in prim:
+        spread = int(d.get("resident_replicas") or 0)
+        spread_hist[str(spread)] = spread_hist.get(str(spread), 0) + 1
+        if spread > 0:
+            dup_n += 1
+            dup_sum += spread
+        picks[str(d.get("pick"))] = picks.get(str(d.get("pick")), 0) + 1
+        if d.get("session"):
+            affine += 1
+            cands = [c for c in (d.get("candidates") or [])
+                     if c.get("eligible", True)]
+            best = max((int(c.get("resident_tokens") or 0)
+                        for c in cands), default=0)
+            mine = next((int(c.get("resident_tokens") or 0)
+                         for c in cands
+                         if c.get("addr") == d.get("pick")), 0)
+            if mine >= best:
+                affine_best += 1
+    digests: Dict[str, dict] = {}
+    for r in records:
+        if r.get("event") == "fleet_digest" and r.get("replica"):
+            digests[str(r["replica"])] = {
+                "blocks": int(r.get("blocks") or 0),
+                "hashes": len(r.get("hashes") or ()),
+                "top": list(r.get("top") or ())[:4]}
+    out = {
+        "decisions": len(decisions),
+        "primary_decisions": len(prim),
+        "reasons": {k: reasons[k] for k in sorted(reasons)},
+        "routed_prompt_tokens": prompt_tok,
+        "redundant_prefill_tokens": red_tok,
+        "redundant_prefill_frac": round(red_tok / max(1, prompt_tok), 6),
+        "prefix_dup_factor": round(dup_sum / dup_n, 4) if dup_n else 0.0,
+        "replica_spread_hist": {k: spread_hist[k]
+                                for k in sorted(spread_hist, key=int)},
+        "picks": {k: picks[k] for k in sorted(picks)},
+    }
+    if affine:
+        out["affinity"] = {
+            "decisions": affine,
+            "prefix_best_frac": round(affine_best / affine, 6)}
+    if digests:
+        out["digests"] = {k: digests[k] for k in sorted(digests)}
+    return out
+
+
+def _policy_pick(policy: str, d: dict, addrs: List[str],
+                 runs: Dict[str, int], inflight: Dict[str, int],
+                 ) -> Optional[str]:
+    if policy == "recorded":
+        p = d.get("pick")
+        return p if p in runs else (addrs[0] if addrs else None)
+    if policy == "least_loaded":
+        return min(addrs, key=lambda a: (inflight.get(a, 0), a))
+    if policy == "prefix_aware":
+        # Longest simulated resident run wins; load then addr break ties
+        # — the candidate policy for ROADMAP's prefix-aware routing.
+        return min(addrs, key=lambda a: (-runs.get(a, 0),
+                                         inflight.get(a, 0), a))
+    if policy == "prefill_decode_split":
+        # Disaggregation sketch: prefill concentrates on a dedicated
+        # half of the fleet (sorted-addr prefix), prefix-aware within
+        # it, so residency consolidates instead of spreading N-wide.
+        pool = addrs[:max(1, len(addrs) // 2)]
+        return min(pool, key=lambda a: (-runs.get(a, 0),
+                                        inflight.get(a, 0), a))
+    raise ValueError(f"unknown replay policy {policy!r}")
+
+
+def replay(records: Sequence[dict], policy: str) -> dict:
+    """Deterministic counterfactual replay of the decision stream under
+    ``policy``. Every policy (including ``recorded``) is scored against
+    the SAME simulated per-replica resident sets, so the redundant-token
+    deltas measure the policy, not bookkeeping differences."""
+    prim = primary_decisions(records)
+    resident: Dict[str, set] = {}
+    tot_prompt = tot_red = tot_hit = 0
+    picks: Dict[str, int] = {}
+    per_decision: Dict[str, dict] = {}
+    for d in prim:
+        bs = int(d.get("block_size") or 0)
+        hxs = [h for h in (d.get("prompt_hashes") or ())
+               if isinstance(h, str)]
+        n_prompt = int(d.get("prompt_tokens") or 0)
+        cands = [c for c in (d.get("candidates") or [])
+                 if c.get("eligible", True) and c.get("addr")]
+        if not cands:
+            continue
+        addrs = sorted(c["addr"] for c in cands)
+        inflight = {c["addr"]: int(c.get("inflight") or 0)
+                    for c in cands}
+        runs: Dict[str, int] = {}
+        for a in addrs:
+            held = resident.get(a)
+            run = 0
+            if held and hxs:
+                for h in hxs:
+                    if h not in held:
+                        break
+                    run += 1
+            runs[a] = run
+        pick = _policy_pick(policy, d, addrs, runs, inflight)
+        if pick is None:
+            continue
+        best_other = max((r for a, r in runs.items() if a != pick),
+                         default=0)
+        red = max(0, min(best_other * bs, n_prompt)
+                  - min(runs.get(pick, 0) * bs, n_prompt))
+        hit = min(runs.get(pick, 0) * bs, n_prompt)
+        tot_prompt += n_prompt
+        tot_red += red
+        tot_hit += hit
+        picks[pick] = picks.get(pick, 0) + 1
+        if hxs:
+            resident.setdefault(pick, set()).update(hxs)
+        did = str(d.get("decision_id") or "")
+        per_decision[did] = {"hit_tokens": hit,
+                             "trace_id": d.get("trace_id")}
+    return {"policy": policy,
+            "decisions": len(prim),
+            "prompt_tokens": tot_prompt,
+            "redundant_prefill_tokens": tot_red,
+            "redundant_frac": round(tot_red / max(1, tot_prompt), 6),
+            "prefix_hit_tokens": tot_hit,
+            "picks": {k: picks[k] for k in sorted(picks)},
+            "per_decision": per_decision}
+
+
+def _waterfall_join(records: Sequence[dict],
+                    ) -> Tuple[Dict[str, float], Optional[float]]:
+    """(trace_id -> observed TTFT seconds, prefill seconds-per-token)
+    from the round-21 request-span ledgers. The per-token rate divides
+    the EXACT prefill decomposition remainder by the tokens actually
+    prefilled (prefix hits excluded — they cost no prefill compute)."""
+    ttfts: Dict[str, float] = {}
+    prefill_s = 0.0
+    prefill_tok = 0
+    for rec in records:
+        if rec.get("event") != "span" or rec.get("span") != "request" \
+                or not isinstance(rec.get("waterfall"), dict):
+            continue
+        wf = rec["waterfall"]
+        tid = rec.get("trace_id")
+        if tid and isinstance(wf.get("ttft_s"), (int, float)):
+            ttfts[str(tid)] = float(wf["ttft_s"])
+        decomp = wf.get("ttft_decomp_s") or {}
+        prefill_s += float(decomp.get("prefill") or 0.0)
+        for ph in wf.get("phases") or ():
+            for c in ph.get("chunks") or ():
+                prefill_tok += max(
+                    0, int(c.get("tokens") or 0)
+                    - int(c.get("prefix_hit_tokens") or 0))
+    spt = (prefill_s / prefill_tok) if prefill_tok > 0 else None
+    return ttfts, spt
+
+
+def report(paths: Sequence[str],
+           policies: Sequence[str] = POLICIES) -> dict:
+    """The `slt fleetscope` body: read -> account -> replay each policy
+    -> bound the savings. Pure function of the logs (byte-identical
+    reports for identical inputs)."""
+    records = read_records(paths)
+    summary = summarize(records)
+    ttfts, spt = _waterfall_join(records)
+    out: dict = {"v": SCHEMA_VERSION, "records": len(records),
+                 "summary": summary}
+    if ttfts:
+        vals = sorted(ttfts.values())
+        out["ttft_recorded_p99_ms"] = round(
+            (_percentile(vals, 0.99) or 0.0) * 1e3, 3)
+    if spt is not None:
+        out["prefill_s_per_token"] = round(spt, 9)
+    rep_replay: Dict[str, dict] = {}
+    base = replay(records, "recorded")
+    base_per = base.pop("per_decision")
+    rep_replay["recorded"] = base
+    for pol in policies:
+        if pol == "recorded":
+            continue
+        r = replay(records, pol)
+        per = r.pop("per_decision")
+        r["redundant_tokens_saved_vs_recorded"] = (
+            base["redundant_prefill_tokens"]
+            - r["redundant_prefill_tokens"])
+        if spt is not None and ttfts:
+            # TTFT-p99 bound: each decision's EXTRA resident tokens
+            # under this policy shave prefill at the observed
+            # seconds-per-token (linear-prefill assumption).
+            adj: List[float] = []
+            for did in sorted(per):
+                tid = str(per[did].get("trace_id") or "")
+                ttft = ttfts.get(tid)
+                if ttft is None:
+                    continue
+                gain = max(0, per[did]["hit_tokens"]
+                           - base_per.get(did, {}).get("hit_tokens", 0))
+                adj.append(max(0.0, ttft - gain * spt))
+            if adj:
+                r["ttft_p99_bound_ms"] = round(
+                    (_percentile(sorted(adj), 0.99) or 0.0) * 1e3, 3)
+        rep_replay[pol] = r
+    out["replay"] = {k: rep_replay[k] for k in sorted(rep_replay)}
+    pa = rep_replay.get("prefix_aware")
+    if pa is not None:
+        out["savings"] = {
+            "policy": "prefix_aware",
+            "prefill_tokens": pa["redundant_tokens_saved_vs_recorded"],
+            "prefill_frac_of_routed": round(
+                pa["redundant_tokens_saved_vs_recorded"]
+                / max(1, base["prompt_tokens"]), 6)}
+        if "ttft_p99_bound_ms" in pa \
+                and "ttft_recorded_p99_ms" in out:
+            out["savings"]["ttft_p99_ms"] = round(
+                out["ttft_recorded_p99_ms"] - pa["ttft_p99_bound_ms"], 3)
+    return out
+
+
+def bench_rows(rep: dict, device_kind: str = "cpu") -> List[dict]:
+    """Bench-history rows for `utils/benchlog.record` / `slt bench
+    --gate`: the recorded TTFT p99 headline gates automatically
+    (``*_ms`` -> better=min) and carries the redundancy fraction + dup
+    factor as attribution columns (gated via ATTRIBUTION_COLUMNS — a
+    bare fraction row would gate better=max, the wrong direction)."""
+    rows: List[dict] = []
+    summary = rep.get("summary") or {}
+    base = (rep.get("replay") or {}).get("recorded") or {}
+    if rep.get("ttft_recorded_p99_ms") is not None:
+        row = {"metric": "fleetscope_ttft_p99_ms",
+               "value": rep["ttft_recorded_p99_ms"],
+               "unit": "ms", "device_kind": device_kind,
+               "count": base.get("decisions"),
+               "fleet_redundant_prefill_frac":
+                   summary.get("redundant_prefill_frac", 0.0),
+               "fleet_prefix_dup_factor":
+                   summary.get("prefix_dup_factor", 0.0)}
+        pa = (rep.get("replay") or {}).get("prefix_aware") or {}
+        if pa.get("ttft_p99_bound_ms") is not None:
+            row["prefix_aware_ttft_p99_bound_ms"] = \
+                pa["ttft_p99_bound_ms"]
+        rows.append(row)
+    return rows
+
+
+def render(rep: dict) -> str:
+    """Human rendering: accounting headline, replay table, savings."""
+    s = rep.get("summary") or {}
+    lines = [f"fleetscope: {rep.get('records', 0)} records, "
+             f"{s.get('primary_decisions', 0)} routed decisions "
+             f"({s.get('decisions', 0)} total incl. hedge/retry/shed)"]
+    lines.append(
+        f"  redundant prefill: {s.get('redundant_prefill_tokens', 0)} "
+        f"of {s.get('routed_prompt_tokens', 0)} routed prompt tokens "
+        f"({s.get('redundant_prefill_frac', 0.0):.1%}); "
+        f"prefix dup factor {s.get('prefix_dup_factor', 0.0):.2f}")
+    hist = s.get("replica_spread_hist") or {}
+    if hist:
+        bits = ", ".join(f"{k} replica(s): {v}"
+                         for k, v in hist.items())
+        lines.append(f"  residency spread: {bits}")
+    aff = s.get("affinity") or {}
+    if aff:
+        lines.append(f"  session affinity: {aff.get('decisions', 0)} "
+                     f"decisions, prefix-best "
+                     f"{aff.get('prefix_best_frac', 0.0):.0%}")
+    replays = rep.get("replay") or {}
+    if replays:
+        lines.append("  counterfactual replay (redundant tokens | "
+                     "TTFT p99 bound):")
+        for pol in sorted(replays):
+            r = replays[pol]
+            ttft = r.get("ttft_p99_bound_ms")
+            if pol == "recorded":
+                ttft = rep.get("ttft_recorded_p99_ms")
+            lines.append(
+                f"    {pol:<20} {r.get('redundant_prefill_tokens', 0):>8}"
+                f" tok ({r.get('redundant_frac', 0.0):6.1%})"
+                + (f"   {ttft:8.1f} ms" if ttft is not None else ""))
+    sav = rep.get("savings") or {}
+    if sav:
+        lines.append(
+            f"  projected win ({sav.get('policy')}): "
+            f"{sav.get('prefill_tokens', 0)} prefill tokens "
+            f"({sav.get('prefill_frac_of_routed', 0.0):.1%} of routed)"
+            + (f", TTFT p99 -{sav['ttft_p99_ms']:.1f} ms"
+               if sav.get("ttft_p99_ms") is not None else ""))
+    return "\n".join(lines)
+
+
+# -- self-check --------------------------------------------------------------
+
+
+def synthetic_records() -> List[dict]:
+    """Deterministic fabricated 3-replica fixture: six requests sharing
+    a 4-chunk system prefix, least-loaded picks spreading it across the
+    whole fleet. Exact expectations (tests assert them): the recorded
+    stream re-prefills the 64-token prefix twice (128 redundant tokens)
+    and prefix-aware replay re-prefills it never (0). Doubles as the
+    committed-fixture generator for tests/fixtures/fleetscope/."""
+    from serverless_learn_tpu.inference.kvcache import chunk_hashes
+
+    bs = 16
+    sys_tokens = list(range(100, 164))            # 4 shared chunks
+    addrs = ("n0:9000", "n1:9000", "n2:9000")
+
+    def cand(addr, inflight, resident, eligible=True):
+        return {"addr": addr, "state": "healthy", "inflight": inflight,
+                "kv_pressure_bucket": 0, "prefix_hit_rate": 0.5,
+                "resident_tokens": resident, "eligible": eligible}
+
+    recs: List[dict] = []
+    t = 1754000000.0
+    # The recorded router spread the shared prefix least-loaded-style:
+    # n0, n1, n2, then back around. Residency below mirrors what the
+    # ping digests would have shown at each decision.
+    plan = [
+        ("t1", addrs[0], [0, 0, 0], 0),    # cold fleet
+        ("t2", addrs[1], [64, 0, 0], 64),  # prefix resident on n0 only
+        ("t3", addrs[2], [64, 64, 0], 64),
+        ("t4", addrs[0], [64, 64, 64], 0),  # everywhere now: no delta
+        ("t5", addrs[1], [64, 64, 64], 0),
+        ("t6", addrs[2], [64, 64, 64], 0),
+    ]
+    for i, (tail, pick, resident, red) in enumerate(plan):
+        prompt = sys_tokens + [2000 + 16 * i + j for j in range(16)]
+        hxs = chunk_hashes(prompt, bs)
+        inflight = [1 if a != pick else 0 for a in addrs]
+        tid = format(i + 1, "x") * 32
+        recs.append({
+            "event": "route_decision",
+            "decision_id": f"{tid[:16]}-{i + 1}",
+            "trace_id": tid, "t_unix_s": t + i,
+            "reason": "least_loaded", "session": False,
+            "pick": pick, "prompt_tokens": len(prompt),
+            "block_size": bs, "prompt_hashes": hxs,
+            "redundant_prefill_tokens": red,
+            "resident_replicas": sum(1 for r in resident if r > 0),
+            "candidates": [cand(a, f, r) for a, f, r
+                           in zip(addrs, inflight, resident)]})
+    # A hedge re-route and a shed — both must be EXCLUDED from replay.
+    recs.append({"event": "route_decision",
+                 "decision_id": "1111111111111111-1.h",
+                 "trace_id": "1" * 32, "t_unix_s": t + 0.5,
+                 "reason": "hedge", "session": False,
+                 "pick": addrs[1], "prompt_tokens": 80,
+                 "block_size": bs,
+                 "prompt_hashes": chunk_hashes(
+                     sys_tokens + list(range(2000, 2016)), bs),
+                 "redundant_prefill_tokens": 0, "resident_replicas": 0,
+                 "candidates": [cand(addrs[1], 0, 0),
+                                cand(addrs[2], 1, 0)]})
+    recs.append({"event": "route_decision",
+                 "decision_id": "eeeeeeeeeeeeeeee-9",
+                 "trace_id": "e" * 32, "t_unix_s": t + 9,
+                 "reason": "shed_queue_full", "session": False,
+                 "pick": None, "prompt_tokens": 0, "block_size": 0,
+                 "prompt_hashes": [], "redundant_prefill_tokens": 0,
+                 "resident_replicas": 0, "candidates": []})
+    # A digest snapshot per replica (what the pings showed post-warm).
+    sys_hxs = chunk_hashes(sys_tokens, bs)
+    for a in addrs:
+        recs.append({"event": "fleet_digest", "replica": a,
+                     "t_unix_s": t + 7, "block_size": bs, "blocks": 5,
+                     "hashes": sys_hxs,
+                     "top": [{"hash": sys_hxs[-1], "tokens": 64,
+                              "hits": 2, "age_s": 1.0}]})
+    # Round-21 waterfalls for two of the requests: observed TTFT + the
+    # prefill rate the TTFT bound scales by (20ms/80tok cold prefill =
+    # 0.25 ms/token).
+    for i, ttft in ((1, 0.030), (2, 0.031)):
+        tid = format(i + 1, "x") * 32
+        recs.append({
+            "event": "span", "span": "request", "trace_id": tid,
+            "span_id": tid[:16], "t0_unix_s": t + i,
+            "duration_s": 0.130, "node": "node0",
+            "marks_s": {"admit": 0.002, "first_token": ttft,
+                        "done": 0.130},
+            "waterfall": {
+                "v": 1, "engine": "continuous",
+                "phases": [
+                    {"phase": "queue", "t0_s": 0.0, "t1_s": 0.002,
+                     "s": 0.002},
+                    {"phase": "admit", "s": 0.001},
+                    {"phase": "compile", "s": 0.007},
+                    {"phase": "prefill", "t1_s": ttft, "s": 0.020,
+                     "chunks": [{"t0_s": 0.010, "t1_s": ttft,
+                                 "tokens": 80, "prefix_hit_tokens": 0,
+                                 "compiled": False, "stall_s": 0.0}]},
+                    {"phase": "decode", "t0_s": ttft, "t1_s": 0.130,
+                     "s": round(0.130 - ttft, 6)}],
+                "ttft_s": ttft,
+                "ttft_decomp_s": {"queue": 0.002, "admit": 0.001,
+                                  "compile": 0.007,
+                                  "prefill": 0.020},
+                "overhead_s": 0.0001}})
+        recs.append({"event": "waterfall_hop", "trace_id": tid,
+                     "node": "router0", "shed": False, "hedged": False,
+                     "retries": 0, "queue_wait_s": 0.0005,
+                     "total_s": 0.131,
+                     "decision_id": f"{tid[:16]}-{i + 1}",
+                     "pick_reason": "least_loaded"})
+    return recs
+
+
+def self_check(fixture_path: Optional[str] = None) -> dict:
+    """`slt fleetscope --self-check`: every schema/determinism promise,
+    verified on a fixture (the committed one in CI, the embedded
+    synthetic copy otherwise)."""
+    import tempfile
+
+    checks: List[dict] = []
+
+    def check(name: str, ok: bool, detail: str = ""):
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+
+    if fixture_path:
+        records = read_records([fixture_path])
+        paths = [fixture_path]
+        tmp = None
+        check("fixture_read", len(records) > 0,
+              f"{len(records)} records from {fixture_path}")
+    else:
+        records = synthetic_records()
+        tmp = tempfile.NamedTemporaryFile(
+            "w", suffix=".jsonl", delete=False)
+        for rec in records:
+            tmp.write(json.dumps(rec, sort_keys=True) + "\n")
+        tmp.close()
+        paths = [tmp.name]
+        check("fixture_read", True,
+              f"{len(records)} embedded synthetic records")
+    try:
+        prim = primary_decisions(records)
+        required = ("decision_id", "trace_id", "pick", "reason",
+                    "prompt_tokens", "block_size", "prompt_hashes",
+                    "redundant_prefill_tokens", "candidates")
+        missing = [k for d in prim for k in required if k not in d]
+        check("decision_schema", prim and not missing,
+              f"{len(prim)} primary decisions; missing: {missing}")
+        excluded = [d for d in records
+                    if d.get("event") == "route_decision"
+                    and d not in prim]
+        check("replay_excludes_nonprimary", len(excluded) >= 1,
+              f"{len(excluded)} hedge/retry/shed decision(s) excluded")
+        rep = report(paths)
+        base = rep["replay"]["recorded"]
+        summary = rep["summary"]
+        check("recorded_replay_exact",
+              base["redundant_prefill_tokens"]
+              == summary["redundant_prefill_tokens"],
+              f"simulated recorded replay "
+              f"({base['redundant_prefill_tokens']} tok) == in-event "
+              f"accounting ({summary['redundant_prefill_tokens']} tok)")
+        pa = rep["replay"].get("prefix_aware") or {}
+        check("prefix_aware_strictly_lower",
+              pa.get("redundant_prefill_tokens", 0)
+              < base["redundant_prefill_tokens"],
+              f"prefix_aware {pa.get('redundant_prefill_tokens')} < "
+              f"recorded {base['redundant_prefill_tokens']}")
+        split = rep["replay"].get("prefill_decode_split") or {}
+        check("split_no_worse",
+              split.get("redundant_prefill_tokens",
+                        base["redundant_prefill_tokens"])
+              <= base["redundant_prefill_tokens"],
+              "prefill/decode split never exceeds recorded redundancy")
+        dump1 = json.dumps(rep, sort_keys=True)
+        dump2 = json.dumps(report(paths), sort_keys=True)
+        check("byte_identical_replay", dump1 == dump2,
+              f"two same-log reports: {len(dump1)} bytes, identical")
+        check("nonzero_redundancy",
+              summary["redundant_prefill_frac"] > 0.0,
+              f"redundant frac {summary['redundant_prefill_frac']}")
+        check("spread_histogram", bool(summary["replica_spread_hist"]),
+              f"hist: {summary['replica_spread_hist']}")
+        check("ttft_bound",
+              "ttft_recorded_p99_ms" in rep
+              and pa.get("ttft_p99_bound_ms") is not None
+              and pa["ttft_p99_bound_ms"]
+              <= rep["ttft_recorded_p99_ms"],
+              f"recorded {rep.get('ttft_recorded_p99_ms')} ms >= bound "
+              f"{pa.get('ttft_p99_bound_ms')} ms")
+        rows = bench_rows(rep)
+        names = {r["metric"] for r in rows}
+        check("bench_rows",
+              "fleetscope_ttft_p99_ms" in names and all(
+                  "fleet_redundant_prefill_frac" in r
+                  and "fleet_prefix_dup_factor" in r for r in rows),
+              f"rows: {sorted(names)}")
+        return {"ok": all(c["ok"] for c in checks), "checks": checks}
+    finally:
+        if tmp is not None:
+            import os
+            os.unlink(tmp.name)

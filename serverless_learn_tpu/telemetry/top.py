@@ -226,6 +226,11 @@ def render(states: List[EndpointState]) -> str:
             roles += 1
             req_rate = st.rate("slt_router_requests_total")
             kv_free = st.val("slt_router_kv_free_frac")
+            # Fleet redundancy columns (round 22): the fraction of
+            # routed prompt tokens re-prefilled while resident on
+            # another replica, and the digest duplication factor.
+            red_frac = st.val("slt_fleet_redundant_prefill_frac")
+            dup = st.val("slt_fleet_prefix_dup_factor")
             fleet_rows.append([
                 st.addr,
                 f"{_num(st.val('slt_router_replicas_healthy'), 0)}"
@@ -242,6 +247,8 @@ def render(states: List[EndpointState]) -> str:
                 + "/" + _ms(_p(st.hist("slt_router_queue_wait_seconds"),
                                0.95)),
                 _ms(_p(st.hist("slt_router_request_seconds"), 0.95)),
+                "-" if red_frac is None else f"{red_frac * 100:.1f}%",
+                "-" if dup is None else _num(dup, 2),
             ])
         if (st.val("slt_requests_total") is not None
                 or st.val("slt_server_requests_total") is not None):
@@ -321,7 +328,8 @@ def render(states: List[EndpointState]) -> str:
         lines.append("  FLEET")
         header = ["endpoint", "healthy", "inflight", "kv free", "req/s",
                   "shed", "hedges(won)", "retries", "eject",
-                  "qwait p50/p95 ms", "lat p95 ms"]
+                  "qwait p50/p95 ms", "lat p95 ms", "rdnt pfl",
+                  "pfx dup"]
         lines += _table(header, fleet_rows)
     alert_rows: List[List[str]] = []
     for st in states:

@@ -677,8 +677,16 @@ def render(rep: dict, width: int = 64) -> str:
                 seg.append((ph["phase"][:1].upper()) * w)
         hop = req.get("router") or {}
         extra = ""
+        if hop.get("pick_reason"):
+            # Round-22 join: WHY this hop chose its replica, by name —
+            # the decision_id keys into `slt fleetscope`'s event stream.
+            extra += f" via:{hop['pick_reason']}"
+            if hop.get("decision_id"):
+                extra += f"[{hop['decision_id']}]"
         if hop.get("hedged"):
-            extra = " hedged"
+            extra += " hedged"
+            if hop.get("hedge_loser"):
+                extra += f"(lost:{hop['hedge_loser']})"
         if wf.get("stall_s"):
             worst = max(wf["stall_s"], key=wf["stall_s"].get)
             extra += f" stall:{worst}"
@@ -741,7 +749,8 @@ def synthetic_records() -> List[dict]:
                     replica="n1:9000", hedge_winner="n1:9000",
                     hedge_loser="n0:9000", hedge_wasted_s=0.041,
                     hedge_cancel_s=0.012, queue_wait_s=0.001,
-                    total_s=0.19))
+                    total_s=0.19, decision_id="aaaaaaaaaaaaaaaa-1",
+                    pick_reason="least_loaded"))
     # Request B: preempted mid-decode; plain hop.
     wf_b = {
         "v": SCHEMA_VERSION, "engine": "continuous",
@@ -770,7 +779,9 @@ def synthetic_records() -> List[dict]:
                      {"admit": 0.002, "first_token": 0.012,
                       "done": 0.212, "preempt": 0.1}, wf_b))
     recs.append(hop("bb" * 16, primary="n0:9000", replica="n0:9000",
-                    queue_wait_s=0.0004, total_s=0.22))
+                    queue_wait_s=0.0004, total_s=0.22,
+                    decision_id="bbbbbbbbbbbbbbbb-2",
+                    pick_reason="session_affinity"))
     # Request C: static engine — reduced phase set, no decode trace.
     wf_c = {
         "v": SCHEMA_VERSION, "engine": "static",
@@ -788,7 +799,8 @@ def synthetic_records() -> List[dict]:
                       "done": 0.256}, wf_c))
     # Request D: shed at the router — no engine record at all.
     recs.append(hop("dd" * 16, shed=True, queue_wait_s=0.0,
-                    total_s=0.0002))
+                    total_s=0.0002, decision_id="dddddddddddddddd-3",
+                    pick_reason="shed_queue_full"))
     return recs
 
 
@@ -826,6 +838,11 @@ def self_check(fixture_path: Optional[str] = None) -> dict:
                and r["router"].get("hedge_wasted_s") is not None)
               for r in hedge),
           f"{len(hedge)} hedged hop(s) carry winner/loser/wasted")
+    check("decision_join",
+          any((r.get("router") or {}).get("decision_id")
+              and (r.get("router") or {}).get("pick_reason")
+              for r in requests),
+          "hop records carry route-decision id + pick reason (round 22)")
     bad_phase = [p.get("phase") for r in with_wf
                  for p in r["waterfall"].get("phases", [])
                  if p.get("phase") not in PHASES]

@@ -1,0 +1,445 @@
+"""Fleetscope (round 22): resident-prefix digests, windowed hit rates,
+router decision provenance, fleet redundancy accounting, and the
+deterministic counterfactual replay.
+
+The digest contract under test: one 64-bit chain hash names one exact
+token prefix (chunk i's hash folds in chunk i-1's, so equal hashes mean
+equal full prefixes, not just equal chunks); digests truncate
+shallow-first so a capped digest UNDER-counts redundancy; and the whole
+pipeline — trie digest -> ping -> router accounting -> `slt fleetscope`
+replay — is deterministic: same logs, byte-identical reports. The slow
+acceptance at the bottom proves it end to end on a live stub fleet with
+the redundancy injected by construction.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from serverless_learn_tpu.inference.kvcache import (BlockPool, PrefixTrie,
+                                                    chunk_hashes)
+from serverless_learn_tpu.telemetry import fleetscope
+from serverless_learn_tpu.telemetry.registry import MetricsRegistry
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "fleetscope",
+                       "fleetscope_fixture.jsonl")
+BENCH_FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                             "fleetscope", "bench_history_fleetscope.json")
+
+BS = 16
+
+
+def _ingest(trie: PrefixTrie, pool: BlockPool, prompt):
+    """The engine's register pattern: matched nodes keep their refs,
+    fresh blocks pass ownership to the trie."""
+    hit = trie.lookup(prompt)
+    need = len(prompt) // trie.block_size - len(hit.blocks)
+    if need > 0:
+        fresh = pool.alloc(need)
+        trie.register(prompt, list(hit.blocks) + fresh)
+        pool.decref(fresh)
+
+
+# -- digest semantics --------------------------------------------------------
+
+
+def test_chunk_hashes_chain_names_exact_prefix():
+    """Chained hashing: chunk i's hash commits to every token before it,
+    so two streams agree on hash i iff they agree on the whole prefix —
+    and diverge on every hash after their first differing token."""
+    a = list(range(64))
+    b = list(range(64))
+    b[3] = 999                       # early divergence
+    ha, hb = chunk_hashes(a, BS), chunk_hashes(b, BS)
+    assert len(ha) == len(hb) == 4
+    assert all(len(h) == 16 for h in ha)          # 64-bit hex
+    assert ha[0] != hb[0] and all(x != y for x, y in zip(ha, hb))
+    # Same chunk CONTENT at a different position hashes differently.
+    c = a[16:32] + a[16:32]
+    hc = chunk_hashes(c, BS)
+    assert hc[0] != hc[1]
+    # Pure function: a second call is bit-identical (restart-stable).
+    assert chunk_hashes(a, BS) == ha
+
+
+def test_collision_bound_is_documented_and_unexercised():
+    """64-bit digests: the birthday bound (~n^2 / 2^65) is documented at
+    the definition site, and a few thousand distinct prefixes produce
+    zero collisions in practice — a collision would only over-count
+    redundancy by one block-chunk, never corrupt the cache itself."""
+    import inspect
+
+    import serverless_learn_tpu.inference.kvcache as kvcache
+
+    doc = inspect.getsource(kvcache)
+    assert "collision" in doc.lower()
+    seen = set()
+    for i in range(200):
+        for h in chunk_hashes([i * 1000 + j for j in range(160)], BS):
+            assert h not in seen
+            seen.add(h)
+    assert len(seen) == 200 * 10
+
+
+def test_trie_digest_deterministic_across_restarts():
+    """Two fresh tries (a restart) fed the same prompts — in DIFFERENT
+    arrival orders — export identical digest hash sets: the digest
+    depends on what is resident, never on insertion history."""
+    prompts = [list(range(100, 164)) + [i] * 16 for i in range(4)]
+    digests = []
+    for order in (prompts, prompts[::-1]):
+        pool = BlockPool(64, BS)
+        trie = PrefixTrie(pool)
+        for p in order:
+            _ingest(trie, pool, p)
+        digests.append(trie.digest(max_hashes=64))
+    assert sorted(digests[0]["hashes"]) == sorted(digests[1]["hashes"])
+    assert digests[0]["block_size"] == BS
+
+
+def test_digest_truncation_drops_deepest_chunks_first():
+    """A capped digest keeps the SHALLOW chunks (BFS): the router then
+    sees a shorter resident run and UNDER-counts redundancy — capping
+    must never fabricate residency."""
+    prompt = list(range(160))        # 10 chunks, one chain
+    pool = BlockPool(32, BS)
+    trie = PrefixTrie(pool)
+    _ingest(trie, pool, prompt)
+    full = chunk_hashes(prompt, BS)
+    dg = trie.digest(max_hashes=4)
+    assert dg["hashes"] == full[:4]
+    assert trie.digest(max_hashes=64)["hashes"] == full
+
+
+def test_digest_top_tracks_hot_deepest_prefix():
+    """Hot-prefix stats land on the DEEPEST matched node — one lookup is
+    one hit on its longest resident prefix, with resident token counts
+    and a last-hit age."""
+    prompt = list(range(64))
+    pool = BlockPool(32, BS)
+    trie = PrefixTrie(pool)
+    _ingest(trie, pool, prompt)
+    for _ in range(3):
+        trie.lookup(prompt)
+    top = trie.digest(top_k=4)["top"]
+    assert top and top[0]["tokens"] == 64
+    assert top[0]["hits"] == 3
+    assert top[0]["hash"] == chunk_hashes(prompt, BS)[-1]
+    assert top[0]["age_s"] >= 0.0
+
+
+# -- windowed hit rate (satellite: the stale lifetime-rate fix) --------------
+
+
+def test_windowed_hit_rate_tracks_traffic_shift():
+    """The replica ping's prefix_hit_rate must MOVE when traffic moves:
+    after a shift from all-hit to all-miss traffic the windowed rate
+    collapses while the lifetime rate (still exported, renamed) lags —
+    the round-21 bug was shipping the lifetime number as the rate."""
+    pool = BlockPool(256, BS)
+    trie = PrefixTrie(pool, hit_window=8)
+    hot = list(range(64))
+    _ingest(trie, pool, hot)
+    for _ in range(16):
+        trie.lookup(hot)                       # phase A: all hits
+    assert trie.window_hit_rate() == 1.0
+    for i in range(8):                         # phase B: all misses
+        trie.lookup([1000 + 64 * i + j for j in range(64)])
+    assert trie.window_hit_rate() == 0.0       # window: misses only
+    lifetime = trie.hits / trie.lookups
+    assert lifetime > 0.5                      # the stale number lags
+
+
+def test_kv_stats_ping_carries_digest_and_both_rates():
+    from serverless_learn_tpu.fleet.testing import KVStubEngine
+
+    eng = KVStubEngine(num_blocks=64, block_size=BS, hit_window=8)
+    prompt = list(range(64))
+    eng.submit(prompt, 2)
+    eng.submit(prompt, 2)
+    kv = eng.kv_stats()
+    assert kv["paged"] and kv["block_size"] == BS
+    assert 0.0 <= kv["prefix_hit_rate"] <= 1.0
+    assert "prefix_hit_rate_lifetime" in kv
+    dg = kv["prefix_digest"]
+    assert dg["hashes"] == chunk_hashes(prompt, BS)
+    assert dg["top"] and dg["top"][0]["tokens"] == 64
+
+
+# -- router decision provenance ----------------------------------------------
+
+
+def _make_router(replicas, registry=None, events=None, **cfg_kw):
+    from serverless_learn_tpu.config import FleetConfig
+    from serverless_learn_tpu.fleet.router import FleetRouter
+
+    defaults = dict(health_interval_s=0.15, dead_after_probes=2,
+                    discover_interval_s=0.3, hedge_min_delay_s=5.0,
+                    eject_s=0.4, upstream_timeout_s=5.0,
+                    queue_timeout_s=1.0)
+    defaults.update(cfg_kw)
+    return FleetRouter(config=FleetConfig(**defaults), host="127.0.0.1",
+                       port=0, replicas=tuple(replicas),
+                       registry=registry or MetricsRegistry(),
+                       emit=(events.append if events is not None
+                             else lambda rec: None))
+
+
+def _decisions(events):
+    return [e for e in events if e.get("event") == "route_decision"]
+
+
+def test_route_decision_event_and_hop_join():
+    """Every admission emits a route_decision with full candidate
+    provenance, and the waterfall hop carries the decision id + pick
+    reason — the satellite-2 join that lets `slt waterfall` say WHY a
+    hop chose its replica."""
+    from serverless_learn_tpu.fleet.testing import KVStubEngine, stub_server
+    from serverless_learn_tpu.inference.server import request
+
+    r1 = stub_server(engine=KVStubEngine(num_blocks=64, block_size=BS))
+    r2 = stub_server(engine=KVStubEngine(num_blocks=64, block_size=BS))
+    events = []
+    router = _make_router([r1.addr, r2.addr], events=events).start()
+    try:
+        time.sleep(0.4)                # first probes: digests land
+        rep = request(router.addr,
+                      {"prompt": list(range(40)), "max_new_tokens": 2})
+        assert "tokens" in rep
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and not (
+                _decisions(events)
+                and any(e.get("event") == "waterfall_hop"
+                        for e in events)):
+            time.sleep(0.02)
+        (dec,) = _decisions(events)
+        assert dec["reason"] == "least_loaded" and not dec["session"]
+        assert dec["pick"] in (r1.addr, r2.addr)
+        assert dec["prompt_tokens"] == 40
+        cands = {c["addr"]: c for c in dec["candidates"]}
+        assert set(cands) == {r1.addr, r2.addr}
+        for c in cands.values():
+            assert c["eligible"] is True and c["inflight"] >= 0
+            assert "kv_pressure_bucket" in c and "resident_tokens" in c
+        # Digests probed -> the prompt's chain hashes ride the event.
+        assert dec["block_size"] == BS
+        assert dec["prompt_hashes"] == chunk_hashes(list(range(40)), BS)
+        (hop,) = [e for e in events if e.get("event") == "waterfall_hop"]
+        assert hop["decision_id"] == dec["decision_id"]
+        assert hop["pick_reason"] == "least_loaded"
+        assert hop["trace_id"] == dec["trace_id"]
+    finally:
+        router.stop(), r1.stop(), r2.stop()
+
+
+def test_session_affinity_reason_and_shed_decision():
+    from serverless_learn_tpu.fleet.testing import stub_server
+    from serverless_learn_tpu.inference.server import request
+
+    r1 = stub_server()
+    events = []
+    router = _make_router([r1.addr], events=events).start()
+    try:
+        time.sleep(0.3)
+        request(router.addr, {"prompt": [1, 2], "max_new_tokens": 2,
+                              "session": "s1"})
+        deadline = time.monotonic() + 3.0
+        while not _decisions(events) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert _decisions(events)[0]["reason"] == "session_affinity"
+        assert _decisions(events)[0]["session"] is True
+    finally:
+        router.stop(), r1.stop()
+    # A fleet with no live replicas sheds — and says so in a decision.
+    events2 = []
+    router2 = _make_router([], events=events2).start()
+    try:
+        rep = request(router2.addr, {"prompt": [1], "max_new_tokens": 1})
+        assert rep.get("code") == "overloaded"
+        deadline = time.monotonic() + 3.0
+        while not _decisions(events2) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        dec = _decisions(events2)[0]
+        assert dec["reason"] == "shed_no_replicas"
+        assert dec["pick"] is None and dec["candidates"] == []
+    finally:
+        router2.stop()
+
+
+def test_waterfall_render_shows_decision_provenance():
+    """`slt waterfall` phase bars carry via:<reason>[<decision_id>] once
+    the router stamps hops (and hedge losers show their provenance)."""
+    from serverless_learn_tpu.telemetry import waterfall
+
+    recs = waterfall.synthetic_records()
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    try:
+        out = waterfall.render(waterfall.report([f.name]))
+    finally:
+        os.unlink(f.name)
+    assert "via:least_loaded[aaaaaaaaaaaaaaaa-1]" in out
+    assert "via:session_affinity[bbbbbbbbbbbbbbbb-2]" in out
+    assert "(lost:" in out                    # hedge loser provenance
+
+
+# -- accounting + replay over the fabricated fixture -------------------------
+
+
+def _fixture_records():
+    with open(FIXTURE) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_redundancy_accounting_exact_on_fixture():
+    """The fabricated 3-replica fixture has hand-computable redundancy:
+    the 64-token shared prefix is re-prefilled exactly twice (n1, n2)
+    under the recorded least-loaded spread = 128 redundant tokens of
+    480 routed; prefix-aware consolidation re-prefills it never."""
+    recs = _fixture_records()
+    summary = fleetscope.summarize(recs)
+    assert summary["primary_decisions"] == 6
+    assert summary["routed_prompt_tokens"] == 480
+    assert summary["redundant_prefill_tokens"] == 128
+    assert summary["redundant_prefill_frac"] == pytest.approx(128 / 480,
+                                                              abs=1e-5)
+    assert summary["replica_spread_hist"] == {"0": 1, "1": 1, "2": 1,
+                                              "3": 3}
+    assert summary["prefix_dup_factor"] == pytest.approx(2.4)
+    assert set(summary["digests"]) == {"n0:9000", "n1:9000", "n2:9000"}
+    # The replay simulator, fed the SAME picks, reproduces the in-event
+    # accounting exactly — and the counterfactuals order as designed.
+    assert fleetscope.replay(recs, "recorded")[
+        "redundant_prefill_tokens"] == 128
+    assert fleetscope.replay(recs, "least_loaded")[
+        "redundant_prefill_tokens"] == 128
+    assert fleetscope.replay(recs, "prefix_aware")[
+        "redundant_prefill_tokens"] == 0
+    assert fleetscope.replay(recs, "prefill_decode_split")[
+        "redundant_prefill_tokens"] == 0
+
+
+def test_replay_excludes_hedge_retry_and_shed_decisions():
+    recs = _fixture_records()
+    prim = fleetscope.primary_decisions(recs)
+    ids = {d["decision_id"] for d in prim}
+    assert len(prim) == 6
+    assert not any("." in i for i in ids)          # no hedge/retry
+    assert "eeeeeeeeeeeeeeee-9" not in ids         # no shed
+
+
+def test_report_is_byte_identical_and_bounds_ttft():
+    rep1 = fleetscope.report([FIXTURE])
+    rep2 = fleetscope.report([FIXTURE])
+    assert json.dumps(rep1, sort_keys=True) == json.dumps(rep2,
+                                                          sort_keys=True)
+    pa = rep1["replay"]["prefix_aware"]
+    assert pa["redundant_tokens_saved_vs_recorded"] == 128
+    # The TTFT bound scales saved prefill tokens by the waterfall's
+    # observed prefill s/token — never below zero, never above recorded.
+    assert pa["ttft_p99_bound_ms"] <= rep1["ttft_recorded_p99_ms"]
+    assert rep1["savings"]["prefill_tokens"] == 128
+    assert rep1["savings"]["ttft_p99_ms"] > 0
+
+
+def test_self_check_passes_on_synthetic_and_committed_fixture():
+    rep = fleetscope.self_check()
+    assert rep["ok"], rep["checks"]
+    rep = fleetscope.self_check(fixture_path=FIXTURE)
+    assert rep["ok"], rep["checks"]
+    assert {c["check"] for c in rep["checks"]} >= {
+        "recorded_replay_exact", "prefix_aware_strictly_lower",
+        "byte_identical_replay", "ttft_bound"}
+
+
+def test_bench_rows_carry_redundancy_columns_and_gate():
+    """The fleetscope rows gate as *_ms (better=min) with the redundancy
+    fraction + dup factor as attribution columns — a standalone fraction
+    row would gate better=max, the wrong direction."""
+    from serverless_learn_tpu.telemetry import benchgate
+    from serverless_learn_tpu.utils.benchlog import load_history
+
+    rows = fleetscope.bench_rows(fleetscope.report([FIXTURE]))
+    (row,) = rows
+    assert row["metric"] == "fleetscope_ttft_p99_ms"
+    assert row["fleet_redundant_prefill_frac"] == pytest.approx(128 / 480,
+                                                                abs=1e-5)
+    assert row["fleet_prefix_dup_factor"] == pytest.approx(2.4)
+    assert "fleet_redundant_prefill_frac" in benchgate.ATTRIBUTION_COLUMNS
+    assert "fleet_prefix_dup_factor" in benchgate.ATTRIBUTION_COLUMNS
+    rep = benchgate.gate_history(load_history(BENCH_FIXTURE),
+                                 metric="fleetscope_")
+    assert rep["ok"] and rep["series"] == 2
+    cols = {a["column"] for c in rep["checks"]
+            for a in c.get("attribution", [])}
+    assert cols >= {"fleet_redundant_prefill_frac",
+                    "fleet_prefix_dup_factor"}
+
+
+# -- surfacing: top pane, exporter endpoint, doctor --------------------------
+
+
+def test_top_and_exporter_surface_fleet_redundancy():
+    from serverless_learn_tpu.telemetry import top as top_mod
+    from serverless_learn_tpu.telemetry.exporter import MetricsExporter
+
+    reg = MetricsRegistry()
+    reg.gauge("slt_router_replicas", "n").set(3)
+    reg.gauge("slt_router_replicas_healthy", "n").set(3)
+    reg.counter("slt_fleet_routed_prompt_tokens_total", "tok").inc(480)
+    reg.counter("slt_fleet_redundant_prefill_tokens_total",
+                "tok").inc(128)
+    reg.gauge("slt_fleet_redundant_prefill_frac", "frac").set(0.2667)
+    reg.gauge("slt_fleet_prefix_dup_factor", "x").set(2.4)
+    exp = MetricsExporter(registry=reg).start()
+    try:
+        st = top_mod.EndpointState(exp.addr)
+        st.poll()
+        out = top_mod.render([st])
+        scope = json.loads(top_mod.fetch_text(exp.addr,
+                                              path="/fleetscope"))
+    finally:
+        exp.stop()
+    assert "rdnt pfl" in out and "pfx dup" in out
+    assert "26.7%" in out and "2.40" in out
+    assert scope["enabled"]
+    assert scope["routed_prompt_tokens"] == 480
+    assert scope["redundant_prefill_tokens"] == 128
+    assert scope["redundant_prefill_frac"] == pytest.approx(0.2667)
+    assert scope["prefix_dup_factor"] == pytest.approx(2.4)
+
+
+def test_doctor_names_redundancy_opportunity_from_logs_alone():
+    from serverless_learn_tpu.telemetry import doctor
+
+    rep = doctor.diagnose(paths=[FIXTURE], bench_history=BENCH_FIXTURE)
+    verdict = rep["summary"]["verdict"]
+    assert "fleet prefix redundancy" in verdict
+    assert "slt fleetscope" in verdict
+    assert rep["fleetscope"]["redundant_prefill_tokens"] == 128
+
+
+# -- acceptance: live stub fleet with constructed redundancy -----------------
+
+
+@pytest.mark.slow
+def test_fleetscope_smoke_live_fleet_acceptance():
+    """The round-22 acceptance on a live 3-replica stub fleet: real
+    prefix tries behind real sockets, one replica pre-warmed with the
+    shared prefix by construction — live counters account the
+    redundancy, digests snapshot, prefix-aware replay strictly beats
+    the recorded stream, reports byte-identical."""
+    from serverless_learn_tpu.fleet.loadgen import run_fleetscope_smoke
+
+    rep = run_fleetscope_smoke(seed=0)
+    assert rep["ok"], rep["checks"]
+    assert rep["router"]["redundant_prefill_tokens_total"] > 0
+    assert rep["bench_rows"] and \
+        "fleet_redundant_prefill_frac" in rep["bench_rows"][0]
