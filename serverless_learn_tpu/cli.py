@@ -878,6 +878,18 @@ def cmd_loadgen(args) -> int:
             history_path=args.history if args.record else None)
         print(json.dumps(rep, indent=None if args.compact else 2))
         return 0 if rep["ok"] else 1
+    if args.canary_smoke:
+        # Round-23 acceptance run: a 2-version stub fleet with a 50%
+        # session-sticky split and golden probes; exit 0 iff the healthy
+        # leg promotes, the injected one-token quality regression flips
+        # the verdict to rollback naming the fingerprint evidence,
+        # probes stay out of the user latency SLIs, and the probe
+        # overhead share is exported and bounded.
+        rep = loadgen.run_canary_smoke(
+            seed=args.seed,
+            history_path=args.history if args.record else None)
+        print(json.dumps(rep, indent=None if args.compact else 2))
+        return 0 if rep["ok"] else 1
     if args.kv_smoke:
         # Round-13 serving headline: same seeded shared-prefix workload
         # at the same offered load vs the paged and monolithic engines;
@@ -1536,6 +1548,52 @@ def cmd_fleetscope(args) -> int:
     return 0 if rep["summary"]["primary_decisions"] > 0 else 1
 
 
+def cmd_canary(args) -> int:
+    """Version-scoped serving SLIs + the promote/hold/rollback verdict
+    engine (telemetry/canary.py): merge ``fleet_version`` /
+    ``canary_config`` / ``canary_probe`` / ``route_decision`` records
+    from router event logs into per-weight-version SLIs (probe traffic
+    excluded), then print the deterministic verdict with its named
+    evidence. Exit 0 on promote/hold, 1 on rollback — scriptable as a
+    deployment gate."""
+    from serverless_learn_tpu.telemetry import canary
+
+    if args.self_check:
+        rep = canary.self_check(fixture_path=args.fixture)
+        print(json.dumps(rep, indent=None if args.compact else 2))
+        return 0 if rep["ok"] else 1
+    if not args.paths:
+        print("canary needs router event logs (--events-log JSONL with "
+              "fleet_version/canary_probe/route_decision records, or "
+              "dirs of them) or --self-check", file=sys.stderr)
+        return 2
+    try:
+        rep = canary.report(args.paths)
+    except (FileNotFoundError, OSError, ValueError) as e:
+        print(f"canary: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    if not rep["records"]:
+        # read_records tolerates missing/garbled files (doctor's rules);
+        # a verdict over ZERO records would be a vacuous "hold" — a gate
+        # pointed at the wrong log must fail loudly instead.
+        print(f"canary: no records in {', '.join(args.paths)}",
+              file=sys.stderr)
+        return 2
+    if args.bench_history:
+        from serverless_learn_tpu.utils.benchlog import record
+
+        for row in canary.bench_rows(rep, device_kind=args.device_kind):
+            record(row, args.bench_history, better="min",
+                   rel_threshold=0.25,
+                   key_fields=("metric", "device_kind"))
+    if args.json:
+        print(json.dumps(rep, sort_keys=True,
+                         indent=None if args.compact else 2))
+    else:
+        print(canary.render(rep))
+    return 1 if rep["verdict"]["decision"] == "rollback" else 0
+
+
 def cmd_bench(args) -> int:
     """Headline benchmark + the perf regression gate. `--gate` compares
     against bench_history.json with the noise-aware threshold
@@ -2134,6 +2192,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "are byte-identical; --record appends the "
                          "fleetscope_smoke_p99_ms row with redundancy "
                          "attribution columns")
+    lg.add_argument("--canary-smoke", action="store_true",
+                    help="canary acceptance run: a 3-replica stub fleet "
+                         "serving two weight versions under a 50%% "
+                         "session-sticky split with golden probes; exit "
+                         "0 iff the healthy leg PROMOTES, an injected "
+                         "one-token output regression flips the verdict "
+                         "to ROLLBACK on fingerprint evidence, probe "
+                         "traffic stays out of the user latency SLIs and "
+                         "its overhead share stays bounded; --record "
+                         "appends the canary_candidate_p99_ms row with "
+                         "verdict attribution columns")
     lg.add_argument("--kv-smoke", action="store_true",
                     help="paged-KV serving headline: seeded shared-prefix "
                          "+ long-prompt workload at fixed offered load vs "
@@ -2480,6 +2549,42 @@ def build_parser() -> argparse.ArgumentParser:
                           "TTFT bound below the recorded p99; exit 1 on "
                           "drift")
     fsc.set_defaults(fn=cmd_fleetscope)
+
+    cnr = sub.add_parser("canary",
+                         help="version-scoped serving SLIs + the "
+                              "promote/hold/rollback verdict engine "
+                              "from router event logs")
+    cnr.add_argument("paths", nargs="*", metavar="EVENTS",
+                     help="JSONL event logs (router --events-log output) "
+                          "or directories of them; fleet_version, "
+                          "canary_config, canary_probe, route_decision "
+                          "and request-span records merge")
+    cnr.add_argument("--json", action="store_true",
+                     help="full JSON report (sorted keys — byte-identical"
+                          " for identical logs) instead of the rendering")
+    cnr.add_argument("--compact", action="store_true",
+                     help="single-line JSON (for scripts)")
+    cnr.add_argument("--device-kind", default="cpu",
+                     help="device-kind stamp for --bench-history rows")
+    cnr.add_argument("--bench-history", metavar="FILE", default=None,
+                     help="append the canary_candidate_p99_ms row (with "
+                          "canary_probe_match_frac / "
+                          "canary_ttft_p99_delta_frac / canary_verdict "
+                          "attribution columns) to this bench history "
+                          "for `slt bench --gate`")
+    cnr.add_argument("--fixture", metavar="FILE", default=None,
+                     help="committed fixture JSONL for --self-check "
+                          "(default: the embedded synthetic records)")
+    cnr.add_argument("--self-check", action="store_true",
+                     help="CI smoke: the committed 2-version fixture "
+                          "reproduces the hand-computed verdicts — "
+                          "promote on parity, rollback on an injected "
+                          "probe-fingerprint regression, rollback on an "
+                          "injected TTFT-p99 regression — each naming "
+                          "its evidence, with probe traffic provably "
+                          "excluded from user SLIs and byte-identical "
+                          "reports; exit 1 on drift")
+    cnr.set_defaults(fn=cmd_canary)
 
     bn = sub.add_parser("bench",
                         help="headline benchmark + perf regression gate "

@@ -523,6 +523,13 @@ class FleetConfig:
     # priority<=0 traffic sheds with the typed overload error — queue
     # depth alone cannot see a fleet whose KV pools are nearly exhausted.
     kv_shed_free_frac: float = 0.02
+    # ---- canary version split (round 23) ----
+    # Initial candidate weight-version fingerprint + traffic fraction;
+    # FleetRouter.set_canary() reconfigures the split at runtime (the
+    # config object stays frozen like every other section). Assignment
+    # is session-sticky: one conversation never straddles versions.
+    canary_version: Optional[str] = None
+    canary_frac: float = 0.0
     # ---- autoscaler ----
     autoscale: bool = False
     min_replicas: int = 1

@@ -913,6 +913,238 @@ def run_fleetscope_smoke(seed: int = 0, n_requests: int = 48,
     return result
 
 
+def _await_versions(router, n: int, deadline_s: float = 5.0) -> dict:
+    """Poll until ``n`` replicas have reported a weight fingerprint
+    (ping-ingested) or the deadline passes; returns the addr->version
+    map either way."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        with router._lock:
+            vers = {r.addr: r.version
+                    for r in router._replicas.values() if r.version}
+        if len(vers) >= n or time.monotonic() > deadline:
+            return vers
+        time.sleep(0.02)
+
+
+def run_canary_smoke(seed: int = 0, n_requests: int = 64,
+                     concurrency: int = 8,
+                     events_path: Optional[str] = None,
+                     history_path: Optional[str] = None) -> dict:
+    """The canary acceptance proof (round 23), measured not asserted:
+    two legs over a 3-replica stub fleet serving TWO weight versions
+    (2x baseline, 1x candidate — the fingerprints ride the admin ping),
+    a 50% session-sticky split, golden probes pinned per version, and
+    the verdict computed offline from the JSONL event log alone.
+
+    * healthy leg: identical candidate behavior -> verdict PROMOTE,
+      probe match 100%, probe traffic absent from the router's
+      user-latency histogram, probe overhead share exported + bounded;
+    * regression leg: the candidate replica's generation is shifted by
+      one token (``reply_offset=1`` — same latency, different content)
+      -> the golden probes alone flip the verdict to ROLLBACK naming
+      the fingerprint evidence. No latency series could see this.
+    * shed exemption: against a saturated 1-replica router in brownout,
+      a priority-0 user request sheds instantly while a probe —
+      identical except for the tag — is admitted and answered.
+
+    The candidate p99 row lands in bench history carrying the
+    ``canary_probe_match_frac`` / ``canary_ttft_p99_delta_frac`` /
+    ``canary_verdict_ok`` attribution columns, gated by
+    ``slt bench --gate``."""
+    import os
+    import tempfile
+
+    from serverless_learn_tpu.config import FleetConfig
+    from serverless_learn_tpu.fleet.router import FleetRouter
+    from serverless_learn_tpu.fleet.testing import StubEngine, stub_server
+    from serverless_learn_tpu.telemetry import canary as canary_mod
+    from serverless_learn_tpu.telemetry.registry import (JsonlEventLog,
+                                                         MetricsRegistry)
+
+    v_base, v_cand = "basefp000001", "candfp000002"
+    checks: List[dict] = []
+
+    def check(name, ok, detail):
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+
+    def leg(name: str, reply_offset: int, leg_events: str) -> dict:
+        log = JsonlEventLog(leg_events)
+        registry = MetricsRegistry()
+        servers = [
+            stub_server(engine=StubEngine(latency_s=0.02,
+                                          weight_version=v_base)),
+            stub_server(engine=StubEngine(latency_s=0.02,
+                                          weight_version=v_base)),
+            stub_server(engine=StubEngine(latency_s=0.02,
+                                          weight_version=v_cand,
+                                          reply_offset=reply_offset)),
+        ]
+        cfg = FleetConfig(max_inflight=256, health_interval_s=0.05,
+                          dead_after_probes=5, hedge_min_delay_s=5.0)
+        router = FleetRouter(config=cfg, host="127.0.0.1", port=0,
+                             replicas=tuple(s.addr for s in servers),
+                             registry=registry, emit=log.emit).start()
+        try:
+            vers = _await_versions(router, 3)
+            router.set_canary(v_cand, 0.5)
+            prober = canary_mod.CanaryProber(
+                send=lambda req: _one_request(router.addr, req, 10.0),
+                candidate_version=v_cand, baseline_version=v_base,
+                registry=registry, emit=log.emit)
+            prober.record_baseline()
+            prober.run_round()
+
+            def make(i: int) -> dict:
+                return {"prompt": [1 + (i % 7), 2, 3], "max_new_tokens": 4,
+                        "session": f"sess-{i}"}
+
+            out = run_closed_loop(router.addr, concurrency, n_requests,
+                                  seed=seed, make_request=make,
+                                  timeout_s=20.0)
+            prober.run_round()
+        finally:
+            router.stop()
+            for s in servers:
+                try:
+                    s.stop()
+                except Exception:
+                    pass
+            log.close()
+        snap = registry.snapshot()
+
+        def _val(metric):
+            fam = snap.get(metric) or {}
+            return sum(s.get("value", 0) for s in fam.get("series", []))
+
+        def _hist_count(metric):
+            fam = snap.get(metric) or {}
+            return sum(s.get("count", 0) for s in fam.get("series", []))
+
+        rep = canary_mod.report([leg_events])
+        return {"name": name, "client": out, "replica_versions": vers,
+                "report": rep, "prober": {"sent": prober.sent,
+                                          "matched": prober.matched,
+                                          "mismatched": prober.mismatched},
+                "router": {
+                    "user_latency_samples": _hist_count(
+                        "slt_router_request_seconds"),
+                    "probe_requests": _val(
+                        "slt_canary_probe_requests_total"),
+                    "probe_overhead_frac": _val(
+                        "slt_canary_probe_overhead_frac"),
+                    "weight_versions": _val("slt_fleet_weight_versions")}}
+
+    own_tmp = events_path is None
+    if own_tmp:
+        fd, events_path = tempfile.mkstemp(suffix=".jsonl",
+                                           prefix="slt-canary-")
+        os.close(fd)
+    reg_events = events_path + ".regression"
+    try:
+        healthy = leg("healthy", 0, events_path)
+        regress = leg("regression", 1, reg_events)
+    finally:
+        if own_tmp and os.path.exists(events_path):
+            os.unlink(events_path)
+        if os.path.exists(reg_events):
+            os.unlink(reg_events)
+
+    h_rep, r_rep = healthy["report"], regress["report"]
+    h_vd, r_vd = h_rep["verdict"], r_rep["verdict"]
+    probes_routed = healthy["router"]["probe_requests"]
+    check("no_hard_failures",
+          healthy["client"]["hard_failures"] == 0
+          and healthy["client"]["ok"] == n_requests
+          and regress["client"]["hard_failures"] == 0,
+          {k: healthy["client"][k] for k in ("sent", "ok", "shed")})
+    check("two_versions_in_service",
+          healthy["router"]["weight_versions"] == 2
+          and len(set(healthy["replica_versions"].values())) == 2,
+          f"versions gauge {healthy['router']['weight_versions']}, "
+          f"pings {sorted(set(healthy['replica_versions'].values()))}")
+    check("split_served_both_sides",
+          (h_rep["summary"]["versions"].get(v_cand, {}).get("requests", 0)
+           >= 8)
+          and (h_rep["summary"]["versions"].get(v_base, {})
+               .get("requests", 0) >= 8),
+          {v: h_rep["summary"]["versions"][v].get("requests")
+           for v in sorted(h_rep["summary"]["versions"])})
+    check("verdict_promote_when_healthy",
+          h_vd["decision"] == "promote"
+          and h_vd["probe_match_frac"] == 1.0,
+          f"{h_vd['decision']}: {h_vd['evidence']}")
+    check("verdict_rollback_on_probe_regression",
+          r_vd["decision"] == "rollback"
+          and any("golden-probe" in e for e in r_vd["evidence"]),
+          f"{r_vd['decision']}: {r_vd['evidence']}")
+    check("probes_excluded_from_user_slis",
+          healthy["router"]["user_latency_samples"] == n_requests
+          and probes_routed > 0,
+          f"latency histogram {healthy['router']['user_latency_samples']} "
+          f"samples for {n_requests} user requests "
+          f"({probes_routed:.0f} probes routed besides)")
+    check("probe_overhead_exported_and_bounded",
+          0.0 < healthy["router"]["probe_overhead_frac"] <= 0.30
+          and 0.0 < h_rep["summary"]["probe_overhead_frac"] <= 0.30,
+          f"gauge {healthy['router']['probe_overhead_frac']}, "
+          f"ledger {h_rep['summary']['probe_overhead_frac']}")
+
+    # Shed exemption, caught in the act: a 1-replica router saturated
+    # into brownout sheds a priority-0 user request instantly but admits
+    # the probe — the identical request, tagged.
+    slow = stub_server(engine=StubEngine(latency_s=0.5))
+    cfg = FleetConfig(max_inflight=2, shed_start_frac=0.5,
+                      queue_timeout_s=3.0, health_interval_s=0.05,
+                      hedge=False)
+    router = FleetRouter(config=cfg, host="127.0.0.1", port=0,
+                         replicas=(slow.addr,),
+                         registry=MetricsRegistry(),
+                         emit=lambda rec: None).start()
+    try:
+        _await_versions(router, 0, deadline_s=0.5)
+        occupant = threading.Thread(
+            target=lambda: _one_request(
+                router.addr, {"prompt": [1, 2], "max_new_tokens": 1},
+                10.0), daemon=True)
+        occupant.start()
+        time.sleep(0.1)  # occupant holds 1 of 2 slots; shed_at == 1
+        user = _one_request(router.addr,
+                            {"prompt": [1, 2], "max_new_tokens": 1,
+                             "priority": 0}, 10.0)
+        probe = _one_request(router.addr,
+                             {"prompt": [1, 2], "max_new_tokens": 1,
+                              "priority": 0, "probe": True}, 10.0)
+        occupant.join(timeout=10)
+        check("probe_shed_exempt",
+              user.get("code") == "overloaded"
+              and "error" not in probe,
+              f"priority-0 user: {user.get('error')!r}; "
+              f"probe: {'ok' if 'error' not in probe else probe['error']}")
+    finally:
+        router.stop()
+        try:
+            slow.stop()
+        except Exception:
+            pass
+
+    rows = canary_mod.bench_rows(h_rep, device_kind="fleet-stub")
+    if history_path:
+        from serverless_learn_tpu.utils.benchlog import record
+
+        for row in rows:
+            record(row, history_path, better="min", rel_threshold=0.5,
+                   key_fields=("metric", "device_kind"))
+    return {"ok": all(c["ok"] for c in checks), "checks": checks,
+            "healthy": {"client": healthy["client"],
+                        "verdict": h_vd,
+                        "router": healthy["router"]},
+            "regression": {"verdict": r_vd,
+                           "prober": regress["prober"]},
+            "bench_rows": rows,
+            "events_path": None if own_tmp else events_path}
+
+
 # -- the CI smoke ------------------------------------------------------------
 
 

@@ -7,10 +7,14 @@ capability 1). A serving replica reuses the exact machinery training
 workers use: a :class:`~serverless_learn_tpu.control.client.WorkerAgent`
 registers with the coordinator (hardened transport, lease heartbeats,
 re-registration after a lapse) under a ``replica:<service>[:<metrics
-addr>]`` name, and deregisters — after a graceful drain — on SIGTERM.
-The router polls coordinator membership and recognizes replicas purely
-by that name convention; a replica whose lease lapses (crash, partition)
-vanishes from membership, which the router treats as retirement.
+addr>][;v=<weight fingerprint>]`` name, and deregisters — after a
+graceful drain — on SIGTERM. The router polls coordinator membership and
+recognizes replicas purely by that name convention; a replica whose
+lease lapses (crash, partition) vanishes from membership, which the
+router treats as retirement. The optional ``;v=`` suffix (round 23)
+carries the replica's weight-version fingerprint at registration time —
+``;`` because the metrics address already contains ``:`` — so the
+router knows what weights a replica serves before its first ping.
 """
 
 from __future__ import annotations
@@ -18,33 +22,46 @@ from __future__ import annotations
 from typing import Optional
 
 REPLICA_PREFIX = "replica:"
+VERSION_SEP = ";v="
 
 
-def replica_name(service: str, metrics_addr: Optional[str] = None) -> str:
+def replica_name(service: str, metrics_addr: Optional[str] = None,
+                 version: Optional[str] = None) -> str:
     """The coordinator-visible name encoding this replica's role. The
-    metrics address rides in the name because PeerInfo carries exactly
-    (addr, name) — and changing the wire message is an SLT005 event."""
-    if ":" in service:
-        raise ValueError(f"fleet service name may not contain ':' "
-                         f"({service!r})")
+    metrics address (and weight-version fingerprint) ride in the name
+    because PeerInfo carries exactly (addr, name) — and changing the
+    wire message is an SLT005 event."""
+    if ":" in service or ";" in service:
+        raise ValueError(f"fleet service name may not contain ':' or "
+                         f"';' ({service!r})")
     name = REPLICA_PREFIX + service
     if metrics_addr:
         name += ":" + metrics_addr
+    if version:
+        if ";" in version:
+            raise ValueError(f"weight version may not contain ';' "
+                             f"({version!r})")
+        name += VERSION_SEP + version
     return name
 
 
 def parse_replica(name: str, addr: str) -> Optional[dict]:
     """Inverse of :func:`replica_name`: {"service", "serve_addr",
-    "metrics_addr"} for replica peers, None for anything else (training
-    workers share the same membership plane)."""
+    "metrics_addr", "version"} for replica peers, None for anything
+    else (training workers share the same membership plane). Names
+    without the round-23 ``;v=`` suffix parse exactly as before."""
     if not isinstance(name, str) or not name.startswith(REPLICA_PREFIX):
         return None
     rest = name[len(REPLICA_PREFIX):]
+    version = None
+    if VERSION_SEP in rest:
+        rest, _, version = rest.partition(VERSION_SEP)
     service, _, metrics_addr = rest.partition(":")
     if not service:
         return None
     return {"service": service, "serve_addr": addr,
-            "metrics_addr": metrics_addr or None}
+            "metrics_addr": metrics_addr or None,
+            "version": version or None}
 
 
 class FleetRegistration:
@@ -57,14 +74,15 @@ class FleetRegistration:
     def __init__(self, coordinator_addr: str, serve_addr: str,
                  service: str = "serve",
                  metrics_addr: Optional[str] = None,
-                 heartbeat_interval_ms: int = 1000):
+                 heartbeat_interval_ms: int = 1000,
+                 version: Optional[str] = None):
         from serverless_learn_tpu.control.client import WorkerAgent
 
         self.service = service
         self.serve_addr = serve_addr
         self.agent = WorkerAgent(
             coordinator_addr, serve_addr,
-            name=replica_name(service, metrics_addr),
+            name=replica_name(service, metrics_addr, version=version),
             n_chips=1, heartbeat_interval_ms=heartbeat_interval_ms)
 
     def start(self) -> "FleetRegistration":

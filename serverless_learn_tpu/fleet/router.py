@@ -106,6 +106,10 @@ class Replica:
         self.digest_hashes: frozenset = frozenset()
         self.digest_block_size: int = 0
         self.digest_top: List[dict] = []
+        # Weight-version fingerprint (round 23): seeded from the ;v=
+        # registration suffix when present, refreshed from every ping
+        # reply. None until the replica reports one.
+        self.version: Optional[str] = None
 
     def note_latency(self, s: float, keep: int = 128):
         self.latencies.append(s)
@@ -135,6 +139,8 @@ class Replica:
                    if self.kv_free_frac is not None else {}),
                 **({"prefix_hit_rate": self.prefix_hit_rate}
                    if self.prefix_hit_rate is not None else {}),
+                **({"version": self.version}
+                   if self.version else {}),
                 **({"last_error": self.last_error}
                    if self.last_error else {})}
 
@@ -238,9 +244,38 @@ class FleetRouter:
         self._decision_seq = 0
         self._redundant_tokens_sum = 0
         self._prompt_tokens_sum = 0
+        # ---- weight-version identity + canary split (round 23) ----
+        self._g_versions = reg.gauge(
+            "slt_fleet_weight_versions",
+            "distinct weight-version fingerprints reported by known "
+            "replicas (a value > 1 with no canary active is skew)")
+        self._m_version_swaps = reg.counter(
+            "slt_fleet_version_swaps_total",
+            "replica weight-version changes observed via ping or "
+            "registration")
+        self._g_canary_frac = reg.gauge(
+            "slt_canary_candidate_frac",
+            "configured candidate-version traffic fraction "
+            "(0 = no canary split active)")
+        self._m_probe_requests = reg.counter(
+            "slt_canary_probe_requests_total",
+            "golden-probe requests routed (shed-exempt, excluded from "
+            "user-facing latency SLIs)")
+        self._g_probe_overhead = reg.gauge(
+            "slt_canary_probe_overhead_frac",
+            "running share of routed requests that were golden probes "
+            "(the bounded canary overhead)")
+        self._probe_req_sum = 0
+        self._total_req_sum = 0
+        # Runtime canary split state (FleetConfig is frozen; these seed
+        # from it and move via set_canary()).
+        self._canary_version: Optional[str] = None
+        self._canary_frac = 0.0
 
         for addr in replicas:
             self.add_replica(addr, static=True)
+        if self.cfg.canary_version:
+            self.set_canary(self.cfg.canary_version, self.cfg.canary_frac)
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -252,7 +287,8 @@ class FleetRouter:
     # -- fleet membership ---------------------------------------------------
 
     def add_replica(self, addr: str, metrics_addr: Optional[str] = None,
-                    name: str = "", static: bool = False) -> Replica:
+                    name: str = "", static: bool = False,
+                    version: Optional[str] = None) -> Replica:
         with self._lock:
             r = self._replicas.get(addr)
             if r is None:
@@ -268,7 +304,48 @@ class FleetRouter:
             if metrics_addr:
                 r.metrics_addr = metrics_addr
             self._refresh_gauges_locked()
+        if version:
+            self._note_version(r, version)
         return r
+
+    def set_canary(self, version: Optional[str], frac: float = 0.0):
+        """Configure (or clear) the candidate version-split. Session-
+        sticky assignment happens per request in _dispatch; the
+        canary_config event gives the offline verdict engine the
+        candidate identity and split fraction."""
+        with self._lock:
+            self._canary_version = version or None
+            self._canary_frac = max(0.0, min(1.0, float(frac)))
+            eff = self._canary_frac if self._canary_version else 0.0
+        self._g_canary_frac.set(eff)
+        try:
+            self._emit({"event": "canary_config",
+                        "t_unix_s": time.time(),
+                        "candidate_version": version or None,
+                        "frac": eff})
+        except Exception:
+            pass
+
+    def _note_version(self, r: Replica, version: str):
+        """Record a replica's reported weight fingerprint; emit a
+        fleet_version event only on CHANGE (mirrors the fleet_digest
+        emit-on-change pattern) and refresh the distinct-version gauge."""
+        with self._lock:
+            prev = r.version
+            if version == prev:
+                return
+            r.version = version
+            distinct = len({x.version for x in self._replicas.values()
+                            if x.version})
+        if prev is not None:
+            self._m_version_swaps.inc()
+        self._g_versions.set(distinct)
+        try:
+            self._emit({"event": "fleet_version", "replica": r.addr,
+                        "t_unix_s": time.time(), "version": version,
+                        "prev": prev})
+        except Exception:
+            pass
 
     def remove_replica(self, addr: str, drain: bool = True,
                        reason: str = "retired"):
@@ -397,6 +474,9 @@ class FleetRouter:
         try:
             rep = self._wire_request(r.addr, {"op": "ping"}, timeout=2.0)
             draining = bool(rep.get("draining"))
+            ver = rep.get("version")
+            if isinstance(ver, str) and ver:
+                self._note_version(r, ver)
             kv = rep.get("kv")
             if isinstance(kv, dict) and kv.get("blocks_total"):
                 # Under _lock like every other Replica-field mutation:
@@ -487,7 +567,8 @@ class FleetRouter:
             seen.add(info["serve_addr"])
             self.add_replica(info["serve_addr"],
                              metrics_addr=info["metrics_addr"],
-                             name=peer.name)
+                             name=peer.name,
+                             version=info.get("version"))
         with self._lock:
             gone = [a for a, r in self._replicas.items()
                     if not r.static and a not in seen
@@ -519,8 +600,26 @@ class FleetRouter:
             return [r for r in self._replicas.values() if r.eligible(now)]
 
     def _pick(self, candidates: List[Replica],
-              session: Optional[str], exclude=()) -> Optional[Replica]:
+              session: Optional[str], exclude=(),
+              want_version: Optional[str] = None,
+              avoid_version: Optional[str] = None,
+              strict_version: bool = False) -> Optional[Replica]:
         pool = [r for r in candidates if r.addr not in exclude]
+        if want_version is not None or avoid_version is not None:
+            # Canary split / pin filter. Non-strict (split traffic)
+            # falls back to the full pool when the wanted version has
+            # no eligible replica — availability beats split fidelity.
+            # Strict (pinned probes, hedges under a split) returns None
+            # instead: a probe must never measure the wrong version and
+            # a hedge must never race two versions (their replies may
+            # legitimately differ, breaking hedge idempotency).
+            vpool = [r for r in pool
+                     if (want_version is None
+                         or r.version == want_version)
+                     and (avoid_version is None
+                          or r.version != avoid_version)]
+            if vpool or strict_version:
+                pool = vpool
         if not pool:
             return None
         if session:
@@ -653,16 +752,31 @@ class FleetRouter:
         t_start = self.clock()
         priority = req.pop("priority", 1)
         session = req.pop("session", None)
+        probe = bool(req.pop("probe", False))
+        pin_version = req.pop("pin_version", None)
+        if not isinstance(pin_version, str) or not pin_version:
+            pin_version = None
         try:
             priority = int(priority)
         except (TypeError, ValueError):
             priority = 1
+        if probe:
+            # Golden probes are shed-exempt (round 23): priority >= 1
+            # bypasses the brownout and KV-pressure sheds below (the
+            # hard queue-full backstop still applies — a probe must not
+            # be able to wedge an overloaded fleet either).
+            priority = max(priority, 1)
         ctx = parse_traceparent(req.get("traceparent")) or new_context()
         req["traceparent"] = ctx.traceparent()
         hop = {"event": "waterfall_hop", "trace_id": ctx.trace_id,
                "node": node_name(), "t_unix_s": time.time(),
                "shed": False, "hedged": False, "retries": 0,
                "queue_wait_s": 0.0}
+        if probe:
+            # Tagged in the ledger so offline SLI aggregation (canary,
+            # waterfall) can exclude probe traffic like the live
+            # histograms below do.
+            hop["probe"] = True
 
         # ---- admission: bounded queue with brownout shedding ----
         cap = max(1, self.cfg.max_inflight)
@@ -682,7 +796,7 @@ class FleetRouter:
                     self._m_shed.inc()
                     self._note_decision(req, [], None, session, hop,
                                         reason="shed_brownout",
-                                        account=False)
+                                        account=False, probe=probe)
                     self._emit_hop(hop, t_start, shed=True)
                     return _overload_reply(
                         f"brownout at {self._inflight}/{cap} in flight")
@@ -691,7 +805,7 @@ class FleetRouter:
                     self._m_shed.inc()
                     self._note_decision(req, [], None, session, hop,
                                         reason="shed_queue_full",
-                                        account=False)
+                                        account=False, probe=probe)
                     self._emit_hop(hop, t_start, shed=True)
                     return _overload_reply(
                         f"queue full ({cap} in flight, waited "
@@ -709,16 +823,29 @@ class FleetRouter:
                 self._adm_cv.notify()
             self._m_shed.inc()
             self._note_decision(req, [], None, session, hop,
-                                reason="shed_kv_pressure", account=False)
+                                reason="shed_kv_pressure",
+                                account=False, probe=probe)
             self._emit_hop(hop, t_start, shed=True)
             return _overload_reply(
                 f"fleet KV pool pressure (free frac < "
                 f"{self.cfg.kv_shed_free_frac:g})")
         hop["queue_wait_s"] = round(self.clock() - t_start, 6)
-        self._h_queue_wait.observe(self.clock() - t_start)
+        if not probe:
+            # User-facing SLI histograms exclude probe traffic; probes
+            # get their own counter + running overhead-share gauge.
+            self._h_queue_wait.observe(self.clock() - t_start)
         self._m_requests.inc()
+        with self._lock:
+            self._total_req_sum += 1
+            if probe:
+                self._probe_req_sum += 1
+            share = self._probe_req_sum / self._total_req_sum
+        if probe:
+            self._m_probe_requests.inc()
+        self._g_probe_overhead.set(round(share, 4))
         try:
-            rep = self._dispatch(req, session, hop)
+            rep = self._dispatch(req, session, hop, probe=probe,
+                                 pin_version=pin_version)
         finally:
             with self._adm_cv:
                 self._inflight -= 1
@@ -726,7 +853,7 @@ class FleetRouter:
                 self._adm_cv.notify()
         if "error" in rep and rep.get("code") != "overloaded":
             self._m_errors.inc()
-        else:
+        elif not probe:
             self._h_latency.observe(self.clock() - t_start)
         self._emit_hop(hop, t_start,
                        shed=bool(rep.get("code") == "overloaded"))
@@ -789,7 +916,8 @@ class FleetRouter:
                        pick: Optional[Replica], session: Optional[str],
                        hop: Optional[dict], reason: str,
                        account: bool = True, parent: Optional[str] = None,
-                       exclude=frozenset()) -> Optional[str]:
+                       exclude=frozenset(), probe: bool = False,
+                       assign: Optional[str] = None) -> Optional[str]:
         """Emit one structured ``route_decision`` record and (for primary
         picks) account fleet-wide redundant prefill.
 
@@ -835,7 +963,8 @@ class FleetRouter:
                             * 5.0)),
                     "prefix_hit_rate": r.prefix_hit_rate,
                     "resident_tokens": run * bs,
-                    "eligible": r.addr not in exclude})
+                    "eligible": r.addr not in exclude,
+                    "version": r.version})
         spread = sum(1 for v in resident.values() if v > 0)
         red = 0
         if account and pick is not None and n_prompt:
@@ -857,11 +986,17 @@ class FleetRouter:
                "trace_id": trace_id, "t_unix_s": time.time(),
                "reason": reason, "session": bool(session),
                "pick": pick.addr if pick is not None else None,
+               "version": pick.version if pick is not None else None,
+               "probe": probe,
                "prompt_tokens": n_prompt, "block_size": bs,
                "prompt_hashes": hxs,
                "redundant_prefill_tokens": red,
                "resident_replicas": spread,
                "candidates": cand_rows}
+        if assign is not None:
+            # Version-split provenance: "candidate"/"baseline" (the
+            # session-sticky canary bucket) or "pinned" (probe target).
+            rec["canary"] = assign
         try:
             self._emit(rec)
         except Exception:
@@ -874,21 +1009,56 @@ class FleetRouter:
         return did
 
     def _dispatch(self, req: dict, session: Optional[str],
-                  hop: Optional[dict] = None) -> dict:
+                  hop: Optional[dict] = None, probe: bool = False,
+                  pin_version: Optional[str] = None) -> dict:
         hedgeable = self.cfg.hedge and self._idempotent(req)
         req = {k: v for k, v in req.items() if k != "idempotent"}
         candidates = self._candidates()
         if not candidates:
             self._m_shed.inc()
             self._note_decision(req, [], None, session, hop,
-                                reason="shed_no_replicas", account=False)
+                                reason="shed_no_replicas", account=False,
+                                probe=probe)
             return _overload_reply("no healthy replicas")
-        primary = self._pick(candidates, session)
+        # ---- version-split assignment (round 23) ----
+        # pin_version (probe targeting) filters STRICTLY; a configured
+        # canary split buckets by session (one conversation never
+        # straddles versions) or by trace for session-free traffic, and
+        # falls back to the full pool when the assigned version has no
+        # eligible replica — availability beats split fidelity.
+        want = avoid = None
+        assign = None
+        if pin_version is not None:
+            want, assign = pin_version, "pinned"
+        else:
+            with self._lock:
+                canary_v = self._canary_version
+                canary_f = self._canary_frac
+            if canary_v and canary_f > 0.0:
+                key = session or (hop or {}).get("trace_id") or ""
+                bucket = int(hashlib.md5(
+                    f"canary|{key}".encode()).hexdigest()[:8],
+                    16) / 4294967296.0
+                if bucket < canary_f:
+                    want, assign = canary_v, "candidate"
+                else:
+                    avoid, assign = canary_v, "baseline"
+        primary = self._pick(candidates, session, want_version=want,
+                             avoid_version=avoid,
+                             strict_version=pin_version is not None)
+        if primary is None:
+            self._m_shed.inc()
+            self._note_decision(req, candidates, None, session, hop,
+                                reason="shed_no_version", account=False,
+                                probe=probe, assign=assign)
+            return _overload_reply(
+                f"no eligible replica serving version {pin_version}")
         if hop is not None:
             hop["primary"] = primary.addr
         did = self._note_decision(
             req, candidates, primary, session, hop,
-            reason="session_affinity" if session else "least_loaded")
+            reason="session_affinity" if session else "least_loaded",
+            probe=probe, assign=assign)
         out: "queue.Queue" = queue.Queue()
         tried = {primary.addr}
         launched = [primary.addr]
@@ -905,9 +1075,16 @@ class FleetRouter:
             try:
                 r, rep, err, _dt = out.get(timeout=timeout)
             except queue.Empty:
-                # Hedge: the primary is slow, race one more replica.
+                # Hedge: the primary is slow, race one more replica —
+                # STRICTLY within the assigned/pinned version (two
+                # versions racing could return divergent completions,
+                # breaking hedge idempotency); no same-version spare
+                # means no hedge.
                 cands = self._candidates()
-                hedge = self._pick(cands, None, exclude=tried)
+                hedge = self._pick(
+                    cands, None, exclude=tried, want_version=want,
+                    avoid_version=avoid,
+                    strict_version=want is not None or avoid is not None)
                 hedged = True
                 if hop is not None:
                     hop["hedged"] = True
@@ -915,7 +1092,8 @@ class FleetRouter:
                     self._note_decision(
                         req, cands, hedge, None, hop, reason="hedge",
                         account=False, parent=f"{did}.h",
-                        exclude=frozenset(tried))
+                        exclude=frozenset(tried), probe=probe,
+                        assign=assign)
                     tried.add(hedge.addr)
                     launched.append(hedge.addr)
                     self._m_hedges.inc()
@@ -948,13 +1126,19 @@ class FleetRouter:
             if pending:
                 continue  # the race partner may still answer
             if retries < self.cfg.max_retries:
+                # Retry prefers the assigned version but falls back to
+                # any replica (non-strict _pick): the client gets one
+                # completion either way, and failover availability
+                # outranks split fidelity once the pick has failed.
                 cands = self._candidates()
-                nxt = self._pick(cands, None, exclude=tried)
+                nxt = self._pick(cands, None, exclude=tried,
+                                 want_version=want, avoid_version=avoid)
                 if nxt is not None:
                     self._note_decision(
                         req, cands, nxt, None, hop, reason="retry",
                         account=False, parent=f"{did}.r{retries + 1}",
-                        exclude=frozenset(tried))
+                        exclude=frozenset(tried), probe=probe,
+                        assign=assign)
                     tried.add(nxt.addr)
                     launched.append(nxt.addr)
                     retries += 1

@@ -40,14 +40,23 @@ class StubEngine:
     is exactly the idempotency contract hedging relies on.
     ``latency_s`` may be a float or a callable (for ramps); ``fail``
     makes submit() return engine errors (ejection tests).
+
+    ``weight_version`` (round 23) rides the admin ping exactly like the
+    real engine's params fingerprint, so 2-version canary fleets need
+    no jax; ``reply_offset`` shifts every generated token — a candidate
+    stub with a nonzero offset is the injected quality regression the
+    golden probes must catch (same prompt, different completion).
     """
 
     def __init__(self, latency_s=0.0, fail: bool = False,
-                 vocab_size: int = 1000, tag: str = ""):
+                 vocab_size: int = 1000, tag: str = "",
+                 weight_version: str = "", reply_offset: int = 0):
         self.latency = latency_s
         self.fail = fail
         self.vocab_size = vocab_size
         self.tag = tag
+        self.weight_version = weight_version
+        self.reply_offset = reply_offset
         self.submitted: List[Tuple[tuple, dict]] = []
         self.inflight = 0
         self._lock = threading.Lock()
@@ -65,7 +74,8 @@ class StubEngine:
                 time.sleep(lat)
             if self.fail:
                 return {"error": "stub engine failure injected"}
-            base = (sum(prompt) * 31 + seed * 7) % self.vocab_size
+            base = (sum(prompt) * 31 + seed * 7
+                    + self.reply_offset) % self.vocab_size
             toks = [(base + i) % self.vocab_size for i in range(max_new)]
             return {"new_tokens": toks, "batch_size": 1}
         finally:

@@ -171,6 +171,13 @@ class GenerationServer:
                 kv = kv_stats()
                 if kv:
                     rep["kv"] = kv
+            # Weight-version identity (round 23): rides the ping (not
+            # the kv dict — monolithic engines have no kv_stats) so the
+            # router can version-tag route decisions and detect a
+            # version-skewed fleet.
+            ver = getattr(self.engine, "weight_version", None)
+            if ver:
+                rep["version"] = ver
             return rep
         if op == "drain":
             threading.Thread(target=self.drain, daemon=True).start()

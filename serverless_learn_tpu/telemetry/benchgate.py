@@ -76,6 +76,19 @@ ATTRIBUTION_COLUMNS = {
     # off *_ms) — the wrong direction — hence attribution columns.
     "fleet_redundant_prefill_frac": ("min", 0.10),
     "fleet_prefix_dup_factor": ("min", 0.75),
+    # Canary verdicts (round 23): the quality fingerprint and the
+    # candidate/baseline latency delta ride the canary_candidate_p99_ms
+    # rows. probe_match_frac regresses DOWN (golden probes diverging
+    # from the recorded baseline completions — a quality break no
+    # latency series can see); the p99 delta fraction regresses UP (the
+    # candidate getting slower relative to baseline even when absolute
+    # latency drifts for everyone); verdict_ok regresses DOWN with a
+    # zero gap — ANY run whose verdict engine said rollback fails the
+    # gate outright. The string canary_verdict column rides un-gated
+    # (non-numeric columns are skipped) for human eyes in the history.
+    "canary_probe_match_frac": ("max", 0.005),
+    "canary_ttft_p99_delta_frac": ("min", 0.10),
+    "canary_verdict_ok": ("max", 0.0),
 }
 
 

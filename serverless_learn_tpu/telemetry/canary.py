@@ -1,0 +1,844 @@
+"""Version-scoped serving SLIs + the promote/hold/rollback verdict
+engine (round 23): `slt canary`.
+
+ROADMAP's "close the loop" item wants canarying before fleet-wide
+weight rollout. Rounds 21-22 made one request and the whole fleet
+legible — but *model version* was not an observable dimension anywhere
+in the serving plane: replicas did not know what weights they serve,
+waterfalls and route decisions carried no version tag, and there was no
+quality SLI at serve time at all. This module is the analysis half of
+the round-23 version-observability layer:
+
+* **Inputs** (all from the existing JSONL events log — no new sink):
+  ``fleet_version`` snapshots (fleet/router.py emits one whenever a
+  replica's ping-reported weight fingerprint changes),
+  ``canary_config`` (the router's version-split: candidate fingerprint
+  + traffic fraction), version/probe-tagged ``route_decision`` records,
+  the round-21 request-span waterfalls (now carrying the serving
+  engine's weight version), and ``canary_probe`` results from the
+  golden-probe runner below.
+* **Quality SLI**: a committed golden-probe set (fixed prompts, greedy
+  decode) runs as *tagged* synthetic traffic through the real engines
+  on a cadence. Expected outputs are fingerprinted against the BASELINE
+  version at canary start; a candidate that stops reproducing them
+  exactly fails the quality SLI long before any latency metric moves.
+  Probe traffic is priority>=1 (exempt from brownout/KV shedding),
+  excluded from user-facing SLI aggregates (router latency histograms
+  and the per-version TTFT percentiles here), but fully present in the
+  waterfall/fleetscope ledgers; its overhead share is itself exported
+  (``slt_canary_probe_overhead_frac``) and bounded in the smoke test.
+* **Verdict engine**: :func:`verdict` folds the per-version SLIs into a
+  deterministic promote/hold/rollback decision with named evidence.
+  Rollback triggers, checked in fixed order: golden-probe fingerprint
+  mismatch on the candidate, candidate p99 latency regression beyond
+  the configured fraction, and a *critical* multi-window error
+  burn-rate (the round-9 :class:`~.health.BurnRate` two-window AND —
+  a transient error blip holds, a sustained burn rolls back). With no
+  rollback trigger, thin evidence (too few probes/requests, no
+  latency sample on both sides, warning-level burn) holds; otherwise
+  the candidate promotes.
+
+Determinism contract: the report is a pure function of the logs — no
+wall clock, no randomness, sorted iteration everywhere — so identical
+logs produce byte-identical reports and the SAME verdict
+(``--self-check`` proves it, including the two injected-regression
+verdict flips over the committed fixture).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+from serverless_learn_tpu.telemetry.health import BurnRate
+from serverless_learn_tpu.telemetry.waterfall import read_records
+
+SCHEMA_VERSION = 1
+
+VERDICTS = ("promote", "hold", "rollback")
+
+# The committed golden-probe set: small fixed prompts, greedy decode
+# (temperature 0), short generations. Token ids stay tiny so every
+# vocab (llama_tiny and the stub engines alike) accepts them. The
+# EXPECTED outputs are deliberately not committed — they depend on the
+# weights — they are fingerprinted against the baseline version at
+# canary start (CanaryProber.record_baseline).
+GOLDEN_PROBES = (
+    {"probe": "g0", "prompt": [3, 1, 4, 1, 5], "max_new_tokens": 8},
+    {"probe": "g1", "prompt": [2, 7, 1, 8, 2, 8], "max_new_tokens": 8},
+    {"probe": "g2", "prompt": [1, 6, 1, 8, 0, 3], "max_new_tokens": 6},
+    {"probe": "g3", "prompt": [9, 9, 8, 2, 4], "max_new_tokens": 6},
+)
+
+UNKNOWN_VERSION = "unknown"
+
+
+@dataclass
+class CanaryConfig:
+    """Verdict thresholds. Defaults are the hand-computed values the
+    committed fixture and the 2-version smoke assert against."""
+    min_probes: int = 4          # candidate golden probes before promote
+    min_requests: int = 8        # candidate user requests before promote
+    probe_match_min: float = 0.999  # exact-greedy: ANY mismatch fails
+    latency_regress_frac: float = 0.25  # candidate p99 vs baseline p99
+    error_budget: float = 0.02   # BurnRate SLO budget over candidate
+    burn_short_s: float = 60.0
+    burn_long_s: float = 720.0
+    fast_burn: float = 14.4
+    slow_burn: float = 6.0
+
+
+def probe_fingerprint(tokens: Sequence[int]) -> str:
+    """Compact exact-output fingerprint: order-sensitive digest of the
+    generated token ids (12 hex chars, same width as the weight
+    fingerprints from ``numerics.weight_version``)."""
+    blob = json.dumps([int(t) for t in tokens])
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def _percentile(sorted_vals: Sequence[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+# -- summarize: version-scoped SLI aggregation -------------------------------
+
+
+def summarize(records: Sequence[dict]) -> dict:
+    """Per-version SLI aggregation from the event log: user/probe
+    request counts, TTFT and end-to-end latency percentiles (probe
+    traffic EXCLUDED), golden-probe match rates, probe overhead share,
+    plus the replica->version map and the candidate/baseline
+    identification the verdict runs on."""
+    from serverless_learn_tpu.telemetry.fleetscope import primary_decisions
+
+    replica_versions: Dict[str, str] = {}
+    version_swaps = 0
+    cfg_cand: Optional[str] = None
+    cfg_frac = 0.0
+    for r in records:
+        ev = r.get("event")
+        if ev == "fleet_version" and r.get("replica"):
+            if r.get("prev"):
+                version_swaps += 1
+            replica_versions[str(r["replica"])] = str(r.get("version") or "")
+        elif ev == "canary_config":
+            cfg_cand = str(r["candidate_version"]) \
+                if r.get("candidate_version") else None
+            cfg_frac = float(r.get("frac") or 0.0)
+    canary_active = bool(cfg_cand) and cfg_frac > 0.0
+
+    per: Dict[str, dict] = {}
+
+    def vstat(v: str) -> dict:
+        return per.setdefault(v, {
+            "requests": 0, "probe_requests": 0,
+            "probe_total": 0, "probe_match": 0, "errors": 0,
+            "ttft_s": [], "latency_s": [], "timeline": []})
+
+    prim = primary_decisions(records)
+    trace_version: Dict[str, str] = {}
+    probe_traces: set = set()
+    n_probe_decisions = 0
+    for d in prim:
+        v = d.get("version") \
+            or replica_versions.get(str(d.get("pick") or ""), None)
+        v = str(v) if v else UNKNOWN_VERSION
+        probe = bool(d.get("probe"))
+        tid = str(d.get("trace_id") or "")
+        if tid:
+            trace_version[tid] = v
+            if probe:
+                probe_traces.add(tid)
+        st = vstat(v)
+        if probe:
+            st["probe_requests"] += 1
+            n_probe_decisions += 1
+        else:
+            st["requests"] += 1
+        st["timeline"].append((float(d.get("t_unix_s") or 0.0), 0))
+
+    for r in records:
+        ev = r.get("event")
+        if ev == "waterfall_hop":
+            tid = str(r.get("trace_id") or "")
+            v = trace_version.get(tid)
+            if v is None or r.get("shed"):
+                continue
+            probe = bool(r.get("probe")) or tid in probe_traces
+            if not probe and isinstance(r.get("total_s"), (int, float)):
+                vstat(v)["latency_s"].append(float(r["total_s"]))
+        elif ev == "span" and r.get("span") == "request":
+            wf = r.get("waterfall")
+            wf = wf if isinstance(wf, dict) else {}
+            tid = str(r.get("trace_id") or "")
+            v = r.get("version") or trace_version.get(tid)
+            if not v or tid in probe_traces:
+                continue
+            if isinstance(wf.get("ttft_s"), (int, float)):
+                vstat(str(v))["ttft_s"].append(float(wf["ttft_s"]))
+        elif ev == "canary_probe":
+            v = str(r.get("version") or UNKNOWN_VERSION)
+            st = vstat(v)
+            st["probe_total"] += 1
+            bad = 0
+            if r.get("error"):
+                st["errors"] += 1
+                bad = 1
+            elif r.get("match"):
+                st["probe_match"] += 1
+            st["timeline"].append((float(r.get("t_unix_s") or 0.0), bad))
+
+    versions_out: Dict[str, dict] = {}
+    timelines: Dict[str, List[List[float]]] = {}
+    for v in sorted(per):
+        st = per[v]
+        row = {"requests": st["requests"],
+               "probe_requests": st["probe_requests"],
+               "probe_total": st["probe_total"],
+               "probe_match": st["probe_match"],
+               "errors": st["errors"]}
+        ttfts = sorted(st["ttft_s"])
+        if ttfts:
+            row["ttft_n"] = len(ttfts)
+            row["ttft_p50_ms"] = round(
+                (_percentile(ttfts, 0.5) or 0.0) * 1e3, 3)
+            row["ttft_p99_ms"] = round(
+                (_percentile(ttfts, 0.99) or 0.0) * 1e3, 3)
+        lats = sorted(st["latency_s"])
+        if lats:
+            row["latency_n"] = len(lats)
+            row["latency_p50_ms"] = round(
+                (_percentile(lats, 0.5) or 0.0) * 1e3, 3)
+            row["latency_p99_ms"] = round(
+                (_percentile(lats, 0.99) or 0.0) * 1e3, 3)
+        if st["probe_total"]:
+            row["probe_match_frac"] = round(
+                st["probe_match"] / st["probe_total"], 6)
+        versions_out[v] = row
+        # Cumulative (t, bad, total) samples, log order, for BurnRate.
+        bad_cum = tot_cum = 0
+        tl: List[List[float]] = []
+        for t, bad in sorted(st["timeline"]):
+            tot_cum += 1
+            bad_cum += bad
+            tl.append([round(t, 3), bad_cum, tot_cum])
+        timelines[v] = tl
+
+    vs = [v for v in versions_out if v != UNKNOWN_VERSION]
+    candidate = cfg_cand if cfg_cand in versions_out else None
+    if candidate is None and len(vs) >= 2:
+        # No canary_config in the log: the minority-traffic version is
+        # the presumed candidate (tie -> lexicographically first).
+        candidate = sorted(
+            vs, key=lambda v: (versions_out[v]["requests"], v))[0]
+    baseline = None
+    others = [v for v in vs if v != candidate]
+    if candidate is not None and others:
+        baseline = sorted(
+            others, key=lambda v: (-versions_out[v]["requests"], v))[0]
+
+    return {
+        "replica_versions": {k: replica_versions[k]
+                             for k in sorted(replica_versions)},
+        "distinct_replica_versions":
+            len(set(replica_versions.values())),
+        "version_swaps": version_swaps,
+        "canary": {"active": canary_active,
+                   "candidate_version": cfg_cand,
+                   "frac": round(cfg_frac, 6)},
+        "versions": versions_out,
+        "candidate": candidate,
+        "baseline": baseline,
+        "primary_decisions": len(prim),
+        "probe_decisions": n_probe_decisions,
+        "probe_overhead_frac": round(
+            n_probe_decisions / max(1, len(prim)), 6),
+        "timelines": timelines,
+    }
+
+
+# -- verdict -----------------------------------------------------------------
+
+
+def verdict(summary: dict, cfg: Optional[CanaryConfig] = None) -> dict:
+    """Deterministic promote/hold/rollback from a :func:`summarize`
+    output. Every decision names its evidence; rollback triggers are
+    checked in fixed order (quality, latency, burn) so the same logs
+    always produce the same verdict with the same evidence list."""
+    cfg = cfg or CanaryConfig()
+    cand, base = summary.get("candidate"), summary.get("baseline")
+    out: dict = {"candidate": cand, "baseline": base,
+                 "probe_match_frac": None, "p99_delta_frac": None,
+                 "delta_basis": None,
+                 "burn": {"severity": None, "short_burn": None,
+                          "long_burn": None}}
+    if not cand or not base:
+        out.update(decision="hold", evidence=[
+            "fewer than two weight versions observed in traffic — "
+            "nothing to compare"])
+        return out
+    c = summary["versions"][cand]
+    b = summary["versions"][base]
+
+    tl = (summary.get("timelines") or {}).get(cand) or []
+    if tl:
+        br = BurnRate(cfg.error_budget, cfg.burn_short_s,
+                      cfg.burn_long_s, cfg.fast_burn, cfg.slow_burn)
+        for t, bad, tot in tl:
+            out["burn"] = br.update(float(t), float(bad), float(tot))
+    burn_sev = out["burn"].get("severity")
+
+    delta = basis = None
+    for key in ("ttft_p99_ms", "latency_p99_ms"):
+        cv, bv = c.get(key), b.get(key)
+        if isinstance(cv, (int, float)) and isinstance(bv, (int, float)) \
+                and bv > 0:
+            delta, basis = round(cv / bv - 1.0, 6), key
+            break
+    out["p99_delta_frac"] = delta
+    out["delta_basis"] = basis
+
+    pt, pm = int(c.get("probe_total") or 0), int(c.get("probe_match") or 0)
+    match_frac = (pm / pt) if pt else None
+    out["probe_match_frac"] = round(match_frac, 6) \
+        if match_frac is not None else None
+
+    rollback_ev: List[str] = []
+    if pt >= cfg.min_probes and match_frac < cfg.probe_match_min:
+        rollback_ev.append(
+            f"golden-probe fingerprint match {pm}/{pt} "
+            f"({match_frac:.0%}) on candidate {cand} — below the "
+            f"exact-greedy floor {cfg.probe_match_min:.1%}")
+    if delta is not None and delta > cfg.latency_regress_frac:
+        rollback_ev.append(
+            f"candidate {basis.replace('_', ' ')} {c[basis]:.1f} vs "
+            f"baseline {b[basis]:.1f} ({delta:+.0%} > "
+            f"+{cfg.latency_regress_frac:.0%} threshold)")
+    if burn_sev == "critical":
+        rollback_ev.append(
+            f"candidate error burn-rate critical: short "
+            f"{out['burn'].get('short_burn'):.1f}x / long "
+            f"{out['burn'].get('long_burn'):.1f}x of the "
+            f"{cfg.error_budget:.0%} budget (two-window AND)")
+    if rollback_ev:
+        out.update(decision="rollback", evidence=rollback_ev)
+        return out
+
+    hold_ev: List[str] = []
+    if pt < cfg.min_probes:
+        hold_ev.append(f"only {pt} candidate golden probe(s) "
+                       f"(< {cfg.min_probes})")
+    if int(c.get("requests") or 0) < cfg.min_requests:
+        hold_ev.append(f"only {c.get('requests', 0)} candidate user "
+                       f"request(s) (< {cfg.min_requests})")
+    if delta is None:
+        hold_ev.append("no p99 latency sample on BOTH versions yet")
+    if burn_sev == "warning":
+        hold_ev.append(
+            f"candidate error burn-rate warning: short "
+            f"{out['burn'].get('short_burn'):.1f}x / long "
+            f"{out['burn'].get('long_burn'):.1f}x of budget")
+    if hold_ev:
+        out.update(decision="hold", evidence=hold_ev)
+        return out
+
+    out.update(decision="promote", evidence=[
+        f"golden probes {pm}/{pt} exact matches on candidate {cand}",
+        f"candidate {basis.replace('_', ' ')} {c[basis]:.1f} vs "
+        f"baseline {b[basis]:.1f} ({delta:+.1%} within "
+        f"+{cfg.latency_regress_frac:.0%})",
+        "error burn-rate clean over both windows"])
+    return out
+
+
+def report(paths: Sequence[str],
+           cfg: Optional[CanaryConfig] = None) -> dict:
+    """The `slt canary` body: read -> per-version SLIs -> verdict.
+    Pure function of the logs (byte-identical for identical inputs)."""
+    records = read_records(paths)
+    return report_records(records, cfg)
+
+
+def report_records(records: Sequence[dict],
+                   cfg: Optional[CanaryConfig] = None) -> dict:
+    summary = summarize(records)
+    return {"v": SCHEMA_VERSION, "records": len(records),
+            "summary": summary, "verdict": verdict(summary, cfg)}
+
+
+# -- bench rows --------------------------------------------------------------
+
+
+def bench_rows(rep: dict, device_kind: str = "fleet") -> List[dict]:
+    """Bench-history rows for `utils/benchlog.record` / `slt bench
+    --gate`: the candidate p99 headline gates automatically (``*_ms``
+    -> better=min) and carries the probe match fraction, the
+    candidate-vs-baseline p99 delta, and the verdict as attribution
+    columns (gated via benchgate.ATTRIBUTION_COLUMNS — a bare fraction
+    row would gate better=max, the wrong direction)."""
+    rows: List[dict] = []
+    summary = rep.get("summary") or {}
+    vd = rep.get("verdict") or {}
+    cand = vd.get("candidate")
+    c = (summary.get("versions") or {}).get(cand) or {}
+    value = c.get("ttft_p99_ms", c.get("latency_p99_ms"))
+    if cand and isinstance(value, (int, float)):
+        rows.append({
+            "metric": "canary_candidate_p99_ms",
+            "value": value, "unit": "ms", "device_kind": device_kind,
+            "count": (c.get("requests") or 0)
+            + (c.get("probe_requests") or 0),
+            "canary_probe_match_frac": vd.get("probe_match_frac"),
+            "canary_ttft_p99_delta_frac": vd.get("p99_delta_frac"),
+            "canary_verdict": vd.get("decision"),
+            "canary_verdict_ok":
+                0.0 if vd.get("decision") == "rollback" else 1.0,
+            "canary_probe_overhead_frac":
+                summary.get("probe_overhead_frac"),
+        })
+    return rows
+
+
+# -- render ------------------------------------------------------------------
+
+
+def render(rep: dict) -> str:
+    """Human rendering: the verdict headline with its evidence, then
+    the per-version SLI table."""
+    s = rep.get("summary") or {}
+    vd = rep.get("verdict") or {}
+    can = s.get("canary") or {}
+    lines = [f"canary: {vd.get('decision', '?').upper()} — candidate "
+             f"{vd.get('candidate') or '?'} vs baseline "
+             f"{vd.get('baseline') or '?'}"
+             + (f" (split frac {can.get('frac', 0.0):.0%})"
+                if can.get("active") else " (no split active)")]
+    for e in vd.get("evidence") or ():
+        lines.append(f"  - {e}")
+    versions = s.get("versions") or {}
+    if versions:
+        lines.append("  per-version SLIs (probe traffic excluded from "
+                     "latency aggregates):")
+        for v in sorted(versions):
+            row = versions[v]
+            tag = " (candidate)" if v == vd.get("candidate") else \
+                  " (baseline)" if v == vd.get("baseline") else ""
+            p99 = row.get("ttft_p99_ms")
+            p99s = f"ttft p99 {p99:.1f} ms" if p99 is not None else (
+                f"latency p99 {row['latency_p99_ms']:.1f} ms"
+                if row.get("latency_p99_ms") is not None else "no latency")
+            probes = f"{row.get('probe_match', 0)}" \
+                     f"/{row.get('probe_total', 0)} probes"
+            lines.append(f"    {v}{tag}: {row.get('requests', 0)} user "
+                         f"req, {probes}, {p99s}, "
+                         f"{row.get('errors', 0)} errors")
+    lines.append(f"  probe overhead: {s.get('probe_decisions', 0)} of "
+                 f"{s.get('primary_decisions', 0)} routed requests "
+                 f"({s.get('probe_overhead_frac', 0.0):.1%})")
+    rv = s.get("replica_versions") or {}
+    if rv:
+        lines.append("  replica versions: " + ", ".join(
+            f"{k}={rv[k]}" for k in sorted(rv)))
+    return "\n".join(lines)
+
+
+# -- golden-probe runner -----------------------------------------------------
+
+
+class CanaryProber:
+    """Golden-probe traffic source. Sends the committed probe set as
+    tagged synthetic requests (``probe: true`` — shed-exempt, excluded
+    from user SLIs by the router) pinned per version via
+    ``pin_version``, fingerprints the greedy outputs, and emits
+    ``canary_probe`` events the verdict engine consumes.
+
+    Transport-agnostic: ``send(req) -> reply`` is injected (loadgen's
+    socket client in the smoke, anything request-shaped in tests), so
+    this module stays free of fleet imports. Expected fingerprints are
+    recorded from the BASELINE version (:meth:`record_baseline`) — the
+    quality SLI is "the candidate reproduces baseline behavior
+    exactly", which needs no committed weight-dependent outputs."""
+
+    def __init__(self, send: Callable[[dict], dict],
+                 candidate_version: str,
+                 baseline_version: Optional[str] = None,
+                 probes: Sequence[dict] = GOLDEN_PROBES,
+                 interval_s: float = 1.0,
+                 registry=None,
+                 emit: Optional[Callable[[dict], None]] = None):
+        self.send = send
+        self.candidate_version = candidate_version
+        self.baseline_version = baseline_version
+        self.probes = list(probes)
+        self.interval_s = float(interval_s)
+        self.emit = emit
+        self.expected: Dict[str, str] = {}
+        self.sent = 0
+        self.matched = 0
+        self.mismatched = 0
+        self._m_sent = self._m_match = self._m_mismatch = None
+        if registry is not None:
+            self._m_sent = registry.counter(
+                "slt_canary_probe_sent_total",
+                "golden probes sent by the canary prober")
+            self._m_match = registry.counter(
+                "slt_canary_probe_match_total",
+                "golden probes whose output fingerprint matched the "
+                "baseline-recorded expectation")
+            self._m_mismatch = registry.counter(
+                "slt_canary_probe_mismatch_total",
+                "golden probes whose output fingerprint diverged from "
+                "the baseline-recorded expectation")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _probe_once(self, probe: dict, pin: Optional[str],
+                    record: bool = False) -> dict:
+        req = {"prompt": list(probe["prompt"]),
+               "max_new_tokens": int(probe.get("max_new_tokens", 8)),
+               "temperature": 0.0, "probe": True, "priority": 1,
+               "session": f"canary-probe:{probe['probe']}:{pin or '-'}"}
+        if pin:
+            req["pin_version"] = pin
+        t0 = time.perf_counter()
+        err = None
+        fp = None
+        try:
+            rep = self.send(req)
+            if rep.get("error") or rep.get("code") not in (None, "ok"):
+                err = str(rep.get("error") or rep.get("code"))
+            else:
+                fp = probe_fingerprint(rep.get("new_tokens")
+                                       or rep.get("tokens") or [])
+        except Exception as e:  # transport failure is a probe error
+            err = f"{type(e).__name__}: {e}"
+        latency = time.perf_counter() - t0
+        name = str(probe["probe"])
+        if record and fp is not None:
+            self.expected[name] = fp
+        expect = self.expected.get(name)
+        match = (err is None and expect is not None and fp == expect)
+        self.sent += 1
+        if self._m_sent is not None:
+            self._m_sent.inc()
+        if err is None and expect is not None:
+            if match:
+                self.matched += 1
+                if self._m_match is not None:
+                    self._m_match.inc()
+            else:
+                self.mismatched += 1
+                if self._m_mismatch is not None:
+                    self._m_mismatch.inc()
+        rec = {"event": "canary_probe", "t_unix_s": time.time(),
+               "probe": name, "version": pin, "match": bool(match),
+               "expect_fp": expect, "got_fp": fp,
+               "latency_s": round(latency, 6)}
+        if record:
+            rec["phase"] = "record"
+        if err is not None:
+            rec["error"] = err
+        if self.emit is not None:
+            try:
+                self.emit(rec)
+            except Exception:
+                pass
+        return rec
+
+    def record_baseline(self) -> List[dict]:
+        """One synchronous round pinned to the baseline version,
+        recording the expected output fingerprint per probe."""
+        return [self._probe_once(p, self.baseline_version, record=True)
+                for p in self.probes]
+
+    def run_round(self) -> dict:
+        """Probe the candidate AND the baseline (control) once each,
+        comparing both against the baseline-recorded expectations."""
+        results = []
+        for pin in (self.baseline_version, self.candidate_version):
+            for p in self.probes:
+                results.append(self._probe_once(p, pin))
+        return {"sent": len(results),
+                "matched": sum(1 for r in results if r["match"]),
+                "errors": sum(1 for r in results if r.get("error"))}
+
+    # Cadence thread: record baseline once, then one round per interval.
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def _loop():
+            self.record_baseline()
+            while not self._stop.wait(self.interval_s):
+                self.run_round()
+
+        self._thread = threading.Thread(
+            target=_loop, name="canary-prober", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+# -- self-check --------------------------------------------------------------
+
+
+V_BASE = "aaaa00001111"
+V_CAND = "bbbb22223333"
+
+
+def synthetic_records(scenario: str = "parity") -> List[dict]:
+    """Deterministic fabricated 2-version fixture: 3 replicas (n0/n1 on
+    the baseline fingerprint, n2 on the candidate), a 25% session-split
+    canary, 24 user requests (16 baseline / 8 candidate) and 8 golden
+    probes (4 per version, deliberately SLOW at 500 ms TTFT so any leak
+    into the user aggregates is unmissable). Hand-computed expectations
+    (tests assert them): user TTFT p99 is 45.0 ms on BOTH versions
+    (parity -> promote); ``probe_regression`` flips the candidate's
+    probe matches to False (-> rollback naming the golden probes);
+    ``ttft_regression`` scales candidate user TTFTs x3 (p99 135 ms,
+    +200% -> rollback naming the p99 delta). Doubles as the committed-
+    fixture generator for tests/fixtures/canary/."""
+    addrs = ("n0:9000", "n1:9000", "n2:9000")
+    vmap = {addrs[0]: V_BASE, addrs[1]: V_BASE, addrs[2]: V_CAND}
+    t = 1754300000.0
+    recs: List[dict] = []
+    recs.append({"event": "canary_config", "t_unix_s": t,
+                 "candidate_version": V_CAND, "frac": 0.25})
+    for a in addrs:
+        recs.append({"event": "fleet_version", "replica": a,
+                     "t_unix_s": t + 0.1, "version": vmap[a],
+                     "prev": None})
+
+    def cand_row(addr, inflight):
+        return {"addr": addr, "state": "healthy", "inflight": inflight,
+                "kv_pressure_bucket": 0, "prefix_hit_rate": 0.0,
+                "resident_tokens": 0, "eligible": True,
+                "version": vmap[addr]}
+
+    def add_request(i, tid, pick, assign, ttft, probe=False):
+        v = vmap[pick]
+        t_i = t + 1 + i
+        recs.append({
+            "event": "route_decision",
+            "decision_id": f"{tid[:16]}-{i + 1}",
+            "trace_id": tid, "t_unix_s": t_i,
+            "reason": "least_loaded", "session": False,
+            "pick": pick, "version": v, "probe": probe,
+            "canary": assign, "prompt_tokens": 96, "block_size": 16,
+            "prompt_hashes": [], "redundant_prefill_tokens": 0,
+            "resident_replicas": 0,
+            "candidates": [cand_row(a, 1 if a != pick else 0)
+                           for a in addrs]})
+        recs.append({
+            "event": "span", "span": "request", "trace_id": tid,
+            "span_id": tid[:16], "t0_unix_s": t_i,
+            "duration_s": round(ttft + 0.1, 6), "node": pick,
+            "version": v,
+            "marks_s": {"admit": 0.002, "first_token": ttft,
+                        "done": round(ttft + 0.1, 6)},
+            "waterfall": {
+                "v": 1, "engine": "continuous",
+                "phases": [
+                    {"phase": "queue", "t0_s": 0.0, "t1_s": 0.002,
+                     "s": 0.002},
+                    {"phase": "admit", "s": 0.001},
+                    {"phase": "compile", "s": 0.007},
+                    {"phase": "prefill", "t1_s": ttft,
+                     "s": round(ttft - 0.010, 6),
+                     "chunks": [{"t0_s": 0.010, "t1_s": ttft,
+                                 "tokens": 96, "prefix_hit_tokens": 0,
+                                 "compiled": False, "stall_s": 0.0}]},
+                    {"phase": "decode", "t0_s": ttft,
+                     "t1_s": round(ttft + 0.1, 6), "s": 0.1}],
+                "ttft_s": ttft,
+                "ttft_decomp_s": {"queue": 0.002, "admit": 0.001,
+                                  "compile": 0.007,
+                                  "prefill": round(ttft - 0.010, 6)},
+                "overhead_s": 0.0001}})
+        recs.append({"event": "waterfall_hop", "trace_id": tid,
+                     "node": "router0", "shed": False, "hedged": False,
+                     "retries": 0, "queue_wait_s": 0.0005,
+                     "probe": probe,
+                     "total_s": round(ttft + 0.101, 6),
+                     "decision_id": f"{tid[:16]}-{i + 1}",
+                     "pick_reason": "least_loaded"})
+
+    # 24 user requests: every 3rd to the candidate (8), the rest
+    # alternating across the two baseline replicas (16).
+    n_base = n_cand = 0
+    for i in range(24):
+        tid = format(i + 1, "032x")
+        if i % 3 == 0:
+            ttft = round(0.038 + 0.001 * n_cand, 6)   # p99 = 0.045
+            add_request(i, tid, addrs[2], "candidate", ttft)
+            n_cand += 1
+        else:
+            ttft = round(0.030 + 0.001 * n_base, 6)   # p99 = 0.045
+            add_request(i, tid, addrs[n_base % 2], "baseline", ttft)
+            n_base += 1
+    # 8 golden probes (4 per version), pinned, 500 ms TTFT: present in
+    # every ledger, EXCLUDED from the user TTFT percentiles above.
+    for j in range(8):
+        pin = addrs[2] if j % 2 else addrs[0]
+        tid = format(100 + j, "032x")
+        add_request(24 + j, tid, pin, "pinned", 0.5, probe=True)
+        recs.append({"event": "canary_probe", "t_unix_s": t + 30 + j,
+                     "probe": f"g{j % 4}", "version": vmap[pin],
+                     "match": True, "expect_fp": "feedc0ffee01",
+                     "got_fp": "feedc0ffee01", "latency_s": 0.5})
+    if scenario == "parity":
+        return recs
+    if scenario == "probe_regression":
+        return _inject_probe_regression(recs)
+    if scenario == "ttft_regression":
+        return _inject_ttft_regression(recs)
+    raise ValueError(f"unknown canary scenario {scenario!r}")
+
+
+def _candidate_of(records: Sequence[dict]) -> Optional[str]:
+    for r in records:
+        if r.get("event") == "canary_config":
+            return r.get("candidate_version")
+    return None
+
+
+def _copy(records: Sequence[dict]) -> List[dict]:
+    return json.loads(json.dumps(list(records)))
+
+
+def _inject_probe_regression(records: Sequence[dict]) -> List[dict]:
+    """Flip the candidate's golden-probe matches to mismatches — the
+    injected quality regression the verdict must catch."""
+    out = _copy(records)
+    cand = _candidate_of(out)
+    for r in out:
+        if r.get("event") == "canary_probe" and r.get("version") == cand:
+            r["match"] = False
+            r["got_fp"] = "badbadbadbad"
+    return out
+
+
+def _inject_ttft_regression(records: Sequence[dict],
+                            factor: float = 3.0) -> List[dict]:
+    """Scale the candidate's USER request TTFTs by ``factor`` (probe
+    spans untouched — they are excluded anyway). The decomposition is
+    scaled with the total, so the round-21 exactness invariant holds on
+    the injected fixture too."""
+    out = _copy(records)
+    cand = _candidate_of(out)
+    probe_traces = {str(r.get("trace_id")) for r in out
+                    if r.get("event") == "route_decision"
+                    and r.get("probe")}
+    for r in out:
+        if r.get("event") != "span" or r.get("span") != "request" \
+                or r.get("version") != cand \
+                or str(r.get("trace_id")) in probe_traces:
+            continue
+        wf = r.get("waterfall")
+        if not isinstance(wf, dict) \
+                or not isinstance(wf.get("ttft_s"), (int, float)):
+            continue
+        wf["ttft_s"] = round(float(wf["ttft_s"]) * factor, 6)
+        decomp = wf.get("ttft_decomp_s") or {}
+        for k in list(decomp):
+            decomp[k] = round(float(decomp[k]) * factor, 6)
+        marks = r.get("marks_s") or {}
+        if isinstance(marks.get("first_token"), (int, float)):
+            marks["first_token"] = round(
+                float(marks["first_token"]) * factor, 6)
+    return out
+
+
+def self_check(fixture_path: Optional[str] = None) -> dict:
+    """`slt canary --self-check`: the acceptance contract, verified on
+    a fixture (the committed one in CI, the embedded synthetic copy
+    otherwise): promote on parity, rollback on the injected golden-
+    probe regression, rollback on the injected TTFT-p99 regression —
+    each verdict naming its evidence — plus probe exclusion from the
+    user SLIs, bounded probe overhead, byte-identical determinism and
+    the bench-row schema the gate consumes."""
+    checks: List[dict] = []
+
+    def check(name: str, ok: bool, detail: str = ""):
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+
+    if fixture_path:
+        records = read_records([fixture_path])
+        check("fixture_read", len(records) > 0,
+              f"{len(records)} records from {fixture_path}")
+    else:
+        records = synthetic_records()
+        check("fixture_read", True,
+              f"{len(records)} embedded synthetic records")
+
+    rep = report_records(records)
+    s, vd = rep["summary"], rep["verdict"]
+    check("two_versions_identified",
+          vd.get("candidate") == V_CAND and vd.get("baseline") == V_BASE,
+          f"candidate {vd.get('candidate')}, baseline "
+          f"{vd.get('baseline')}")
+    cand_row = (s["versions"].get(V_CAND) or {})
+    base_row = (s["versions"].get(V_BASE) or {})
+    check("probe_exclusion_from_user_slis",
+          cand_row.get("ttft_p99_ms") == 45.0
+          and base_row.get("ttft_p99_ms") == 45.0,
+          f"user TTFT p99 {base_row.get('ttft_p99_ms')}/"
+          f"{cand_row.get('ttft_p99_ms')} ms despite 500 ms probe "
+          f"spans in the same log")
+    check("probe_overhead_bounded",
+          0.0 < s.get("probe_overhead_frac", 0.0) <= 0.30,
+          f"probe overhead {s.get('probe_overhead_frac')} "
+          f"({s.get('probe_decisions')} of {s.get('primary_decisions')}"
+          f" routed)")
+    check("verdict_promote_on_parity",
+          vd.get("decision") == "promote"
+          and vd.get("probe_match_frac") == 1.0
+          and vd.get("p99_delta_frac") == 0.0,
+          f"{vd.get('decision')}: {'; '.join(vd.get('evidence') or ())}")
+
+    vd_q = report_records(_inject_probe_regression(records))["verdict"]
+    check("verdict_rollback_on_probe_regression",
+          vd_q.get("decision") == "rollback"
+          and any("golden-probe" in e for e in vd_q.get("evidence") or ()),
+          f"{vd_q.get('decision')}: "
+          f"{'; '.join(vd_q.get('evidence') or ())}")
+    vd_t = report_records(_inject_ttft_regression(records))["verdict"]
+    check("verdict_rollback_on_ttft_regression",
+          vd_t.get("decision") == "rollback"
+          and vd_t.get("p99_delta_frac") == 2.0
+          and any("p99" in e for e in vd_t.get("evidence") or ()),
+          f"{vd_t.get('decision')} (delta "
+          f"{vd_t.get('p99_delta_frac')}): "
+          f"{'; '.join(vd_t.get('evidence') or ())}")
+
+    dump1 = json.dumps(rep, sort_keys=True)
+    dump2 = json.dumps(report_records(read_records([fixture_path]))
+                       if fixture_path else report_records(
+                           synthetic_records()), sort_keys=True)
+    check("byte_identical_report", dump1 == dump2,
+          f"two same-log reports: {len(dump1)} bytes, identical")
+
+    rows = bench_rows(rep)
+    names = {r["metric"] for r in rows}
+    cols = ("canary_probe_match_frac", "canary_ttft_p99_delta_frac",
+            "canary_verdict", "canary_verdict_ok")
+    check("bench_rows",
+          "canary_candidate_p99_ms" in names
+          and all(all(c in r for c in cols) for r in rows),
+          f"rows: {sorted(names)}")
+    check("render", f"canary: PROMOTE" in render(rep),
+          "verdict headline renders")
+    return {"ok": all(c["ok"] for c in checks), "checks": checks}

@@ -553,6 +553,36 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
                 f"{fsum.get('prefix_dup_factor', 0.0):.2f}) — "
                 f"prefix-aware routing would reclaim them "
                 f"(see `slt fleetscope`)")
+    # Weight-version canary (round 23): fleet_version / canary_config /
+    # canary_probe records feed the verdict engine (telemetry/canary.py)
+    # — a rollback-grade candidate gets NAMED with its evidence, and a
+    # fleet serving 2+ weight fingerprints with NO canary split active
+    # is flagged as version skew (an un-gated partial rollout), all from
+    # the event trail alone.
+    canary_row: Optional[dict] = None
+    if any(r.get("event") in ("fleet_version", "canary_config",
+                              "canary_probe") for r in records):
+        from serverless_learn_tpu.telemetry import canary as _canary
+
+        csum = _canary.summarize(records)
+        cverdict = _canary.verdict(csum)
+        canary_row = {"summary": csum, "verdict": cverdict}
+        cinfo = csum.get("canary") or {}
+        if cinfo.get("active") and cverdict.get("decision") == "rollback":
+            why = (cverdict.get("evidence") or ["(no evidence recorded)"])[0]
+            verdict_bits.append(
+                f"canary ROLLBACK: candidate "
+                f"{cverdict.get('candidate') or '?'} — {why} "
+                f"(see `slt canary`)")
+        skew = csum.get("distinct_replica_versions") or 0
+        if skew >= 2 and not cinfo.get("active"):
+            vers = sorted({v for v in
+                           (csum.get("replica_versions") or {}).values()
+                           if v})
+            verdict_bits.append(
+                f"fleet version skew: {skew} weight fingerprints in "
+                f"service ({', '.join(vers[:4])}) with no canary split "
+                f"active — un-gated partial rollout (see `slt canary`)")
     # Step-interior hardware attribution (round 16): xray summaries —
     # from capture-meta.json records in the event trail and from capture
     # dirs handed to --xray — put a NAME on the training plateau ("step
@@ -614,6 +644,7 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
         "goodput": goodput_by_node,
         "waterfall": waterfall_rows,
         "fleetscope": fleetscope_row,
+        "canary": canary_row,
         "xray": xray_rows,
         "flight_dumps": collected["dumps"],
         "bench": bench,

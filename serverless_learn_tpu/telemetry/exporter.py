@@ -16,8 +16,18 @@
                                    ITL percentiles, per-cause decode
                                    stall totals, prefill interference,
                                    speculative accept rate (round 21)
+    GET /fleetscope                the router's fleet prefix-redundancy
+                                   rollup (round 22)
+    GET /canary                    weight-version + canary rollup:
+                                   distinct fleet versions, candidate
+                                   split fraction, golden-probe match
+                                   counters and overhead share (round 23)
     GET /debug/profile?seconds=N   capture a jax.profiler device trace
                                    (armed by --profile-dir on ANY role)
+
+Unknown paths get a structured JSON 404 naming the served endpoints, and
+an endpoint handler that blows up gets a structured JSON 500 — scrapers
+and ``slt top`` never have to parse a bare text error (round 23).
 
 One ThreadingHTTPServer on a daemon thread — zero dependencies, safe to
 embed in a serving process (scrapes read a consistent snapshot under the
@@ -50,6 +60,11 @@ from serverless_learn_tpu.telemetry.registry import (
     MetricsRegistry, get_registry, percentile_from_buckets)
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+# Every path do_GET serves, in docstring order — the 404 body names them
+# so a typo'd scrape is self-correcting.
+ENDPOINTS = ("/metrics", "/metrics.json", "/healthz", "/alerts",
+             "/goodput", "/numerics", "/stalls", "/fleetscope",
+             "/canary", "/debug/profile")
 # Kept as the endpoint's documented bound; the value lives with the
 # shared profiler service now.
 from serverless_learn_tpu.telemetry.profiler import (  # noqa: E402
@@ -110,15 +125,27 @@ class MetricsExporter:
                         self._reply_json(200, exporter._stalls())
                     elif path == "/fleetscope":
                         self._reply_json(200, exporter._fleetscope())
+                    elif path == "/canary":
+                        self._reply_json(200, exporter._canary())
                     elif path == "/debug/profile":
                         code, obj = exporter._profile(
                             parse_qs(url.query),
                             self.headers.get("X-SLT-Trace"))
                         self._reply_json(code, obj)
                     else:
-                        self._reply(404, "text/plain", b"not found\n")
+                        self._reply_json(
+                            404, {"ok": False,
+                                  "error": f"unknown path {path!r}",
+                                  "endpoints": list(ENDPOINTS)})
                 except (BrokenPipeError, ConnectionResetError):
                     pass  # scraper hung up mid-reply; nothing to salvage
+                except Exception as e:
+                    try:
+                        self._reply_json(
+                            500, {"ok": False,
+                                  "error": f"{type(e).__name__}: {e}"})
+                    except OSError:
+                        pass
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
@@ -276,6 +303,50 @@ class MetricsExporter:
                         "slt_fleet_prefix_dup_factor"),
                     "hedges": _val("slt_router_hedges_total"),
                     "sheds": _val("slt_router_shed_total")}
+        except Exception as e:
+            return {"enabled": False,
+                    "error": f"{type(e).__name__}: {e}"}
+
+    # -- weight versions / canary -------------------------------------------
+
+    def _canary(self) -> dict:
+        """The /canary body (round 23): this process's live weight-version
+        and canary rollup — distinct fleet versions and swap count (router),
+        in-place engine swaps (replica), the configured candidate split
+        fraction, and golden-probe counters with the bounded overhead
+        share. `slt canary` computes the full promote/hold/rollback
+        verdict from event logs; `slt top` polls this for its VERSION
+        pane."""
+        try:
+            snap = self.registry.snapshot()
+
+            def _val(name):
+                fam = snap.get(name)
+                if not fam or not fam.get("series"):
+                    return None
+                return sum(float(s.get("value") or 0.0)
+                           for s in fam["series"])
+
+            frac = _val("slt_canary_candidate_frac")
+            versions = _val("slt_fleet_weight_versions")
+            match = _val("slt_canary_probe_match_total")
+            mismatch = _val("slt_canary_probe_mismatch_total")
+            judged = (match or 0.0) + (mismatch or 0.0)
+            return {"enabled": versions is not None or frac is not None,
+                    "weight_versions": versions,
+                    "version_swaps": _val("slt_fleet_version_swaps_total"),
+                    "engine_weight_swaps": _val(
+                        "slt_engine_weight_swaps_total"),
+                    "candidate_frac": frac,
+                    "probe_requests": _val(
+                        "slt_canary_probe_requests_total"),
+                    "probe_overhead_frac": _val(
+                        "slt_canary_probe_overhead_frac"),
+                    "probe_sent": _val("slt_canary_probe_sent_total"),
+                    "probe_match": match,
+                    "probe_mismatch": mismatch,
+                    "probe_match_frac": (round((match or 0.0) / judged, 4)
+                                         if judged else None)}
         except Exception as e:
             return {"enabled": False,
                     "error": f"{type(e).__name__}: {e}"}

@@ -166,6 +166,25 @@ def fingerprint(tree, depth: int = DEFAULT_DEPTH,
     return out
 
 
+def weight_version(tree, depth: int = DEFAULT_DEPTH,
+                   chunks: int = DEFAULT_CHUNKS) -> Optional[str]:
+    """Compact weight-identity string for the serving plane (round 23):
+    the :func:`fingerprint` digest, floats rendered at 6 significant
+    digits (stable across re-loads of the same checkpoint, insensitive
+    to last-ulp noise), hashed to 12 hex chars. This is the version tag
+    replicas stamp into registration, pings, waterfalls and route
+    decisions — same weights => same tag, everywhere."""
+    import hashlib
+
+    if tree is None:
+        return None
+    fp = fingerprint(tree, depth=depth, chunks=chunks)
+    blob = json.dumps(
+        {name: {k: f"{float(v):.6g}" for k, v in sorted(digest.items())}
+         for name, digest in sorted(fp.items())}, sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
 def step_summary(params, grads, updates, loss=None,
                  depth: int = DEFAULT_DEPTH,
                  chunks: int = DEFAULT_CHUNKS,

@@ -139,6 +139,7 @@ class EndpointState:
         self.error: Optional[str] = None
         self.alerts: List[dict] = []  # firing alerts from /alerts
         self.goodput: Optional[dict] = None  # /goodput report, if served
+        self.canary: Optional[dict] = None  # /canary rollup, if served
 
     def poll(self):
         self.prev, self.t_prev = self.data, self.t
@@ -153,6 +154,7 @@ class EndpointState:
         # its metrics as before, with no ALERTS rows.
         self.alerts = []
         self.goodput = None
+        self.canary = None
         if self.data is not None:
             try:
                 import json as _json
@@ -169,6 +171,17 @@ class EndpointState:
                 gp = _json.loads(fetch_text(self.addr, "/goodput"))
                 if gp.get("total_s"):
                     self.goodput = gp
+            except Exception:
+                pass
+            # Weight-version/canary rollup (round 23): same best-effort
+            # probe; endpoints predating /canary (or with no version
+            # telemetry) just skip the VERSION pane.
+            try:
+                import json as _json
+
+                cn = _json.loads(fetch_text(self.addr, "/canary"))
+                if cn.get("enabled"):
+                    self.canary = cn
             except Exception:
                 pass
 
@@ -500,6 +513,36 @@ def render(states: List[EndpointState]) -> str:
         lines += _table(["endpoint", "hbm live/peak", "busy",
                          "exp comms", "hbm-bound", "dcn bw", "xray"],
                         hw_rows)
+    # VERSION pane (round 23): weight-version identity + canary at a
+    # glance — distinct fleet fingerprints, swap counts, the configured
+    # candidate split fraction, and the golden-probe match/overhead
+    # numbers. Endpoints without /canary (or with no version telemetry)
+    # skip the pane.
+    version_rows: List[List[str]] = []
+    for st in states:
+        cn = st.canary
+        if not cn:
+            continue
+        swaps = (cn.get("version_swaps") or 0.0) \
+            + (cn.get("engine_weight_swaps") or 0.0)
+        frac = cn.get("candidate_frac")
+        mf = cn.get("probe_match_frac")
+        ov = cn.get("probe_overhead_frac")
+        version_rows.append([
+            st.addr,
+            _num(cn.get("weight_versions"), 0),
+            _num(swaps, 0),
+            "-" if frac is None else _pct(frac),
+            _num(cn.get("probe_requests"), 0),
+            "-" if mf is None else _pct(mf),
+            "-" if ov is None else _pct(ov),
+        ])
+    if version_rows:
+        lines.append("")
+        lines.append("  VERSION")
+        lines += _table(["endpoint", "versions", "swaps", "canary frac",
+                         "probes", "probe match", "probe ovhd"],
+                        version_rows)
     if alert_rows:
         lines.append("")
         lines.append("  ALERTS")

@@ -67,6 +67,7 @@ STALL_CAUSES = (
     "kv_exhausted",    # KV block pool exhausted; decode backpressured
     "compaction",      # live decode batch re-packed after retire/preempt
     "harvest_drain",   # dispatcher blocked draining an earlier future
+    "weight_swap",     # in-place params swap (canary rollout, round 23)
 )
 MARKER_CAUSES = frozenset({"preempt", "kv_exhausted"})
 # "other": residual excess with no boundary event in the window — kept

@@ -1,0 +1,848 @@
+"""Canary (round 23): weight-version identity end to end, golden-probe
+quality SLIs, and the promote/hold/rollback verdict engine.
+
+The contract under test: a weight version is ONE fingerprint everywhere
+(numerics digest -> registration name -> ping -> route_decision ->
+waterfall span), the router's version split is session-sticky and
+deterministic, golden-probe traffic is shed-exempt and EXCLUDED from
+user SLI aggregates while staying fully present in the ledgers, and
+`slt canary` folds the version-tagged streams into a deterministic
+verdict whose evidence names the exact trigger. The slow acceptance at
+the bottom proves the whole loop on a live 2-version stub fleet with an
+injected quality regression flipping the verdict to rollback.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from serverless_learn_tpu.telemetry import canary
+from serverless_learn_tpu.telemetry.registry import MetricsRegistry
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "canary",
+                       "canary_fixture.jsonl")
+BENCH_FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                             "canary", "bench_history_canary.json")
+
+V_BASE, V_CAND = canary.V_BASE, canary.V_CAND
+
+
+# -- version identity --------------------------------------------------------
+
+
+def test_probe_fingerprint_order_sensitive_and_deterministic():
+    fp = canary.probe_fingerprint([1, 2, 3, 4])
+    assert len(fp) == 12 and fp == canary.probe_fingerprint([1, 2, 3, 4])
+    assert fp != canary.probe_fingerprint([4, 3, 2, 1])
+    assert fp != canary.probe_fingerprint([1, 2, 3])
+
+
+def test_weight_version_fingerprints_weights_not_metadata():
+    """Same weights => same 12-hex tag; different weights => different
+    tag; no weights => no tag (a replica without params registers
+    version-less and parses exactly as before round 23)."""
+    import jax.numpy as jnp
+
+    from serverless_learn_tpu.telemetry.numerics import weight_version
+
+    tree = {"dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.zeros(4)}}
+    v1 = weight_version(tree)
+    assert v1 is not None and len(v1) == 12
+    assert weight_version({"dense": {"kernel": jnp.ones((4, 4)),
+                                     "bias": jnp.zeros(4)}}) == v1
+    tree2 = {"dense": {"kernel": jnp.ones((4, 4)) * 2.0,
+                       "bias": jnp.zeros(4)}}
+    assert weight_version(tree2) != v1
+    assert weight_version(None) is None
+
+
+def test_replica_name_roundtrips_version():
+    from serverless_learn_tpu.fleet.registration import (parse_replica,
+                                                         replica_name)
+
+    name = replica_name("serve", "10.0.0.1:9100", version="aaaa00001111")
+    assert name.endswith(";v=aaaa00001111")
+    info = parse_replica(name, "10.0.0.1:9000")
+    assert info == {"service": "serve", "serve_addr": "10.0.0.1:9000",
+                    "metrics_addr": "10.0.0.1:9100",
+                    "version": "aaaa00001111"}
+    # Pre-round-23 names (no ;v=) parse exactly as before.
+    old = parse_replica(replica_name("serve", "m:1"), "a:2")
+    assert old["version"] is None and old["metrics_addr"] == "m:1"
+    with pytest.raises(ValueError):
+        replica_name("serve", version="bad;stuff")
+    with pytest.raises(ValueError):
+        replica_name("se;rve")
+
+
+# -- verdict engine ----------------------------------------------------------
+
+
+def _mk_summary(cand_row, base_row, timeline=None):
+    """Hand-built summarize() output: verdict() is a pure function of
+    this shape, so units can poke single triggers."""
+    return {"candidate": V_CAND, "baseline": V_BASE,
+            "versions": {V_CAND: cand_row, V_BASE: base_row},
+            "timelines": {V_CAND: timeline or []},
+            "canary": {"active": True, "candidate_version": V_CAND,
+                       "frac": 0.25}}
+
+
+_HEALTHY_CAND = {"requests": 10, "probe_total": 4, "probe_match": 4,
+                 "errors": 0, "ttft_p99_ms": 45.0}
+_HEALTHY_BASE = {"requests": 20, "probe_total": 4, "probe_match": 4,
+                 "errors": 0, "ttft_p99_ms": 45.0}
+
+
+def test_verdict_promote_names_all_three_checks():
+    vd = canary.verdict(_mk_summary(dict(_HEALTHY_CAND),
+                                    dict(_HEALTHY_BASE)))
+    assert vd["decision"] == "promote"
+    assert vd["probe_match_frac"] == 1.0
+    assert vd["p99_delta_frac"] == 0.0 and vd["delta_basis"] == "ttft_p99_ms"
+    ev = " ".join(vd["evidence"])
+    assert "golden probes 4/4" in ev and "burn-rate clean" in ev
+
+
+def test_verdict_holds_without_two_versions():
+    vd = canary.verdict({"candidate": None, "baseline": None,
+                         "versions": {}, "timelines": {}})
+    assert vd["decision"] == "hold"
+    assert "fewer than two weight versions" in vd["evidence"][0]
+
+
+def test_verdict_holds_on_thin_evidence_with_named_gaps():
+    c = dict(_HEALTHY_CAND, probe_total=2, probe_match=2, requests=3)
+    del c["ttft_p99_ms"]
+    b = dict(_HEALTHY_BASE)
+    del b["ttft_p99_ms"]
+    vd = canary.verdict(_mk_summary(c, b))
+    assert vd["decision"] == "hold"
+    ev = " ".join(vd["evidence"])
+    assert "only 2 candidate golden probe(s)" in ev
+    assert "only 3 candidate user request(s)" in ev
+    assert "no p99 latency sample on BOTH versions" in ev
+
+
+def test_verdict_rollback_orders_quality_before_latency():
+    """Both triggers fire: the evidence list is quality-first (fixed
+    check order), and ANY probe mismatch fails the exact-greedy floor."""
+    c = dict(_HEALTHY_CAND, probe_match=3, ttft_p99_ms=90.0)
+    vd = canary.verdict(_mk_summary(c, dict(_HEALTHY_BASE)))
+    assert vd["decision"] == "rollback"
+    assert len(vd["evidence"]) == 2
+    assert "golden-probe fingerprint match 3/4" in vd["evidence"][0]
+    assert "ttft p99 ms 90.0 vs baseline 45.0" in vd["evidence"][1]
+    assert "+100%" in vd["evidence"][1]
+
+
+def test_verdict_rollback_on_critical_burn_only():
+    """Perfect probes and flat latency, but a sustained candidate error
+    burn: the round-9 two-window AND goes critical and rolls back —
+    while a short blip (long window still clean) only holds."""
+    # Sustained: ~50% errors over 800 s >> 14.4x of the 2% budget in
+    # BOTH windows.
+    t0 = 1754300000.0
+    sustained = []
+    bad = 0
+    for i in range(200):
+        bad += i % 2
+        sustained.append([t0 + 4.0 * i, bad, i + 1])
+    vd = canary.verdict(_mk_summary(dict(_HEALTHY_CAND),
+                                    dict(_HEALTHY_BASE), sustained))
+    assert vd["decision"] == "rollback"
+    assert "burn-rate critical" in vd["evidence"][0]
+    assert "two-window AND" in vd["evidence"][0]
+    # Moderate sustained burn (~14% errors = ~7x of the 2% budget in
+    # BOTH windows): warning-level, so the verdict HOLDS — naming the
+    # burn — instead of rolling back.
+    warn = []
+    bad = 0
+    for i in range(200):
+        bad += 1 if i % 7 == 0 else 0
+        warn.append([t0 + 4.0 * i, bad, i + 1])
+    vd2 = canary.verdict(_mk_summary(dict(_HEALTHY_CAND),
+                                     dict(_HEALTHY_BASE), warn))
+    assert vd2["decision"] == "hold"
+    assert any("burn-rate warning" in e for e in vd2["evidence"])
+
+
+# -- summarize + the committed fixture ---------------------------------------
+
+
+def _fixture_records():
+    with open(FIXTURE) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_committed_fixture_is_the_synthetic_parity_scenario():
+    """Fixture-drift guard: the committed JSONL is byte-for-byte the
+    embedded generator's parity scenario, so self_check's hand-computed
+    expectations can never silently diverge from the committed file."""
+    with open(FIXTURE) as f:
+        committed = [line.rstrip("\n") for line in f if line.strip()]
+    expected = [json.dumps(r, sort_keys=True)
+                for r in canary.synthetic_records("parity")]
+    assert committed == expected
+
+
+def test_summarize_excludes_probes_from_user_slis_exactly():
+    """The fixture's 8 golden probes run at 500 ms TTFT — >10x the user
+    traffic. Hand-computed: user TTFT p99 stays 45.0 ms on BOTH
+    versions, probe counts land in probe_* fields, and the overhead
+    share is exactly 8/32."""
+    s = canary.summarize(_fixture_records())
+    assert s["candidate"] == V_CAND and s["baseline"] == V_BASE
+    assert s["canary"] == {"active": True, "candidate_version": V_CAND,
+                           "frac": 0.25}
+    c, b = s["versions"][V_CAND], s["versions"][V_BASE]
+    assert (c["requests"], b["requests"]) == (8, 16)
+    assert c["ttft_p99_ms"] == 45.0 and b["ttft_p99_ms"] == 45.0
+    assert c["ttft_n"] == 8 and b["ttft_n"] == 16   # probes not counted
+    assert c["probe_total"] == 4 and c["probe_match_frac"] == 1.0
+    assert s["probe_decisions"] == 8
+    assert s["probe_overhead_frac"] == pytest.approx(8 / 32)
+    assert s["distinct_replica_versions"] == 2
+    assert s["replica_versions"] == {"n0:9000": V_BASE, "n1:9000": V_BASE,
+                                     "n2:9000": V_CAND}
+
+
+def test_self_check_passes_on_synthetic_and_committed_fixture():
+    rep = canary.self_check()
+    assert rep["ok"], rep["checks"]
+    rep = canary.self_check(fixture_path=FIXTURE)
+    assert rep["ok"], rep["checks"]
+    assert {c["check"] for c in rep["checks"]} >= {
+        "verdict_promote_on_parity", "verdict_rollback_on_probe_regression",
+        "verdict_rollback_on_ttft_regression",
+        "probe_exclusion_from_user_slis", "byte_identical_report"}
+
+
+def test_report_is_byte_identical_and_injectors_flip_verdict():
+    rep1 = canary.report([FIXTURE])
+    rep2 = canary.report([FIXTURE])
+    assert json.dumps(rep1, sort_keys=True) == json.dumps(rep2,
+                                                          sort_keys=True)
+    assert rep1["verdict"]["decision"] == "promote"
+    recs = _fixture_records()
+    vq = canary.report_records(
+        canary._inject_probe_regression(recs))["verdict"]
+    assert vq["decision"] == "rollback" and vq["probe_match_frac"] == 0.0
+    vt = canary.report_records(
+        canary._inject_ttft_regression(recs))["verdict"]
+    assert vt["decision"] == "rollback"
+    assert vt["p99_delta_frac"] == 2.0        # 135 ms vs 45 ms: +200%
+
+
+# -- golden-probe runner -----------------------------------------------------
+
+
+class _FakeFleet:
+    """Request-shaped stand-in for the router: greedy echo of the
+    prompt, with the candidate version optionally diverging (the
+    quality regression) and errors injectable."""
+
+    def __init__(self):
+        self.divergent = False
+        self.fail_candidate = False
+        self.requests = []
+
+    def send(self, req):
+        self.requests.append(req)
+        pin = req.get("pin_version")
+        if self.fail_candidate and pin == V_CAND:
+            raise ConnectionResetError("replica died")
+        off = 1 if (self.divergent and pin == V_CAND) else 0
+        return {"tokens": [t + off for t in req["prompt"]]}
+
+
+def test_prober_tags_requests_and_scores_matches():
+    fleet = _FakeFleet()
+    reg = MetricsRegistry()
+    events = []
+    pr = canary.CanaryProber(fleet.send, V_CAND, V_BASE, registry=reg,
+                             emit=events.append)
+    base = pr.record_baseline()
+    assert len(base) == 4 and all(r["phase"] == "record" for r in base)
+    assert len(pr.expected) == 4
+    rnd = pr.run_round()
+    assert rnd == {"sent": 8, "matched": 8, "errors": 0}
+    # Every wire request is tagged probe traffic: shed-exempt priority,
+    # greedy, pinned, and named so ledgers can join it back.
+    for req in fleet.requests:
+        assert req["probe"] is True and req["priority"] >= 1
+        assert req["temperature"] == 0.0
+        assert req["pin_version"] in (V_BASE, V_CAND)
+        assert req["session"].startswith("canary-probe:")
+    snap = reg.snapshot()
+
+    def val(name):
+        return sum(s["value"] for s in snap[name]["series"])
+
+    assert val("slt_canary_probe_sent_total") == 12
+    # The recording round itself scores 4 matches (fp == just-recorded
+    # expectation), so 4 + 8 land in the match counter.
+    assert val("slt_canary_probe_match_total") == 12
+    assert val("slt_canary_probe_mismatch_total") == 0
+    assert all(e["event"] == "canary_probe" for e in events)
+
+
+def test_prober_catches_divergence_and_transport_errors():
+    fleet = _FakeFleet()
+    reg = MetricsRegistry()
+    pr = canary.CanaryProber(fleet.send, V_CAND, V_BASE, registry=reg)
+    pr.record_baseline()
+    fleet.divergent = True
+    rnd = pr.run_round()
+    assert rnd == {"sent": 8, "matched": 4, "errors": 0}   # baseline ok
+    assert pr.mismatched == 4
+    fleet.divergent = False
+    fleet.fail_candidate = True
+    rnd2 = pr.run_round()
+    assert rnd2["errors"] == 4                 # transport = probe error
+    snap = reg.snapshot()
+    mism = sum(s["value"]
+               for s in snap["slt_canary_probe_mismatch_total"]["series"])
+    assert mism == 4                           # errors are not mismatches
+
+
+# -- router: version split, stickiness, probe exemption ----------------------
+
+
+def _make_router(replicas, registry=None, events=None, **cfg_kw):
+    from serverless_learn_tpu.config import FleetConfig
+    from serverless_learn_tpu.fleet.router import FleetRouter
+
+    defaults = dict(health_interval_s=0.05, dead_after_probes=5,
+                    discover_interval_s=0.3, hedge_min_delay_s=5.0,
+                    eject_s=0.4, upstream_timeout_s=5.0,
+                    queue_timeout_s=2.0)
+    defaults.update(cfg_kw)
+    return FleetRouter(config=FleetConfig(**defaults), host="127.0.0.1",
+                       port=0, replicas=tuple(replicas),
+                       registry=registry or MetricsRegistry(),
+                       emit=(events.append if events is not None
+                             else lambda rec: None))
+
+
+def _await_versions(router, n, deadline_s=5.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        with router._lock:
+            if sum(1 for r in router._replicas.values()
+                   if r.version) >= n:
+                return True
+        time.sleep(0.02)
+    return False
+
+
+def _two_version_fleet(events, registry=None):
+    from serverless_learn_tpu.fleet.testing import StubEngine, stub_server
+
+    base = stub_server(engine=StubEngine(latency_s=0.0,
+                                         weight_version="basefp000001"))
+    cand = stub_server(engine=StubEngine(latency_s=0.0,
+                                         weight_version="candfp000002",
+                                         reply_offset=1))
+    router = _make_router([base.addr, cand.addr], registry=registry,
+                          events=events).start()
+    assert _await_versions(router, 2)
+    return router, base, cand
+
+
+def test_router_ingests_versions_and_splits_session_sticky():
+    """Ping-reported fingerprints become fleet_version events and
+    route_decision tags; the 50% split is md5-session-sticky — the SAME
+    6/10 candidate/baseline assignment every run, and a re-sent session
+    never moves."""
+    from serverless_learn_tpu.inference.server import request
+
+    events = []
+    reg = MetricsRegistry()
+    router, base, cand = _two_version_fleet(events, registry=reg)
+    try:
+        router.set_canary("candfp000002", 0.5)
+        # Deterministic md5 bucketing: sess-{3,5,8,11,14,15} -> candidate
+        # (precomputed; the same 6/16 every run on every machine).
+        expect_cand = {3, 5, 8, 11, 14, 15}
+        for rnd in range(2):
+            for i in range(16):
+                rep = request(router.addr,
+                              {"prompt": [1 + i % 5, 2], "max_new_tokens": 2,
+                               "session": f"sess-{i}"})
+                assert "new_tokens" in rep, rep
+                # The candidate stub's reply_offset shifts the output:
+                # the COMPLETION itself proves which version served —
+                # and round 2 reproducing round 1 proves stickiness.
+                base0 = ((1 + i % 5 + 2) * 31) % 1000
+                served_cand = rep["new_tokens"][0] == (base0 + 1) % 1000
+                assert served_cand == (i in expect_cand), (rnd, i)
+        deadline = time.monotonic() + 3.0
+        decs = []
+        while time.monotonic() < deadline and len(decs) < 32:
+            decs = [e for e in events if e.get("event") == "route_decision"]
+            time.sleep(0.02)
+        for d in decs:
+            assert d["version"] in ("basefp000001", "candfp000002")
+            assert d["canary"] in ("candidate", "baseline")
+        assert sum(1 for d in decs
+                   if d["canary"] == "candidate") == 2 * len(expect_cand)
+        fv = [e for e in events if e.get("event") == "fleet_version"]
+        assert {e["version"] for e in fv} == {"basefp000001",
+                                              "candfp000002"}
+        cfg_ev = [e for e in events if e.get("event") == "canary_config"]
+        assert cfg_ev and cfg_ev[-1]["frac"] == 0.5
+        snap = reg.snapshot()
+        assert sum(s["value"] for s in
+                   snap["slt_fleet_weight_versions"]["series"]) == 2
+        assert sum(s["value"] for s in
+                   snap["slt_canary_candidate_frac"]["series"]) == 0.5
+    finally:
+        router.stop(), base.stop(), cand.stop()
+
+
+def test_pin_version_routes_strictly_and_sheds_unknown():
+    """pin_version is strict: the candidate fingerprint reaches the
+    candidate replica (reply_offset proves it by OUTPUT, not just by
+    addr), and an unknown fingerprint sheds with a typed reason instead
+    of silently serving the wrong weights."""
+    from serverless_learn_tpu.inference.server import request
+
+    events = []
+    router, base, cand = _two_version_fleet(events)
+    try:
+        rep_b = request(router.addr, {"prompt": [5, 6, 7],
+                                      "max_new_tokens": 2,
+                                      "pin_version": "basefp000001"})
+        rep_c = request(router.addr, {"prompt": [5, 6, 7],
+                                      "max_new_tokens": 2,
+                                      "pin_version": "candfp000002"})
+        # The candidate stub's reply_offset shifts every generated
+        # token: versions produce different completions by construction.
+        assert rep_c["new_tokens"] == [(t + 1) % 1000
+                                       for t in rep_b["new_tokens"]]
+        rep_x = request(router.addr, {"prompt": [1], "max_new_tokens": 1,
+                                      "pin_version": "nope"})
+        assert rep_x.get("code") == "overloaded"
+        assert "no eligible replica serving version nope" in rep_x["error"]
+        deadline = time.monotonic() + 3.0
+        shed = []
+        while time.monotonic() < deadline and not shed:
+            shed = [e for e in events
+                    if e.get("event") == "route_decision"
+                    and e.get("reason") == "shed_no_version"]
+            time.sleep(0.02)
+        assert shed and shed[0]["pick"] is None
+    finally:
+        router.stop(), base.stop(), cand.stop()
+
+
+def test_probe_traffic_excluded_from_user_slis_but_counted():
+    """Probes route and serve, but the user latency histogram does not
+    move — the probe counter and overhead gauge do, and the decision
+    stream carries probe=True for the offline ledgers."""
+    from serverless_learn_tpu.inference.server import request
+
+    events = []
+    reg = MetricsRegistry()
+    router, base, cand = _two_version_fleet(events, registry=reg)
+    try:
+        for i in range(4):
+            request(router.addr, {"prompt": [1, 2], "max_new_tokens": 2,
+                                  "session": f"u{i}"})
+        for i in range(2):
+            rep = request(router.addr, {"prompt": [1, 2],
+                                        "max_new_tokens": 2,
+                                        "probe": True, "priority": 1})
+            assert "tokens" in rep
+        snap = reg.snapshot()
+
+        def val(name):
+            return sum(s["value"] for s in snap[name]["series"])
+
+        hist = snap["slt_router_request_seconds"]["series"]
+        assert sum(s["count"] for s in hist) == 4     # users only
+        assert val("slt_canary_probe_requests_total") == 2
+        assert val("slt_canary_probe_overhead_frac") == pytest.approx(
+            2 / 6, abs=1e-3)
+        deadline = time.monotonic() + 3.0
+        probes = []
+        while time.monotonic() < deadline and len(probes) < 2:
+            probes = [e for e in events
+                      if e.get("event") == "route_decision"
+                      and e.get("probe")]
+            time.sleep(0.02)
+        assert len(probes) == 2
+    finally:
+        router.stop(), base.stop(), cand.stop()
+
+
+def test_probe_is_shed_exempt_under_brownout():
+    """A saturated replica browns out priority-0 users; the SAME shaped
+    request tagged probe:true is priority-forced past the brownout gate
+    (quality SLIs must keep flowing exactly when the fleet is sick)."""
+    from serverless_learn_tpu.fleet.testing import StubEngine, stub_server
+    from serverless_learn_tpu.inference.server import request
+
+    slow = stub_server(engine=StubEngine(latency_s=0.5))
+    router = _make_router([slow.addr], max_inflight=2,
+                          shed_start_frac=0.5,
+                          queue_timeout_s=3.0).start()
+    try:
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            with router._lock:
+                if router._replicas:
+                    break
+            time.sleep(0.02)
+        occupied = threading.Thread(
+            target=lambda: request(router.addr, {"prompt": [1],
+                                                 "max_new_tokens": 2}),
+            daemon=True)
+        occupied.start()
+        time.sleep(0.15)               # occupant holds 1 of 2 slots
+        user = request(router.addr, {"prompt": [1], "max_new_tokens": 1,
+                                     "priority": 0})
+        assert user.get("code") == "overloaded"
+        assert "brownout" in user["error"]
+        probe = request(router.addr, {"prompt": [1], "max_new_tokens": 1,
+                                      "priority": 0, "probe": True})
+        assert "tokens" in probe, probe
+        occupied.join(timeout=5.0)
+    finally:
+        router.stop(), slow.stop()
+
+
+# -- satellite: mid-request weight swap in the waterfall ---------------------
+
+
+def test_weight_swap_is_a_named_interval_stall_cause():
+    from serverless_learn_tpu.telemetry import waterfall
+
+    assert "weight_swap" in waterfall.STALL_CAUSES
+    assert "weight_swap" not in waterfall.MARKER_CAUSES  # interval cause
+
+
+def test_waterfall_attributes_mid_request_swap_exactly():
+    """A request decoding THROUGH a weight swap: the swap window is
+    noted as a boundary interval, the stalled gap names weight_swap,
+    and the round-21 exactness invariant holds to the microsecond —
+    base_s + sum(causes) == gap_s, with the swap claiming the excess."""
+    from serverless_learn_tpu.telemetry import waterfall
+
+    ev = waterfall.BoundaryEvents()
+    wf = waterfall.RequestWaterfall(min_stall_s=0.001)
+    t = 100.0
+    wf.first_token(t)
+    # Establish a 10 ms ITL baseline.
+    for i in range(1, 6):
+        out = wf.note_decode(t + 0.010 * i, 1, ev)
+        assert out is not None and out[1] is None      # no stall yet
+    # The engine swaps weights for 80 ms mid-decode ...
+    t_swap0 = t + 0.055
+    t_swap1 = t_swap0 + 0.080
+    ev.note("weight_swap", t_swap0, t_swap1)
+    # ... and the next harvest lands 90 ms after the previous one.
+    itl, causes = wf.note_decode(t + 0.050 + 0.090, 1, ev)
+    assert causes is not None and set(causes) == {"weight_swap"}
+    (stall,) = wf.stalls
+    assert stall["causes"].keys() == {"weight_swap"}
+    assert stall["base_s"] + sum(stall["causes"].values()) \
+        == pytest.approx(stall["gap_s"], abs=2e-6)
+    assert stall["causes"]["weight_swap"] == pytest.approx(0.080, abs=0.005)
+    assert wf.stall_totals["weight_swap"] > 0.07
+
+
+def test_waterfall_finalize_and_summarize_keep_swap_invariants():
+    """finalize() rebases the swap stall into the span record, the TTFT
+    decomposition stays exact-by-construction, summarize() folds the
+    cause into the fleet stall ledger, and the module's own self-check
+    still passes with the round-23 cause in the taxonomy."""
+    from serverless_learn_tpu.telemetry import waterfall
+    from serverless_learn_tpu.telemetry.registry import Span
+
+    ev = waterfall.BoundaryEvents()
+    wf = waterfall.RequestWaterfall(min_stall_s=0.001)
+    span = Span("request")
+    t0 = span.t0
+    span.marks["admit"] = 0.002
+    span.marks["first_token"] = 0.040
+    span.marks["done"] = 0.400
+    wf.note_admit(t0, t0 + 0.001)
+    wf.first_token(t0 + 0.040)
+    for i in range(1, 6):
+        wf.note_decode(t0 + 0.040 + 0.010 * i, 1, ev)
+    ev.note("weight_swap", t0 + 0.095, t0 + 0.175)
+    wf.note_decode(t0 + 0.090 + 0.090, 1, ev)
+    rec = wf.finalize(span)
+    decomp = rec["ttft_decomp_s"]
+    assert sum(decomp.values()) == pytest.approx(rec["ttft_s"], abs=2e-6)
+    (stall,) = rec["stalls"]
+    assert set(stall["causes"]) == {"weight_swap"}
+    assert stall["base_s"] + sum(stall["causes"].values()) \
+        == pytest.approx(stall["gap_s"], abs=2e-6)
+    assert rec["stall_s"]["weight_swap"] > 0.07
+    summary = waterfall.summarize([{
+        "t0_unix_s": 1754300000.0, "duration_s": 0.4, "node": "n0",
+        "trace_id": "ab" * 16, "marks_s": dict(span.marks),
+        "waterfall": rec, "router": None}])
+    assert summary["stall_s"].keys() == {"weight_swap"}
+    assert summary["dominant_stall_cause"] == "weight_swap"
+    assert summary["invariants"] == {"ttft_decomp_bad": 0,
+                                     "stall_sum_bad": 0}
+    assert waterfall.self_check()["ok"]
+
+
+# -- surfacing: exporter endpoint, top pane, doctor --------------------------
+
+
+def _fetch_json(addr, path):
+    import urllib.request
+
+    with urllib.request.urlopen(f"http://{addr}{path}", timeout=5) as r:
+        return r.status, r.headers.get("Content-Type"), \
+            json.loads(r.read().decode())
+
+
+def test_exporter_serves_canary_rollup():
+    from serverless_learn_tpu.telemetry.exporter import MetricsExporter
+
+    reg = MetricsRegistry()
+    reg.gauge("slt_fleet_weight_versions", "n").set(2)
+    reg.counter("slt_fleet_version_swaps_total", "n").inc(1)
+    reg.gauge("slt_canary_candidate_frac", "frac").set(0.25)
+    reg.counter("slt_canary_probe_requests_total", "n").inc(8)
+    reg.gauge("slt_canary_probe_overhead_frac", "frac").set(0.25)
+    reg.counter("slt_canary_probe_sent_total", "n").inc(12)
+    reg.counter("slt_canary_probe_match_total", "n").inc(7)
+    reg.counter("slt_canary_probe_mismatch_total", "n").inc(1)
+    exp = MetricsExporter(registry=reg).start()
+    try:
+        code, ctype, cn = _fetch_json(exp.addr, "/canary")
+    finally:
+        exp.stop()
+    assert code == 200 and ctype == "application/json"
+    assert cn["enabled"] and cn["weight_versions"] == 2
+    assert cn["candidate_frac"] == 0.25
+    assert cn["probe_requests"] == 8
+    assert cn["probe_match_frac"] == pytest.approx(7 / 8)
+    assert cn["probe_overhead_frac"] == 0.25
+
+
+def test_exporter_structured_errors_on_unknown_and_malformed():
+    """Satellite: every exporter miss is a machine-readable JSON body
+    with the SAME content type as the happy path — a scraper never has
+    to parse an HTML error page."""
+    import urllib.error
+    import urllib.request
+
+    from serverless_learn_tpu.telemetry import exporter as exp_mod
+    from serverless_learn_tpu.telemetry.exporter import MetricsExporter
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        exp = MetricsExporter(registry=MetricsRegistry(),
+                              profile_dir=td).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://{exp.addr}/no/such/endpoint", timeout=5)
+            err = ei.value
+            assert err.code == 404
+            assert err.headers.get("Content-Type") == "application/json"
+            body = json.loads(err.read().decode())
+            assert body["ok"] is False
+            assert "unknown path '/no/such/endpoint'" in body["error"]
+            assert "/canary" in body["endpoints"]
+            assert set(body["endpoints"]) == set(exp_mod.ENDPOINTS)
+            with pytest.raises(urllib.error.HTTPError) as ei2:
+                urllib.request.urlopen(
+                    f"http://{exp.addr}/debug/profile?seconds=abc",
+                    timeout=5)
+            assert ei2.value.code == 400
+            body2 = json.loads(ei2.value.read().decode())
+            assert body2 == {"ok": False,
+                             "error": "seconds must be a number"}
+        finally:
+            exp.stop()
+
+
+def test_top_renders_version_pane():
+    from serverless_learn_tpu.telemetry import top as top_mod
+    from serverless_learn_tpu.telemetry.exporter import MetricsExporter
+
+    reg = MetricsRegistry()
+    reg.gauge("slt_router_replicas", "n").set(2)
+    reg.gauge("slt_fleet_weight_versions", "n").set(2)
+    reg.counter("slt_fleet_version_swaps_total", "n").inc(3)
+    reg.gauge("slt_canary_candidate_frac", "frac").set(0.25)
+    reg.counter("slt_canary_probe_requests_total", "n").inc(8)
+    reg.gauge("slt_canary_probe_overhead_frac", "frac").set(0.2)
+    reg.counter("slt_canary_probe_sent_total", "n").inc(10)
+    reg.counter("slt_canary_probe_match_total", "n").inc(10)
+    exp = MetricsExporter(registry=reg).start()
+    try:
+        st = top_mod.EndpointState(exp.addr)
+        st.poll()
+        out = top_mod.render([st])
+    finally:
+        exp.stop()
+    assert "VERSION" in out
+    assert "canary frac" in out and "probe match" in out
+    assert "25%" in out and "100%" in out and "20%" in out
+
+
+def test_doctor_flags_unmanaged_version_skew():
+    """Two fingerprints in service with NO canary split configured is an
+    un-gated partial rollout — doctor names it from the event log alone
+    and points at `slt canary`."""
+    import tempfile
+
+    from serverless_learn_tpu.telemetry import doctor
+
+    recs = [
+        {"event": "fleet_version", "replica": "n0:9000",
+         "t_unix_s": 1754300000.0, "version": "aaaa00001111", "prev": None},
+        {"event": "fleet_version", "replica": "n1:9000",
+         "t_unix_s": 1754300001.0, "version": "bbbb22223333", "prev": None},
+    ]
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    try:
+        rep = doctor.diagnose(paths=[f.name])
+    finally:
+        os.unlink(f.name)
+    verdict = rep["summary"]["verdict"]
+    assert "fleet version skew: 2 weight fingerprints" in verdict
+    assert "slt canary" in verdict
+    assert rep["canary"]["summary"]["distinct_replica_versions"] == 2
+
+
+def test_doctor_names_bad_canary_from_logs_alone():
+    import tempfile
+
+    from serverless_learn_tpu.telemetry import doctor
+
+    recs = canary.synthetic_records("probe_regression")
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    try:
+        rep = doctor.diagnose(paths=[f.name])
+    finally:
+        os.unlink(f.name)
+    verdict = rep["summary"]["verdict"]
+    assert "canary ROLLBACK" in verdict
+    assert V_CAND in verdict and "golden-probe" in verdict
+    assert rep["canary"]["verdict"]["decision"] == "rollback"
+    # A healthy split must NOT page: parity logs produce no canary line.
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as f2:
+        for r in canary.synthetic_records("parity"):
+            f2.write(json.dumps(r) + "\n")
+    try:
+        rep2 = doctor.diagnose(paths=[f2.name])
+    finally:
+        os.unlink(f2.name)
+    assert "canary ROLLBACK" not in rep2["summary"]["verdict"]
+    assert "version skew" not in rep2["summary"]["verdict"]
+
+
+# -- bench gate --------------------------------------------------------------
+
+
+def test_bench_rows_carry_canary_columns_and_gate():
+    from serverless_learn_tpu.telemetry import benchgate
+    from serverless_learn_tpu.utils.benchlog import load_history
+
+    rows = canary.bench_rows(canary.report([FIXTURE]),
+                             device_kind="cpu")
+    (row,) = rows
+    assert row["metric"] == "canary_candidate_p99_ms"
+    assert row["value"] == 45.0
+    assert row["canary_probe_match_frac"] == 1.0
+    assert row["canary_verdict"] == "promote"
+    assert row["canary_verdict_ok"] == 1.0
+    for col in ("canary_probe_match_frac", "canary_ttft_p99_delta_frac",
+                "canary_verdict_ok"):
+        assert col in benchgate.ATTRIBUTION_COLUMNS
+    rep = benchgate.gate_history(load_history(BENCH_FIXTURE),
+                                 metric="canary_")
+    assert rep["ok"] and rep["series"] == 1
+    cols = {a["column"] for c in rep["checks"]
+            for a in c.get("attribution", [])}
+    assert cols >= {"canary_probe_match_frac",
+                    "canary_ttft_p99_delta_frac", "canary_verdict_ok"}
+
+
+def test_gate_fails_a_rollback_run_outright():
+    """canary_verdict_ok gates with a ZERO gap: one rollback run fails
+    the gate even if its latency value is the best ever seen."""
+    from serverless_learn_tpu.telemetry import benchgate
+
+    entry = {"metric": "canary_candidate_p99_ms", "value": 40.0,
+             "unit": "ms", "device_kind": "cpu", "count": 35,
+             "canary_probe_match_frac": 0.0,
+             "canary_ttft_p99_delta_frac": 0.0,
+             "canary_verdict": "rollback", "canary_verdict_ok": 0.0}
+    rep = benchgate.run_gate(BENCH_FIXTURE, entry=entry,
+                             key_fields=("metric", "device_kind"))
+    assert not rep["ok"]
+    bad = {a["column"] for c in rep["checks"]
+           for a in c.get("attribution", []) if not a["ok"]}
+    assert bad == {"canary_probe_match_frac", "canary_verdict_ok"}
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_cli_canary_self_check_and_rollback_exit_code(capsys):
+    import tempfile
+
+    from serverless_learn_tpu.cli import main
+
+    assert main(["canary", "--self-check", "--compact",
+                 "--fixture", FIXTURE]) == 0
+    out = capsys.readouterr().out
+    assert '"ok": true' in out
+    # Promote over the committed fixture: exit 0, verdict rendered.
+    assert main(["canary", FIXTURE, "--compact"]) == 0
+    assert "canary: PROMOTE" in capsys.readouterr().out
+    # The deployment gate: a rollback verdict is a NON-ZERO exit.
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as f:
+        for r in canary.synthetic_records("probe_regression"):
+            f.write(json.dumps(r) + "\n")
+    try:
+        assert main(["canary", f.name, "--compact"]) == 1
+        assert "canary: ROLLBACK" in capsys.readouterr().out
+    finally:
+        os.unlink(f.name)
+    assert main(["canary", "/no/such/file.jsonl", "--compact"]) == 2
+
+
+# -- acceptance: live 2-version fleet ----------------------------------------
+
+
+@pytest.mark.slow
+def test_canary_smoke_live_fleet_acceptance():
+    """The round-23 acceptance on a live 2-version stub fleet: version
+    ingestion via pings, deterministic session split, golden probes
+    shed-exempt and excluded from user SLIs with bounded exported
+    overhead, promote on the healthy leg, and the injected golden-probe
+    regression flipping the verdict to rollback."""
+    from serverless_learn_tpu.fleet.loadgen import run_canary_smoke
+
+    rep = run_canary_smoke(seed=0)
+    assert rep["ok"], rep["checks"]
+    assert rep["healthy"]["verdict"]["decision"] == "promote"
+    assert rep["regression"]["verdict"]["decision"] == "rollback"
+    assert rep["bench_rows"] and \
+        rep["bench_rows"][0]["canary_verdict"] == "promote"

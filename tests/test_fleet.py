@@ -466,7 +466,8 @@ def test_replica_self_registration_and_discovery():
                                                          replica_name)
 
     assert parse_replica(replica_name("svc", "1.2.3.4:9"), "a:1") == {
-        "service": "svc", "serve_addr": "a:1", "metrics_addr": "1.2.3.4:9"}
+        "service": "svc", "serve_addr": "a:1", "metrics_addr": "1.2.3.4:9",
+        "version": None}
     assert parse_replica("worker-7", "a:1") is None
     with pytest.raises(ValueError):
         replica_name("has:colon")
