@@ -21,12 +21,13 @@ check:  ## project-aware static analysis alone (SLT001-SLT009)
 lockcheck:  ## fast telemetry/health/goodput tier under the runtime lock-order detector
 	SLT_LOCKCHECK=1 python -m pytest tests/test_analysis.py tests/test_telemetry.py \
 		tests/test_health.py tests/test_goodput.py tests/test_canary.py \
-		-q -m "not slow"
+		tests/test_regress.py -q -m "not slow"
 
 racecheck:  ## concurrency surface under the vector-clock happens-before race detector
 	SLT_RACECHECK=1 python -m pytest tests/test_fleet.py tests/test_gossip.py \
 		tests/test_kvcache.py tests/test_continuous.py tests/test_telemetry.py \
-		tests/test_health.py tests/test_canary.py -q -m "not slow"
+		tests/test_health.py tests/test_canary.py tests/test_regress.py \
+		-q -m "not slow"
 
 test-all:  ## the full suite (~13 min on CPU)
 	python -m pytest tests/ -q

@@ -122,6 +122,17 @@ def measure() -> dict:
     grep = ledger.report(mfu=utilization)
     record["goodput"] = grep["goodput"]
     record["badput_breakdown"] = grep["badput_breakdown"]
+    # Cross-run identity stamps (round 24): git_sha + config fingerprint
+    # make any two history rows joinable for `slt regress`; readers
+    # treat missing stamps as joinable-but-unattributable, never errors.
+    from serverless_learn_tpu.telemetry import regress
+
+    sha = regress.git_sha(os.path.dirname(os.path.abspath(__file__)))
+    if sha:
+        record["git_sha"] = sha
+    fp = regress.config_fingerprint(cfg)
+    if fp:
+        record["config_fingerprint"] = fp
     return record
 
 
@@ -197,6 +208,36 @@ def _xray_columns(trainer, state, batch, n_dev, step_s, analytic_mfu):
     return out
 
 
+def write_run_bundle(rec, history_path) -> "str | None":
+    """Stamp this measurement's RunBundle (round 24): the full xray
+    summary + goodput breakdown + the row itself under
+    ``<history_dir>/bundles/<run_id>/run.json``, with ``rec["bundle"]``
+    set to the history-relative pointer BEFORE the row is recorded —
+    any two gated rows then resolve to their bundles and `slt regress`
+    can decompose the delta. Best-effort: a failure leaves the row
+    un-pointered (joinable but unattributable), never blocks the bench."""
+    try:
+        from serverless_learn_tpu.telemetry import regress, xray
+
+        run_id = (time.strftime("bench-%Y%m%dT%H%M%S")
+                  + f"-{os.getpid()}")
+        hist_dir = os.path.dirname(os.path.abspath(history_path))
+        out_dir = os.path.join(hist_dir, "bundles", run_id)
+        rec["bundle"] = os.path.join("bundles", run_id)
+        regress.write_bundle(
+            out_dir, run_id=run_id, role="bench",
+            bench_rows=[rec],
+            xray_summary=xray.get_last_summary(),
+            config={"model": "resnet18_cifar",
+                    "zero_stage": rec.get("zero_stage")},
+            config_fp=rec.get("config_fingerprint"),
+            git_sha_value=rec.get("git_sha"))
+        return rec["bundle"]
+    except Exception:
+        rec.pop("bundle", None)
+        return None
+
+
 def main():
     from serverless_learn_tpu.utils.benchlog import (
         best_comparable, load_history, record as record_history)
@@ -214,6 +255,7 @@ def main():
         if retry["value"] > rec["value"]:
             rec = retry
         rec["retried_after_transient"] = True
+    write_run_bundle(rec, HISTORY)
     rec = record_history(
         rec, HISTORY, better="max", rel_threshold=0.03, key_fields=KEYS)
     print(json.dumps(rec))

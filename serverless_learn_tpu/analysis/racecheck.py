@@ -455,6 +455,12 @@ DEFAULT_MODULES = (
     # replay simulator must never grow hidden module-level caches that
     # two concurrent reports could tear.
     "serverless_learn_tpu.telemetry.fleetscope",
+    # round 24: regress is pure cross-run analysis — RunBundle caches
+    # (events/xray/goodput memoized per instance) must stay
+    # instance-owned; instrumentation keeps the report a pure function
+    # of the two bundles, with no module-level state two concurrent
+    # comparisons could tear.
+    "serverless_learn_tpu.telemetry.regress",
 )
 
 

@@ -326,6 +326,44 @@ def _make_checkpointer(args, name: Optional[str] = None, cfg=None):
     return Checkpointer(store, name=name)
 
 
+def _write_train_bundle(args, cfg, state=None, extra=None):
+    """`--run-bundle DIR` (round 24): stamp the run's artifacts — event
+    JSONL, numerics fingerprint trail, xray capture/summary, config +
+    git + weight-version fingerprints — into one ``run.json`` manifest
+    so `slt regress` can attribute any later delta against this run.
+    Best-effort: a failed stamp warns and never fails the run."""
+    out_dir = getattr(args, "run_bundle", None)
+    if not out_dir:
+        return None
+    try:
+        from serverless_learn_tpu.telemetry import regress, xray
+
+        weight_version = None
+        if state is not None and hasattr(state, "params"):
+            try:
+                from serverless_learn_tpu.telemetry import (
+                    numerics as _numerics)
+
+                weight_version = _numerics.weight_version(state.params)
+            except Exception:
+                pass
+        return regress.write_bundle(
+            out_dir, role="train",
+            events=[p for p in [getattr(args, "events_log", None)] if p],
+            fingerprints=[p for p in [cfg.numerics.fingerprint_log] if p],
+            xray_summary=xray.get_last_summary(),
+            xray_dirs=[p for p in [getattr(args, "profile_dir", None)]
+                       if p],
+            config=regress.config_stamp(cfg),
+            config_fp=regress.config_fingerprint(cfg),
+            git_sha_value=regress.git_sha(),
+            weight_version=weight_version,
+            extra=extra)
+    except Exception as e:
+        print(f"WARNING: --run-bundle write failed: {e}", file=sys.stderr)
+        return None
+
+
 def cmd_train(args) -> int:
     import contextlib
 
@@ -399,6 +437,7 @@ def cmd_train(args) -> int:
                       "final_step": int(jax.device_get(state.step)),
                       **{k: round(v, 3) for k, v in summary.items()}},
                      stream=sys.stdout)
+            _write_train_bundle(args, cfg, state=state)
             return 0
 
         callback = None
@@ -455,6 +494,8 @@ def cmd_train(args) -> int:
                   "goodput": grep["goodput"],
                   "badput_breakdown": grep["badput_breakdown"],
                   "spans": get_tracer().summary()}, stream=sys.stdout)
+        _write_train_bundle(args, cfg, state=state,
+                            extra={"goodput": grep})
     finally:
         if ckpt is not None:
             ckpt.close()  # drain async upload, disarm the emergency hook
@@ -1619,6 +1660,7 @@ def cmd_bench(args) -> int:
         from serverless_learn_tpu.utils.benchlog import record
 
         entry = bench_mod.measure()
+        bench_mod.write_run_bundle(entry, history)
         record(entry, history, better="max", rel_threshold=args.threshold,
                key_fields=("metric", "device_kind", "batch_per_chip"))
     # Default scope: the headline series (bench.py's own guard keys).
@@ -1630,10 +1672,64 @@ def cmd_bench(args) -> int:
     rep = benchgate.run_gate(history, entry=entry,
                              rel_threshold=args.threshold,
                              metric=metric)
+    if getattr(args, "attribute", False) and not rep.get("ok") \
+            and rep.get("regressions"):
+        # Round 24: a failed gate names its cause. Attribution compares
+        # the failing row against the best-passing comparable row — via
+        # their RunBundles when both carry `bundle` pointers, via the
+        # row-level attribution columns otherwise — and never raises
+        # (the gate must keep gating even over pre-bundle history).
+        from serverless_learn_tpu.telemetry import regress
+        from serverless_learn_tpu.utils.benchlog import load_history
+
+        rep["attribution"] = regress.attribute_gate_failures(
+            rep, load_history(history),
+            history_dir=os.path.dirname(os.path.abspath(history)))
     print(json.dumps(rep, indent=None if args.compact else 2))
+    for a in rep.get("attribution") or []:
+        cause = a.get("dominant") or a.get("note") or a.get("error") \
+            or "no attribution available"
+        print(f"gate FAILED ({a.get('metric')}): {cause}",
+              file=sys.stderr)
     if not args.gate:
         return 0
     return 0 if rep.get("ok") else 1
+
+
+def cmd_regress(args) -> int:
+    """Cross-run differential attribution: compare two RunBundles and
+    decompose the headline delta along every ledger that covers it —
+    goodput phases, xray step interiors, waterfall TTFT/stalls, DCN
+    wire bytes, config drift, numerics bisection — each decomposition
+    machine-checked to sum to its headline delta (telemetry/regress.py).
+    Byte-identical report on identical inputs; exit 1 when a sum
+    invariant fails (the ledgers disagree about the same run — a
+    telemetry bug worth failing on)."""
+    from serverless_learn_tpu.telemetry import regress
+
+    if args.self_check:
+        rep = regress.self_check(fixture_dir=args.fixture)
+        print(json.dumps(rep, sort_keys=True,
+                         indent=None if args.compact else 2))
+        return 0 if rep["ok"] else 1
+    if not args.run_a or not args.run_b:
+        print("usage: slt regress RUN_A RUN_B (bundle dirs or run.json "
+              "paths), or slt regress --self-check", file=sys.stderr)
+        return 2
+    try:
+        bundle_a = regress.RunBundle.load(args.run_a)
+        bundle_b = regress.RunBundle.load(args.run_b)
+    except (IOError, OSError, ValueError) as e:
+        print(f"regress: cannot load bundle: {e}", file=sys.stderr)
+        return 2
+    rep = regress.compare(bundle_a, bundle_b, metric=args.metric,
+                          tolerance=args.tolerance)
+    if args.json:
+        print(json.dumps(rep, sort_keys=True,
+                         indent=None if args.compact else 2))
+    else:
+        print(regress.render(rep))
+    return 0 if rep["invariants"]["ok"] else 1
 
 
 def cmd_check(args) -> int:
@@ -2004,6 +2100,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     t = sub.add_parser("train", help="run a training job on local devices")
     _add_train_flags(t)
+    t.add_argument("--run-bundle", metavar="DIR", default=None,
+                   help="stamp this run's RunBundle manifest (run.json: "
+                        "events/fingerprint logs, xray summary, config "
+                        "+ git/weight fingerprints, goodput) into DIR "
+                        "for `slt regress` cross-run attribution")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("eval", help="forward-only eval (optionally from ckpt)")
@@ -2612,7 +2713,46 @@ def build_parser() -> argparse.ArgumentParser:
                          "documented shared-chip variance)")
     bn.add_argument("--compact", action="store_true",
                     help="single-line JSON report (for scripts)")
+    bn.add_argument("--attribute", action="store_true",
+                    help="on gate failure, attribute each regression "
+                         "against the best-passing comparable row — via "
+                         "RunBundles when both rows carry `bundle` "
+                         "pointers, via the row-level attribution "
+                         "columns otherwise — and print the dominant "
+                         "cause on stderr (telemetry/regress.py)")
     bn.set_defaults(fn=cmd_bench)
+
+    rg = sub.add_parser("regress",
+                        help="cross-run differential attribution: "
+                             "decompose a headline delta between two "
+                             "RunBundles along every ledger (goodput, "
+                             "xray, waterfall, dcn, config, numerics) "
+                             "with machine-checked sum invariants")
+    rg.add_argument("run_a", nargs="?", default=None,
+                    help="baseline run: bundle dir or run.json path")
+    rg.add_argument("run_b", nargs="?", default=None,
+                    help="candidate run: bundle dir or run.json path")
+    rg.add_argument("--metric", default=None,
+                    help="headline metric substring to pair bench rows "
+                         "on (default: first comparable pair)")
+    rg.add_argument("--tolerance", type=float, default=0.05,
+                    help="decomposition residual tolerance relative to "
+                         "the decomposition's own scale (default 0.05)")
+    rg.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout (sorted "
+                         "keys — byte-identical on identical inputs)")
+    rg.add_argument("--compact", action="store_true",
+                    help="single-line JSON (with --json)")
+    rg.add_argument("--self-check", action="store_true",
+                    help="pin the decomposition contract: synthetic "
+                         "exactness, residual flagging, determinism, "
+                         "and the committed two-run fixture's "
+                         "hand-computed report byte-for-byte; exit 1 "
+                         "on drift")
+    rg.add_argument("--fixture", default=None, metavar="DIR",
+                    help="fixture dir for --self-check (default: "
+                         "tests/fixtures/regress)")
+    rg.set_defaults(fn=cmd_regress)
 
     ck = sub.add_parser("check",
                         help="project-aware static analysis: lock order, "

@@ -317,9 +317,48 @@ def bench_rows(points: List[dict], label: str = "fleet",
     return rows
 
 
-def record_rows(rows: List[dict], history_path: str) -> List[dict]:
+def stamp_bundle(rows: List[dict], history_path: str,
+                 role: str = "loadgen",
+                 events_path: Optional[str] = None) -> Optional[str]:
+    """Round 24: stamp a RunBundle next to the history file and point
+    every row at it (``row["bundle"]`` is history-relative), so two
+    gated loadgen rows are joinable by `slt regress`. ``events_path``
+    rides along only when the caller's event log outlives the smoke
+    (own-tmp logs are deleted on return — a pointer to them would be
+    noise; bundle loaders tolerate missing artifacts anyway).
+    Best-effort: failure leaves the rows un-pointered, never fails the
+    smoke."""
+    import os
+
+    try:
+        from serverless_learn_tpu.telemetry import regress as _regress
+
+        run_id = (time.strftime(f"{role}-%Y%m%dT%H%M%S")
+                  + f"-{os.getpid()}")
+        hist_dir = os.path.dirname(os.path.abspath(history_path))
+        ptr = os.path.join("bundles", run_id)
+        sha = _regress.git_sha()
+        for row in rows:
+            row["bundle"] = ptr
+            if sha:
+                row.setdefault("git_sha", sha)
+        _regress.write_bundle(
+            os.path.join(hist_dir, "bundles", run_id),
+            run_id=run_id, role=role, bench_rows=rows,
+            events=[p for p in [events_path] if p],
+            git_sha_value=sha)
+        return ptr
+    except Exception:
+        for row in rows:
+            row.pop("bundle", None)
+        return None
+
+
+def record_rows(rows: List[dict], history_path: str,
+                events_path: Optional[str] = None) -> List[dict]:
     from serverless_learn_tpu.utils.benchlog import record
 
+    stamp_bundle(rows, history_path, events_path=events_path)
     for row in rows:
         record(row, history_path, better="min",
                key_fields=("metric", "device_kind"))
@@ -556,8 +595,9 @@ def run_kv_smoke(seed: int = 0, rate_rps: float = 10.0,
     if history_path:
         from serverless_learn_tpu.utils.benchlog import record
 
-        for row in rows:
-            better = row.pop("_better")
+        betters = [row.pop("_better") for row in rows]
+        stamp_bundle(rows, history_path, role="loadgen-kv")
+        for row, better in zip(rows, betters):
             record(row, history_path, better=better,
                    key_fields=("metric", "device_kind"))
     else:
@@ -741,6 +781,8 @@ def run_waterfall_smoke(seed: int = 0, events_path: Optional[str] = None,
     if history_path:
         from serverless_learn_tpu.utils.benchlog import record
 
+        stamp_bundle(rows, history_path, role="loadgen-serve",
+                     events_path=None if own_tmp else events_path)
         for row in rows:
             record(row, history_path, better="min", rel_threshold=0.25,
                    key_fields=("metric", "device_kind"))
@@ -894,6 +936,8 @@ def run_fleetscope_smoke(seed: int = 0, n_requests: int = 48,
     if history_path:
         from serverless_learn_tpu.utils.benchlog import record
 
+        stamp_bundle(rows, history_path, role="loadgen-fleetscope",
+                     events_path=None if own_tmp else events_path)
         for row in rows:
             record(row, history_path, better="min", rel_threshold=0.5,
                    key_fields=("metric", "device_kind"))
@@ -1132,6 +1176,8 @@ def run_canary_smoke(seed: int = 0, n_requests: int = 64,
     if history_path:
         from serverless_learn_tpu.utils.benchlog import record
 
+        stamp_bundle(rows, history_path, role="loadgen-canary",
+                     events_path=None if own_tmp else events_path)
         for row in rows:
             record(row, history_path, better="min", rel_threshold=0.5,
                    key_fields=("metric", "device_kind"))

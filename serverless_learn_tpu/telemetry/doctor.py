@@ -308,6 +308,27 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
     if bench_path and os.path.exists(bench_path):
         bench = {"history": bench_path,
                  "regressions": bench_regressions(bench_path)}
+        # Cross-run attribution (round 24): every regression the gate
+        # would fail gets a named cause — via RunBundles when the rows
+        # carry `bundle` pointers, via row-level attribution columns
+        # otherwise. Lazy import keeps doctor jax-free; attribute_*
+        # never raises.
+        if bench["regressions"]:
+            from serverless_learn_tpu.telemetry import regress as _regress
+
+            attribution = _regress.attribute_bench_history(
+                bench_path, metric=None)
+            if attribution:
+                bench["attribution"] = attribution
+        # Analytic-vs-hardware MFU disagreement (round 16 warning, now a
+        # cross-run signal): surface the latest row per series that
+        # carries it instead of leaving it stderr-only at record time.
+        from serverless_learn_tpu.telemetry import regress as _regress
+        from serverless_learn_tpu.utils.benchlog import load_history
+
+        mfu_rows = _regress.mfu_hw_disagreements(load_history(bench_path))
+        if mfu_rows:
+            bench["mfu_vs_hw_warnings"] = mfu_rows
 
     firing = [a for a in alerts if a.get("state") == "firing"]
     critical = [a for a in firing if a.get("severity") == "critical"]
@@ -612,6 +633,22 @@ def diagnose(paths: Sequence[str] = (), endpoints: Sequence[str] = (),
     if bench and bench["regressions"]:
         verdict_bits.append(
             f"{len(bench['regressions'])} bench regression(s) vs history")
+        # The round-24 verdicts: name the dominant cause of the worst
+        # attributed regressions instead of just counting them.
+        for a in (bench.get("attribution") or [])[:2]:
+            if a.get("dominant"):
+                verdict_bits.append(
+                    f"bench regression attributed ({a.get('metric')}): "
+                    f"{a['dominant']}")
+            elif a.get("note"):
+                verdict_bits.append(
+                    f"bench regression unattributable "
+                    f"({a.get('metric')}): {a['note']}")
+    if bench and bench.get("mfu_vs_hw_warnings"):
+        w = bench["mfu_vs_hw_warnings"][0]
+        verdict_bits.append(
+            f"analytic MFU disagrees with hardware busy fraction on "
+            f"{w.get('metric')}: {w.get('warning')}")
     low_goodput = sorted(
         (node, rep) for node, rep in goodput_by_node.items()
         if rep["total_s"] >= 5.0 and rep["goodput"] < 0.5)

@@ -1,0 +1,1191 @@
+"""`slt regress`: cross-run differential attribution (round 24).
+
+Every observability layer so far explains ONE run — goodput ledgers
+(round 4), xray hardware attribution (round 16), numerics fingerprints
+(round 17), request waterfalls (round 21) — while the bench gate only
+ever says "metric X regressed" ACROSS runs. This module is the missing
+cross-run layer, in three pieces:
+
+* **RunBundle** — one indexable ``run.json`` manifest per run, stamping
+  the artifacts the run produced (bench rows, xray summaries + capture
+  dirs, the goodput/waterfall/route-decision/dcn_wire JSONL trail,
+  numerics fingerprint logs) plus the identity stamps that make two
+  runs joinable: ``git_sha``, ``config_fingerprint``, ``weight_version``
+  and a small config extract (zero_stage, wire dtypes). ``bench.py``,
+  ``cmd_train --run-bundle`` and the `slt loadgen` smokes write bundles;
+  bench_history rows gain a ``bundle`` pointer (relative to the history
+  file) so any two gated rows resolve to their bundles.
+
+* **A deterministic delta-decomposition engine** — :func:`compare`
+  explains a headline delta along every ledger that covers it: goodput
+  phase deltas, xray per-step compute/exposed-collective/idle deltas
+  (plus per-axis collective growth, per-op roofline verdict flips and
+  the HBM-bound-fraction shift), waterfall TTFT per-phase and
+  per-stall-cause deltas, DCN per-consumer wire-byte and compression
+  deltas, config/zero_stage/weight-version drift, and (lazily, the one
+  jax-heavy import) ``numerics.diff_fingerprint_logs`` bisection when
+  both runs carry fingerprint trails. Every decomposition carries the
+  machine-checked invariant that its terms sum to its headline delta
+  within tolerance (``sums_to_delta``), and the report is **byte-
+  identical on identical inputs**: no wall-clock stamps, sorted keys,
+  rounded floats.
+
+* **Verdict ranking** — ranked one-sentence verdicts, ledger-major
+  (headline/xray first — it explains the step time directly — then
+  goodput, waterfall, DCN, numerics, warnings), magnitude-sorted within
+  each ledger; ``dominant_cause`` is the first. `slt bench --gate
+  --attribute` runs :func:`attribute_gate_failures` on any gate failure
+  so the exit message NAMES the cause; ``slt doctor`` folds the same
+  verdicts into its diagnosis; rows without bundles degrade to
+  row-level attribution over ``benchgate.ATTRIBUTION_COLUMNS`` (and
+  rows predating those columns are *joinable but unattributable* —
+  never an error).
+
+Deliberately jax-free at import (doctor's rule): ``numerics`` is
+imported inside :func:`numerics_bisection` only. No registry metrics
+are defined here — regress is pure log analysis over ledgers that
+already export theirs (SLT002 is satisfied vacuously).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+BUNDLE_FORMAT = "slt-run-bundle-v1"
+BUNDLE_FILENAME = "run.json"
+REPORT_FORMAT = "slt-regress-report-v1"
+# Decomposition residual tolerance, relative to the larger of |delta|
+# and the largest |term| (a 2.0s delta decomposed to within 0.1s is
+# fine; a 0.0s delta with 0.5s terms is not).
+DEFAULT_TOLERANCE = 0.05
+
+
+# -- identity stamps ---------------------------------------------------------
+
+
+def git_sha(root: Optional[str] = None) -> Optional[str]:
+    """Short HEAD sha of the checkout (best-effort: None when git or the
+    repo is unavailable — stamps are joinable-but-optional everywhere)."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            cwd=root or None, capture_output=True, text=True, timeout=10)
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else None
+    except Exception:
+        return None
+
+
+def config_fingerprint(cfg: Any) -> Optional[str]:
+    """Stable sha256 prefix of a config (ExperimentConfig or plain
+    dict): same knobs -> same fingerprint, so two history rows can be
+    declared same-config without shipping the config."""
+    import hashlib
+
+    try:
+        if hasattr(cfg, "to_json"):
+            text = cfg.to_json()
+        else:
+            text = json.dumps(cfg, sort_keys=True, default=str)
+        return hashlib.sha256(text.encode()).hexdigest()[:12]
+    except Exception:
+        return None
+
+
+def config_stamp(cfg: Any) -> dict:
+    """The small config extract a bundle carries inline — the knobs the
+    decomposition engine names when they drift. Best-effort over both
+    ExperimentConfig objects and dicts."""
+    out: dict = {}
+    try:
+        if hasattr(cfg, "train"):
+            out["model"] = getattr(cfg, "model", None)
+            out["zero_stage"] = getattr(cfg.train, "zero_stage", None)
+            out["grad_reduce_dtype"] = getattr(
+                cfg.train, "grad_reduce_dtype", None)
+            ls = getattr(cfg, "local_sgd", None)
+            if ls is not None:
+                out["wire_dtype"] = getattr(ls, "wire_dtype", None)
+        elif isinstance(cfg, dict):
+            for k in ("model", "zero_stage", "grad_reduce_dtype",
+                      "wire_dtype"):
+                if k in cfg:
+                    out[k] = cfg[k]
+    except Exception:
+        pass
+    return {k: v for k, v in out.items() if v is not None}
+
+
+# -- RunBundle ---------------------------------------------------------------
+
+
+class RunBundle:
+    """One run's manifest + artifact loaders.
+
+    ``manifest`` may carry artifacts two ways: inline (``events`` /
+    ``xray_summary`` / ``bench_rows`` lists and dicts directly in the
+    manifest — the synthetic/self-check path) or as relative paths under
+    ``artifacts`` (the on-disk path). Loaders merge both and tolerate
+    missing files: a bundle whose events log was rotated away still
+    joins on its stamps.
+    """
+
+    def __init__(self, manifest: dict, root: Optional[str] = None):
+        self.manifest = manifest if isinstance(manifest, dict) else {}
+        self.root = root
+        self._events: Optional[List[dict]] = None
+
+    @classmethod
+    def load(cls, path: str) -> "RunBundle":
+        """Accepts the bundle directory or the ``run.json`` inside it."""
+        if os.path.isdir(path):
+            path = os.path.join(path, BUNDLE_FILENAME)
+        with open(path) as f:
+            manifest = json.load(f)
+        if not isinstance(manifest, dict):
+            raise ValueError(f"bundle manifest {path} is not an object")
+        return cls(manifest, root=os.path.dirname(os.path.abspath(path)))
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def run_id(self) -> str:
+        return str(self.manifest.get("run_id") or "?")
+
+    def identity(self) -> dict:
+        """The stamp block a report quotes (no absolute paths — reports
+        must be byte-identical across checkouts)."""
+        m = self.manifest
+        return {"run_id": self.run_id,
+                "role": m.get("role"),
+                "git_sha": m.get("git_sha"),
+                "config_fingerprint": m.get("config_fingerprint"),
+                "weight_version": m.get("weight_version")}
+
+    def config(self) -> dict:
+        cfg = self.manifest.get("config")
+        return cfg if isinstance(cfg, dict) else {}
+
+    # -- artifacts ---------------------------------------------------------
+
+    def _artifact_paths(self, key: str) -> List[str]:
+        arts = self.manifest.get("artifacts")
+        vals = (arts or {}).get(key) or []
+        if isinstance(vals, str):
+            vals = [vals]
+        out = []
+        for v in vals:
+            p = v if os.path.isabs(v) or self.root is None \
+                else os.path.join(self.root, v)
+            out.append(p)
+        return out
+
+    def bench_rows(self) -> List[dict]:
+        rows = self.manifest.get("bench_rows") or []
+        return [r for r in rows if isinstance(r, dict)]
+
+    def events(self) -> List[dict]:
+        """All JSONL event records: inline + artifact logs (missing or
+        garbled files contribute nothing — doctor's tolerance rules)."""
+        if self._events is None:
+            from serverless_learn_tpu.telemetry import waterfall as _wf
+
+            recs = [r for r in (self.manifest.get("events") or [])
+                    if isinstance(r, dict)]
+            paths = [p for p in self._artifact_paths("events")
+                     if os.path.exists(p)]
+            if paths:
+                recs = recs + _wf.read_records(paths)
+            self._events = recs
+        return self._events
+
+    def fingerprint_records(self) -> List[dict]:
+        """numerics_fingerprint/numerics_stats records from the event
+        trail plus any dedicated fingerprint logs."""
+        recs = [r for r in self.events()
+                if r.get("event") in ("numerics_fingerprint",
+                                      "numerics_stats")]
+        from serverless_learn_tpu.telemetry import waterfall as _wf
+
+        paths = [p for p in self._artifact_paths("fingerprints")
+                 if os.path.exists(p)]
+        if paths:
+            recs = recs + [r for r in _wf.read_records(paths)
+                           if r.get("event") in ("numerics_fingerprint",
+                                                 "numerics_stats")]
+        return recs
+
+    def xray_summary(self) -> Optional[dict]:
+        """The stamped xray summary: inline, an artifact file, or (last
+        resort, best-effort) a re-analysis of a stamped capture dir."""
+        inline = self.manifest.get("xray_summary")
+        if isinstance(inline, dict):
+            return inline
+        for p in self._artifact_paths("xray_summary"):
+            try:
+                with open(p) as f:
+                    obj = json.load(f)
+                if isinstance(obj, dict):
+                    return obj
+            except (IOError, OSError, ValueError):
+                continue
+        for d in self._artifact_paths("xray_dirs"):
+            try:
+                from serverless_learn_tpu.telemetry import xray as _xray
+
+                return _xray.analyze_dir(d)
+            except Exception:
+                continue
+        return None
+
+    def goodput(self) -> Dict[str, dict]:
+        from serverless_learn_tpu.telemetry import goodput as _goodput
+
+        return _goodput.aggregate_events(self.events())
+
+    def waterfall_summary(self) -> Optional[dict]:
+        from serverless_learn_tpu.telemetry import waterfall as _wf
+
+        requests = _wf.merge_requests(self.events())
+        if not any(r.get("waterfall") for r in requests):
+            return None
+        return _wf.summarize(requests)
+
+    def dcn_by_consumer(self) -> Dict[str, dict]:
+        """Per-consumer wire accounting from ``dcn_wire`` records."""
+        out: Dict[str, dict] = {}
+        for r in self.events():
+            if r.get("event") != "dcn_wire":
+                continue
+            agg = out.setdefault(str(r.get("consumer", "?")),
+                                 {"logical_bytes": 0.0, "wire_bytes": 0.0,
+                                  "transfers": 0, "dtypes": [],
+                                  "fallbacks": 0})
+            agg["logical_bytes"] += float(r.get("logical_bytes") or 0)
+            agg["wire_bytes"] += float(r.get("wire_bytes") or 0)
+            agg["transfers"] += 1
+            dt = str(r.get("wire_dtype", "float32"))
+            if dt not in agg["dtypes"]:
+                agg["dtypes"].append(dt)
+            if r.get("fallback"):
+                agg["fallbacks"] += 1
+        for agg in out.values():
+            agg["dtypes"] = sorted(agg["dtypes"])
+            agg["compression_ratio"] = round(
+                agg["logical_bytes"] / agg["wire_bytes"], 6) \
+                if agg["wire_bytes"] > 0 else None
+        return out
+
+
+def write_bundle(out_dir: str, *, run_id: Optional[str] = None,
+                 role: str = "run",
+                 bench_rows: Optional[Sequence[dict]] = None,
+                 events: Sequence[str] = (),
+                 fingerprints: Sequence[str] = (),
+                 xray_summary: Optional[dict] = None,
+                 xray_dirs: Sequence[str] = (),
+                 config: Optional[dict] = None,
+                 config_fp: Optional[str] = None,
+                 git_sha_value: Optional[str] = None,
+                 weight_version: Optional[str] = None,
+                 extra: Optional[dict] = None) -> str:
+    """Write ``out_dir/run.json``; returns its path. Artifact paths are
+    stored relative to ``out_dir`` (a bundle directory moved whole keeps
+    working; paths outside it degrade to ``..``-relative, and loaders
+    tolerate their absence)."""
+    os.makedirs(out_dir, exist_ok=True)
+    out_dir = os.path.abspath(out_dir)
+
+    def _rel(p: str) -> str:
+        try:
+            return os.path.relpath(os.path.abspath(p), out_dir)
+        except ValueError:
+            return os.path.abspath(p)
+
+    manifest: dict = {
+        "format": BUNDLE_FORMAT,
+        "run_id": run_id or f"{role}-{time.strftime('%Y%m%dT%H%M%S')}-"
+                            f"{os.getpid()}",
+        "role": role,
+        "created_unix_s": round(time.time(), 3),
+    }
+    if git_sha_value:
+        manifest["git_sha"] = git_sha_value
+    if config_fp:
+        manifest["config_fingerprint"] = config_fp
+    if weight_version:
+        manifest["weight_version"] = weight_version
+    if config:
+        manifest["config"] = config
+    if bench_rows:
+        manifest["bench_rows"] = list(bench_rows)
+    artifacts: dict = {}
+    if events:
+        artifacts["events"] = [_rel(p) for p in events]
+    if fingerprints:
+        artifacts["fingerprints"] = [_rel(p) for p in fingerprints]
+    if xray_dirs:
+        artifacts["xray_dirs"] = [_rel(p) for p in xray_dirs]
+    if xray_summary is not None:
+        path = os.path.join(out_dir, "xray_summary.json")
+        with open(path, "w") as f:
+            json.dump(xray_summary, f, sort_keys=True)
+        artifacts["xray_summary"] = "xray_summary.json"
+    if artifacts:
+        manifest["artifacts"] = artifacts
+    if extra:
+        manifest.update(extra)
+    path = os.path.join(out_dir, BUNDLE_FILENAME)
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+# -- the decomposition engine ------------------------------------------------
+
+
+def _decomp(ledger: str, headline: str, delta: float,
+            terms: Dict[str, float], tolerance: float,
+            unit: str = "s") -> dict:
+    """One machine-checked decomposition: terms must sum to the headline
+    delta within tolerance (relative to the decomposition's own scale)."""
+    terms = {k: round(float(v), 9) for k, v in terms.items()}
+    residual = delta - sum(terms.values())
+    scale = max([abs(delta)] + [abs(v) for v in terms.values()] + [1e-9])
+    return {"ledger": ledger, "headline": headline, "unit": unit,
+            "delta": round(delta, 9),
+            "terms": dict(sorted(terms.items())),
+            "residual": round(residual, 9),
+            "sums_to_delta": bool(abs(residual) <= tolerance * scale)}
+
+
+def _share(term: float, delta: float) -> float:
+    return abs(term / delta) if delta else 0.0
+
+
+def goodput_decomposition(a: Dict[str, dict], b: Dict[str, dict],
+                          tolerance: float) -> List[dict]:
+    """Per common node: the run-wall-clock delta decomposed into phase
+    deltas (``unattributed`` included — build_report makes the phase
+    seconds partition the total, so this is exact by construction)."""
+    common = sorted(set(a) & set(b))
+    # Node names are often pid-suffixed (`vm-<pid>`), so two runs of the
+    # same single-node job never share a name — pair the lone nodes
+    # anyway; the headline names both sides so the join is visible.
+    if not common and len(a) == 1 and len(b) == 1:
+        pairs = [((na := next(iter(a))), next(iter(b)),
+                  na if na == next(iter(b))
+                  else f"{na}->{next(iter(b))}")]
+    else:
+        pairs = [(n, n, n) for n in common]
+    out = []
+    for node_a, node_b, label in pairs:
+        ra, rb = a[node_a], b[node_b]
+        pa = {n: float(p["seconds"]) for n, p in ra["phases"].items()}
+        pb = {n: float(p["seconds"]) for n, p in rb["phases"].items()}
+        terms = {n: pb.get(n, 0.0) - pa.get(n, 0.0)
+                 for n in set(pa) | set(pb)}
+        out.append(_decomp(
+            "goodput", f"run_total_s[{label}]",
+            float(rb["total_s"]) - float(ra["total_s"]),
+            terms, tolerance))
+    return out
+
+
+def _xray_step_means(summary: dict) -> Optional[dict]:
+    """Mean per-step seconds {wall, compute, exposed, other_busy, idle}.
+    Prefers the full summary's per_step list; degrades to the compact
+    shape's fracs over ``steps.mean_wall_s``."""
+    steps = (summary or {}).get("steps") or {}
+    per = steps.get("per_step") or []
+    if per:
+        n = float(len(per))
+        wall = sum(s.get("wall_s", 0.0) for s in per) / n
+        busy = sum(s.get("busy_s", 0.0) for s in per) / n
+        idle = sum(s.get("idle_s", 0.0) for s in per) / n
+        exposed = sum(s.get("exposed_collective_s", 0.0) for s in per) / n
+        compute = sum(s.get("compute_s", 0.0) for s in per) / n
+    else:
+        wall = steps.get("mean_wall_s")
+        if not isinstance(wall, (int, float)) or wall <= 0:
+            return None
+        busy = wall * float(summary.get("busy_frac") or 0.0)
+        idle = wall * float(summary.get("idle_frac") or 0.0)
+        exposed = wall * float(summary.get("exposed_comms_frac") or 0.0)
+        compute = None
+    out = {"wall_s": wall, "busy_s": busy, "idle_s": idle,
+           "exposed_collective_s": exposed}
+    if compute is not None:
+        out["compute_s"] = compute
+    return out
+
+
+def xray_decomposition(sa: Optional[dict], sb: Optional[dict],
+                       tolerance: float
+                       ) -> Tuple[Optional[dict], dict]:
+    """The step-interior decomposition: mean step-wall delta split into
+    compute / exposed-collective / other-busy / idle (busy+idle=wall and
+    busy=compute+exposed+other by the xray step math, so the terms
+    partition the wall exactly). Also returns the xray facts block:
+    per-collective@axis deltas, per-op roofline verdict flips, the
+    HBM-bound-fraction and achieved-vs-roofline shifts."""
+    ma = _xray_step_means(sa) if sa else None
+    mb = _xray_step_means(sb) if sb else None
+    if not ma or not mb:
+        return None, {}
+    terms: Dict[str, float] = {}
+    d_exposed = mb["exposed_collective_s"] - ma["exposed_collective_s"]
+    terms["exposed_collective_s"] = d_exposed
+    if "compute_s" in ma and "compute_s" in mb:
+        d_compute = mb["compute_s"] - ma["compute_s"]
+        other_a = ma["busy_s"] - ma["compute_s"] \
+            - ma["exposed_collective_s"]
+        other_b = mb["busy_s"] - mb["compute_s"] \
+            - mb["exposed_collective_s"]
+        terms["compute_s"] = d_compute
+        terms["other_busy_s"] = other_b - other_a
+    else:
+        terms["other_busy_s"] = (mb["busy_s"]
+                                 - mb["exposed_collective_s"]) \
+            - (ma["busy_s"] - ma["exposed_collective_s"])
+    terms["idle_s"] = mb["idle_s"] - ma["idle_s"]
+    dec = _decomp("xray", "step_wall_s",
+                  mb["wall_s"] - ma["wall_s"], terms, tolerance)
+
+    facts: dict = {}
+    ca = (sa or {}).get("per_collective_s") or {}
+    cb = (sb or {}).get("per_collective_s") or {}
+    coll = {k: round(float(cb.get(k, 0.0)) - float(ca.get(k, 0.0)), 9)
+            for k in sorted(set(ca) | set(cb))}
+    coll = {k: v for k, v in coll.items() if v != 0.0}
+    if coll:
+        facts["per_collective_delta_s"] = coll
+    ops_a = {o.get("op"): o.get("bound")
+             for o in ((sa or {}).get("roofline") or {}).get("ops") or []}
+    flips = []
+    for o in ((sb or {}).get("roofline") or {}).get("ops") or []:
+        prev = ops_a.get(o.get("op"))
+        if prev and o.get("bound") and prev != o["bound"]:
+            flips.append({"op": o["op"], "a": prev, "b": o["bound"]})
+    if flips:
+        facts["roofline_verdict_flips"] = flips
+    for key in ("hbm_bound_frac", "achieved_vs_roofline"):
+        va = ((sa or {}).get("roofline") or {}).get(key)
+        vb = ((sb or {}).get("roofline") or {}).get(key)
+        if isinstance(va, (int, float)) and isinstance(vb, (int, float)):
+            facts[key] = {"a": va, "b": vb,
+                          "delta": round(vb - va, 6)}
+    return dec, facts
+
+
+def waterfall_decomposition(wa: Optional[dict], wb: Optional[dict],
+                            tolerance: float) -> List[dict]:
+    """Serving deltas: per percentile, the TTFT delta decomposed along
+    the percentile request's recorded phase decomposition (sums within
+    the waterfall schema's own 5% invariant); plus the stall-cause
+    decomposition of the attributed-stall total (exact)."""
+    out: List[dict] = []
+    if not wa or not wb:
+        return out
+    ta, tb = wa.get("ttft") or {}, wb.get("ttft") or {}
+    for q in ("p50", "p95", "p99"):
+        if ta.get(f"{q}_s") is None or tb.get(f"{q}_s") is None:
+            continue
+        da = ta.get(f"{q}_decomp_s") or {}
+        db = tb.get(f"{q}_decomp_s") or {}
+        terms = {ph: float(db.get(ph, 0.0)) - float(da.get(ph, 0.0))
+                 for ph in set(da) | set(db)}
+        out.append(_decomp(
+            "waterfall", f"ttft_{q}_s",
+            float(tb[f"{q}_s"]) - float(ta[f"{q}_s"]), terms, tolerance))
+    sa, sb = wa.get("stall_s") or {}, wb.get("stall_s") or {}
+    if sa or sb:
+        terms = {c: float(sb.get(c, 0.0)) - float(sa.get(c, 0.0))
+                 for c in set(sa) | set(sb)}
+        out.append(_decomp(
+            "waterfall", "decode_stall_total_s",
+            sum(sb.values()) - sum(sa.values()), terms, tolerance))
+    return out
+
+
+def dcn_decomposition(da: Dict[str, dict], db: Dict[str, dict],
+                      tolerance: float
+                      ) -> Tuple[Optional[dict], dict]:
+    """Wire-byte delta decomposed per consumer (exact by construction),
+    plus the per-consumer compression-ratio facts the verdict quotes."""
+    if not da and not db:
+        return None, {}
+    terms = {c: float((db.get(c) or {}).get("wire_bytes", 0.0))
+             - float((da.get(c) or {}).get("wire_bytes", 0.0))
+             for c in set(da) | set(db)}
+    total = sum(float((d.get(c) or {}).get("wire_bytes", 0.0))
+                for d, sign in ((db, 1), (da, -1))
+                for c in d) if False else \
+        sum(float((db.get(c) or {}).get("wire_bytes", 0.0))
+            for c in db) \
+        - sum(float((da.get(c) or {}).get("wire_bytes", 0.0))
+              for c in da)
+    dec = _decomp("dcn", "wire_bytes_total", total, terms, tolerance,
+                  unit="bytes")
+    facts: dict = {}
+    for c in sorted(set(da) | set(db)):
+        ra = (da.get(c) or {}).get("compression_ratio")
+        rb = (db.get(c) or {}).get("compression_ratio")
+        if ra is not None or rb is not None:
+            facts[c] = {"compression_ratio_a": ra,
+                        "compression_ratio_b": rb,
+                        "dtypes_a": (da.get(c) or {}).get("dtypes"),
+                        "dtypes_b": (db.get(c) or {}).get("dtypes")}
+    return dec, facts
+
+
+# Row stamp fields that name a config/identity drift when they differ
+# across the compared rows (bundle config fields join the same list).
+DRIFT_FIELDS = ("zero_stage", "batch_per_chip", "device_kind", "unit",
+                "git_sha", "config_fingerprint")
+
+
+def config_drift(bundle_a: Optional[RunBundle],
+                 bundle_b: Optional[RunBundle],
+                 row_a: Optional[dict] = None,
+                 row_b: Optional[dict] = None) -> List[dict]:
+    """{"field", "a", "b"} for every identity/config field that differs
+    — schema-tolerant: a field absent on either side is skipped, never
+    an error (missing stamps are joinable-but-unattributable)."""
+    out: List[dict] = []
+    seen = set()
+
+    def _diff(field: str, va, vb):
+        if field in seen or va is None or vb is None or va == vb:
+            return
+        seen.add(field)
+        out.append({"field": field, "a": va, "b": vb})
+
+    ia = bundle_a.identity() if bundle_a else {}
+    ib = bundle_b.identity() if bundle_b else {}
+    for f in ("git_sha", "config_fingerprint", "weight_version"):
+        _diff(f, ia.get(f), ib.get(f))
+    ca = bundle_a.config() if bundle_a else {}
+    cb = bundle_b.config() if bundle_b else {}
+    for f in sorted(set(ca) | set(cb)):
+        _diff(f, ca.get(f), cb.get(f))
+    for f in DRIFT_FIELDS:
+        _diff(f, (row_a or {}).get(f), (row_b or {}).get(f))
+    return out
+
+
+def numerics_bisection(bundle_a: RunBundle, bundle_b: RunBundle,
+                       rtol: float = 1e-5, atol: float = 1e-6
+                       ) -> Optional[dict]:
+    """``numerics.diff_fingerprint_logs`` over the two trails when both
+    carry fingerprints — the loss-curve bisection reused across runs.
+    The ONE jax-heavy import, taken lazily and skipped cleanly."""
+    fa = bundle_a.fingerprint_records()
+    fb = bundle_b.fingerprint_records()
+    if not fa or not fb:
+        return None
+    try:
+        from serverless_learn_tpu.telemetry import numerics as _numerics
+    except Exception:
+        return {"skipped": "numerics unavailable (no jax runtime)"}
+    return _numerics.diff_fingerprint_logs(fa, fb, rtol=rtol, atol=atol)
+
+
+# -- headline + verdicts -----------------------------------------------------
+
+
+def _pair_headline_rows(rows_a: List[dict], rows_b: List[dict],
+                        metric: Optional[str] = None
+                        ) -> Tuple[Optional[dict], Optional[dict]]:
+    """First bench-row pair comparable under the gate's keys (metric,
+    device_kind, batch_per_chip)."""
+    for ra in rows_a:
+        if metric and metric not in str(ra.get("metric", "")):
+            continue
+        for rb in rows_b:
+            if all(ra.get(k) == rb.get(k) for k in
+                   ("metric", "device_kind", "batch_per_chip")) \
+                    and isinstance(ra.get("value"), (int, float)) \
+                    and isinstance(rb.get("value"), (int, float)):
+                return ra, rb
+    return None, None
+
+
+def _headline_block(row_a: Optional[dict], row_b: Optional[dict]
+                    ) -> Optional[dict]:
+    if not row_a or not row_b:
+        return None
+    va, vb = float(row_a["value"]), float(row_b["value"])
+    out = {"metric": row_a.get("metric"), "unit": row_a.get("unit"),
+           "a": va, "b": vb, "delta": round(vb - va, 6),
+           "delta_frac": round((vb - va) / va, 6) if va else None}
+    sa, sb = row_a.get("step_time_ms"), row_b.get("step_time_ms")
+    if isinstance(sa, (int, float)) and isinstance(sb, (int, float)) \
+            and sa > 0:
+        out["step_time_ms"] = {"a": sa, "b": sb,
+                               "delta_frac": round((sb - sa) / sa, 6)}
+    for k in ("mfu", "goodput"):
+        ka, kb = row_a.get(k), row_b.get(k)
+        if isinstance(ka, (int, float)) and isinstance(kb, (int, float)):
+            out[k] = {"a": ka, "b": kb, "delta": round(kb - ka, 6)}
+    return out
+
+
+def _xray_term_sentence(term: str, delta: float, share: float,
+                        facts: dict) -> str:
+    pct = f"{share * 100:.0f}%"
+    if term == "exposed_collective_s":
+        coll = facts.get("per_collective_delta_s") or {}
+        worst = max(coll, key=coll.get) if coll else None
+        if worst and coll[worst] > 0:
+            kind, _, axis = worst.partition("@")
+            return (f"{pct} is new exposed {kind}"
+                    + (f" on the {axis} axis" if axis else ""))
+        return f"{pct} is newly exposed collective time"
+    if term == "compute_s":
+        flips = facts.get("roofline_verdict_flips") or []
+        suffix = ""
+        if flips:
+            f0 = flips[0]
+            suffix = (f" (op {f0['op']} flipped "
+                      f"{f0['a']} -> {f0['b']})")
+        return f"{pct} is slower compute{suffix}"
+    if term == "idle_s":
+        return f"{pct} is new device idle (host/input gaps)"
+    return f"{pct} is {term.replace('_', ' ').replace(' s', '')}"
+
+
+def build_verdicts(headline: Optional[dict],
+                   decompositions: List[dict], facts: dict,
+                   drift: List[dict], numerics: Optional[dict],
+                   warnings: List[str]) -> List[str]:
+    """Ranked one-sentence verdicts. Ranking rule (documented in
+    ARCHITECTURE.md): ledger-major — the xray/step headline sentence
+    first (it explains the headline metric directly), then goodput,
+    waterfall, DCN, numerics, warnings — magnitude-sorted within each
+    ledger; config drift rides the first sentence it explains."""
+    verdicts: List[str] = []
+    drift_txt = "; ".join(f"{d['field']} changed {d['a']} -> {d['b']}"
+                          for d in drift
+                          if d["field"] not in ("git_sha",
+                                                "config_fingerprint"))
+    by_ledger: Dict[str, List[dict]] = {}
+    for d in decompositions:
+        by_ledger.setdefault(d["ledger"], []).append(d)
+
+    for d in by_ledger.get("xray", []):
+        delta = d["delta"]
+        if delta == 0:
+            continue
+        head = "step_time"
+        if headline and headline.get("step_time_ms"):
+            frac = headline["step_time_ms"]["delta_frac"]
+            head = f"step_time {frac * 100:+.1f}%"
+        else:
+            head = f"step_wall {delta * 1e3:+.2f}ms"
+        parts = sorted(
+            ((t, v) for t, v in d["terms"].items()
+             if _share(v, delta) >= 0.05 and (v > 0) == (delta > 0)),
+            key=lambda tv: (-abs(tv[1]), tv[0]))
+        bits = [_xray_term_sentence(t, v, _share(v, delta),
+                                    facts.get("xray") or {})
+                for t, v in parts[:3]]
+        sentence = f"{head}: " + "; ".join(bits) if bits else head
+        if drift_txt:
+            sentence += f"; {drift_txt}"
+        verdicts.append(sentence)
+
+    for d in sorted(by_ledger.get("goodput", []),
+                    key=lambda d: (-abs(d["delta"]), d["headline"])):
+        delta = d["delta"]
+        if abs(delta) < 1e-9:
+            continue
+        node = d["headline"].partition("[")[2].rstrip("]")
+        top = sorted(((t, v) for t, v in d["terms"].items()
+                      if (v > 0) == (delta > 0) and v != 0),
+                     key=lambda tv: (-abs(tv[1]), tv[0]))[:2]
+        bits = ", ".join(
+            f"{t} {v:+.3f}s ({_share(v, delta) * 100:.0f}%)"
+            for t, v in top)
+        verdicts.append(
+            f"run wall-clock {delta:+.3f}s on {node}: {bits}")
+
+    for d in sorted(by_ledger.get("waterfall", []),
+                    key=lambda d: (-abs(d["delta"]), d["headline"])):
+        delta = d["delta"]
+        if abs(delta) < 1e-9:
+            continue
+        top = sorted(((t, v) for t, v in d["terms"].items()
+                      if (v > 0) == (delta > 0) and v != 0),
+                     key=lambda tv: (-abs(tv[1]), tv[0]))[:2]
+        bits = ", ".join(
+            f"{t} {v * 1e3:+.1f}ms ({_share(v, delta) * 100:.0f}%)"
+            for t, v in top)
+        verdicts.append(f"{d['headline']} {delta * 1e3:+.1f}ms: {bits}")
+
+    for c, f in sorted((facts.get("dcn") or {}).items()):
+        ra, rb = f.get("compression_ratio_a"), f.get("compression_ratio_b")
+        if ra and rb and ra / rb >= 1.5:
+            verdicts.append(
+                f"dcn[{c}]: wire bytes per transfer grew "
+                f"{ra / rb:.1f}x (compression ratio {ra:.2f} -> "
+                f"{rb:.2f} — codec disengaged?)")
+        elif ra and rb and rb / ra >= 1.5:
+            verdicts.append(
+                f"dcn[{c}]: wire bytes per transfer shrank "
+                f"{rb / ra:.1f}x (compression ratio {ra:.2f} -> "
+                f"{rb:.2f})")
+
+    if numerics and numerics.get("diverged"):
+        verdicts.append(
+            f"loss curves diverged: first divergent step "
+            f"{numerics.get('first_divergent_step')} in "
+            f"{numerics.get('subtree')} ({numerics.get('field')}, "
+            f"rel_err {numerics.get('rel_err')})")
+
+    verdicts.extend(warnings)
+    if not verdicts and drift_txt:
+        verdicts.append(f"no ledger covers the delta; config drift: "
+                        f"{drift_txt}")
+    return verdicts
+
+
+# -- compare -----------------------------------------------------------------
+
+
+def compare(bundle_a: RunBundle, bundle_b: RunBundle,
+            metric: Optional[str] = None,
+            tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """The cross-run report: deterministic (sorted keys, rounded floats,
+    NO wall-clock stamps) — byte-identical on identical inputs, which
+    ``self_check`` pins over the committed fixture pair."""
+    row_a, row_b = _pair_headline_rows(bundle_a.bench_rows(),
+                                       bundle_b.bench_rows(),
+                                       metric=metric)
+    headline = _headline_block(row_a, row_b)
+
+    decompositions: List[dict] = []
+    facts: dict = {}
+
+    xdec, xfacts = xray_decomposition(bundle_a.xray_summary(),
+                                      bundle_b.xray_summary(), tolerance)
+    if xdec:
+        decompositions.append(xdec)
+    if xfacts:
+        facts["xray"] = xfacts
+    decompositions.extend(goodput_decomposition(
+        bundle_a.goodput(), bundle_b.goodput(), tolerance))
+    decompositions.extend(waterfall_decomposition(
+        bundle_a.waterfall_summary(), bundle_b.waterfall_summary(),
+        tolerance))
+    ddec, dfacts = dcn_decomposition(bundle_a.dcn_by_consumer(),
+                                     bundle_b.dcn_by_consumer(),
+                                     tolerance)
+    if ddec:
+        decompositions.append(ddec)
+    if dfacts:
+        facts["dcn"] = dfacts
+
+    drift = config_drift(bundle_a, bundle_b, row_a, row_b)
+    numerics = numerics_bisection(bundle_a, bundle_b)
+
+    warnings: List[str] = []
+    wa = (row_a or {}).get("mfu_vs_hw_warning")
+    wb = (row_b or {}).get("mfu_vs_hw_warning")
+    if wb and not wa:
+        warnings.append(f"mfu_vs_hw_warning appeared in run "
+                        f"{bundle_b.run_id}: {wb}")
+    elif wa and not wb:
+        warnings.append(f"mfu_vs_hw_warning cleared since run "
+                        f"{bundle_a.run_id}")
+
+    verdicts = build_verdicts(headline, decompositions, facts, drift,
+                              numerics, warnings)
+    failed = [d["headline"] for d in decompositions
+              if not d["sums_to_delta"]]
+    report = {
+        "format": REPORT_FORMAT,
+        "tolerance": tolerance,
+        "run_a": bundle_a.identity(),
+        "run_b": bundle_b.identity(),
+        "headline": headline,
+        "decompositions": decompositions,
+        "facts": facts,
+        "config_drift": drift,
+        "numerics": numerics,
+        "warnings": warnings,
+        "verdicts": verdicts,
+        "dominant_cause": verdicts[0] if verdicts else None,
+        "invariants": {"checked": len(decompositions),
+                       "failed": failed, "ok": not failed},
+    }
+    return report
+
+
+def render(report: dict) -> str:
+    """Human rendering of a compare report."""
+    lines = [f"regress: {report['run_a'].get('run_id')} -> "
+             f"{report['run_b'].get('run_id')}"]
+    h = report.get("headline")
+    if h:
+        frac = h.get("delta_frac")
+        lines.append(
+            f"  headline {h.get('metric')}: {h.get('a')} -> {h.get('b')}"
+            + (f" ({frac * 100:+.1f}%)" if frac is not None else ""))
+    for d in report.get("decompositions", []):
+        ok = "ok" if d["sums_to_delta"] else "RESIDUAL"
+        terms = ", ".join(f"{t} {v:+.6g}"
+                          for t, v in d["terms"].items() if v)
+        lines.append(f"  [{d['ledger']}] {d['headline']} "
+                     f"{d['delta']:+.6g}{d['unit']} = {terms} "
+                     f"(residual {d['residual']:+.2g}, {ok})")
+    for d in report.get("config_drift", []):
+        lines.append(f"  drift: {d['field']} {d['a']} -> {d['b']}")
+    for i, v in enumerate(report.get("verdicts", [])):
+        lines.append(f"  {'verdict' if i == 0 else '       '} {v}")
+    inv = report.get("invariants", {})
+    if not inv.get("ok", True):
+        lines.append(f"  INVARIANT FAILED: decomposition(s) "
+                     f"{', '.join(inv.get('failed', []))} do not sum "
+                     f"to their headline delta")
+    return "\n".join(lines)
+
+
+# -- gate attribution (bundle-backed with row-level fallback) ----------------
+
+
+def mfu_hw_disagreements(history: Sequence[dict]) -> List[dict]:
+    """Latest row per series carrying ``mfu_vs_hw_warning`` (the round-16
+    analytic-vs-hardware MFU cross-check, now a cross-run consumer:
+    doctor and regress surface it instead of stderr-only)."""
+    latest: Dict[tuple, dict] = {}
+    for h in history:
+        if not isinstance(h, dict):
+            continue
+        key = (h.get("metric"), h.get("device_kind"),
+               h.get("batch_per_chip"))
+        latest[key] = h
+    out = []
+    for key in sorted(latest, key=str):
+        h = latest[key]
+        w = h.get("mfu_vs_hw_warning")
+        if w:
+            out.append({"metric": h.get("metric"),
+                        "device_kind": h.get("device_kind"),
+                        "time": h.get("time"), "warning": str(w)})
+    return out
+
+
+def attribute_rows(row_a: Optional[dict], row_b: Optional[dict],
+                   tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Row-level attribution when bundles are absent: deltas over
+    ``benchgate.ATTRIBUTION_COLUMNS`` + the goodput stamps + config
+    drift, ranked worst-first. Rows predating every column are
+    *joinable but unattributable* — a note, never an error."""
+    from serverless_learn_tpu.telemetry.benchgate import (
+        ATTRIBUTION_COLUMNS)
+
+    out: dict = {"mode": "rows", "deltas": [], "verdicts": []}
+    if not row_a or not row_b:
+        out["note"] = "missing comparison row"
+        return out
+    scored: List[Tuple[float, str, dict]] = []
+    for col, spec in ATTRIBUTION_COLUMNS.items():
+        better, gap = spec[0], spec[1]
+        kind = spec[2] if len(spec) > 2 else "abs"
+        va, vb = row_a.get(col), row_b.get(col)
+        if not isinstance(va, (int, float)) \
+                or not isinstance(vb, (int, float)):
+            continue
+        delta = float(vb) - float(va)
+        margin = gap if kind == "abs" else abs(va) * gap
+        worse = delta > margin if better == "min" else delta < -margin
+        row = {"column": col, "a": va, "b": vb,
+               "delta": round(delta, 9), "regressed": bool(worse)}
+        out["deltas"].append(row)
+        if worse:
+            severity = abs(delta) / max(abs(va), gap, 1e-9)
+            scored.append((severity, col, row))
+    for severity, col, row in sorted(scored,
+                                     key=lambda s: (-s[0], s[1])):
+        out["verdicts"].append(
+            f"{col} moved {row['a']} -> {row['b']} "
+            f"({row['delta']:+.6g})")
+    gpa, gpb = row_a.get("goodput"), row_b.get("goodput")
+    if isinstance(gpa, (int, float)) and isinstance(gpb, (int, float)) \
+            and gpb < gpa - 0.02:
+        bba = row_a.get("badput_breakdown") or {}
+        bbb = row_b.get("badput_breakdown") or {}
+        growth = {k: float(bbb.get(k, 0.0)) - float(bba.get(k, 0.0))
+                  for k in set(bba) | set(bbb)}
+        worst = max(sorted(growth), key=lambda k: growth[k], default=None)
+        if worst is not None and growth[worst] > 0:
+            out["verdicts"].append(
+                f"goodput fell {gpa:.3f} -> {gpb:.3f}; fastest-growing "
+                f"badput: {worst} (+{growth[worst] * 100:.1f}pp)")
+    drift = config_drift(None, None, row_a, row_b)
+    if drift:
+        out["config_drift"] = drift
+        out["verdicts"].extend(
+            f"{d['field']} changed {d['a']} -> {d['b']}" for d in drift
+            if d["field"] not in ("git_sha", "config_fingerprint"))
+    if not out["deltas"]:
+        out["note"] = ("rows predate the attribution columns — "
+                       "joinable but unattributable")
+    out["dominant"] = out["verdicts"][0] if out["verdicts"] else None
+    return out
+
+
+def _series_rows(history: Sequence[dict], check: dict) -> List[dict]:
+    keys = ("metric", "device_kind", "batch_per_chip")
+    return [h for h in history
+            if isinstance(h, dict)
+            and all(check.get(k) is None or h.get(k) == check.get(k)
+                    for k in keys)
+            and h.get("metric") == check.get("metric")
+            and isinstance(h.get("value"), (int, float))]
+
+
+def attribute_gate_failures(gate_report: dict,
+                            history: Sequence[dict],
+                            history_dir: Optional[str] = None,
+                            tolerance: float = DEFAULT_TOLERANCE
+                            ) -> List[dict]:
+    """For every regression in a ``benchgate`` report: find the failing
+    (latest) row and the best-passing earlier comparable row, then
+    attribute — via their bundles when both rows carry resolvable
+    ``bundle`` pointers, via row-level deltas otherwise. Never raises;
+    per-check failures degrade to an ``error`` note."""
+    out: List[dict] = []
+    for check in gate_report.get("regressions") or []:
+        note: dict = {"metric": check.get("metric")}
+        try:
+            rows = _series_rows(history, check)
+            if not rows:
+                note.update({"mode": "rows",
+                             "note": "series rows not found"})
+                out.append(note)
+                continue
+            entry = rows[-1]
+            earlier = rows[:-1]
+            best_v = check.get("best")
+            best_row = None
+            for h in earlier:
+                if best_v is None or h.get("value") == best_v:
+                    best_row = h  # last matching wins (most recent best)
+            if best_row is None and earlier:
+                best_row = earlier[-1]
+            ba = _load_row_bundle(best_row, history_dir)
+            bb = _load_row_bundle(entry, history_dir)
+            if ba is not None and bb is not None:
+                rep = compare(ba, bb, metric=check.get("metric"),
+                              tolerance=tolerance)
+                note.update({"mode": "bundles",
+                             "dominant": rep.get("dominant_cause"),
+                             "verdicts": rep.get("verdicts"),
+                             "invariants": rep.get("invariants"),
+                             "report": rep})
+            else:
+                rowrep = attribute_rows(best_row, entry,
+                                        tolerance=tolerance)
+                note.update(rowrep)
+        except Exception as e:  # the gate must keep gating
+            note.update({"mode": "error",
+                         "error": f"{type(e).__name__}: {e}"})
+        out.append(note)
+    return out
+
+
+def _load_row_bundle(row: Optional[dict], history_dir: Optional[str]
+                     ) -> Optional[RunBundle]:
+    ptr = (row or {}).get("bundle")
+    if not isinstance(ptr, str) or not ptr:
+        return None
+    path = ptr if os.path.isabs(ptr) or not history_dir \
+        else os.path.join(history_dir, ptr)
+    try:
+        return RunBundle.load(path)
+    except (IOError, OSError, ValueError):
+        return None
+
+
+def attribute_bench_history(history_path: str,
+                            metric: Optional[str] = None,
+                            tolerance: float = DEFAULT_TOLERANCE
+                            ) -> List[dict]:
+    """Doctor's entry point: dry-run the gate over every series in the
+    history and attribute whatever failed. Never raises."""
+    try:
+        from serverless_learn_tpu.telemetry import benchgate
+        from serverless_learn_tpu.utils.benchlog import load_history
+
+        history = load_history(history_path)
+        if not history:
+            return []
+        rep = benchgate.gate_history(history, metric=metric)
+        if rep.get("ok"):
+            return []
+        return attribute_gate_failures(
+            rep, history,
+            history_dir=os.path.dirname(os.path.abspath(history_path)),
+            tolerance=tolerance)
+    except Exception:
+        return []
+
+
+# -- self-check --------------------------------------------------------------
+
+
+def default_fixture_dir() -> str:
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, "tests", "fixtures", "regress")
+
+
+def _synthetic_bundles() -> Tuple[RunBundle, RunBundle]:
+    """In-memory two-run pair with hand-computable deltas: the goodput
+    total grows 2.0s (step +1.8, data_wait +0.2), the step wall grows
+    0.018s (81% exposed all-reduce@dp, 10% compute, 9% idle), and
+    zero_stage drifts 1 -> 0."""
+    def xray(wall, busy, idle, exposed, compute, coll):
+        return {"busy_frac": round(busy / wall, 6),
+                "idle_frac": round(idle / wall, 6),
+                "exposed_comms_frac": round(exposed / wall, 6),
+                "per_collective_s": coll,
+                "steps": {"n": 2, "mean_wall_s": wall,
+                          "per_step": [{"wall_s": wall, "busy_s": busy,
+                                        "idle_s": idle,
+                                        "exposed_collective_s": exposed,
+                                        "compute_s": compute}] * 2},
+                "roofline": {}}
+
+    def events(base, step3, wait):
+        return [
+            {"event": "phase", "phase": "compile", "node": "n0",
+             "t0_unix_s": base, "duration_s": 2.0, "self_s": 2.0},
+            {"event": "phase", "phase": "step", "node": "n0",
+             "t0_unix_s": base + 2.0, "duration_s": 4.0, "self_s": 4.0},
+            {"event": "phase", "phase": "step", "node": "n0",
+             "t0_unix_s": base + 6.0, "duration_s": 4.0, "self_s": 4.0},
+            {"event": "phase", "phase": "step", "node": "n0",
+             "t0_unix_s": base + 10.0, "duration_s": step3,
+             "self_s": step3},
+            {"event": "phase", "phase": "data_wait", "node": "n0",
+             "t0_unix_s": base + 10.0 + step3, "duration_s": wait,
+             "self_s": wait},
+        ]
+
+    a = RunBundle({
+        "format": BUNDLE_FORMAT, "run_id": "syn-a", "role": "bench",
+        "git_sha": "aaaa", "config_fingerprint": "cfg-a",
+        "config": {"zero_stage": 1},
+        "bench_rows": [{"metric": "syn_sps", "value": 1000.0,
+                        "unit": "sps", "device_kind": "syn",
+                        "batch_per_chip": 8, "step_time_ms": 100.0}],
+        "events": events(1000.0, 2.0, 0.5),
+        "xray_summary": xray(0.100, 0.090, 0.010, 0.005, 0.080,
+                             {"all-reduce@dp": 0.010}),
+    })
+    b = RunBundle({
+        "format": BUNDLE_FORMAT, "run_id": "syn-b", "role": "bench",
+        "git_sha": "bbbb", "config_fingerprint": "cfg-b",
+        "config": {"zero_stage": 0},
+        "bench_rows": [{"metric": "syn_sps", "value": 847.0,
+                        "unit": "sps", "device_kind": "syn",
+                        "batch_per_chip": 8, "step_time_ms": 118.0}],
+        "events": events(2000.0, 3.8, 0.7),
+        "xray_summary": xray(0.118, 0.10638, 0.01162, 0.01958, 0.0818,
+                             {"all-reduce@dp": 0.039}),
+    })
+    return a, b
+
+
+def self_check(fixture_dir: Optional[str] = None) -> dict:
+    """The CI smoke (`slt regress --self-check`): the decomposition
+    contract over synthetic deltas, the residual invariant actually
+    flags inconsistent inputs, determinism is byte-exact, and the
+    committed two-run fixture reproduces its hand-computed report
+    byte-for-byte. Never raises."""
+    report: dict = {"ok": False, "checks": []}
+
+    def check(name: str, ok: bool, detail: str = ""):
+        report["checks"].append({"check": name, "ok": bool(ok),
+                                 **({"detail": detail} if detail else {})})
+        return ok
+
+    try:
+        a, b = _synthetic_bundles()
+        rep = compare(a, b)
+        decs = {d["headline"]: d for d in rep["decompositions"]}
+        gd = decs.get("run_total_s[n0]")
+        check("goodput_decomposition_exact",
+              gd is not None and gd["sums_to_delta"]
+              and abs(gd["delta"] - 2.0) < 1e-6
+              and abs(gd["terms"].get("step", 0.0) - 1.8) < 1e-6
+              and abs(gd["terms"].get("data_wait", 0.0) - 0.2) < 1e-6,
+              json.dumps(gd, sort_keys=True) if gd else "missing")
+        xd = decs.get("step_wall_s")
+        check("xray_decomposition_exact",
+              xd is not None and xd["sums_to_delta"]
+              and abs(xd["delta"] - 0.018) < 1e-9
+              and abs(xd["terms"]["exposed_collective_s"] - 0.01458)
+              < 1e-9,
+              json.dumps(xd, sort_keys=True) if xd else "missing")
+        check("invariants_ok", rep["invariants"]["ok"],
+              json.dumps(rep["invariants"]))
+        dom = rep.get("dominant_cause") or ""
+        check("dominant_names_exposed_collective",
+              "exposed all-reduce" in dom and "dp" in dom, dom)
+        check("config_drift_named",
+              any(d["field"] == "zero_stage" for d in rep["config_drift"]),
+              json.dumps(rep["config_drift"]))
+        rep2 = compare(*_synthetic_bundles())
+        check("byte_identical",
+              json.dumps(rep, sort_keys=True)
+              == json.dumps(rep2, sort_keys=True))
+        bad = _decomp("test", "t", 1.0, {"x": 0.2}, DEFAULT_TOLERANCE)
+        check("residual_flagged", not bad["sums_to_delta"],
+              json.dumps(bad))
+        rowrep = attribute_rows(
+            {"metric": "m", "value": 10.0, "exposed_comms_frac": 0.05},
+            {"metric": "m", "value": 8.0, "exposed_comms_frac": 0.20})
+        check("row_attribution_names_column",
+              rowrep["dominant"] is not None
+              and "exposed_comms_frac" in rowrep["dominant"],
+              str(rowrep["dominant"]))
+        old = attribute_rows({"metric": "m", "value": 10.0},
+                             {"metric": "m", "value": 8.0})
+        check("precolumn_rows_unattributable_not_error",
+              old["dominant"] is None and "unattributable" in
+              old.get("note", ""), json.dumps(old))
+
+        fdir = fixture_dir or default_fixture_dir()
+        if os.path.isdir(fdir):
+            fa = RunBundle.load(os.path.join(fdir, "run_a"))
+            fb = RunBundle.load(os.path.join(fdir, "run_b"))
+            frep = compare(fa, fb)
+            check("fixture_invariants_ok", frep["invariants"]["ok"],
+                  json.dumps(frep["invariants"]))
+            fdom = frep.get("dominant_cause") or ""
+            check("fixture_dominant_names_exposed_collective",
+                  "exposed all-reduce" in fdom and "dp" in fdom, fdom)
+            expected = os.path.join(fdir, "expected_report.json")
+            if os.path.exists(expected):
+                with open(expected) as f:
+                    want = f.read()
+                got = json.dumps(frep, indent=2, sort_keys=True) + "\n"
+                check("fixture_report_byte_identical", got == want,
+                      "" if got == want else
+                      f"drift at char "
+                      f"{next((i for i, (x, y) in enumerate(zip(got, want)) if x != y), min(len(got), len(want)))}")
+        elif fixture_dir is not None:
+            check("fixture_present", False, f"no fixture at {fdir}")
+        report["ok"] = all(c["ok"] for c in report["checks"])
+    except Exception as e:
+        check("exception", False, f"{type(e).__name__}: {e}")
+    return report

@@ -199,6 +199,40 @@ def test_aggregate_events_per_node_breakdown():
     assert by_node["b"]["goodput"] == pytest.approx(1.0)
 
 
+def test_aggregate_events_agrees_with_live_ledger(monkeypatch):
+    """Offline path vs live path over the SAME run (round 24): the
+    per-node report rebuilt by ``aggregate_events`` from the emitted
+    phase records agrees with the live ledger's own ``report()`` within
+    tolerance. Real clock on purpose — emitted ``t0_unix_s`` comes from
+    ``time.time()`` regardless of the injected clock, so a fake clock
+    would give the two paths different denominators by construction."""
+    import time as _time
+
+    from serverless_learn_tpu.telemetry import tracing
+
+    captured = []
+    monkeypatch.setattr(tracing, "emit_event",
+                        lambda rec: captured.append(dict(rec, node="t")))
+    led = PhaseLedger(emit=True, emit_min_s=0.0)
+    with led.phase("compile"):
+        _time.sleep(0.08)
+    for _ in range(2):
+        with led.phase("step"):
+            _time.sleep(0.1)
+    with led.phase("data_wait"):
+        _time.sleep(0.06)
+    live = led.report()
+    offline = aggregate_events(captured)["t"]
+    assert offline["goodput"] == pytest.approx(live["goodput"], abs=0.05)
+    assert offline["total_s"] == pytest.approx(live["total_s"], abs=0.05)
+    for name, ph in live["phases"].items():
+        if name == "unattributed":
+            continue
+        assert offline["phases"][name]["seconds"] == pytest.approx(
+            ph["seconds"], rel=0.1, abs=0.02)
+        assert offline["phases"][name]["count"] == ph["count"]
+
+
 # -- CLI: goodput (fast) -----------------------------------------------------
 
 def test_goodput_cli_from_events(tmp_path, capsys):

@@ -1,0 +1,442 @@
+"""Cross-run differential attribution (`telemetry/regress.py`,
+`slt regress`, `slt bench --gate --attribute`, round 24).
+
+Fast tier only: the decomposition engine's sum invariants on synthetic
+and committed-fixture runs (hand-computed deltas — the goodput total
+grows exactly 2.0s, the xray step wall exactly 18ms with 81% of it new
+exposed all-reduce on dp), byte-identical reports as a drift guard
+against ``tests/fixtures/regress/expected_report.json``, RunBundle
+write/load round-trips, the gate's `--attribute` path naming the
+planted dominant cause (and degrading to row-level / unattributable —
+never crashing — over pre-bundle and pre-column history), and doctor
+folding the verdicts into its diagnosis. No accelerator, no network.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from serverless_learn_tpu.telemetry import regress
+from serverless_learn_tpu.telemetry.regress import (RunBundle,
+                                                    attribute_rows,
+                                                    compare, config_drift,
+                                                    mfu_hw_disagreements,
+                                                    write_bundle)
+
+FIXTURE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "fixtures", "regress")
+FIXTURE_HISTORY = os.path.join(FIXTURE_DIR, "bench_history_regress.json")
+
+
+def _fixture_bundles():
+    return (RunBundle.load(os.path.join(FIXTURE_DIR, "run_a")),
+            RunBundle.load(os.path.join(FIXTURE_DIR, "run_b")))
+
+
+# -- decomposition invariants (synthetic) ------------------------------------
+
+
+def test_goodput_decomposition_sums_exactly():
+    """Run-total delta = sum of phase deltas, by construction: +2.0s =
+    step +1.8 + data_wait +0.2 on the synthetic pair."""
+    a, b = regress._synthetic_bundles()
+    rep = compare(a, b)
+    gd = next(d for d in rep["decompositions"]
+              if d["headline"] == "run_total_s[n0]")
+    assert gd["sums_to_delta"] is True
+    assert gd["delta"] == pytest.approx(2.0)
+    assert gd["terms"]["step"] == pytest.approx(1.8)
+    assert gd["terms"]["data_wait"] == pytest.approx(0.2)
+    assert gd["terms"]["compile"] == pytest.approx(0.0)
+
+
+def test_xray_decomposition_partitions_step_wall():
+    """busy+idle == wall and busy == compute+exposed+other, so the four
+    terms partition the step-wall delta exactly; the verdict quotes the
+    exposed share (81%) and names the grown collective's mesh axis."""
+    a, b = regress._synthetic_bundles()
+    rep = compare(a, b)
+    xd = next(d for d in rep["decompositions"]
+              if d["headline"] == "step_wall_s")
+    assert xd["sums_to_delta"] is True
+    assert xd["delta"] == pytest.approx(0.018)
+    assert xd["terms"]["exposed_collective_s"] == pytest.approx(0.01458)
+    assert xd["terms"]["compute_s"] == pytest.approx(0.0018)
+    assert xd["terms"]["idle_s"] == pytest.approx(0.00162)
+    dom = rep["dominant_cause"]
+    assert "81% is new exposed all-reduce" in dom and "dp" in dom
+    assert "zero_stage changed 1 -> 0" in dom
+
+
+def test_goodput_pairs_lone_nodes_with_different_names():
+    """Real runs carry pid-suffixed node names (`vm-<pid>`), so two runs
+    of the same single-node job never share a name — the lone nodes pair
+    anyway, with both names visible in the headline."""
+    a = {"vm-100": {"total_s": 10.0, "phases": {
+        "step": {"seconds": 10.0, "count": 5}}}}
+    b = {"vm-200": {"total_s": 12.0, "phases": {
+        "step": {"seconds": 12.0, "count": 5}}}}
+    decs = regress.goodput_decomposition(a, b, 0.05)
+    assert len(decs) == 1
+    assert decs[0]["headline"] == "run_total_s[vm-100->vm-200]"
+    assert decs[0]["sums_to_delta"] is True
+    assert decs[0]["terms"]["step"] == pytest.approx(2.0)
+    # Multi-node runs still join strictly by name.
+    a["vm-300"] = a["vm-100"]
+    assert regress.goodput_decomposition(a, b, 0.05) == []
+
+
+def test_inconsistent_terms_fail_the_sum_invariant():
+    """The machine check is real: terms that do NOT sum to the headline
+    delta flag the decomposition and fail the report's invariant."""
+    bad = regress._decomp("test", "t", 1.0, {"x": 0.2}, 0.05)
+    assert bad["sums_to_delta"] is False
+    assert bad["residual"] == pytest.approx(0.8)
+    ok = regress._decomp("test", "t", 1.0, {"x": 0.98}, 0.05)
+    assert ok["sums_to_delta"] is True
+
+
+def test_report_is_deterministic_and_portable():
+    """Byte-identical on identical inputs; no wall-clock stamps and no
+    absolute paths in the compare output (reports must diff clean
+    across checkouts and reruns)."""
+    rep1 = compare(*regress._synthetic_bundles())
+    rep2 = compare(*regress._synthetic_bundles())
+    s1 = json.dumps(rep1, sort_keys=True)
+    assert s1 == json.dumps(rep2, sort_keys=True)
+    assert "created_unix_s" not in s1
+    assert os.sep + "tmp" not in s1 and "/root/" not in s1
+
+
+# -- the committed fixture (hand-computed) -----------------------------------
+
+
+def test_fixture_report_matches_committed_expected():
+    """Drift guard: the committed two-run fixture reproduces its
+    expected_report.json byte-for-byte. Regenerate deliberately (and
+    re-review the hand-computed numbers) if the engine changes."""
+    a, b = _fixture_bundles()
+    got = json.dumps(compare(a, b), indent=2, sort_keys=True) + "\n"
+    with open(os.path.join(FIXTURE_DIR, "expected_report.json")) as f:
+        assert got == f.read()
+
+
+def test_fixture_decompositions_each_sum_to_headline():
+    """Acceptance: every per-ledger decomposition over the fixture pair
+    sums to its headline delta within tolerance — goodput (+2.0s run),
+    xray (+18ms step), waterfall TTFT (+50ms = compile 80% + prefill
+    20%), stall causes (+40ms preempt), DCN (+740kB diloco)."""
+    a, b = _fixture_bundles()
+    rep = compare(a, b)
+    assert rep["invariants"]["ok"] is True
+    assert rep["invariants"]["checked"] >= 5
+    by = {d["headline"]: d for d in rep["decompositions"]}
+    assert by["run_total_s[n0]"]["delta"] == pytest.approx(2.0)
+    assert by["ttft_p99_s"]["terms"]["compile"] == pytest.approx(0.04)
+    assert by["ttft_p99_s"]["terms"]["prefill"] == pytest.approx(0.01)
+    assert by["decode_stall_total_s"]["terms"]["preempt"] == \
+        pytest.approx(0.04)
+    assert by["wire_bytes_total"]["terms"]["diloco"] == \
+        pytest.approx(740000.0)
+    # The ledger facts the verdicts quote: per-axis collective growth,
+    # the roofline flip, the codec-disengaged compression collapse, and
+    # the numerics bisection naming the first divergent step.
+    xf = rep["facts"]["xray"]
+    assert xf["per_collective_delta_s"]["all-reduce@dp"] == \
+        pytest.approx(0.07)
+    assert xf["roofline_verdict_flips"] == [
+        {"op": "fusion.123", "a": "compute-bound", "b": "hbm-bound"}]
+    dcn = rep["facts"]["dcn"]["diloco"]
+    assert dcn["compression_ratio_a"] == pytest.approx(3.846154)
+    assert dcn["compression_ratio_b"] == pytest.approx(1.0)
+    assert rep["numerics"]["diverged"] is True
+    assert rep["numerics"]["first_divergent_step"] == 2
+
+
+def test_self_check_passes():
+    rep = regress.self_check()
+    assert rep["ok"] is True, [c for c in rep["checks"] if not c["ok"]]
+
+
+# -- RunBundle write/load ----------------------------------------------------
+
+
+def test_bundle_roundtrip(tmp_path):
+    events = tmp_path / "events.jsonl"
+    events.write_text(json.dumps(
+        {"event": "phase", "phase": "step", "node": "n0",
+         "t0_unix_s": 1.0, "duration_s": 2.0, "self_s": 2.0}) + "\n")
+    path = write_bundle(
+        str(tmp_path / "bundle"), run_id="rt-1", role="train",
+        bench_rows=[{"metric": "m", "value": 1.0}],
+        events=[str(events)],
+        xray_summary={"busy_frac": 0.5, "steps": {"mean_wall_s": 0.1}},
+        config={"zero_stage": 1}, config_fp="cfg-x",
+        git_sha_value="abc123", weight_version="wv-1",
+        extra={"goodput": {"goodput": 0.9}})
+    b = RunBundle.load(path)
+    assert b.run_id == "rt-1"
+    assert b.identity()["git_sha"] == "abc123"
+    assert b.identity()["weight_version"] == "wv-1"
+    assert b.config() == {"zero_stage": 1}
+    assert b.bench_rows() == [{"metric": "m", "value": 1.0}]
+    assert [r["phase"] for r in b.events() if r.get("event") == "phase"] \
+        == ["step"]
+    assert b.xray_summary()["busy_frac"] == 0.5
+    assert b.goodput()["n0"]["total_s"] == pytest.approx(2.0)
+    # Loading the directory (not the run.json) works too.
+    assert RunBundle.load(str(tmp_path / "bundle")).run_id == "rt-1"
+
+
+def test_bundle_tolerates_missing_artifacts(tmp_path):
+    """A bundle whose event log was rotated away still loads and joins
+    on its stamps — loaders consume only what exists."""
+    path = write_bundle(str(tmp_path / "b"), run_id="gone", role="bench",
+                        events=[str(tmp_path / "never-written.jsonl")])
+    b = RunBundle.load(path)
+    assert b.events() == []
+    assert b.xray_summary() is None
+    assert b.goodput() == {}
+    assert b.waterfall_summary() is None
+    rep = compare(b, b)
+    assert rep["invariants"]["ok"] is True  # nothing to check, nothing broke
+
+
+# -- row-level fallback + schema tolerance -----------------------------------
+
+
+def _row(value, **extra):
+    return {"metric": "resnet18_cifar_train_samples_per_sec_per_chip",
+            "value": value, "unit": "samples/sec/chip",
+            "device_kind": "TPU v5 lite", "batch_per_chip": 4096, **extra}
+
+
+def test_attribute_rows_names_planted_column():
+    rep = attribute_rows(_row(100.0, exposed_comms_frac=0.05,
+                              zero_stage=1),
+                         _row(80.0, exposed_comms_frac=0.22,
+                              zero_stage=0))
+    assert rep["mode"] == "rows"
+    assert "exposed_comms_frac" in rep["dominant"]
+    assert any("zero_stage changed 1 -> 0" in v for v in rep["verdicts"])
+
+
+def test_attribute_rows_predating_columns_is_note_not_error():
+    """Satellite: rows that predate every attribution column are
+    joinable but unattributable — a note, never an exception."""
+    rep = attribute_rows(_row(100.0), _row(80.0))
+    assert rep["dominant"] is None
+    assert "unattributable" in rep["note"]
+
+
+def test_config_drift_skips_missing_stamps():
+    """Missing git_sha/config_fingerprint stamps never register as
+    drift (schema tolerance for pre-round-24 rows)."""
+    drift = config_drift(None, None, _row(100.0),
+                         _row(80.0, git_sha="bbb"))
+    assert drift == []
+    drift = config_drift(None, None, _row(100.0, git_sha="aaa"),
+                         _row(80.0, git_sha="bbb"))
+    assert [d["field"] for d in drift] == ["git_sha"]
+
+
+def test_mfu_hw_disagreements_surfaces_latest_row():
+    hist = [_row(100.0),
+            _row(99.0, mfu_vs_hw_warning="analytic mfu 0.62 exceeds "
+                                         "hardware busy fraction 0.48")]
+    rows = mfu_hw_disagreements(hist)
+    assert len(rows) == 1 and "0.62" in rows[0]["warning"]
+    # The warning's appearance across two compared runs rides the report.
+    a = RunBundle({"run_id": "wa", "bench_rows": [_row(100.0)]})
+    b = RunBundle({"run_id": "wb", "bench_rows": [
+        _row(99.0, mfu_vs_hw_warning="cost-model overcount?")]})
+    rep = compare(a, b)
+    assert any("mfu_vs_hw_warning appeared" in w for w in rep["warnings"])
+    assert any("mfu_vs_hw_warning" in v for v in rep["verdicts"])
+
+
+# -- CLI: slt regress --------------------------------------------------------
+
+
+def test_cli_regress_fixture_pair(capsys):
+    from serverless_learn_tpu.cli import main
+
+    assert main(["regress", os.path.join(FIXTURE_DIR, "run_a"),
+                 os.path.join(FIXTURE_DIR, "run_b"), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["invariants"]["ok"] is True
+    assert "exposed all-reduce" in rep["dominant_cause"]
+
+
+def test_cli_regress_human_render(capsys):
+    from serverless_learn_tpu.cli import main
+
+    assert main(["regress", os.path.join(FIXTURE_DIR, "run_a"),
+                 os.path.join(FIXTURE_DIR, "run_b")]) == 0
+    out = capsys.readouterr().out
+    assert "verdict" in out and "drift: zero_stage 1 -> 0" in out
+
+
+def test_cli_regress_self_check(capsys):
+    from serverless_learn_tpu.cli import main
+
+    assert main(["regress", "--self-check", "--compact"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ok"] is True
+    names = {c["check"] for c in rep["checks"]}
+    assert "fixture_report_byte_identical" in names
+
+
+def test_cli_regress_usage_and_load_errors(tmp_path, capsys):
+    from serverless_learn_tpu.cli import main
+
+    assert main(["regress"]) == 2
+    assert main(["regress", str(tmp_path / "nope"),
+                 str(tmp_path / "nada")]) == 2
+
+
+# -- CLI: slt bench --gate --attribute ---------------------------------------
+
+
+def test_bench_gate_attribute_names_planted_cause(capsys):
+    """Acceptance: over the committed fixture history the gate fails
+    AND the exit message names the planted dominant cause — the
+    exposed-collective growth on dp."""
+    from serverless_learn_tpu.cli import main
+
+    assert main(["bench", "--gate", "--attribute", "--dry-run",
+                 "--history", FIXTURE_HISTORY]) == 1
+    out = capsys.readouterr()
+    assert "gate FAILED" in out.err
+    assert "exposed all-reduce" in out.err and "dp" in out.err
+    rep = json.loads(out.out)
+    assert rep["attribution"][0]["mode"] == "bundles"
+    assert rep["attribution"][0]["invariants"]["ok"] is True
+
+
+def test_bench_gate_attribute_row_fallback(tmp_path, capsys):
+    """History rows with attribution columns but no bundle pointers
+    degrade to row-level attribution naming the worst column."""
+    from serverless_learn_tpu.cli import main
+
+    hist = tmp_path / "hist.json"
+    hist.write_text(json.dumps(
+        [_row(100.0, exposed_comms_frac=0.05),
+         _row(80.0, exposed_comms_frac=0.30)]))
+    assert main(["bench", "--gate", "--attribute", "--dry-run",
+                 "--history", str(hist)]) == 1
+    out = capsys.readouterr()
+    assert "exposed_comms_frac" in out.err
+    rep = json.loads(out.out)
+    assert rep["attribution"][0]["mode"] == "rows"
+
+
+def test_bench_gate_attribute_pre_column_history_no_crash(tmp_path,
+                                                          capsys):
+    """Satellite regression test: a history where EVERY row predates
+    the attribution columns (pre-round-16 shape) must neither gate on
+    those columns nor crash --attribute — the regression is reported
+    as joinable-but-unattributable."""
+    from serverless_learn_tpu.cli import main
+
+    hist = tmp_path / "old.json"
+    # Pre-round-16 rows: value + keys only (no goodput, no attribution
+    # columns, no stamps, no bundle pointers).
+    hist.write_text(json.dumps([_row(100.0), _row(80.0)]))
+    assert main(["bench", "--gate", "--attribute", "--dry-run",
+                 "--history", str(hist)]) == 1
+    out = capsys.readouterr()
+    assert "unattributable" in out.err
+    rep = json.loads(out.out)
+    assert rep["attribution"][0].get("note")
+    capsys.readouterr()
+    # And a NON-regressing pre-column history passes clean (the columns
+    # must not gate when no row ever carried them).
+    flat = tmp_path / "flat.json"
+    flat.write_text(json.dumps([_row(100.0), _row(101.0)]))
+    assert main(["bench", "--gate", "--attribute", "--dry-run",
+                 "--history", str(flat)]) == 0
+
+
+# -- loadgen bundle stamping -------------------------------------------------
+
+
+def test_loadgen_stamp_bundle_points_rows(tmp_path):
+    from serverless_learn_tpu.fleet.loadgen import stamp_bundle
+
+    hist = tmp_path / "hist.json"
+    rows = [{"metric": "serve_ttft_p99_ms", "value": 12.0,
+             "device_kind": "serve-cpu"}]
+    ptr = stamp_bundle(rows, str(hist), role="loadgen-test")
+    assert ptr and rows[0]["bundle"] == ptr
+    b = RunBundle.load(os.path.join(str(tmp_path), ptr))
+    assert b.manifest["role"] == "loadgen-test"
+    assert b.bench_rows()[0]["metric"] == "serve_ttft_p99_ms"
+    assert b.bench_rows()[0]["bundle"] == ptr  # rows stamped pre-write
+
+
+# -- bench.py bundle stamping ------------------------------------------------
+
+
+def test_bench_write_run_bundle(tmp_path):
+    import bench as bench_mod
+
+    rec = _row(100.0, zero_stage=1, git_sha="abc",
+               config_fingerprint="cfg-1")
+    hist = tmp_path / "bench_history.json"
+    ptr = bench_mod.write_run_bundle(rec, str(hist))
+    assert ptr and rec["bundle"] == ptr
+    b = RunBundle.load(os.path.join(str(tmp_path), ptr))
+    assert b.manifest["role"] == "bench"
+    assert b.identity()["git_sha"] == "abc"
+    assert b.bench_rows()[0]["value"] == 100.0
+
+
+# -- doctor integration ------------------------------------------------------
+
+
+def test_doctor_folds_cross_run_verdicts():
+    from serverless_learn_tpu.telemetry import doctor
+
+    rep = doctor.diagnose(bench_history=FIXTURE_HISTORY)
+    verdict = rep["summary"]["verdict"]
+    assert "bench regression attributed" in verdict
+    assert "exposed all-reduce" in verdict
+    attrib = rep["bench"]["attribution"]
+    assert attrib and attrib[0]["mode"] == "bundles"
+
+
+def test_doctor_surfaces_mfu_vs_hw_warning(tmp_path):
+    from serverless_learn_tpu.telemetry import doctor
+
+    hist = tmp_path / "hist.json"
+    hist.write_text(json.dumps(
+        [_row(100.0),
+         _row(99.0, mfu_vs_hw_warning="analytic mfu 0.62 exceeds "
+                                      "hardware busy fraction 0.48")]))
+    rep = doctor.diagnose(bench_history=str(hist))
+    assert "analytic MFU disagrees" in rep["summary"]["verdict"]
+    assert rep["bench"]["mfu_vs_hw_warnings"][0]["warning"].startswith(
+        "analytic mfu 0.62")
+
+
+# -- gate integration (library level) ----------------------------------------
+
+
+def test_attribute_gate_failures_never_raises_on_garbage():
+    """A malformed gate report/history degrades per-check, keeps gating."""
+    out = regress.attribute_gate_failures(
+        {"regressions": [{"metric": "m", "device_kind": None,
+                          "batch_per_chip": None}]},
+        [{"metric": "m", "value": "not-a-number"}], history_dir=None)
+    assert out and out[0].get("mode") in ("rows", "error")
+
+
+def test_attribute_bench_history_clean_history_is_empty(tmp_path):
+    hist = tmp_path / "hist.json"
+    hist.write_text(json.dumps([_row(100.0), _row(101.0)]))
+    assert regress.attribute_bench_history(str(hist)) == []
+    assert regress.attribute_bench_history(
+        str(tmp_path / "missing.json")) == []
