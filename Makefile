@@ -1,7 +1,7 @@
 # Developer entry points. The Python package needs no build; `native/` holds
 # the C++ control/data-plane daemons.
 
-.PHONY: test test-all lint check lockcheck racecheck native tsan bench lm-bench data-bench gen-bench dryrun clean
+.PHONY: test test-all lint check lockcheck racecheck jitcheck native tsan bench lm-bench data-bench gen-bench dryrun clean
 
 test:  ## fast tier (<2 min on CPU); compile-heavy tests are marked slow
 	python -m pytest tests/ -q -m "not slow"
@@ -15,7 +15,7 @@ lint:  ## ruff (when installed) + bytecode-compile + project-aware `slt check`
 	python -m compileall -q serverless_learn_tpu tests benchmarks bench.py
 	python -m serverless_learn_tpu check
 
-check:  ## project-aware static analysis alone (SLT001-SLT009)
+check:  ## project-aware static analysis alone (SLT001-SLT013)
 	python -m serverless_learn_tpu check
 
 lockcheck:  ## fast telemetry/health/goodput tier under the runtime lock-order detector
@@ -28,6 +28,13 @@ racecheck:  ## concurrency surface under the vector-clock happens-before race de
 		tests/test_kvcache.py tests/test_continuous.py tests/test_telemetry.py \
 		tests/test_health.py tests/test_canary.py tests/test_regress.py \
 		-q -m "not slow"
+
+jitcheck:  ## inference/training compile discipline under the runtime jit monitor
+	SLT_JITCHECK=1 python -m pytest tests/test_continuous.py \
+		tests/test_serve_batching.py tests/test_train_step.py \
+		tests/test_grad_accum_eval.py tests/test_jitcheck.py \
+		-q -m "not slow"
+	python -m serverless_learn_tpu jit --self-check
 
 test-all:  ## the full suite (~13 min on CPU)
 	python -m pytest tests/ -q

@@ -15,13 +15,25 @@ Layout:
 
 * ``engine.py`` — file discovery, the :class:`Finding` model, the
   committed baseline-suppression file, text/JSON reporting.
-* ``rules/`` — one module per SLT rule (SLT001..SLT006); see
+* ``rules/`` — one module per SLT rule (SLT001..SLT013); see
   ``rules/__init__.py`` for the registry and README for how to add one.
 * ``lockcheck.py`` — the RUNTIME half of SLT001: an opt-in
   (``SLT_LOCKCHECK=1``) instrumented lock wrapper that records real
   acquisition orderings during the test suite and fails on cycles.
+* ``racecheck.py`` — the runtime half of SLT007 (``SLT_RACECHECK=1``):
+  vector-clock happens-before tracking over the lockcheck listeners.
+* ``jitcheck.py`` — the runtime half of SLT010-SLT013
+  (``SLT_JITCHECK=1``): wraps ``jax.jit``, records every real XLA
+  compile, enforces declared per-site compile budgets and frozen
+  windows, and detects donated-buffer reuse logically (the round-15
+  "Array has been deleted" class, caught on CPU).
+* ``shardcheck.py`` — SLT013's jaxpr harness: trace a jitted function
+  and audit where its sharding constraints sit (the PR 13 grad-accum
+  once-per-step rule, reusable).
 
-Run it: ``slt check [--rule SLTxxx] [--json] [--update-baseline]``.
+Run it: ``slt check [--rule SLTxxx] [--json] [--update-baseline]``;
+replay compile logs with ``slt jit LOG`` (``slt jit --self-check``
+validates the verdict engine).
 """
 
 from serverless_learn_tpu.analysis.engine import (Finding, Project,

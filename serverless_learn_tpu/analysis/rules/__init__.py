@@ -16,14 +16,20 @@ from serverless_learn_tpu.analysis.rules import (slt001_lock_order,
                                                  slt006_config_drift,
                                                  slt007_guarded_by,
                                                  slt008_resource_lifecycle,
-                                                 slt009_atomicity)
+                                                 slt009_atomicity,
+                                                 slt010_dtype_flow,
+                                                 slt011_donation_safety,
+                                                 slt012_recompile_hazard,
+                                                 slt013_sharding_drift)
 
 RULES = {
     mod.RULE_ID: mod
     for mod in (slt001_lock_order, slt002_metric_drift, slt003_jit_purity,
                 slt004_thread_lifecycle, slt005_proto_compat,
                 slt006_config_drift, slt007_guarded_by,
-                slt008_resource_lifecycle, slt009_atomicity)
+                slt008_resource_lifecycle, slt009_atomicity,
+                slt010_dtype_flow, slt011_donation_safety,
+                slt012_recompile_hazard, slt013_sharding_drift)
 }
 
 TITLES = {rid: mod.TITLE for rid, mod in RULES.items()}

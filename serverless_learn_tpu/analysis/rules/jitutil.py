@@ -1,0 +1,194 @@
+"""Shared AST helpers for the jit-program rules (SLT003, SLT010-SLT013).
+
+Every rule that reasons about ``@jax.jit``/``partial(jax.jit, ...)``
+bodies needs the same three primitives: resolve a dotted call target,
+decide whether a decorator/call IS a jit, and enumerate the function
+nodes whose bodies trace. SLT003 grew them first; the round-25 rules
+(dtype flow, donation safety, recompile hazards) share them from here so
+"what counts as jitted" has exactly one definition.
+
+Pure ast — no jax import (``slt check`` runs on toolchain-less nodes).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import List, Optional, Set, Tuple
+
+
+def call_parts(func: ast.AST) -> Tuple[Optional[str], Optional[str]]:
+    """(dotted receiver or None, attr/name) for a call target."""
+    if isinstance(func, ast.Name):
+        return None, func.id
+    if isinstance(func, ast.Attribute):
+        node, parts = func.value, []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts)), func.attr
+        return "?", func.attr
+    return None, None
+
+
+def is_jit_call(node: ast.AST) -> bool:
+    """jax.jit / pjit / partial(jax.jit, ...) as a decorator or call."""
+    if isinstance(node, ast.Call):
+        recv, attr = call_parts(node.func)
+        if attr in ("jit", "pjit"):
+            return True
+        if attr == "partial" and node.args:
+            return is_jit_call(node.args[0])
+        return False
+    recv, attr = call_parts(node) if isinstance(
+        node, (ast.Attribute, ast.Name)) else (None, None)
+    return attr in ("jit", "pjit")
+
+
+def _literal_int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """(0,) / 0 / (1, 2) as a tuple of ints; None when not literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+def _literal_str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+@dataclass
+class JitInfo:
+    """Static facts parsed off one jit creation (decorator or call)."""
+
+    donate_argnums: Tuple[int, ...] = ()
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    # True when the literal kwargs could not be fully resolved (a
+    # variable donate mask, computed argnums): rules should degrade to
+    # "unknown", never guess.
+    partial_knowledge: bool = False
+    call: Optional[ast.Call] = None
+
+
+def jit_info(node: ast.AST) -> JitInfo:
+    """Parse donate/static knowledge off a jit decorator/call node.
+
+    Accepts ``jax.jit`` (bare), ``jax.jit(f, ...)`` and
+    ``partial(jax.jit, ...)``; keyword values that are not int/str
+    literals (e.g. ``donate_argnums=donate`` where ``donate`` is
+    computed) set ``partial_knowledge``.
+    """
+    info = JitInfo()
+    if not isinstance(node, ast.Call):
+        return info
+    recv, attr = call_parts(node.func)
+    if attr == "partial" and node.args and is_jit_call(node.args[0]):
+        pass  # kwargs live on the partial call itself
+    info.call = node
+    for kw in node.keywords:
+        if kw.arg == "donate_argnums":
+            got = _literal_int_tuple(kw.value)
+            if got is None:
+                info.partial_knowledge = True
+            else:
+                info.donate_argnums = got
+        elif kw.arg == "static_argnums":
+            got = _literal_int_tuple(kw.value)
+            if got is None:
+                info.partial_knowledge = True
+            else:
+                info.static_argnums = got
+        elif kw.arg == "static_argnames":
+            got = _literal_str_tuple(kw.value)
+            if got is None:
+                info.partial_knowledge = True
+            else:
+                info.static_argnames = got
+    return info
+
+
+@dataclass
+class JittedFn:
+    """One function whose body traces, plus how it got jitted."""
+
+    node: ast.AST               # FunctionDef / AsyncFunctionDef / Lambda
+    info: JitInfo = field(default_factory=JitInfo)
+
+    @property
+    def name(self) -> str:
+        return getattr(self.node, "name", "<lambda>")
+
+    def param_names(self) -> List[str]:
+        a = self.node.args
+        return [p.arg for p in
+                list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+
+    def static_params(self) -> Set[str]:
+        """Parameter NAMES declared static (argnums resolved against the
+        positional list, argnames taken verbatim)."""
+        names = self.param_names()
+        out = set(self.info.static_argnames)
+        for i in self.info.static_argnums:
+            if 0 <= i < len(names):
+                out.add(names[i])
+        return out
+
+
+def jitted_functions(tree: ast.AST) -> List[JittedFn]:
+    """Function nodes whose bodies trace: decorated defs, local defs
+    passed to jax.jit(...), and lambdas jitted inline — each paired with
+    the donate/static knowledge parsed off its jit site."""
+    jitted: List[JittedFn] = []
+    seen: Set[int] = set()
+    local_defs = {}
+
+    def add(fn_node: ast.AST, info: JitInfo):
+        if id(fn_node) not in seen:
+            seen.add(id(fn_node))
+            jitted.append(JittedFn(fn_node, info))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local_defs.setdefault(node.name, node)
+            for dec in node.decorator_list:
+                if is_jit_call(dec):
+                    add(node, jit_info(dec))
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and is_jit_call(node)):
+            continue
+        recv, attr = call_parts(node.func)
+        if attr == "partial":
+            continue  # the decorator form, handled above
+        if node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Name) and target.id in local_defs:
+                add(local_defs[target.id], jit_info(node))
+            elif isinstance(target, ast.Lambda):
+                add(target, jit_info(node))
+    return jitted
+
+
+def body_walk(fn: ast.AST):
+    """ast.walk over a function's body (handles Lambda's expr body)."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    return ast.walk(ast.Module(body=list(body), type_ignores=[]))

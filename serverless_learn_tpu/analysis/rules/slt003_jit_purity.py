@@ -15,9 +15,10 @@ their bodies, including nested defs (a ``lax.scan`` body traces too).
 from __future__ import annotations
 
 import ast
-from typing import List, Optional, Set
+from typing import List
 
 from serverless_learn_tpu.analysis.engine import Finding, Project
+from serverless_learn_tpu.analysis.rules import jitutil
 
 RULE_ID = "SLT003"
 TITLE = "Python side effects inside jitted functions"
@@ -47,72 +48,12 @@ _IMPURE_BARE_ATTRS = {
 }
 
 
-def _call_parts(func: ast.AST):
-    if isinstance(func, ast.Name):
-        return None, func.id
-    if isinstance(func, ast.Attribute):
-        node, parts = func.value, []
-        while isinstance(node, ast.Attribute):
-            parts.append(node.attr)
-            node = node.value
-        if isinstance(node, ast.Name):
-            parts.append(node.id)
-            return ".".join(reversed(parts)), func.attr
-        return "?", func.attr
-    return None, None
-
-
-def _is_jit_call(node: ast.AST) -> bool:
-    """jax.jit / pjit / partial(jax.jit, ...) as a decorator or call."""
-    if isinstance(node, ast.Call):
-        recv, attr = _call_parts(node.func)
-        if attr in ("jit", "pjit"):
-            return True
-        if attr == "partial" and node.args:
-            return _is_jit_call(node.args[0])
-        return False
-    recv, attr = _call_parts(node) if isinstance(
-        node, (ast.Attribute, ast.Name)) else (None, None)
-    return attr in ("jit", "pjit")
-
-
-def _jitted_functions(tree: ast.AST) -> List[ast.AST]:
-    """Function nodes whose bodies trace: decorated defs, local defs
-    passed to jax.jit(...), and lambdas jitted inline."""
-    jitted: List[ast.AST] = []
-    local_defs = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            local_defs.setdefault(node.name, node)
-            for dec in node.decorator_list:
-                if _is_jit_call(dec):
-                    jitted.append(node)
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call) and _is_jit_call(node)):
-            continue
-        recv, attr = _call_parts(node.func)
-        args = node.args
-        if attr == "partial":
-            continue  # the decorator form, handled above
-        if args:
-            target = args[0]
-            if isinstance(target, ast.Name) and target.id in local_defs:
-                jitted.append(local_defs[target.id])
-            elif isinstance(target, ast.Lambda):
-                jitted.append(target)
-    seen: Set[int] = set()
-    out = []
-    for n in jitted:
-        if id(n) not in seen:
-            seen.add(id(n))
-            out.append(n)
-    return out
+_call_parts = jitutil.call_parts
 
 
 def _impurities(fn: ast.AST) -> List[tuple]:
     out = []
-    body = fn.body if isinstance(fn.body, list) else [fn.body]
-    for node in ast.walk(ast.Module(body=list(body), type_ignores=[])):
+    for node in jitutil.body_walk(fn):
         if not isinstance(node, ast.Call):
             continue
         recv, attr = _call_parts(node.func)
@@ -137,9 +78,9 @@ def run(proj: Project) -> List[Finding]:
     for sf in proj.files:
         if sf.tree is None:
             continue
-        for fn in _jitted_functions(sf.tree):
-            name = getattr(fn, "name", "<lambda>")
-            for line, what, why in _impurities(fn):
+        for jf in jitutil.jitted_functions(sf.tree):
+            name = jf.name
+            for line, what, why in _impurities(jf.node):
                 findings.append(Finding(
                     RULE_ID, sf.path, line,
                     f"{what}() inside jitted {name}: {why}"))

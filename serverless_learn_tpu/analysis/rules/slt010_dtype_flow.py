@@ -1,0 +1,311 @@
+"""SLT010: dtype discipline through jitted bodies (the bf16 proof rail).
+
+The mixed-precision compute path (ROADMAP item #1) lives or dies on
+dtype discipline that XLA will never complain about: a ``jnp.sum`` over
+a bf16 activation quietly accumulates in 8 mantissa bits, a stray
+``float64`` literal silently truncates under the default ``x64=off``
+(and forks the compile key the day it is enabled), and a bf16 value
+meeting an f32 value upcasts the whole downstream expression without
+anyone deciding it should. None of these are visible on a CPU parity
+run — the values are merely *less precise*, not wrong — so this rule is
+the static proof rail: a tiny dtype lattice walked over every jitted
+body (``jitutil.jitted_functions``: decorated defs, ``jax.jit(f)``
+locals, inline lambdas).
+
+The lattice is deliberately conservative: a value's dtype is only KNOWN
+when an explicit cast/constructor says so (``.astype(jnp.bfloat16)``,
+``jnp.zeros(..., jnp.float32)``, ``jnp.bfloat16(x)``); everything else
+is unknown and never findings. That keeps the rule quiet on code that
+threads caller-supplied dtypes through (``gi.astype(a.dtype)``) while
+still catching the classes that bit or nearly bit this repo:
+
+* **bf16 accumulation** (error): a reduction/normalization call
+  (``sum/mean/var/std/cumsum/softmax/log_softmax/logsumexp/norm``, as
+  ``jnp.``/``jax.nn.``/method form) whose operand is known bf16/f16
+  with no ``dtype=`` escape hatch.
+* **f64 in a jitted body** (error): any dtype expression resolving to
+  float64 (``jnp.float64``, ``np.double``, ``dtype=float``,
+  ``"float64"``).
+* **silent mixed-precision arithmetic** (warning): a binary op whose
+  operands are KNOWN bf16/f16 on one side and f32 on the other — the
+  upcast is legal promotion, but on a hot path it should be a decision
+  (``.astype``) rather than an accident.
+* **master-weight contract** (error, ``config.py`` only): the
+  ``TrainConfig.param_dtype`` default must stay ``"float32"`` — f32
+  master weights are the contract every optimizer-state/ZeRO layout
+  and the bf16 compute path assume.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from serverless_learn_tpu.analysis.engine import Finding, Project
+from serverless_learn_tpu.analysis.rules import jitutil
+
+RULE_ID = "SLT010"
+TITLE = "dtype flow through jitted functions"
+SCOPE = "file"
+
+# Lattice points. None = unknown (never findings).
+BF16, F16, F32, F64 = "bfloat16", "float16", "float32", "float64"
+_LOW = (BF16, F16)
+
+_DTYPE_ATTRS = {
+    "bfloat16": BF16, "float16": F16, "half": F16,
+    "float32": F32, "single": F32,
+    "float64": F64, "double": F64, "float_": F64,
+}
+_DTYPE_STRINGS = {
+    "bfloat16": BF16, "bf16": BF16, "float16": F16, "f16": F16,
+    "float32": F32, "f32": F32, "float64": F64, "f64": F64,
+}
+
+_REDUCTIONS = {"sum", "mean", "var", "std", "cumsum", "softmax",
+               "log_softmax", "logsumexp", "norm", "average"}
+_ARRAY_CTORS = {"zeros", "ones", "full", "empty", "asarray", "array",
+                "arange", "linspace", "zeros_like", "ones_like",
+                "full_like"}
+
+
+def _dtype_of_expr(node: ast.AST) -> Optional[str]:
+    """Resolve a dtype EXPRESSION (jnp.bfloat16, "f32", float) if it is
+    a literal dtype reference; None when unknown/dynamic."""
+    if isinstance(node, ast.Attribute):
+        return _DTYPE_ATTRS.get(node.attr)
+    if isinstance(node, ast.Name):
+        if node.id == "float":
+            return F64  # Python float = float64
+        return _DTYPE_ATTRS.get(node.id)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return _DTYPE_STRINGS.get(node.value)
+    return None
+
+
+class _FnChecker(ast.NodeVisitor):
+    """One pass over one jitted body with a name -> dtype environment.
+
+    Statement order is the visit order; assignments update the env, so
+    the inference is flow-sensitive enough for straight-line bodies
+    (branches just keep visiting with the shared env — an over-
+    approximation that can only lose knowledge, because conflicting
+    writes overwrite rather than merge)."""
+
+    def __init__(self, fn_name: str):
+        self.fn_name = fn_name
+        self.env: Dict[str, Optional[str]] = {}
+        self.findings: List[tuple] = []  # (line, message, severity)
+
+    # -- dtype inference ---------------------------------------------------
+
+    def infer(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, ast.Call):
+            return self._infer_call(node)
+        if isinstance(node, ast.BinOp):
+            left, right = self.infer(node.left), self.infer(node.right)
+            if left in _LOW and right == F32 or (right in _LOW
+                                                 and left == F32):
+                self.findings.append((
+                    node.lineno,
+                    f"mixed {left if left in _LOW else right}/f32 "
+                    f"arithmetic in jitted {self.fn_name} silently "
+                    f"upcasts to float32; make the cast explicit "
+                    f"(.astype) so the compute dtype is a decision",
+                    "warning"))
+                return F32
+            if left == right:
+                return left
+            return left or right if (left is None or right is None) \
+                else None
+        if isinstance(node, ast.UnaryOp):
+            return self.infer(node.operand)
+        if isinstance(node, (ast.IfExp,)):
+            a, b = self.infer(node.body), self.infer(node.orelse)
+            return a if a == b else None
+        return None
+
+    def _call_dtype_kwarg(self, node: ast.Call) -> Optional[str]:
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                got = _dtype_of_expr(kw.value)
+                if got is None:
+                    return "dynamic"
+                return got
+        return None
+
+    def _infer_call(self, node: ast.Call) -> Optional[str]:
+        recv, attr = jitutil.call_parts(node.func)
+        # x.astype(D)
+        if attr == "astype" and node.args:
+            got = _dtype_of_expr(node.args[0])
+            return got
+        # jnp.bfloat16(x) / jnp.float32(x) constructor casts
+        if recv in ("jnp", "jax.numpy", "np", "numpy") and attr:
+            as_dtype = _DTYPE_ATTRS.get(attr)
+            if as_dtype is not None:
+                return as_dtype
+            if attr in _ARRAY_CTORS:
+                kw = self._call_dtype_kwarg(node)
+                if kw == "dynamic":
+                    return None
+                if kw is not None:
+                    return kw
+                # zeros(shape, dtype) positional form
+                if len(node.args) >= 2:
+                    got = _dtype_of_expr(node.args[1])
+                    if got is not None:
+                        return got
+                    return None
+                # default float dtype under x64=off
+                if attr in ("zeros", "ones", "empty", "linspace"):
+                    return F32
+                return None
+            if attr in _REDUCTIONS:
+                kw = self._call_dtype_kwarg(node)
+                if kw not in (None, "dynamic"):
+                    return kw
+                return self.infer(node.args[0]) if node.args else None
+        if attr == "with_sharding_constraint" and node.args:
+            return self.infer(node.args[0])
+        return None
+
+    # -- checks ------------------------------------------------------------
+
+    def _check_reduction(self, node: ast.Call):
+        recv, attr = jitutil.call_parts(node.func)
+        if attr not in _REDUCTIONS:
+            return
+        if recv in ("jnp", "jax.numpy", "np", "numpy", "jax.nn", "nn",
+                    "jnp.linalg", "jax.scipy.special"):
+            operand = node.args[0] if node.args else None
+        elif recv is not None and attr in ("sum", "mean", "var", "std",
+                                           "cumsum"):
+            # method form x.sum(): receiver is the operand expression —
+            # only a plain Name receiver is resolvable in the env.
+            operand = (ast.Name(id=recv, ctx=ast.Load())
+                       if "." not in recv else None)
+        else:
+            return
+        if operand is None:
+            return
+        got = self.infer(operand)
+        if got not in _LOW:
+            return
+        kw = self._call_dtype_kwarg(node)
+        if kw in (F32, F64, "dynamic"):
+            return  # explicit accumulator escape hatch
+        self.findings.append((
+            node.lineno,
+            f"{attr}() over {got} in jitted {self.fn_name} accumulates "
+            f"in {got} (8-bit mantissa); cast to float32 first or pass "
+            f"dtype=jnp.float32",
+            "error"))
+
+    def _check_f64(self, node: ast.AST):
+        line = getattr(node, "lineno", 0)
+        if isinstance(node, ast.Call):
+            recv, attr = jitutil.call_parts(node.func)
+            if (recv in ("jnp", "jax.numpy", "np", "numpy")
+                    and _DTYPE_ATTRS.get(attr) == F64):
+                self.findings.append((
+                    line,
+                    f"float64 constructor {recv}.{attr}() in jitted "
+                    f"{self.fn_name}: silently truncated with x64 "
+                    f"disabled, forks the compile key when enabled",
+                    "error"))
+            for kw in node.keywords:
+                if kw.arg == "dtype" and _dtype_of_expr(kw.value) == F64:
+                    self.findings.append((
+                        line,
+                        f"dtype=float64 in jitted {self.fn_name}: "
+                        f"silently truncated with x64 disabled, forks "
+                        f"the compile key when enabled",
+                        "error"))
+
+    # -- traversal ---------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign):
+        got = self.infer(node.value)
+        self.generic_visit(node)
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                self.env[tgt.id] = got
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            got = self.infer(node.value)
+            if isinstance(node.target, ast.Name):
+                self.env[node.target.id] = got
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self.generic_visit(node)
+        if isinstance(node.target, ast.Name):
+            self.env.pop(node.target.id, None)
+
+    def visit_Call(self, node: ast.Call):
+        self._check_reduction(node)
+        self._check_f64(node)
+        # Make inference side effects (mixed-arith findings inside call
+        # args) fire even for expression statements.
+        self.infer(node)
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node: ast.BinOp):
+        self.infer(node)
+        self.generic_visit(node)
+
+
+def _check_param_dtype_contract(sf) -> List[Finding]:
+    """config.py: TrainConfig.param_dtype default must stay float32 —
+    the master-weight contract the bf16 compute path and the ZeRO
+    layouts assume."""
+    out: List[Finding] = []
+    if not sf.path.endswith("config.py") or sf.tree is None:
+        return out
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name == "TrainConfig"):
+            continue
+        for stmt in node.body:
+            if not (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)
+                    and stmt.target.id == "param_dtype"
+                    and stmt.value is not None):
+                continue
+            default = (stmt.value.value
+                       if isinstance(stmt.value, ast.Constant) else None)
+            if default not in ("float32", "f32"):
+                out.append(Finding(
+                    RULE_ID, sf.path, stmt.lineno,
+                    f"TrainConfig.param_dtype defaults to {default!r}: "
+                    f"master weights must stay float32 — bf16 compute "
+                    f"reads a bf16 COPY, the update applies to the f32 "
+                    f"master (the contract ZeRO layouts and loss-parity "
+                    f"gates assume)"))
+    return out
+
+
+def run(proj: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in proj.files:
+        if sf.tree is None:
+            continue
+        findings.extend(_check_param_dtype_contract(sf))
+        for jf in jitutil.jitted_functions(sf.tree):
+            checker = _FnChecker(jf.name)
+            body = (jf.node.body if isinstance(jf.node.body, list)
+                    else [jf.node.body])
+            for stmt in body:
+                checker.visit(stmt)
+            seen = set()
+            for line, msg, sev in checker.findings:
+                if (line, msg) in seen:
+                    continue
+                seen.add((line, msg))
+                findings.append(Finding(RULE_ID, sf.path, line, msg,
+                                        severity=sev))
+    return findings

@@ -1,0 +1,339 @@
+"""SLT011: reads of host aliases after buffer donation (round-15 class).
+
+``donate_argnums`` tells XLA it may reuse an input buffer for an output
+— after the call, the Python name still points at a deleted
+``jax.Array``, and the first read raises ``RuntimeError: Array has been
+deleted``. Worse, CPU ignores donation entirely, so the bug is
+invisible on every parity run and only detonates on a TPU — which is
+exactly how the round-15 emergency-save incident happened: a checkpoint
+path read ``state.params`` after the step donated ``state``.
+
+This rule walks each function that CALLS a donating jit and tracks the
+donated argument paths (``state``, ``self._state``,
+``self._state["pages"]``) as *dead* from the call onward:
+
+* a Load of a dead path → finding (donation line + read line);
+* rebinding revives — ``state, metrics = step(state, batch)`` is the
+  sanctioned pattern (targets are processed AFTER the call in the same
+  statement, so the self-rebind is safe);
+* If branches are walked on copies and the dead-set merged as the
+  UNION of paths dead on any branch exit (a read after the join is a
+  bug if either branch donated without rebinding);
+* loop bodies are walked twice, so donate-in-iteration-1 /
+  read-in-iteration-2 without a rebind is caught.
+
+Donating callables are collected from the whole file first: decorated
+defs, ``name = jax.jit(f, donate_argnums=…)`` assignments (including
+``self._attr = …``), and factory functions that RETURN a donating jit
+(one hop: ``fn = make_step(…)`` makes ``fn(…)`` donate with the
+factory's mask). Non-literal donate masks set ``partial_knowledge`` and
+the call site is skipped — unknown never findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import copy
+from typing import Dict, List, Optional, Set, Tuple
+
+from serverless_learn_tpu.analysis.engine import Finding, Project
+from serverless_learn_tpu.analysis.rules import jitutil
+
+RULE_ID = "SLT011"
+TITLE = "host reads of donated buffers"
+SCOPE = "file"
+
+
+def _path_of(node: ast.AST) -> Optional[str]:
+    """Dotted/subscript path for an lvalue-ish expression: ``state``,
+    ``self._state``, ``self._state["pages"]``. None when dynamic."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _path_of(node.value)
+        return f"{base}.{node.attr}" if base else None
+    if isinstance(node, ast.Subscript):
+        base = _path_of(node.value)
+        if base is None:
+            return None
+        sl = node.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value,
+                                                       (str, int)):
+            return f"{base}[{sl.value!r}]"
+        return None
+    return None
+
+
+class _DonorTable:
+    """name -> donate mask for everything in this file that donates."""
+
+    def __init__(self):
+        self.masks: Dict[str, Tuple[int, ...]] = {}
+
+    def add(self, name: Optional[str], info: jitutil.JitInfo):
+        if name and info.donate_argnums and not info.partial_knowledge:
+            self.masks[name] = info.donate_argnums
+
+    def mask_for_call(self, call: ast.Call) -> Optional[Tuple[int, ...]]:
+        path = _path_of(call.func)
+        if path is None:
+            return None
+        # exact name, or trailing attr (self._step / trainer.step)
+        if path in self.masks:
+            return self.masks[path]
+        tail = path.rsplit(".", 1)[-1]
+        return self.masks.get(tail)
+
+
+def _collect_donors(tree: ast.AST) -> _DonorTable:
+    table = _DonorTable()
+    factories: Dict[str, Tuple[int, ...]] = {}
+
+    for node in ast.walk(tree):
+        # @partial(jax.jit, donate_argnums=...) / @jax.jit(..., donate_...)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if jitutil.is_jit_call(dec):
+                    table.add(node.name, jitutil.jit_info(dec))
+            # factory: returns a name bound to a donating jit inside
+            inner = _DonorTable()
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Assign)
+                        and isinstance(sub.value, ast.Call)
+                        and jitutil.is_jit_call(sub.value)):
+                    info = jitutil.jit_info(sub.value)
+                    for tgt in sub.targets:
+                        inner.add(_path_of(tgt), info)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) and sub.value is not None:
+                    rp = _path_of(sub.value)
+                    if rp and rp in inner.masks:
+                        factories[node.name] = inner.masks[rp]
+        # name = jax.jit(f, donate_argnums=...)  (incl. self._attr = ...)
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and jitutil.is_jit_call(node.value)):
+            info = jitutil.jit_info(node.value)
+            for tgt in node.targets:
+                table.add(_path_of(tgt), info)
+
+    # one hop: fn = make_step(...) where make_step returns a donor
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            recv, attr = jitutil.call_parts(node.value.func)
+            if attr in factories:
+                for tgt in node.targets:
+                    path = _path_of(tgt)
+                    if path:
+                        table.masks[path] = factories[attr]
+    return table
+
+
+class _FlowWalker:
+    """Linear walk of one function body tracking dead (donated) paths."""
+
+    def __init__(self, donors: _DonorTable, fn_name: str):
+        self.donors = donors
+        self.fn_name = fn_name
+        # path -> (donation line, callee name)
+        self.dead: Dict[str, Tuple[int, str]] = {}
+        self.aliases: Dict[str, str] = {}  # alias -> canonical path
+        self.findings: List[tuple] = []
+
+    # -- helpers -----------------------------------------------------------
+
+    def _canon(self, path: str) -> str:
+        return self.aliases.get(path, path)
+
+    def _kill(self, path: str, line: int, callee: str):
+        self.dead[self._canon(path)] = (line, callee)
+
+    def _revive(self, path: str):
+        canon = self._canon(path)
+        for dead_path in list(self.dead):
+            if dead_path == canon or dead_path.startswith(canon + "[") \
+                    or dead_path.startswith(canon + "."):
+                del self.dead[dead_path]
+        # rebinding also breaks the alias link
+        self.aliases.pop(path, None)
+
+    def _check_load(self, node: ast.AST):
+        path = _path_of(node)
+        if path is None:
+            return
+        canon = self._canon(path)
+        hit = self.dead.get(canon)
+        if hit is None:
+            # a read of state.params is dead if state was donated
+            for dead_path, rec in self.dead.items():
+                if canon.startswith(dead_path + ".") \
+                        or canon.startswith(dead_path + "["):
+                    hit = rec
+                    break
+        if hit is not None:
+            don_line, callee = hit
+            self.findings.append((
+                node.lineno,
+                f"{path} read in {self.fn_name} after being donated to "
+                f"{callee}() at line {don_line}: on TPU the buffer is "
+                f"deleted and this raises 'Array has been deleted' "
+                f"(CPU runs silently mask it); rebind from the call's "
+                f"return value first"))
+            # report once per (path, donation site)
+            self.dead.pop(canon, None)
+
+    def _walk_expr(self, node: ast.AST, skip: Optional[Set[int]] = None):
+        """Check every Load in an expression, then process donations of
+        any donor call it contains."""
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if skip and id(sub) in skip:
+                continue
+            if isinstance(sub, (ast.Name, ast.Attribute, ast.Subscript)) \
+                    and isinstance(getattr(sub, "ctx", None), ast.Load):
+                # only check MAXIMAL paths: parent handled via startswith
+                self._check_load(sub)
+                if skip is None:
+                    skip = set()
+                for inner in ast.walk(sub):
+                    if inner is not sub:
+                        skip.add(id(inner))
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._apply_donation(sub)
+
+    def _apply_donation(self, call: ast.Call):
+        mask = self.donors.mask_for_call(call)
+        if not mask:
+            return
+        recv, attr = jitutil.call_parts(call.func)
+        callee = f"{recv}.{attr}" if recv else (attr or "<fn>")
+        for i in mask:
+            if i < len(call.args):
+                path = _path_of(call.args[i])
+                if path is not None:
+                    self._kill(path, call.lineno, callee)
+
+    # -- statements --------------------------------------------------------
+
+    def walk(self, stmts: List[ast.stmt]):
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, ast.Assign):
+            # value first (loads checked, donations applied), THEN
+            # targets revive — handles state, m = step(state, batch)
+            self._walk_expr(stmt.value)
+            for tgt in stmt.targets:
+                self._assign_target(tgt, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._walk_expr(stmt.value)
+                self._assign_target(stmt.target, stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            self._walk_expr(stmt.value)
+            self._check_load(stmt.target)
+        elif isinstance(stmt, ast.Expr):
+            self._walk_expr(stmt.value)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._walk_expr(stmt.value)
+        elif isinstance(stmt, ast.If):
+            self._walk_expr(stmt.test)
+            then = self._fork()
+            then.walk(stmt.body)
+            other = self._fork()
+            other.walk(stmt.orelse)
+            self._join(then, other)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._walk_expr(stmt.iter)
+            # two passes: catch donate-in-iter-1 / read-in-iter-2
+            self.walk(stmt.body)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self._walk_expr(stmt.test)
+            self.walk(stmt.body)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+        elif isinstance(stmt, ast.With) or isinstance(stmt,
+                                                      ast.AsyncWith):
+            for item in stmt.items:
+                self._walk_expr(item.context_expr)
+            self.walk(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self.walk(stmt.body)
+            for h in stmt.handlers:
+                self.walk(h.body)
+            self.walk(stmt.orelse)
+            self.walk(stmt.finalbody)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            pass  # nested scopes analyzed on their own
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                path = _path_of(tgt)
+                if path:
+                    self._revive(path)
+        else:
+            for sub in ast.iter_child_nodes(stmt):
+                if isinstance(sub, ast.expr):
+                    self._walk_expr(sub)
+
+    def _assign_target(self, tgt: ast.AST, value: ast.AST):
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._assign_target(elt, value)
+            return
+        path = _path_of(tgt)
+        if path is None:
+            return
+        self._revive(path)
+        # alias tracking: a = b makes a point at b's buffer
+        vpath = _path_of(value) if not isinstance(
+            value, (ast.Tuple, ast.List)) else None
+        if vpath is not None:
+            self.aliases[path] = self._canon(vpath)
+
+    # -- branch join -------------------------------------------------------
+
+    def _fork(self) -> "_FlowWalker":
+        w = _FlowWalker(self.donors, self.fn_name)
+        w.dead = dict(self.dead)
+        w.aliases = dict(self.aliases)
+        w.findings = self.findings  # shared: findings from any branch count
+        return w
+
+    def _join(self, a: "_FlowWalker", b: "_FlowWalker"):
+        # union: dead on either branch exit stays dead after the join
+        merged = dict(b.dead)
+        merged.update(a.dead)
+        self.dead = merged
+        self.aliases = {k: v for k, v in a.aliases.items()
+                        if b.aliases.get(k) == v}
+
+
+def run(proj: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in proj.files:
+        if sf.tree is None:
+            continue
+        donors = _collect_donors(sf.tree)
+        if not donors.masks:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            walker = _FlowWalker(donors, node.name)
+            walker.walk(node.body)
+            seen = set()
+            for line, msg in walker.findings:
+                if (line, msg) in seen:
+                    continue
+                seen.add((line, msg))
+                findings.append(Finding(RULE_ID, sf.path, line, msg))
+    return findings

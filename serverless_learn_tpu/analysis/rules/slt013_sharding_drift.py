@@ -1,0 +1,197 @@
+"""SLT013: PartitionSpec axes vs the declared mesh, and scan-body
+constraints (the PR 13 grad-accum rule, generalized).
+
+Sharding annotations fail open: ``P("ftp", None)`` with a typo'd axis
+raises only when a mesh actually binds — and on CPU parity runs the
+mesh is 1-wide everywhere, so ``with_sharding_constraint`` against a
+misspelled or since-renamed axis is a silent no-op that only detonates
+(or silently mis-lays-out) on real hardware. And PR 13's hard-won rule
+— ZeRO's reduce-scatter constraint must sit OUTSIDE the grad-accum
+``lax.scan``, once per step, not once per microbatch — was pinned by a
+single bespoke jaxpr audit in one test. This rule is the static half of
+that proof rail (``analysis/shardcheck.py`` is the runtime half the
+test now shares):
+
+* **undeclared axis** (error): any string axis inside a
+  ``P(...)``/``PartitionSpec(...)`` literal (including tuple entries
+  like ``("dp", "fsdp")``) that is not in the declared axis set —
+  ``MeshConfig.AXIS_NAMES`` from ``config.py`` plus any literal
+  ``Mesh(..., axis_names=…)`` in the project (SCOPE="project": the
+  declaration and the annotations live in different modules).
+* **compose_axis drift** (error): a literal ``axis`` argument to
+  ``compose_axis(...)`` outside the declared set — the composition
+  silently returns the spec unchanged (``mesh.shape.get(axis, 1)``),
+  i.e. the ZeRO sharding quietly never happens.
+* **constraint in scan body** (error): ``with_sharding_constraint``
+  lexically inside a function passed to ``jax.lax.scan`` — a collective
+  per microbatch instead of per step, the exact regression PR 13's
+  audit exists to prevent. Helper functions merely CALLED from a scan
+  body are out of static reach — that half lives in the runtime
+  harness.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from serverless_learn_tpu.analysis.engine import Finding, Project
+from serverless_learn_tpu.analysis.rules import jitutil
+
+RULE_ID = "SLT013"
+TITLE = "sharding-annotation drift"
+SCOPE = "project"
+
+
+# -- declared axes (project-wide) ----------------------------------------
+
+
+def _declared_axes(proj: Project) -> Set[str]:
+    axes: Set[str] = set()
+    for sf in proj.files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            # AXIS_NAMES = ("dp", "fsdp", ...) class/module constant
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) \
+                            and tgt.id == "AXIS_NAMES":
+                        got = jitutil._literal_str_tuple(node.value)
+                        if got:
+                            axes.update(got)
+            # Mesh(..., axis_names=("dp", ...)) literals
+            if isinstance(node, ast.Call):
+                recv, attr = jitutil.call_parts(node.func)
+                if attr == "Mesh":
+                    for kw in node.keywords:
+                        if kw.arg == "axis_names":
+                            got = jitutil._literal_str_tuple(kw.value)
+                            if got:
+                                axes.update(got)
+                    if len(node.args) >= 2:
+                        got = jitutil._literal_str_tuple(node.args[1])
+                        if got:
+                            axes.update(got)
+    return axes
+
+
+# -- P(...) spec literals ------------------------------------------------
+
+
+def _spec_axes(call: ast.Call) -> List[tuple]:
+    """(line, axis) for every string axis in a P(...) literal,
+    descending into tuple entries."""
+    out: List[tuple] = []
+
+    def collect(node: ast.AST):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.append((node.lineno, node.value))
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                collect(elt)
+
+    for arg in call.args:
+        collect(arg)
+    return out
+
+
+def _is_spec_call(node: ast.Call) -> bool:
+    recv, attr = jitutil.call_parts(node.func)
+    return attr in ("P", "PartitionSpec") or \
+        (recv is None and attr in ("P", "PartitionSpec"))
+
+
+def _check_spec_axes(sf, axes: Set[str], findings: List[Finding]):
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call) and _is_spec_call(node)):
+            continue
+        for line, axis in _spec_axes(node):
+            if axis not in axes:
+                findings.append(Finding(
+                    RULE_ID, sf.path, line,
+                    f"PartitionSpec names axis {axis!r} which is not a "
+                    f"declared mesh axis {sorted(axes)}: on a bound "
+                    f"mesh this raises, on the 1-wide CPU mesh it is a "
+                    f"silent no-op — fix the axis or declare it in "
+                    f"MeshConfig.AXIS_NAMES"))
+
+
+def _check_compose_axis(sf, axes: Set[str], findings: List[Finding]):
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        recv, attr = jitutil.call_parts(node.func)
+        if attr != "compose_axis":
+            continue
+        # compose_axis(spec, shape, mesh, axis) — axis is arg 3 or kw
+        axis_node = None
+        if len(node.args) >= 4:
+            axis_node = node.args[3]
+        for kw in node.keywords:
+            if kw.arg == "axis":
+                axis_node = kw.value
+        if isinstance(axis_node, ast.Constant) \
+                and isinstance(axis_node.value, str) \
+                and axis_node.value not in axes:
+            findings.append(Finding(
+                RULE_ID, sf.path, node.lineno,
+                f"compose_axis(..., axis={axis_node.value!r}) names an "
+                f"undeclared mesh axis: mesh.shape.get() returns 1 and "
+                f"the composition is a silent no-op — the ZeRO "
+                f"sharding never happens"))
+
+
+# -- constraints inside scan bodies --------------------------------------
+
+
+def _scan_body_names(tree: ast.AST) -> Set[str]:
+    """Names of functions passed as the body argument to lax.scan."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        recv, attr = jitutil.call_parts(node.func)
+        if attr != "scan" or (recv is not None
+                              and not recv.endswith("lax")):
+            continue
+        if node.args and isinstance(node.args[0], ast.Name):
+            out.add(node.args[0].id)
+    return out
+
+
+def _check_scan_constraints(sf, findings: List[Finding]):
+    scan_bodies = _scan_body_names(sf.tree)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            continue
+        is_scan_body = getattr(node, "name", None) in scan_bodies
+        if not is_scan_body:
+            continue
+        for sub in jitutil.body_walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            recv, attr = jitutil.call_parts(sub.func)
+            if attr == "with_sharding_constraint":
+                findings.append(Finding(
+                    RULE_ID, sf.path, sub.lineno,
+                    f"with_sharding_constraint inside scan body "
+                    f"{getattr(node, 'name', '<lambda>')}: this runs "
+                    f"a collective PER MICROBATCH, not per step — "
+                    f"hoist the constraint outside the scan (the PR 13 "
+                    f"grad-accum rule)"))
+
+
+def run(proj: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    axes = _declared_axes(proj)
+    if not axes:
+        return findings  # no declaration to check against: stay quiet
+    for sf in proj.files:
+        if sf.tree is None:
+            continue
+        _check_spec_axes(sf, axes, findings)
+        _check_compose_axis(sf, axes, findings)
+        _check_scan_constraints(sf, findings)
+    return findings

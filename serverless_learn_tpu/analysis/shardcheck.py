@@ -1,0 +1,120 @@
+"""Jaxpr-level sharding audits: SLT013's runtime harness.
+
+The static rule (``rules/slt013_sharding_drift.py``) catches what the
+AST shows — a ``with_sharding_constraint`` lexically inside a scan
+body, a typo'd axis in a ``P(...)`` literal. But the PR 13 grad-accum
+rule is a property of the TRACED program: a constraint applied by a
+helper three calls deep still lands inside the scan's jaxpr, and only
+the jaxpr knows. This module generalizes the bespoke audit that
+``test_grad_accum_eval`` carried since PR 13 into a reusable harness
+any sharding-sensitive test can point at a jitted function:
+
+    report = shardcheck.audit(trainer.step_fn, state, batch)
+    assert report.in_scan == []          # no per-microbatch collective
+    assert report.axes_used <= set(mesh.axis_names)
+
+Pure read-side: tracing via ``jax.make_jaxpr`` compiles nothing and
+runs nothing, so an audit is cheap enough to pin every sharding rule in
+the fast tier.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Set
+
+__all__ = ["collect_constraints", "audit", "ShardReport"]
+
+#: Primitives whose sub-jaxprs execute once per iteration: a sharding
+#: constraint inside any of these runs a collective per step of the
+#: loop, not per call of the jitted program.
+LOOP_PRIMITIVES = ("scan", "while", "fori_loop")
+
+
+def _iter_sub_jaxprs(eqn):
+    """Every sub-jaxpr hanging off one equation's params (scan/cond
+    bodies, pjit calls, custom_vjp branches — any params shape)."""
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (list, tuple)) else (v,)
+        for item in vs:
+            sub = getattr(item, "jaxpr", item if hasattr(item, "eqns")
+                          else None)
+            if sub is not None and hasattr(sub, "eqns"):
+                yield sub
+
+
+def collect_constraints(jaxpr, inside_loop: bool = False,
+                        acc: Dict[str, List[str]] = None
+                        ) -> Dict[str, List[str]]:
+    """All ``sharding_constraint`` specs in a jaxpr, split by whether
+    they sit inside a loop body, recursing through every sub-jaxpr.
+
+    The PR 13 audit, verbatim but loop-primitive-general: keys are
+    ``"in_scan"`` (any :data:`LOOP_PRIMITIVES` body) and
+    ``"outside"``; values are ``str(sharding)`` of each constraint."""
+    if acc is None:
+        acc = {"in_scan": [], "outside": []}
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "sharding_constraint":
+            acc["in_scan" if inside_loop else "outside"].append(
+                str(eqn.params.get("sharding")))
+        loops = inside_loop or eqn.primitive.name in LOOP_PRIMITIVES
+        for sub in _iter_sub_jaxprs(eqn):
+            collect_constraints(sub, loops, acc)
+    return acc
+
+
+def _axes_of_spec(spec_str: str) -> Set[str]:
+    """Axis names mentioned in one str(sharding): every quoted token
+    inside the PartitionSpec(...) rendering."""
+    import re
+
+    out: Set[str] = set()
+    for m in re.finditer(r"""['"]([A-Za-z_][A-Za-z0-9_]*)['"]""",
+                         spec_str):
+        out.add(m.group(1))
+    return out
+
+
+@dataclass
+class ShardReport:
+    """One audit of one traced program."""
+
+    in_scan: List[str] = field(default_factory=list)
+    outside: List[str] = field(default_factory=list)
+
+    @property
+    def axes_used(self) -> Set[str]:
+        axes: Set[str] = set()
+        for spec in self.in_scan + self.outside:
+            axes |= _axes_of_spec(spec)
+        return axes
+
+    def outside_with_axis(self, axis: str) -> List[str]:
+        """Constraints outside any loop whose spec names ``axis`` —
+        e.g. the once-per-step dp reduce-scatter specs."""
+        return [s for s in self.outside if axis in _axes_of_spec(s)]
+
+    def in_scan_with_axis(self, axis: str) -> List[str]:
+        return [s for s in self.in_scan if axis in _axes_of_spec(s)]
+
+    def assert_no_loop_constraints(self, axis: str = None):
+        hits = (self.in_scan_with_axis(axis) if axis is not None
+                else self.in_scan)
+        if hits:
+            what = f"{axis!r}-sharded " if axis else ""
+            raise AssertionError(
+                f"{what}sharding constraint(s) inside a loop body — one "
+                f"collective PER ITERATION, not per step (the PR 13 "
+                f"grad-accum regression): {hits}")
+
+
+def audit(fn, *args, **kwargs) -> ShardReport:
+    """Trace ``fn(*args, **kwargs)`` (no compile, no execute) and
+    return its :class:`ShardReport`."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+    cons = collect_constraints(jaxpr.jaxpr)
+    return ShardReport(in_scan=cons["in_scan"],
+                       outside=cons["outside"])

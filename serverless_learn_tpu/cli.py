@@ -1807,6 +1807,57 @@ def cmd_race(args) -> int:
     return 0 if not mon.races() else 2
 
 
+def cmd_jit(args) -> int:
+    """Offline compile-log replay (analysis/jitcheck.py): rebuild
+    budgets, freeze/thaw nesting and per-jit compile counts from a JSONL
+    log recorded under ``SLT_JITCHECK=1 SLT_JITCHECK_LOG=path`` and
+    re-derive the verdicts deterministically. Exit 0 = every compile
+    within budget, none frozen, no donated-buffer reuse; 2 =
+    violations. ``--self-check`` validates the verdict engine itself
+    against synthetic seeded logs (the CI step that proves the detector
+    detects). jax-free: a toolchain-less node can audit a log a TPU run
+    produced."""
+    from serverless_learn_tpu.analysis import jitcheck
+
+    if args.self_check:
+        failures = jitcheck.self_check()
+        if failures:
+            for f in failures:
+                print(f"self-check FAILED: {f}", file=sys.stderr)
+            return 2
+        print("slt jit --self-check: verdict engine OK (clean log "
+              "passes; budget/frozen/donation-reuse each convict)")
+        return 0
+    if not args.log:
+        print("usage: slt jit LOG (or --self-check)", file=sys.stderr)
+        return 2
+    try:
+        rep = jitcheck.replay_log(args.log)
+    except OSError as e:
+        raise SystemExit(f"cannot read {args.log}: {e}")
+    if args.json:
+        print(json.dumps({"log": args.log, "compiles": rep["compiles"],
+                          "sites": rep["sites"],
+                          "violations": rep["violations"],
+                          "ok": not rep["violations"]}, indent=2))
+    else:
+        print(f"slt jit: {rep['compiles']} compile(s) across "
+              f"{len(rep['sites'])} site(s), "
+              f"{len(rep['violations'])} violation(s) "
+              f"[{rep['events']} events]")
+        for site, n in sorted(rep["sites"].items()):
+            print(f"  {site}: {n} compile(s)")
+        for v in rep["violations"]:
+            print(f"  VIOLATION [{v['kind']}] {v.get('site', '?')}"
+                  + (f" (budget {v['budget']}, compiled {v['n']}x)"
+                     if v["kind"] == "budget" else "")
+                  + (f" in frozen window {v.get('label')!r}"
+                     if v["kind"] == "frozen" else ""))
+            for fr in v.get("stack", [])[-5:]:
+                print(f"    {fr}")
+    return 0 if not rep["violations"] else 2
+
+
 def cmd_chaos(args) -> int:
     """Deterministic chaos harness over the SWIM gossip membership
     (chaos/sim.py): `run` executes a FaultPlan (kills, restarts,
@@ -2758,8 +2809,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="project-aware static analysis: lock order, "
                              "metric drift, jit purity, thread lifecycle, "
                              "proto compat, config drift, guarded-by, "
-                             "resource lifecycle, atomicity "
-                             "(SLT001-SLT009)")
+                             "resource lifecycle, atomicity, dtype flow, "
+                             "donation safety, recompile hazards, "
+                             "sharding drift (SLT001-SLT013)")
     ck.add_argument("--rule", action="append", metavar="SLTxxx",
                     help="run only this rule (repeatable)")
     ck.add_argument("--changed-only", action="store_true",
@@ -2797,6 +2849,24 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also report races the racecheck ALLOWLIST "
                          "suppresses (with their justifications)")
     rc.set_defaults(fn=cmd_race)
+
+    jt = sub.add_parser("jit",
+                        help="replay a recorded SLT_JITCHECK_LOG compile "
+                             "log through the budget/frozen-window/"
+                             "donation verdict engine: deterministic "
+                             "offline triage of a recompile or donated-"
+                             "buffer reuse a CI run caught")
+    jt.add_argument("log", nargs="?", default=None,
+                    help="JSONL event log written by a run with "
+                         "SLT_JITCHECK=1 SLT_JITCHECK_LOG=path")
+    jt.add_argument("--self-check", action="store_true",
+                    help="validate the verdict engine against synthetic "
+                         "seeded logs (clean log passes; budget-exceed, "
+                         "frozen-compile and donation-reuse each "
+                         "convict) and exit")
+    jt.add_argument("--json", action="store_true",
+                    help="machine-readable verdict on stdout")
+    jt.set_defaults(fn=cmd_jit)
 
     ch = sub.add_parser("chaos",
                         help="fault-injection chaos harness: run a "

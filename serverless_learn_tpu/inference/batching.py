@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from serverless_learn_tpu.analysis import jitcheck
 from serverless_learn_tpu.inference.generate import generate, init_cache
 from serverless_learn_tpu.telemetry import (RATE_BUCKETS, SIZE_BUCKETS,
                                             Span, get_registry, goodput)
@@ -55,6 +56,7 @@ from serverless_learn_tpu.telemetry.tracing import node_name
 from serverless_learn_tpu.telemetry.waterfall import RequestWaterfall
 
 
+@jitcheck.bucket
 def _bucket(n: int, floor: int = 8) -> int:
     b = floor
     while b < n:

@@ -85,6 +85,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from serverless_learn_tpu.analysis import jitcheck
 from serverless_learn_tpu.config import KVCacheConfig, WaterfallConfig
 from serverless_learn_tpu.inference import kvcache
 from serverless_learn_tpu.inference.batching import PROMPT_BUCKETS, _bucket
@@ -99,6 +100,7 @@ from serverless_learn_tpu.telemetry.waterfall import (BoundaryEvents,
                                                       RequestWaterfall)
 
 
+@jitcheck.bucket
 def _wbucket(n: int) -> int:
     """Power-of-FOUR bucket for table-window widths: the window only
     changes attention span (cost is linear in it), so coarse buckets
@@ -108,6 +110,19 @@ def _wbucket(n: int) -> int:
     while b < n:
         b *= 4
     return b
+
+
+# Compile-budget contract (enforced under SLT_JITCHECK=1, see
+# analysis/jitcheck.py): every jit this engine creates is memoized per
+# shape bucket, so each jit OBJECT compiles exactly once — a second
+# compile means a key leaked past its cache (or a bucket function was
+# bypassed) and fails the session with the triggering stack.
+for _site in ("_build_chunk", "_admit_jit", "_paged_prefill_jit",
+              "_paged_chunk_jit"):
+    jitcheck.declare_budget(
+        f"serverless_learn_tpu/inference/continuous.py:{_site}",
+        max_compiles_per_jit=1)
+del _site
 
 
 def _fold_keys(seeds: jax.Array, positions: jax.Array) -> jax.Array:

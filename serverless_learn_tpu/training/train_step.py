@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from serverless_learn_tpu.analysis import jitcheck
 from serverless_learn_tpu.config import ExperimentConfig
 from serverless_learn_tpu.models.registry import ModelBundle, get_model
 from serverless_learn_tpu.parallel.mesh import batch_sharding, make_mesh, replicated
@@ -93,6 +94,17 @@ class Trainer:
         return jax.tree_util.tree_map(
             lambda x, s: jax.make_array_from_process_local_data(s, x),
             host_batch, self.batch_shardings)
+
+
+# Compile-budget contract (SLT_JITCHECK=1, analysis/jitcheck.py): the
+# three jits build_trainer creates — init, step, eval — each see ONE
+# abstract signature per trainer (the loop feeds fixed-shape sharded
+# batches), so each jit object compiles exactly once. A second compile
+# on the same object is shape drift in the hot loop and fails the
+# session with the stack that caused it.
+jitcheck.declare_budget(
+    "serverless_learn_tpu/training/train_step.py:build_trainer",
+    max_compiles_per_jit=1)
 
 
 def build_trainer(

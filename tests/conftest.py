@@ -55,8 +55,33 @@ if _RACECHECK:
 
     _racecheck.install()
 
+# -- runtime compile monitoring (SLT_JITCHECK=1) -------------------------------
+#
+# The dynamic half of SLT010-SLT013 (analysis/jitcheck.py): wrap every
+# jax.jit the package creates, record real compilations (site, abstract
+# shapes, donation mask, elapsed), enforce the per-site compile budgets
+# declared next to the bucket functions, and detect donated-buffer reuse
+# logically (the round-15 "Array has been deleted" class — caught on CPU
+# where donation is otherwise a silent no-op). Installed HERE, before
+# any `@jax.jit` decorator binds at package import. Budget/frozen/reuse
+# violations fail the session below (exit 5; lockcheck=3, racecheck=4).
+
+_JITCHECK = os.environ.get("SLT_JITCHECK", "") == "1"
+if _JITCHECK:
+    from serverless_learn_tpu.analysis import jitcheck as _jitcheck
+
+    _jitcheck.install()
+
 
 def pytest_sessionfinish(session, exitstatus):
+    if _JITCHECK:
+        jmon = _jitcheck.monitor()
+        print(f"\n{jmon.report()}")
+        jmon.close_log()
+        if jmon.violations():
+            pytest.exit("jitcheck: compile-budget/frozen-window/"
+                        "donation violations observed (see report "
+                        "above)", returncode=5)
     if _RACECHECK:
         rmon = _racecheck.monitor()
         print(f"\n{rmon.report()}")
@@ -255,6 +280,13 @@ SLOW_TESTS = {
     # trainer compiles; the adamw variant and the mlp parity/layout/
     # checkpoint/elastic tests stay in the fast tier)
     "tests/test_optimizers.py::test_zero1_update_matches_replicated[adafactor]",
+    # round 25 (jitcheck: the engine+trainer acceptance run pays real
+    # compiles, and the no-baseline HEAD scan duplicates the full-repo
+    # walk test_analysis already pays once; the rule fixtures, monitor
+    # units and subprocess session-failure tests stay fast)
+    "tests/test_jitcheck.py::"
+    "test_warmed_engine_and_train_loop_have_no_unexpected_compiles",
+    "tests/test_jitcheck.py::test_repo_at_head_is_clean_for_new_rules",
 }
 
 

@@ -11,6 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from serverless_learn_tpu.analysis import shardcheck
 from serverless_learn_tpu.config import (
     DataConfig, ExperimentConfig, MeshConfig, OptimizerConfig, TrainConfig)
 from serverless_learn_tpu.data.datasets import SyntheticSource
@@ -188,29 +189,15 @@ def test_zero2_grad_accum_matches_whole_batch(devices):
                                    rtol=1e-5, atol=1e-6)
 
 
-def _collect_constraints(jaxpr, inside_scan=False, acc=None):
-    """All sharding_constraint specs in a jaxpr, split by whether they
-    sit inside a scan body (recursing through every sub-jaxpr)."""
-    if acc is None:
-        acc = {"in_scan": [], "outside": []}
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == "sharding_constraint":
-            acc["in_scan" if inside_scan else "outside"].append(
-                str(eqn.params.get("sharding")))
-        for v in eqn.params.values():
-            sub = getattr(v, "jaxpr", v if hasattr(v, "eqns") else None)
-            if sub is not None and hasattr(sub, "eqns"):
-                _collect_constraints(
-                    sub, inside_scan or eqn.primitive.name == "scan", acc)
-    return acc
-
-
 def test_zero_reduce_scatter_once_per_step_not_per_microbatch(devices):
     """The regression audit ISSUE 13 asks for: under ZeRO-2 + grad_accum
     the microbatch scan must accumulate LOCALLY — the dp-sharding
     constraint that becomes the reduce-scatter is applied exactly once,
     after the scan, never inside its body (a constraint in the body
-    would force one cross-replica collective per microbatch)."""
+    would force one cross-replica collective per microbatch). Since
+    round 25 the jaxpr walk lives in ``analysis/shardcheck.py``
+    (SLT013's runtime harness) so every sharding-sensitive test shares
+    one audit."""
     cfg = _cfg(model_overrides={"dtype": jnp.float32}).override(
         train=TrainConfig(batch_size=32, num_steps=1, grad_accum=4,
                           zero_stage=2))
@@ -218,14 +205,17 @@ def test_zero_reduce_scatter_once_per_step_not_per_microbatch(devices):
     state = trainer.init()
     src = SyntheticSource(trainer.bundle.make_batch, cfg.data, 32, seed=7)
     batch = trainer.shard_batch(next(iter(src)))
-    jaxpr = jax.make_jaxpr(trainer.step_fn)(state, batch)
-    cons = _collect_constraints(jaxpr.jaxpr)
-    assert cons["in_scan"] == [], \
-        f"dp collective forced inside the accum scan: {cons['in_scan']}"
+    report = shardcheck.audit(trainer.step_fn, state, batch)
+    report.assert_no_loop_constraints()
     # The grads/updates constraints exist and sit outside the scan: at
     # least the microbatch input constraints plus dp-sharded grad specs
     # whose leading entry IS the dp axis (the batch constraints shard
     # dim 0 over the scan axis — spec starts with None).
-    dp_grads = [s for s in cons["outside"]
+    dp_grads = [s for s in report.outside
                 if "PartitionSpec('dp'" in s or 'PartitionSpec("dp"' in s]
-    assert len(dp_grads) >= 2, cons["outside"]
+    assert len(dp_grads) >= 2, report.outside
+    # And every axis the traced program mentions is a declared one —
+    # the runtime face of SLT013's axis-drift check.
+    from serverless_learn_tpu.config import MeshConfig
+    assert report.axes_used <= set(MeshConfig.AXIS_NAMES), \
+        report.axes_used
